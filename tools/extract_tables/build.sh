@@ -1,0 +1,36 @@
+#!/bin/bash
+# Build and run the CLD2 table extractor against the read-only reference
+# snapshot, producing raw blobs in tools/extract_tables/out/.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+REF=/root/reference/cld2
+OUT=out
+BUILD=build
+mkdir -p "$OUT" "$BUILD"
+
+CXXFLAGS="-O2 -w -I$REF/internal -I$REF/public"
+
+g++ $CXXFLAGS -c extract_main.cc -o $BUILD/extract_main.o
+g++ $CXXFLAGS -c prop_dump.cc -o $BUILD/prop_dump.o
+
+# Reference translation units: generated DATA tables + the state-table
+# interpreter needed to run the property DFAs at extraction time.
+for src in \
+  cld2_generated_deltaocta0527 \
+  cld2_generated_distinctocta0527 \
+  cld_generated_cjk_delta_bi_32 \
+  generated_distinct_bi_0 \
+  cld2_generated_cjk_compatible \
+  cld_generated_cjk_uni_prop_80 \
+  cld_generated_score_quad_octa_1024_256 \
+  generated_language \
+  generated_ulscript \
+  utf8statetable \
+  offsetmap \
+  ; do
+  g++ $CXXFLAGS -c "$REF/internal/$src.cc" -o "$BUILD/$src.o"
+done
+
+g++ $BUILD/*.o -o $BUILD/extract_cld2_tables
+./$BUILD/extract_cld2_tables "$OUT"

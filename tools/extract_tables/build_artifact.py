@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Assemble the language_detector_tpu table artifact from extracted blobs.
+
+Reads the raw .bin/.txt blobs produced by build.sh (extract_main.cc) plus the
+closest-alt-language data table (parsed from the reference's source text,
+compact_lang_det_impl.cc:259-427 — a data table of enum names), and writes a
+single compressed .npz artifact that is the framework's model-weight file.
+
+Run: python3 build_artifact.py [--out ../../language_detector_tpu/data/cld2_tables.npz]
+"""
+import argparse
+import re
+from pathlib import Path
+
+import numpy as np
+
+HERE = Path(__file__).resolve().parent
+OUT_DIR = HERE / "out"
+REF_IMPL = Path("/root/reference/cld2/internal/compact_lang_det_impl.cc")
+
+DTYPES = {"uint8": np.uint8, "uint16": np.uint16, "uint32": np.uint32,
+          "int16": np.int16, "int32": np.int32}
+
+
+def load_blobs():
+    arrays = {}
+    strings = {}
+    for line in (OUT_DIR / "manifest.txt").read_text().splitlines():
+        name, dtype, n = line.split()
+        if dtype == "str":
+            txt = (OUT_DIR / f"{name}.txt").read_text()
+            vals = txt.split("\n")
+            if vals and vals[-1] == "":
+                vals.pop()
+            strings[name] = vals
+        else:
+            raw = (OUT_DIR / f"{name}.bin").read_bytes()
+            arrays[name] = np.frombuffer(raw, dtype=DTYPES[dtype]).copy()
+            assert arrays[name].size == int(n), name
+    return arrays, strings
+
+
+def parse_closest_alt(cnames):
+    """Parse the kClosestAltLanguage data table out of the reference source."""
+    src = REF_IMPL.read_text()
+    m = re.search(r"kClosestAltLanguage\[\] = \{(.*?)\};", src, re.S)
+    body = m.group(1)
+    min_corr = int(re.search(r"kMinCorrPercent = (\d+)", src).group(1))
+    unknown = cnames.index("UNKNOWN_LANGUAGE")  # 26
+    cname_to_id = {c: i for i, c in enumerate(cnames)}
+    ids = []
+    # Entries look like: (28 >= kMinCorrPercent) ? SCOTS : UNKNOWN_LANGUAGE,
+    for pct, alt in re.findall(
+            r"\(\s*(\d+) >= kMinCorrPercent\) \? (\w+) : UNKNOWN_LANGUAGE",
+            body):
+        ids.append(cname_to_id.get(alt, unknown)
+                   if int(pct) >= min_corr else unknown)
+    return np.array(ids, dtype=np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(HERE.parent.parent /
+                    "language_detector_tpu/data/cld2_tables.npz"))
+    args = ap.parse_args()
+
+    arrays, strings = load_blobs()
+    out = {}
+
+    for t in ["deltaocta", "distinctocta", "cjkdeltabi", "distinctbi",
+              "cjkcompat"]:
+        meta = arrays[f"{t}_meta"]
+        size = int(meta[1])
+        out[f"{t}_buckets"] = arrays[f"{t}_buckets"].reshape(size, 4)
+        out[f"{t}_ind"] = arrays[f"{t}_ind"]
+        out[f"{t}_meta"] = meta
+        out[f"{t}_langscripts"] = np.array(strings[f"{t}_langscripts"][0])
+
+    out["avg_delta_octa_score"] = arrays["avg_delta_octa_score"].reshape(614, 4)
+    out["lg_prob_v2"] = arrays["lg_prob_v2_tbl"].reshape(240, 8)
+    out["lang_scripts"] = arrays["lang_scripts"].reshape(614, 4)
+    for k in ["lang_to_plang", "plang_to_lang_latn", "plang_to_lang_othr",
+              "plang_close_set_latn", "plang_close_set_othr",
+              "ulscript_rtype", "ulscript_default_lang",
+              "cjk_uni_prop", "script_of_cp"]:
+        out[k] = arrays[k]
+    out["lower_pairs"] = arrays["lower_pairs"].reshape(-1, 2)
+
+    for k in ["lang_name", "lang_code", "lang_cname", "ulscript_name",
+              "ulscript_code"]:
+        out[k] = np.array(strings[k])
+
+    out["closest_alt_lang"] = parse_closest_alt(strings["lang_cname"])
+
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(out_path, **out)
+    print(f"wrote {out_path} ({out_path.stat().st_size/1e6:.2f} MB, "
+          f"{len(out)} arrays)")
+
+
+if __name__ == "__main__":
+    main()

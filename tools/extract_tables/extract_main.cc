@@ -1,0 +1,251 @@
+// Extract CLD2 scoring tables (model weights) from the reference snapshot
+// into flat binary blobs + a text manifest, for conversion into the
+// language_detector_tpu table artifact.
+//
+// This tool compiles AGAINST the read-only reference at /root/reference
+// (generated data tables + the UTF-8 state-table interpreter). It extracts
+// DATA ONLY — the runtime framework re-implements all algorithms TPU-first.
+//
+// Reference data contracts:
+//   cld2tablesummary.h:37-49  (CLD2TableSummary: buckets/indirect/keymask)
+//   generated_language.cc     (language registry arrays)
+//   generated_ulscript.cc     (script registry arrays)
+//   cld_generated_cjk_uni_prop_80.cc (CJK unigram UTF8PropObj DFA)
+//
+// Usage: extract_main <output_dir>
+
+#include <stdio.h>
+#include <string.h>
+#include <stdlib.h>
+#include <string>
+#include <vector>
+
+#include "integral_types.h"
+#include "cld2tablesummary.h"
+#include "utf8statetable.h"
+#include "generated_language.h"
+#include "generated_ulscript.h"
+#include "cldutil_shared.h"   // kLgProbV2Tbl quantized-prob decode table
+
+namespace CLD2 {
+// Table objects defined in the generated .cc files we compile alongside.
+extern const CLD2TableSummary kDeltaOcta_obj;       // deltaocta0527
+extern const CLD2TableSummary kDistinctOcta_obj;    // distinctocta0527
+extern const CLD2TableSummary kCjkDeltaBi_obj;      // cjk_delta_bi_32
+extern const CLD2TableSummary kDistinctBiTable_obj; // distinct_bi_0 (dummy)
+extern const CLD2TableSummary kCjkCompat_obj;       // cjk_compatible
+extern const UTF8PropObj cld_generated_CjkUni_obj;  // cjk_uni_prop_80
+extern const short kAvgDeltaOctaScore[];            // score_quad_octa_1024_256
+extern const uint32 kCompatTableIndSize;            // cjkcompat ind length
+
+// Registry arrays from generated_language.cc / generated_ulscript.cc
+extern const int kLanguageToNameSize;
+extern const char* const kLanguageToName[];
+extern const int kLanguageToCodeSize;
+extern const char* const kLanguageToCode[];
+extern const int kLanguageToCNameSize;
+extern const char* const kLanguageToCName[];
+extern const int kLanguageToScriptsSize;
+extern const FourScripts kLanguageToScripts[];
+extern const int kLanguageToPLangSize;
+extern const uint8 kLanguageToPLang[];
+extern const uint16 kPLangToLanguageLatn[];
+extern const uint16 kPLangToLanguageOthr[];
+extern const uint8 kPLangToCloseSetLatn[];
+extern const uint8 kPLangToCloseSetOthr[];
+extern const int kULScriptToNameSize;
+extern const char* const kULScriptToName[];
+extern const int kULScriptToCodeSize;
+extern const char* const kULScriptToCode[];
+extern const int kULScriptToRtypeSize;
+extern const ULScriptRType kULScriptToRtype[];
+extern const int kULScriptToDefaultLangSize;
+extern const Language kULScriptToDefaultLang[];
+}  // namespace CLD2
+
+// From prop_dump.cc (separate TU: macro-heavy DFA headers clash otherwise)
+int ScriptNumOfCodepoint(int cp);
+int LowercaseCodepoint(int cp, unsigned char* out_utf8, int* out_len);
+
+using namespace CLD2;
+
+static FILE* g_manifest = nullptr;
+static std::string g_outdir;
+
+static void WriteBlob(const char* name, const void* data, size_t bytes,
+                      const char* dtype, size_t n_elems) {
+  std::string path = g_outdir + "/" + name + ".bin";
+  FILE* f = fopen(path.c_str(), "wb");
+  if (!f) { perror(path.c_str()); exit(1); }
+  if (bytes > 0 && fwrite(data, 1, bytes, f) != bytes) {
+    perror("fwrite"); exit(1);
+  }
+  fclose(f);
+  fprintf(g_manifest, "%s %s %zu\n", name, dtype, n_elems);
+}
+
+static void WriteStrings(const char* name, const char* const* arr, int n) {
+  std::string path = g_outdir + "/" + name + ".txt";
+  FILE* f = fopen(path.c_str(), "wb");
+  if (!f) { perror(path.c_str()); exit(1); }
+  for (int i = 0; i < n; ++i) fprintf(f, "%s\n", arr[i]);
+  fclose(f);
+  fprintf(g_manifest, "%s str %d\n", name, n);
+}
+
+static void WriteOneString(const char* name, const char* s) {
+  std::string path = g_outdir + "/" + name + ".txt";
+  FILE* f = fopen(path.c_str(), "wb");
+  if (!f) { perror(path.c_str()); exit(1); }
+  fprintf(f, "%s\n", s);
+  fclose(f);
+  fprintf(g_manifest, "%s str 1\n", name);
+}
+
+static void DumpSummary(const char* name, const CLD2TableSummary& t,
+                        uint32 ind_len_override = 0) {
+  char buf[128];
+  snprintf(buf, sizeof(buf), "%s_buckets", name);
+  WriteBlob(buf, t.kCLDTable, sizeof(uint32) * 4 * t.kCLDTableSize,
+            "uint32", 4 * t.kCLDTableSize);
+  // Indirect array length: entries < SizeOne are single langprobs; entries at
+  // SizeOne.. are pairs located at SizeOne + 2*(i - SizeOne). Scan buckets for
+  // the max indirect subscript to size the array (reference sizes are static).
+  uint32 max_ind = 0;
+  uint32 not_keymask = ~t.kCLDTableKeyMask;
+  for (uint32 b = 0; b < t.kCLDTableSize; ++b) {
+    for (int k = 0; k < 4; ++k) {
+      uint32 ind = t.kCLDTable[b].keyvalue[k] & not_keymask;
+      if (ind > max_ind) max_ind = ind;
+    }
+  }
+  uint32 ind_len;
+  if (ind_len_override > 0) {
+    ind_len = ind_len_override;
+  } else if (max_ind < t.kCLDTableSizeOne) {
+    ind_len = t.kCLDTableSizeOne;  // all singles
+  } else {
+    ind_len = max_ind + (max_ind - t.kCLDTableSizeOne) + 2;
+  }
+  snprintf(buf, sizeof(buf), "%s_ind", name);
+  WriteBlob(buf, t.kCLDTableInd, sizeof(uint32) * ind_len, "uint32", ind_len);
+  snprintf(buf, sizeof(buf), "%s_meta", name);
+  uint32 meta[4] = {t.kCLDTableSizeOne, t.kCLDTableSize, t.kCLDTableKeyMask,
+                    t.kCLDTableBuildDate};
+  WriteBlob(buf, meta, sizeof(meta), "uint32", 4);
+  snprintf(buf, sizeof(buf), "%s_langscripts", name);
+  WriteOneString(buf, t.kRecognizedLangScripts);
+}
+
+// Run the CJK unigram property DFA over every codepoint -> flat uint16 array.
+static void DumpCjkUniProp() {
+  const int kMaxCp = 0x110000;
+  std::vector<uint8> prop(kMaxCp, 0);
+  for (int cp = 0; cp < kMaxCp; ++cp) {
+    if (cp >= 0xD800 && cp < 0xE000) continue;  // surrogates
+    unsigned char buf[8];
+    int len;
+    if (cp < 0x80) { buf[0] = cp; len = 1; }
+    else if (cp < 0x800) {
+      buf[0] = 0xC0 | (cp >> 6); buf[1] = 0x80 | (cp & 0x3F); len = 2;
+    } else if (cp < 0x10000) {
+      buf[0] = 0xE0 | (cp >> 12); buf[1] = 0x80 | ((cp >> 6) & 0x3F);
+      buf[2] = 0x80 | (cp & 0x3F); len = 3;
+    } else {
+      buf[0] = 0xF0 | (cp >> 18); buf[1] = 0x80 | ((cp >> 12) & 0x3F);
+      buf[2] = 0x80 | ((cp >> 6) & 0x3F); buf[3] = 0x80 | (cp & 0x3F); len = 4;
+    }
+    const uint8* src = buf;
+    int srclen = len;
+    int v = UTF8GenericPropertyBigOneByte(&cld_generated_CjkUni_obj,
+                                          &src, &srclen);
+    prop[cp] = static_cast<uint8>(v);
+  }
+  WriteBlob("cjk_uni_prop", prop.data(), prop.size(), "uint8", prop.size());
+}
+
+// Script number per codepoint (letters/marks -> ULScript, else 0) and
+// CLD2 lowercase mapping, via prop_dump.cc helpers.
+static void DumpScriptAndLower() {
+  const int kMaxCp = 0x110000;
+  std::vector<uint8> script(kMaxCp, 0);
+  std::string lower_pairs;  // stream of uint32 cp, uint32 lowered_cp
+  for (int cp = 0; cp < kMaxCp; ++cp) {
+    if (cp >= 0xD800 && cp < 0xE000) continue;
+    int s = ScriptNumOfCodepoint(cp);
+    script[cp] = static_cast<uint8>(s < 0 ? 0 : (s & 0xFF));
+    unsigned char out[16];
+    int outlen = 0;
+    int lowered = LowercaseCodepoint(cp, out, &outlen);
+    if (lowered >= 0 && lowered != cp) {
+      uint32 rec[2] = {static_cast<uint32>(cp), static_cast<uint32>(lowered)};
+      lower_pairs.append(reinterpret_cast<const char*>(rec), 8);
+    }
+  }
+  WriteBlob("script_of_cp", script.data(), script.size(), "uint8",
+            script.size());
+  WriteBlob("lower_pairs", lower_pairs.data(), lower_pairs.size(), "uint32",
+            lower_pairs.size() / 4);
+}
+
+int main(int argc, char** argv) {
+  if (argc != 2) { fprintf(stderr, "usage: %s outdir\n", argv[0]); return 1; }
+  g_outdir = argv[1];
+  std::string mpath = g_outdir + "/manifest.txt";
+  g_manifest = fopen(mpath.c_str(), "wb");
+  if (!g_manifest) { perror(mpath.c_str()); return 1; }
+
+  DumpSummary("deltaocta", kDeltaOcta_obj);
+  DumpSummary("distinctocta", kDistinctOcta_obj);
+  DumpSummary("cjkdeltabi", kCjkDeltaBi_obj);
+  DumpSummary("distinctbi", kDistinctBiTable_obj);
+  // CjkCompat's indirect array is indexed by the unigram property class
+  // (not by bucket probe), so size it from the table's own extern.
+  DumpSummary("cjkcompat", kCjkCompat_obj, kCompatTableIndSize);
+
+  WriteBlob("avg_delta_octa_score", kAvgDeltaOctaScore, sizeof(short) * 614 * 4,
+            "int16", 614 * 4);
+  WriteBlob("lg_prob_v2_tbl", kLgProbV2Tbl, kLgProbV2TblSize * 8, "uint8",
+            kLgProbV2TblSize * 8);
+
+  WriteStrings("lang_name", kLanguageToName, kLanguageToNameSize);
+  WriteStrings("lang_code", kLanguageToCode, kLanguageToCodeSize);
+  WriteStrings("lang_cname", kLanguageToCName, kLanguageToCNameSize);
+  {
+    // FourScripts = 4 ULScript entries per language
+    std::vector<int32_t> ls(kLanguageToScriptsSize * 4);
+    for (int i = 0; i < kLanguageToScriptsSize; ++i)
+      for (int j = 0; j < 4; ++j)
+        ls[i * 4 + j] = static_cast<int32_t>(kLanguageToScripts[i][j]);
+    WriteBlob("lang_scripts", ls.data(), ls.size() * 4, "int32", ls.size());
+  }
+  WriteBlob("lang_to_plang", kLanguageToPLang, kLanguageToPLangSize, "uint8",
+            kLanguageToPLangSize);
+  WriteBlob("plang_to_lang_latn", kPLangToLanguageLatn, 256 * 2, "uint16", 256);
+  WriteBlob("plang_to_lang_othr", kPLangToLanguageOthr, 256 * 2, "uint16", 256);
+  WriteBlob("plang_close_set_latn", kPLangToCloseSetLatn, 256, "uint8", 256);
+  WriteBlob("plang_close_set_othr", kPLangToCloseSetOthr, 256, "uint8", 256);
+
+  WriteStrings("ulscript_name", kULScriptToName, kULScriptToNameSize);
+  WriteStrings("ulscript_code", kULScriptToCode, kULScriptToCodeSize);
+  {
+    std::vector<int32_t> rt(kULScriptToRtypeSize);
+    for (int i = 0; i < kULScriptToRtypeSize; ++i)
+      rt[i] = static_cast<int32_t>(kULScriptToRtype[i]);
+    WriteBlob("ulscript_rtype", rt.data(), rt.size() * 4, "int32", rt.size());
+  }
+  {
+    std::vector<int32_t> dl(kULScriptToDefaultLangSize);
+    for (int i = 0; i < kULScriptToDefaultLangSize; ++i)
+      dl[i] = static_cast<int32_t>(kULScriptToDefaultLang[i]);
+    WriteBlob("ulscript_default_lang", dl.data(), dl.size() * 4, "int32",
+              dl.size());
+  }
+
+  DumpCjkUniProp();
+  DumpScriptAndLower();
+
+  fclose(g_manifest);
+  fprintf(stderr, "extracted tables to %s\n", g_outdir.c_str());
+  return 0;
+}

@@ -1,0 +1,82 @@
+// Separate translation unit for the macro-heavy UTF-8 DFA data headers
+// (utf8prop_lettermarkscriptnum.h and utf8repl_lettermarklower.h both
+// re-#define S1_/T1_/etc., so they cannot share a TU).
+//
+// Exposes per-codepoint script-number and lowercase queries by running the
+// reference's state-table interpreter (utf8statetable.cc, linked in) over
+// single-character inputs. Extraction-time only; the runtime framework uses
+// the resulting flat arrays.
+
+#include <string.h>
+
+#include "integral_types.h"
+#include "utf8statetable.h"
+#include "stringpiece.h"
+
+#include "utf8prop_lettermarkscriptnum.h"
+
+// The repl header's macros collide with the prop header's; isolate via a
+// second nested include in a disjoint macro environment.
+#undef S1_
+#undef S2_
+#undef S3_
+#undef S21
+#undef S31
+#undef S32
+#undef T1_
+#undef T2_
+#undef S11
+#undef SL_
+
+#include "utf8repl_lettermarklower.h"
+
+static int EncodeUtf8(int cp, unsigned char* buf) {
+  if (cp < 0x80) { buf[0] = cp; return 1; }
+  if (cp < 0x800) {
+    buf[0] = 0xC0 | (cp >> 6); buf[1] = 0x80 | (cp & 0x3F); return 2;
+  }
+  if (cp < 0x10000) {
+    buf[0] = 0xE0 | (cp >> 12); buf[1] = 0x80 | ((cp >> 6) & 0x3F);
+    buf[2] = 0x80 | (cp & 0x3F); return 3;
+  }
+  buf[0] = 0xF0 | (cp >> 18); buf[1] = 0x80 | ((cp >> 12) & 0x3F);
+  buf[2] = 0x80 | ((cp >> 6) & 0x3F); buf[3] = 0x80 | (cp & 0x3F); return 4;
+}
+
+static int DecodeUtf8(const unsigned char* buf, int len) {
+  if (len <= 0) return -1;
+  unsigned char b0 = buf[0];
+  if (b0 < 0x80) return b0;
+  if (b0 < 0xE0) return ((b0 & 0x1F) << 6) | (buf[1] & 0x3F);
+  if (b0 < 0xF0)
+    return ((b0 & 0x0F) << 12) | ((buf[1] & 0x3F) << 6) | (buf[2] & 0x3F);
+  return ((b0 & 0x07) << 18) | ((buf[1] & 0x3F) << 12) |
+         ((buf[2] & 0x3F) << 6) | (buf[3] & 0x3F);
+}
+
+// ULScript number of a letter/mark codepoint, 0 otherwise.
+int ScriptNumOfCodepoint(int cp) {
+  unsigned char buf[8];
+  int len = EncodeUtf8(cp, buf);
+  const CLD2::uint8* src = buf;
+  int srclen = len;
+  return CLD2::UTF8GenericPropertyTwoByte(
+      &CLD2::utf8prop_lettermarkscriptnum_obj, &src, &srclen);
+}
+
+// CLD2 lowercase of a codepoint (identity if unmapped). Returns the lowered
+// codepoint, or -1 if the mapping is not 1 char -> 1 char.
+int LowercaseCodepoint(int cp, unsigned char* out_utf8, int* out_len) {
+  unsigned char inbuf[8];
+  int inlen = EncodeUtf8(cp, inbuf);
+  char outbuf[32];
+  StringPiece istr(reinterpret_cast<const char*>(inbuf), inlen);
+  StringPiece ostr(outbuf, sizeof(outbuf));
+  int bytes_consumed = 0, bytes_filled = 0, chars_changed = 0;
+  CLD2::UTF8GenericReplace(&CLD2::utf8repl_lettermarklower_obj, istr, ostr,
+                           &bytes_consumed, &bytes_filled, &chars_changed);
+  if (bytes_filled <= 0 || bytes_filled > 4) return -1;
+  memcpy(out_utf8, outbuf, bytes_filled);
+  *out_len = bytes_filled;
+  return DecodeUtf8(reinterpret_cast<unsigned char*>(outbuf), bytes_filled);
+}
