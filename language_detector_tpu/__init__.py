@@ -1,0 +1,27 @@
+"""language_detector_tpu — a TPU-native language-identification framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+GolosChain/language-detector service (Go HTTP shell over the CLD2 C++
+n-gram engine; see /root/reference). The host side segments UTF-8 text
+into per-script spans and computes n-gram fingerprints; the device side
+scores batches of documents with vectorized hash-table gathers and
+segmented reductions over a `jax.sharding.Mesh`.
+
+Public API:
+    detect(text)                 -> DetectionResult (top-3 + reliability)
+    detect_batch(texts)          -> list[DetectionResult]
+    LanguageDetector             -> configurable detector object
+    load_tables() / ScoringTables
+"""
+
+from .registry import (  # noqa: F401
+    Registry,
+    registry,
+    UNKNOWN_LANGUAGE,
+    TG_UNKNOWN_LANGUAGE,
+    ENGLISH,
+)
+from .tables import ScoringTables, load_tables  # noqa: F401
+from .detector import LanguageDetector, DetectionResult, detect, detect_batch  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,86 @@
+"""Public detection API.
+
+`LanguageDetector` wraps the engines: the scalar host engine (reference
+semantics, used for validation and as fallback for rare recursion paths) and
+the batched TPU engine (models/ngram.py) for throughput. Mirrors the service
+surface of the reference wrapper (wrapper.cc:7-16 detect_language) and the
+richer ExtDetectLanguageSummary (compact_lang_det.h:168-426).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .engine_scalar import ScalarResult, detect_scalar
+from .registry import Registry, UNKNOWN_LANGUAGE, registry as default_registry
+from .tables import ScoringTables, load_tables
+
+
+@dataclasses.dataclass
+class DetectionResult:
+    """Top-3 detection result (compact_lang_det.h:147-165 contract)."""
+
+    language: str             # ISO code of summary language ("un" if unknown)
+    language_id: int
+    is_reliable: bool
+    top3: list                # [(code, percent, normalized_score)] * 3
+    text_bytes: int
+
+    @classmethod
+    def from_scalar(cls, r: ScalarResult, reg: Registry) -> "DetectionResult":
+        return cls(
+            language=reg.code(r.summary_lang),
+            language_id=r.summary_lang,
+            is_reliable=r.is_reliable,
+            top3=[(reg.code(l), p, s) for l, p, s in
+                  zip(r.language3, r.percent3, r.normalized_score3)],
+            text_bytes=r.text_bytes,
+        )
+
+
+class LanguageDetector:
+    """Configurable detector over a table artifact."""
+
+    def __init__(self, tables: ScoringTables | None = None,
+                 reg: Registry | None = None, flags: int = 0):
+        self.tables = tables or load_tables()
+        self.registry = reg or default_registry
+        self.flags = flags
+        self._batch_engine = None  # lazily built JAX engine; False = absent
+
+    def detect(self, text: str) -> DetectionResult:
+        r = detect_scalar(text, self.tables, self.registry, self.flags)
+        return DetectionResult.from_scalar(r, self.registry)
+
+    def detect_batch(self, texts: list[str]) -> list[DetectionResult]:
+        engine = self._get_batch_engine()
+        if not engine:
+            return [self.detect(t) for t in texts]
+        return engine.detect_batch(texts)
+
+    def _get_batch_engine(self):
+        if self._batch_engine is None:
+            try:
+                from .models.ngram import NgramBatchEngine
+                self._batch_engine = NgramBatchEngine(self.tables,
+                                                      self.registry)
+            except ImportError:
+                self._batch_engine = False  # don't re-attempt per call
+        return self._batch_engine
+
+
+_default_detector: LanguageDetector | None = None
+
+
+def _get_default() -> LanguageDetector:
+    global _default_detector
+    if _default_detector is None:
+        _default_detector = LanguageDetector()
+    return _default_detector
+
+
+def detect(text: str) -> DetectionResult:
+    return _get_default().detect(text)
+
+
+def detect_batch(texts: list[str]) -> list[DetectionResult]:
+    return _get_default().detect_batch(texts)

@@ -1,0 +1,117 @@
+"""Scoring-table artifact: the model weights of the n-gram detector.
+
+Holds the 4-way-associative hash tables (buckets + indirect langprob arrays)
+and auxiliary decode tables, loaded from the compressed npz artifact built by
+tools/extract_tables. Mirrors the reference's ScoringTables bundle
+(scoreonescriptspan.h:100-114) and CLD2TableSummary (cld2tablesummary.h:37-49),
+re-laid-out as flat numpy arrays so they can be uploaded once to TPU HBM and
+probed with vectorized gathers.
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+_DATA = Path(__file__).parent / "data" / "cld2_tables.npz"
+
+
+@dataclasses.dataclass
+class NgramTable:
+    """One 4-way-associative <gram-fingerprint, langprobs> hash table."""
+
+    buckets: np.ndarray    # [size, 4] uint32: key | indirect-subscript
+    ind: np.ndarray        # [n] uint32 packed langprobs
+    size_one: int          # indirect subscripts >= this decode to 2 entries
+    size: int              # bucket count (power of two)
+    keymask: int           # upper-bit mask selecting the stored key
+    build_date: int
+    langscripts: str       # recognized "en-Latn az-Arab ..." list
+
+    @classmethod
+    def from_npz(cls, z, prefix: str) -> "NgramTable":
+        meta = z[f"{prefix}_meta"]
+        return cls(
+            buckets=z[f"{prefix}_buckets"],
+            ind=z[f"{prefix}_ind"],
+            size_one=int(meta[0]),
+            size=int(meta[1]),
+            keymask=int(meta[2]),
+            build_date=int(meta[3]),
+            langscripts=str(z[f"{prefix}_langscripts"]),
+        )
+
+    @property
+    def empty(self) -> bool:
+        return self.size <= 1
+
+
+def _empty_table() -> NgramTable:
+    return NgramTable(
+        buckets=np.zeros((1, 4), dtype=np.uint32),
+        ind=np.zeros(2, dtype=np.uint32),
+        size_one=1, size=1, keymask=0xFFFFF000, build_date=0, langscripts="")
+
+
+@dataclasses.dataclass
+class ScoringTables:
+    """Full weight bundle for the n-gram scorer."""
+
+    quadgram: NgramTable          # primary quadgram table (RTypeMany base)
+    quadgram2: NgramTable         # dual quadgram table (may be empty)
+    deltaocta: NgramTable         # word (octagram) delta scores
+    distinctocta: NgramTable      # distinctive words + word pairs
+    cjkdeltabi: NgramTable        # CJK bigram delta scores
+    distinctbi: NgramTable        # CJK distinct bigrams (empty in snapshot)
+    cjkcompat: NgramTable         # CJK compat classes -> langprobs
+    cjk_uni_prop: np.ndarray      # [0x110000] uint8 codepoint -> compat class
+    avg_delta_octa_score: np.ndarray  # [614, 4] int16 expected score/KB
+    lg_prob: np.ndarray           # [240, 8] uint8 quantized log-prob decode
+    script_of_cp: np.ndarray      # [0x110000] uint8 letter -> ULScript (0=not)
+    lower_pairs: np.ndarray       # [n, 2] uint32 (cp, lowercase cp)
+
+    @classmethod
+    def load(cls, path: Path = _DATA,
+             quad_path: Path | None = None) -> "ScoringTables":
+        z = np.load(path, allow_pickle=False)
+        if quad_path is None:
+            qp = Path(__file__).parent / "data" / "quad_tables.npz"
+            quad_path = qp if qp.exists() else None
+        if quad_path is not None:
+            qz = np.load(quad_path, allow_pickle=False)
+            quad = NgramTable.from_npz(qz, "quadgram")
+            quad2 = (NgramTable.from_npz(qz, "quadgram2")
+                     if "quadgram2_meta" in qz.files else _empty_table())
+        else:
+            import warnings
+            warnings.warn(
+                "quad_tables.npz not found: quadgram scoring disabled, so "
+                "most Latin/Cyrillic/Greek-script languages will detect as "
+                "unknown. Build it with tools/train_quad_tables.py.",
+                stacklevel=2)
+            quad, quad2 = _empty_table(), _empty_table()
+        return cls(
+            quadgram=quad,
+            quadgram2=quad2,
+            deltaocta=NgramTable.from_npz(z, "deltaocta"),
+            distinctocta=NgramTable.from_npz(z, "distinctocta"),
+            cjkdeltabi=NgramTable.from_npz(z, "cjkdeltabi"),
+            distinctbi=NgramTable.from_npz(z, "distinctbi"),
+            cjkcompat=NgramTable.from_npz(z, "cjkcompat"),
+            cjk_uni_prop=z["cjk_uni_prop"],
+            avg_delta_octa_score=z["avg_delta_octa_score"],
+            lg_prob=z["lg_prob_v2"],
+            script_of_cp=z["script_of_cp"],
+            lower_pairs=z["lower_pairs"],
+        )
+
+
+_tables_cache: dict = {}
+
+
+def load_tables(path: Path = _DATA) -> ScoringTables:
+    key = str(path)
+    if key not in _tables_cache:
+        _tables_cache[key] = ScoringTables.load(path)
+    return _tables_cache[key]

@@ -1,0 +1,77 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Multi-chip hardware is unavailable in CI; all parallelism tests run against
+XLA's host-platform device partitioning, the same mechanism the driver's
+dryrun_multichip check uses.
+"""
+import os
+import subprocess
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+import ctypes  # noqa: E402
+
+import pytest  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+ORACLE_SO = REPO / "tools" / "oracle" / "libcld2_oracle.so"
+
+
+@pytest.fixture(scope="session")
+def oracle():
+    """ctypes handle to the reference parity oracle; builds it on demand.
+
+    Skips dependent tests when the read-only reference snapshot is absent
+    (e.g. in a deployment environment)."""
+    if not ORACLE_SO.exists():
+        build = ORACLE_SO.parent / "build.sh"
+        if not Path("/root/reference/cld2").exists():
+            pytest.skip("reference snapshot unavailable; oracle tests skipped")
+        subprocess.run([str(build)], check=True, capture_output=True)
+    lib = ctypes.CDLL(str(ORACLE_SO))
+    lib.o_quadhash.restype = ctypes.c_uint32
+    lib.o_octahash.restype = ctypes.c_uint64
+    lib.o_bihash.restype = ctypes.c_uint32
+    lib.o_pairhash.restype = ctypes.c_uint64
+    lib.o_pairhash.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.o_detect.restype = ctypes.c_int
+    lib.o_lang_code.restype = ctypes.c_char_p
+    lib.o_scanner_new.restype = ctypes.c_void_p
+    lib.o_scanner_new.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.o_scanner_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_int)]
+    lib.o_scanner_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def oracle_detect(lib, text: bytes, flags: int = 0):
+    """Helper: run full oracle detection, return (summary_code, top3, reliable)."""
+    l3 = (ctypes.c_int * 3)()
+    p3 = (ctypes.c_int * 3)()
+    s3 = (ctypes.c_double * 3)()
+    tb = ctypes.c_int()
+    rel = ctypes.c_int()
+    lang = lib.o_detect(text, len(text), 1, flags, l3, p3, s3,
+                        ctypes.byref(tb), ctypes.byref(rel))
+    top3 = [(lib.o_lang_code(l3[i]).decode(), p3[i], s3[i]) for i in range(3)]
+    return (lib.o_lang_code(lang).decode(), lang, top3, bool(rel.value),
+            tb.value)
+
+
+def oracle_spans(lib, text: bytes):
+    """Helper: iterate the oracle's script-span scanner."""
+    h = lib.o_scanner_new(text, len(text), 1)
+    out = ctypes.create_string_buffer(40960 + 16)
+    n = ctypes.c_int()
+    sc = ctypes.c_int()
+    spans = []
+    while lib.o_scanner_next(h, out, ctypes.byref(n), ctypes.byref(sc)):
+        spans.append((out.raw[:n.value], sc.value))
+    lib.o_scanner_free(h)
+    return spans

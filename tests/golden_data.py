@@ -1,0 +1,71 @@
+"""Parse the reference's golden {language, paragraph} suite as test fixtures.
+
+Reads unittest_data.h from the read-only reference snapshot at test time
+(kept out of the repo); tests depending on it skip when the snapshot is
+absent. Handles C string concatenation, hex/octal escapes, and commented-out
+entries.
+"""
+import re
+from functools import lru_cache
+from pathlib import Path
+
+DATA_H = Path("/root/reference/cld2/internal/unittest_data.h")
+
+_ESC = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "'": "'"}
+
+
+def _unescape(lit: str) -> bytes:
+    out = bytearray()
+    i = 0
+    raw = lit.encode("utf-8")
+    while i < len(raw):
+        c = raw[i]
+        if c != 0x5C:  # backslash
+            out.append(c)
+            i += 1
+            continue
+        nxt = chr(raw[i + 1])
+        if nxt == "x":
+            j = i + 2
+            h = ""
+            while j < len(raw) and chr(raw[j]) in "0123456789abcdefABCDEF":
+                h += chr(raw[j])
+                j += 1
+            out.append(int(h, 16) & 0xFF)
+            i = j
+        elif nxt in "01234567":
+            j = i + 1
+            o = ""
+            while j < len(raw) and chr(raw[j]) in "01234567" and len(o) < 3:
+                o += chr(raw[j])
+                j += 1
+            out.append(int(o, 8) & 0xFF)
+            i = j
+        else:
+            out.extend(_ESC.get(nxt, nxt).encode())
+            i += 2
+    return bytes(out)
+
+
+@lru_cache(maxsize=1)
+def golden_pairs() -> list:
+    """[(name, expected_lang_code, text_bytes)] from unittest_data.h."""
+    if not DATA_H.exists():
+        return []
+    src = DATA_H.read_text(encoding="utf-8")
+    # Strip line comments so commented-out variants are ignored
+    src = "\n".join(l for l in src.splitlines()
+                    if not l.lstrip().startswith("//"))
+    out = []
+    for m in re.finditer(
+            r'const char\*\s+kTeststr_(\w+)\s*=\s*((?:"(?:[^"\\]|\\.)*"\s*)+);',
+            src, re.S):
+        name = m.group(1)
+        lits = re.findall(r'"((?:[^"\\]|\\.)*)"', m.group(2))
+        text = b"".join(_unescape(l) for l in lits)
+        if name == "version":
+            continue
+        # name pattern: <langcode>_<Script>[digit]
+        lang = name.split("_")[0]
+        out.append((name, lang, text))
+    return out

@@ -1,0 +1,52 @@
+"""Full-document detection parity: scalar engine vs the compiled oracle.
+
+Both run the same table artifact (no quadgram tables in the snapshot), so
+summary language, top-3, percents, and reliability must agree exactly.
+"""
+import pytest
+
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.registry import registry
+
+from conftest import oracle_detect
+
+TEXTS = [
+    # CJK (unigram/bigram path is fully populated in the artifact)
+    "国民の大多数が内閣を支持し、集団的自衛権の行使を認める判断を歓迎した。",
+    "中华人民共和国是世界上人口最多的国家，拥有悠久的历史和丰富的文化。",
+    "한국어는 한글을 사용하는 언어이며 대한민국의 공용어입니다. 한국어 텍스트와",
+    "日本語のテキストです。東京は日本の首都であり、世界最大の都市圏です。",
+    # Script-only (RTypeOne) languages
+    "ελληνικά γλώσσα είναι πολύ όμορφη και έχει μεγάλη ιστορία",
+    "ภาษาไทยเป็นภาษาที่สวยงามและมีประวัติศาสตร์ยาวนาน",
+    "தமிழ் மொழி மிகவும் அழகான மொழி ஆகும்",
+    "ქართული ენა ძალიან ლამაზია და აქვს დიდი ისტორია",
+    # Latin/Cyrillic word-scored languages (octagram tables only)
+    "This is a simple English sentence about the weather and the news.",
+    "le monde est grand et la vie est belle pour tous les hommes",
+    "das ist ein schöner Tag und die Sonne scheint hell über der Stadt",
+    "Это советы помогут вам избежать проблем при покупке квартиры",
+    "confiserie et de la chocolaterie des digues du fleuve",
+    # Mixed scripts
+    "国民の大多数が Some English mixed in. ещё немного по-русски тут",
+    "हिन्दी भाषा में यह वाक्य लिखा गया है और यह सुंदर है",
+    # Degenerate
+    "12345 67890 !!! ???",
+    "a",
+    "",
+    "   ",
+]
+
+
+@pytest.mark.parametrize("text", TEXTS)
+def test_detect_parity(oracle, text):
+    code, lang_id, top3, reliable, tb = oracle_detect(oracle,
+                                                      text.encode("utf-8"))
+    r = detect_scalar(text)
+    mine_code = registry.code(r.summary_lang)
+    mine_top3 = [(registry.code(l), p) for l, p in
+                 zip(r.language3, r.percent3)]
+    assert mine_code == code, (text, mine_code, code, top3, mine_top3)
+    assert mine_top3 == [(c, p) for c, p, _ in top3], (text, mine_top3, top3)
+    assert r.is_reliable == reliable, (text, r.is_reliable, reliable)
+    assert r.text_bytes == tb, (text, r.text_bytes, tb)

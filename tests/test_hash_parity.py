@@ -1,0 +1,62 @@
+"""Bit-parity of n-gram fingerprints vs the compiled reference oracle.
+
+The scoring tables are keyed by these hashes; any divergence silently turns
+hits into misses, so these tests fuzz broadly.
+"""
+import ctypes
+import random
+
+import numpy as np
+import pytest
+
+from language_detector_tpu.preprocess.hashing import (
+    bi_hash_v2, octa_hash40, pair_hash, quad_hash_v2)
+
+WORDS = [
+    b"the", b"confiserie", b"chocolaterie", b"a", b"ab", b"abc", b"abcd",
+    b"abcdefgh", b"abcdefghijkl", b"abcdefghijklmnopqrstuvwx",
+    "ñandú".encode(), "vögel".encode(), "больж".encode(),
+    "справочник".encode(), "الاتحاد".encode(), "ブログトップ".encode(),
+    "中华人民共和国".encode(), "príliš".encode(), "žluťoučký".encode(),
+]
+
+
+def _buffers():
+    rng = random.Random(42)
+    cases = []
+    for w in WORDS:
+        for pre in (b" ", b"x"):
+            for post in (b" ", b"y"):
+                buf = b" " + pre + w + post + b"   \0\0\0\0\0\0\0\0"
+                cases.append((buf, 2, len(w)))
+    # random byte soup (printable + UTF-8-ish), random lengths
+    for _ in range(200):
+        n = rng.randint(1, 24)
+        body = bytes(rng.randrange(0x21, 0xF5) for _ in range(n))
+        buf = b"  " + body + b"    \0\0\0\0\0\0\0\0"
+        cases.append((buf, 2, n))
+    return cases
+
+
+@pytest.mark.parametrize("fn,oname,maxlen", [
+    (quad_hash_v2, "o_quadhash", 12),
+    (octa_hash40, "o_octahash", 24),
+    (bi_hash_v2, "o_bihash", 8),
+])
+def test_hash_parity(oracle, fn, oname, maxlen):
+    ofn = getattr(oracle, oname)
+    for buf, pos, n in _buffers():
+        if n > maxlen and oname != "o_octahash":
+            continue  # reference callers never exceed these lengths
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        mine = fn(arr, np.array([pos]), np.array([n]))[0]
+        theirs = ofn(buf, pos, n)
+        assert int(mine) == int(theirs), (buf, pos, n)
+
+
+def test_pair_hash_parity(oracle):
+    rng = random.Random(7)
+    for _ in range(100):
+        a = rng.getrandbits(40)
+        b = rng.getrandbits(40)
+        assert int(pair_hash(a, b)) == oracle.o_pairhash(a, b)

@@ -1,0 +1,43 @@
+"""Long single-script documents: span-splitting parity with the reference
+scanner (40KB buffer cap, near-end halving, getonescriptspan.cc:814-1000)."""
+import random
+
+import pytest
+
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.preprocess.segment import segment_text
+from language_detector_tpu.registry import registry
+
+from conftest import oracle_detect, oracle_spans
+
+
+# Mixed-kanji alphabets exercise the >1000-hit hitbuffer rounds
+_JA = "のがをにはで大内閣を支持し判断東京都内会議専門家参加世界経済議論政府政策発表国民生活影響"
+_ZH = "的是在有人这中大为上个国我以要他时来用们生到作地于出就分对成会可主发年动"
+
+
+@pytest.mark.parametrize("n_chars,alphabet", [
+    (13849, "αβγδεζηθικλμνξοπρστυφχψω "),
+    (27699, "αβγδεζηθικλμνξοπρστυφχψω "),
+    (50000, "αβγδεζηθικλμνξοπρστυφχψω "),
+    (60000, "abcdefghijklmnopqrstuvwxyz  "),
+    (3500, _JA + _ZH),
+    (20000, _JA + _ZH),
+])
+def test_long_span_parity(oracle, n_chars, alphabet):
+    rng = random.Random(3)
+    text = "".join(rng.choice(alphabet) for _ in range(n_chars))
+    ref = [(t, s) for t, s in oracle_spans(oracle, text.encode())]
+    mine = segment_text(text)
+    assert [(sp.text, sp.ulscript) for sp in mine] == ref
+
+    code, _, top3, reliable, tb = oracle_detect(oracle, text.encode())
+    r = detect_scalar(text)
+    assert registry.code(r.summary_lang) == code
+    assert r.text_bytes == tb
+    # Full top-3 including percents and normalized scores: catches chunk
+    # boundary / reliability drift on multi-round spans.
+    mine3 = [(registry.code(l), p, s) for l, p, s in
+             zip(r.language3, r.percent3, r.normalized_score3)]
+    assert mine3 == top3
+    assert r.is_reliable == reliable

@@ -1,0 +1,36 @@
+"""Script-span segmentation parity vs the reference scanner."""
+import pytest
+
+from language_detector_tpu.preprocess.segment import segment_text
+
+from conftest import oracle_spans
+
+TEXTS = [
+    "This is plain English text, with punctuation!",
+    "Confiserie et chocolaterie — des digues du fleuve.",
+    "Šach je dosková hra pre dvoch hráčov, cieľom je dať mat.",
+    "Это советы помогут вам избежать проблем при покупке квартиры.",
+    "国民の大多数が内閣を支持した。 Some English mixed in. ещё по-русски.",
+    "Mixed: English text então Português depois English again.",
+    "العربية لغة جميلة wa English words huna.",
+    "ελληνικά και λατινικά letters mixed δύο scripts.",
+    "    leading spaces and\t\ttabs\nnewlines   ",
+    "numbers 12345 and - punctuation!!! only?",
+    "ḀḁḂ unusual Latin-extended ṪṫṬ characters ẑẒ",
+    "한국어 텍스트와 English 텍스트가 섞여 있습니다",
+    "ภาษาไทยเป็นภาษาที่สวยงาม",
+    "हिन्दी भाषा में यह वाक्य लिखा गया है",
+]
+
+
+@pytest.mark.parametrize("text", TEXTS)
+def test_span_parity(oracle, text):
+    ref = oracle_spans(oracle, text.encode("utf-8"))
+    mine = segment_text(text)
+    ref_clean = [(t, s) for (t, s) in ref]
+    assert len(mine) == len(ref_clean), (
+        [(r[0], r[1]) for r in ref_clean],
+        [(sp.text, sp.ulscript) for sp in mine])
+    for sp, (rt, rs) in zip(mine, ref_clean):
+        assert sp.ulscript == rs, (sp.text, rt, rs)
+        assert sp.text == rt, (sp.text, rt)

@@ -1,0 +1,37 @@
+"""Anti-spam squeeze/repeat-stripping parity with the oracle
+(CheapSqueeze / CheapRepWords / trigger recursion,
+compact_lang_det_impl.cc:541-971, :1852-1918, :2061-2105)."""
+import random
+
+import pytest
+
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.registry import registry
+
+from conftest import oracle_detect
+
+
+def _cases():
+    rng = random.Random(5)
+    vocab = ["maison", "jardin", "fleuve", "montagne", "rivière", "forêt",
+             "soleil", "lune"]
+    ru = ["москва", "жизнь", "человек", "город", "страна", "время",
+          "работа", "слово", "день", "рука"]
+    return {
+        "repeat300": "le monde est grand et la vie est belle " * 300,
+        "vocab4000": " ".join(rng.choice(vocab) for _ in range(4000)),
+        "ru1500": " ".join(random.Random(2).choice(ru) for _ in range(1500)),
+        "spaces": ("a  b  c  d  e  f  " * 400),
+        "ja_repeat": "国民の大多数が内閣を支持した。" * 500,
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_cases()))
+def test_squeeze_parity(oracle, name):
+    text = _cases()[name]
+    code, _, top3, reliable, tb = oracle_detect(oracle, text.encode())
+    r = detect_scalar(text)
+    mine = (registry.code(r.summary_lang), r.text_bytes,
+            [(registry.code(l), p) for l, p in zip(r.language3, r.percent3)],
+            r.is_reliable)
+    assert mine == (code, tb, [(c, p) for c, p, _ in top3], reliable)

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Build the parity-oracle shared library (reference CLD2 with stubbed quad
+# tables) for use by tests via ctypes. Output: tools/oracle/libcld2_oracle.so
+set -euo pipefail
+cd "$(dirname "$0")"
+
+REF=/root/reference/cld2
+CXXFLAGS="-O2 -w -fPIC -I$REF/internal -I$REF/public"
+
+# Same library file list as the reference's compile_libs.sh full build, with
+# debug_empty instead of debug and quad_stub.cc standing in for the two
+# quadgram table files missing from the snapshot.
+g++ $CXXFLAGS -shared \
+  shim.cc quad_stub.cc \
+  $REF/internal/cldutil.cc $REF/internal/cldutil_shared.cc \
+  $REF/internal/compact_lang_det.cc \
+  $REF/internal/compact_lang_det_hint_code.cc \
+  $REF/internal/compact_lang_det_impl.cc \
+  $REF/internal/debug_empty.cc \
+  $REF/internal/fixunicodevalue.cc \
+  $REF/internal/generated_entities.cc \
+  $REF/internal/generated_language.cc \
+  $REF/internal/generated_ulscript.cc \
+  $REF/internal/getonescriptspan.cc \
+  $REF/internal/lang_script.cc \
+  $REF/internal/offsetmap.cc \
+  $REF/internal/scoreonescriptspan.cc \
+  $REF/internal/tote.cc \
+  $REF/internal/utf8statetable.cc \
+  $REF/internal/cld_generated_cjk_uni_prop_80.cc \
+  $REF/internal/cld2_generated_cjk_compatible.cc \
+  $REF/internal/cld_generated_cjk_delta_bi_32.cc \
+  $REF/internal/generated_distinct_bi_0.cc \
+  $REF/internal/cld2_generated_deltaocta0527.cc \
+  $REF/internal/cld2_generated_distinctocta0527.cc \
+  $REF/internal/cld_generated_score_quad_octa_1024_256.cc \
+  -o libcld2_oracle.so
+
+echo "built $(pwd)/libcld2_oracle.so"

@@ -1,0 +1,41 @@
+// Stub quadgram tables for the parity oracle.
+//
+// The reference snapshot is missing its two quadgram data files
+// (cld2_generated_quad0122.cc / cld2_generated_quadchrome_2.cc — see
+// compile_libs.sh:31-53), so the oracle is built with empty 1-bucket tables:
+// every quadgram lookup misses and scoring falls back to octagram/CJK/script
+// signals. The TPU framework under test runs with the same table set, so
+// agreement tests remain apples-to-apples.
+
+#include "integral_types.h"
+#include "cld2tablesummary.h"
+
+namespace CLD2 {
+
+static const IndirectProbBucket4 kQuadStubBuckets[1] = {
+  {{0, 0, 0, 0}},
+};
+static const uint32 kQuadStubInd[2] = {0, 0};
+
+extern const CLD2TableSummary kQuad_obj = {
+  kQuadStubBuckets,
+  kQuadStubInd,
+  1,            // kCLDTableSizeOne
+  1,            // kCLDTableSize (bucket count; power of two)
+  0xFFFFF000,   // kCLDTableKeyMask
+  20130527,     // build date
+  "",           // recognized lang-scripts
+};
+
+// Size 0 disables the dual-table second probe (cldutil.cc:357).
+extern const CLD2TableSummary kQuad_obj2 = {
+  kQuadStubBuckets,
+  kQuadStubInd,
+  1,
+  0,
+  0xFFFFF000,
+  20130527,
+  "",
+};
+
+}  // namespace CLD2

@@ -1,0 +1,84 @@
+// extern "C" shim over the reference CLD2 build, used ONLY by parity tests
+// (tests/test_oracle_parity.py) through ctypes. Exposes the hash functions,
+// the script-span scanner, and full-document detection so every layer of the
+// TPU reimplementation can be validated against the original behavior.
+
+#include <string.h>
+#include <stdlib.h>
+
+#include "integral_types.h"
+#include "cldutil_shared.h"
+#include "getonescriptspan.h"
+#include "lang_script.h"
+#include "compact_lang_det.h"
+#include "encodings.h"
+
+using namespace CLD2;
+
+extern "C" {
+
+// ---- hash parity ----------------------------------------------------------
+// buf must have >=1 byte before pos and >=3 bytes after pos+len (the
+// reference hashers read the pre/post byte for space indicators and
+// overshoot up to 3 bytes).
+unsigned int o_quadhash(const char* buf, int pos, int len) {
+  return QuadHashV2(buf + pos, len);
+}
+unsigned long long o_octahash(const char* buf, int pos, int len) {
+  return OctaHash40(buf + pos, len);
+}
+unsigned int o_bihash(const char* buf, int pos, int len) {
+  return BiHashV2(buf + pos, len);
+}
+unsigned long long o_pairhash(unsigned long long a, unsigned long long b) {
+  return PairHash(a, b);
+}
+
+// ---- script-span scanner parity ------------------------------------------
+void* o_scanner_new(const char* text, int len, int is_plain_text) {
+  return new ScriptScanner(text, len, is_plain_text != 0);
+}
+// Returns 1 and fills out/out_len/out_script while spans remain, else 0.
+// out must hold >= 40960+8 bytes. Lowercased span text.
+int o_scanner_next(void* handle, char* out, int* out_len, int* out_script) {
+  ScriptScanner* ss = static_cast<ScriptScanner*>(handle);
+  LangSpan span;
+  if (!ss->GetOneScriptSpanLower(&span)) return 0;
+  memcpy(out, span.text, span.text_bytes + 4);
+  *out_len = span.text_bytes;
+  *out_script = static_cast<int>(span.ulscript);
+  return 1;
+}
+void o_scanner_free(void* handle) {
+  delete static_cast<ScriptScanner*>(handle);
+}
+
+// ---- full-document detection parity --------------------------------------
+// Returns summary language id; fills top-3 languages/percents/scores.
+int o_detect(const char* text, int len, int is_plain_text, int flags,
+             int* lang3, int* percent3, double* score3,
+             int* text_bytes, int* is_reliable) {
+  Language language3[3];
+  int pct3[3];
+  double ns3[3];
+  int tb = 0;
+  bool rel = false;
+  CLDHints hints = {NULL, NULL, UNKNOWN_ENCODING, UNKNOWN_LANGUAGE};
+  Language summary = ExtDetectLanguageSummary(
+      text, len, is_plain_text != 0, &hints, flags,
+      language3, pct3, ns3, NULL, &tb, &rel);
+  for (int i = 0; i < 3; ++i) {
+    lang3[i] = static_cast<int>(language3[i]);
+    percent3[i] = pct3[i];
+    score3[i] = ns3[i];
+  }
+  *text_bytes = tb;
+  *is_reliable = rel ? 1 : 0;
+  return static_cast<int>(summary);
+}
+
+const char* o_lang_code(int lang) {
+  return LanguageCode(static_cast<Language>(lang));
+}
+
+}  // extern "C"
