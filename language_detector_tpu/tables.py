@@ -73,24 +73,44 @@ class ScoringTables:
 
     @classmethod
     def load(cls, path: Path = _DATA,
-             quad_path: Path | None = None) -> "ScoringTables":
+             quad_path: Path | None | bool = None) -> "ScoringTables":
+        """Load the table bundle.
+
+        quad_path: None = auto-discover data/quad_tables.npz;
+        False = explicitly disable quadgram tables (reference-snapshot
+        parity mode); a Path = load that file."""
+        if quad_path is True:
+            raise ValueError("quad_path must be a Path, None (auto-discover) "
+                             "or False (disable)")
         z = np.load(path, allow_pickle=False)
+        expected_override = None
+        discovery_miss = False
         if quad_path is None:
             qp = Path(__file__).parent / "data" / "quad_tables.npz"
-            quad_path = qp if qp.exists() else None
-        if quad_path is not None:
+            quad_path = qp if qp.exists() else False
+            discovery_miss = quad_path is False
+        if quad_path is not False:
             qz = np.load(quad_path, allow_pickle=False)
             quad = NgramTable.from_npz(qz, "quadgram")
             quad2 = (NgramTable.from_npz(qz, "quadgram2")
                      if "quadgram2_meta" in qz.files else _empty_table())
+            if "expected_score_override" in qz.files:
+                # Trained tables carry their own expected-score calibration
+                # (the reference regenerates kAvgDeltaOctaScore per table
+                # build via cld2_do_score.cc; zero = "no data yet" => the
+                # delta reliability model governs, cldutil.cc:588).
+                expected_override = qz["expected_score_override"]
         else:
-            import warnings
-            warnings.warn(
-                "quad_tables.npz not found: quadgram scoring disabled, so "
-                "most Latin/Cyrillic/Greek-script languages will detect as "
-                "unknown. Build it with tools/train_quad_tables.py.",
-                stacklevel=2)
+            if discovery_miss:
+                import warnings
+                warnings.warn(
+                    "quad_tables.npz not found: quadgram scoring disabled, "
+                    "so most Latin/Cyrillic/Greek-script languages will "
+                    "detect as unknown. Build it with "
+                    "tools/train_quad_tables.py.", stacklevel=2)
             quad, quad2 = _empty_table(), _empty_table()
+        expected = z["avg_delta_octa_score"] if expected_override is None \
+            else expected_override
         return cls(
             quadgram=quad,
             quadgram2=quad2,
@@ -100,7 +120,7 @@ class ScoringTables:
             distinctbi=NgramTable.from_npz(z, "distinctbi"),
             cjkcompat=NgramTable.from_npz(z, "cjkcompat"),
             cjk_uni_prop=z["cjk_uni_prop"],
-            avg_delta_octa_score=z["avg_delta_octa_score"],
+            avg_delta_octa_score=expected,
             lg_prob=z["lg_prob_v2"],
             script_of_cp=z["script_of_cp"],
             lower_pairs=z["lower_pairs"],

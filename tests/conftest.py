@@ -23,6 +23,14 @@ ORACLE_SO = REPO / "tools" / "oracle" / "libcld2_oracle.so"
 
 
 @pytest.fixture(scope="session")
+def base_tables():
+    """Snapshot-parity table set: quadgram tables explicitly disabled, like
+    the compiled oracle (whose quad data files are missing upstream)."""
+    from language_detector_tpu.tables import ScoringTables
+    return ScoringTables.load(quad_path=False)
+
+
+@pytest.fixture(scope="session")
 def oracle():
     """ctypes handle to the reference parity oracle; builds it on demand.
 

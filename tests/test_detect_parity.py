@@ -39,10 +39,10 @@ TEXTS = [
 
 
 @pytest.mark.parametrize("text", TEXTS)
-def test_detect_parity(oracle, text):
+def test_detect_parity(oracle, base_tables, text):
     code, lang_id, top3, reliable, tb = oracle_detect(oracle,
                                                       text.encode("utf-8"))
-    r = detect_scalar(text)
+    r = detect_scalar(text, base_tables)
     mine_code = registry.code(r.summary_lang)
     mine_top3 = [(registry.code(l), p) for l, p in
                  zip(r.language3, r.percent3)]

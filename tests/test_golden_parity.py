@@ -17,12 +17,12 @@ PAIRS = golden_pairs()
 
 
 @pytest.mark.skipif(not PAIRS, reason="reference snapshot unavailable")
-def test_golden_full_parity(oracle):
+def test_golden_full_parity(oracle, base_tables):
     mismatches = []
     for name, lang, raw in PAIRS:
         text = raw.decode("utf-8", errors="replace")
         code, lang_id, top3, reliable, tb = oracle_detect(oracle, raw)
-        r = detect_scalar(text)
+        r = detect_scalar(text, base_tables)
         mine = (registry.code(r.summary_lang),
                 [(registry.code(l), p) for l, p in
                  zip(r.language3, r.percent3)], r.is_reliable)
@@ -33,18 +33,22 @@ def test_golden_full_parity(oracle):
 
 
 @pytest.mark.skipif(not PAIRS, reason="reference snapshot unavailable")
-def test_golden_accuracy_floor(oracle):
-    """Sanity floor: the no-quad table set must still identify most
-    CJK/script-only/distinct-word languages."""
+def test_golden_accuracy_floor():
+    """Accuracy gate on the production table set (trained quadgram tables).
+
+    Context: the reference snapshot is missing its quadgram data files, so
+    the compiled reference itself scores only 56/402 here; the trained
+    tables (tools/train_quad_tables.py) recover Latin/Cyrillic/etc.
+    detection to ~65%."""
+    from language_detector_tpu.tables import ScoringTables
+    prod = ScoringTables.load()
     hits = 0
     total = 0
     for name, lang, raw in PAIRS:
-        r = detect_scalar(raw.decode("utf-8", errors="replace"))
+        r = detect_scalar(raw.decode("utf-8", errors="replace"), prod)
         total += 1
-        if registry.code(r.summary_lang) == lang:
+        got = registry.code(r.summary_lang)
+        if got == lang or (got, lang) == ("hmn", "blu"):  # same language
             hits += 1
     assert total > 100
-    # With the snapshot's table set (quadgram tables absent upstream) the
-    # compiled oracle itself scores 56/402; the floor tracks that. It rises
-    # once trained quad tables land (train/quad_tables.py).
-    assert hits / total > 0.12, f"accuracy {hits}/{total}"
+    assert hits / total > 0.60, f"accuracy {hits}/{total}"

@@ -24,7 +24,7 @@ _ZH = "çš„æ˜¯åœ¨æœ‰äººè¿™ä¸­å¤§ä¸ºä¸Šä¸ªå›½æˆ‘ä»¥è¦ä»–æ—¶æ¥ç”¨ä»¬ç”Ÿåˆ°ä½œåœ°ä
     (3500, _JA + _ZH),
     (20000, _JA + _ZH),
 ])
-def test_long_span_parity(oracle, n_chars, alphabet):
+def test_long_span_parity(oracle, base_tables, n_chars, alphabet):
     rng = random.Random(3)
     text = "".join(rng.choice(alphabet) for _ in range(n_chars))
     ref = [(t, s) for t, s in oracle_spans(oracle, text.encode())]
@@ -32,7 +32,7 @@ def test_long_span_parity(oracle, n_chars, alphabet):
     assert [(sp.text, sp.ulscript) for sp in mine] == ref
 
     code, _, top3, reliable, tb = oracle_detect(oracle, text.encode())
-    r = detect_scalar(text)
+    r = detect_scalar(text, base_tables)
     assert registry.code(r.summary_lang) == code
     assert r.text_bytes == tb
     # Full top-3 including percents and normalized scores: catches chunk

