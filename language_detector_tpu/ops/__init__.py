@@ -1,0 +1,2 @@
+from .device_tables import DeviceTables  # noqa: F401
+from .score import score_batch  # noqa: F401

@@ -1,0 +1,82 @@
+"""Device-resident scoring tables: the model weights in TPU HBM.
+
+Uploaded once, replicated across the mesh (they are small: ~2MB total).
+Bucket arrays stay in their packed uint32 form and are probed with
+vectorized gathers; auxiliary decode tables are flat arrays.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import Registry
+from ..tables import NgramTable, ScoringTables
+
+
+@dataclasses.dataclass
+class DeviceNgramTable:
+    buckets: jnp.ndarray   # [size, 4] uint32
+    ind: jnp.ndarray       # [n] uint32
+    size_one: int
+    size: int
+    keymask: int
+
+    @classmethod
+    def from_host(cls, t: NgramTable) -> "DeviceNgramTable":
+        return cls(buckets=jnp.asarray(t.buckets),
+                   ind=jnp.asarray(t.ind),
+                   size_one=t.size_one, size=t.size, keymask=t.keymask)
+
+
+@dataclasses.dataclass
+class DeviceTables:
+    quadgram: DeviceNgramTable
+    quadgram2: DeviceNgramTable
+    deltaocta: DeviceNgramTable
+    distinctocta: DeviceNgramTable
+    cjkdeltabi: DeviceNgramTable
+    distinctbi: DeviceNgramTable
+    cjkcompat: DeviceNgramTable
+    lg_prob3: jnp.ndarray          # [240, 3] uint8: 3-entry qprob decode
+    expected_score: jnp.ndarray    # [614, 4] int32
+    plang_to_lang: jnp.ndarray     # [2, 256] int32 (latn, othr)
+    lang_rtype_default: jnp.ndarray  # [102, 2] int32 (rtype, default lang)
+    close_set: jnp.ndarray         # [614] int32 close-set id
+    closest_alt: jnp.ndarray       # [614] int32 closest alternate (or 26)
+    is_figs: jnp.ndarray           # [614] bool
+    quad2_enabled: bool
+
+    @classmethod
+    def from_host(cls, t: ScoringTables, reg: Registry) -> "DeviceTables":
+        close = np.zeros(reg.num_languages, np.int32)
+        for lang in range(reg.num_languages):
+            close[lang] = reg.close_set(lang)
+        alt = np.full(reg.num_languages, 26, np.int32)  # 26 = UNKNOWN
+        alt[:len(reg.closest_alt_lang)] = reg.closest_alt_lang
+        figs = np.zeros(reg.num_languages, bool)
+        for code in ("fr", "it", "de", "es"):
+            figs[reg.code_to_lang[code]] = True
+        rd = np.stack([reg.ulscript_rtype.astype(np.int32),
+                       reg.ulscript_default_lang.astype(np.int32)], axis=1)
+        return cls(
+            quadgram=DeviceNgramTable.from_host(t.quadgram),
+            quadgram2=DeviceNgramTable.from_host(t.quadgram2),
+            deltaocta=DeviceNgramTable.from_host(t.deltaocta),
+            distinctocta=DeviceNgramTable.from_host(t.distinctocta),
+            cjkdeltabi=DeviceNgramTable.from_host(t.cjkdeltabi),
+            distinctbi=DeviceNgramTable.from_host(t.distinctbi),
+            cjkcompat=DeviceNgramTable.from_host(t.cjkcompat),
+            lg_prob3=jnp.asarray(t.lg_prob[:, 5:8]),
+            expected_score=jnp.asarray(
+                t.avg_delta_octa_score.astype(np.int32)),
+            plang_to_lang=jnp.asarray(np.stack([
+                reg.plang_to_lang_latn.astype(np.int32),
+                reg.plang_to_lang_othr.astype(np.int32)])),
+            lang_rtype_default=jnp.asarray(rd),
+            close_set=jnp.asarray(close),
+            closest_alt=jnp.asarray(alt),
+            is_figs=jnp.asarray(figs),
+            quad2_enabled=not t.quadgram2.empty and t.quadgram2.size != 0,
+        )

@@ -1,0 +1,258 @@
+"""Host-side batch packer: texts -> fixed-shape candidate tensors.
+
+The TPU engine's front half. For each document the host performs the
+inherently sequential byte work (segmentation, gram positions, fingerprints,
+the hash-only word repeat filter, squeeze triggers) and emits a *linear
+candidate list* in the exact merge order the scalar engine scores hits
+(delta <= distinct <= base at equal offsets, seed first). The device then
+probes tables, applies the hit-dependent quad repeat filter, assigns chunks,
+and reduces — all in fixed [B, L] shapes.
+
+Documents that exceed the slot budget or need multiple hitbuffer rounds per
+span are flagged for the scalar fallback path (the long tail; service
+traffic is short).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..registry import (RTYPE_CJK, RTYPE_MANY, RTYPE_NONE, RTYPE_ONE,
+                        ULSCRIPT_LATIN, Registry)
+from ..tables import ScoringTables
+from .grams import MAX_SCORING_HITS, quad_positions, word_positions
+from .hashing import (bi_hash_v2, octa_hash40, octa_subscript_key, pair_hash,
+                      quad_hash_v2, quad_subscript_key)
+from .segment import ScriptSpan, segment_text, utf8_len_of_cps
+from .squeeze import TEST_THRESH, cheap_squeeze_trigger_test
+
+# Candidate kinds (device dispatch)
+PAD, SEED, QUAD, UNI, DELTA_OCTA, DISTINCT_OCTA, BI_DELTA, BI_DISTINCT = \
+    range(8)
+
+# Kinds that count as base hits (chunk quota; UNIHIT/QUADHIT analogue)
+BASE_KINDS = (SEED, QUAD, UNI)
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """Fixed-shape candidate tensors for one batch of documents."""
+
+    # Per-slot arrays [B, L]
+    kind: np.ndarray          # int8 candidate kind
+    offset: np.ndarray        # int32 span-buffer offset
+    sub: np.ndarray           # int32 bucket subscript (table by kind)
+    key: np.ndarray           # uint32 probe key
+    fp: np.ndarray            # uint32 quad fingerprint (repeat filter)
+    direct: np.ndarray        # uint32 direct payload (seed langprob/uni class)
+    chunk_base: np.ndarray    # int32 first chunk id of the slot's span
+    span_start: np.ndarray    # int32 first slot index of the slot's span
+    span_end_off: np.ndarray  # int32 span end offset (dummy entry offset)
+    side: np.ndarray          # int8 0=latn 1=othr boost stream
+    cjk: np.ndarray           # int8 1 if CJK-scored span
+    script: np.ndarray        # int16 span ULScript
+    # Per-chunk arrays [B, C]
+    chunk_script: np.ndarray  # int16 ULScript of the chunk's span
+    chunk_cjk: np.ndarray     # int8
+    chunk_side: np.ndarray    # int8
+    # Direct doc-tote adds for RTypeNone/One spans [B, 4, 2] (lang, bytes)
+    direct_adds: np.ndarray
+    # Per-doc [B]
+    text_bytes: np.ndarray    # int32 total scored text bytes
+    fallback: np.ndarray      # bool: needs scalar path
+    n_docs: int
+
+
+def _seed_langprob(reg: Registry, ulscript: int) -> int:
+    lang = reg.default_language(ulscript)
+    pslang = reg.per_script_number(ULSCRIPT_LATIN, lang)
+    return (pslang << 8) | 0  # qprob 1 -> backmap[1] = 0
+
+
+def _pack_quad_span(span: ScriptSpan, tables: ScoringTables):
+    """Quad + word candidates of one RTypeMany span, in linear merge order.
+
+    Returns (records, overflow): records are dicts with kind/offset/... The
+    quad repeat filter is left to the device (it depends on hit results);
+    the word repeat filter and pair construction are hash-only and happen
+    here, exactly as the scalar engine does."""
+    limit = span.text_bytes
+    qpos, qlens, _ = quad_positions(span.buf, 1, limit)
+    if len(qpos) > MAX_SCORING_HITS:
+        return None  # multi-round span -> scalar fallback
+    qfps = quad_hash_v2(span.buf, qpos, qlens) if len(qpos) else \
+        np.zeros(0, np.uint32)
+    qt = tables.quadgram
+    qsub, qkey = quad_subscript_key(qfps, qt.keymask, qt.size)
+
+    wstarts, wlens, wpriors = word_positions(span.buf, 1, limit)
+    wfps = octa_hash40(span.buf, wstarts, wlens) if len(wstarts) else \
+        np.zeros(0, np.uint64)
+
+    # Hash-only octa repeat filter + pair hashes (cldutil.cc:459-502)
+    recs = []
+    cache = [np.uint64(0), np.uint64(0)]
+    nxt = 0
+    dt, xt = tables.deltaocta, tables.distinctocta
+    n_delta = n_distinct = 0
+    for i in range(len(wfps)):
+        fpw = wfps[i]
+        if fpw == cache[0] or fpw == cache[1]:
+            continue
+        cache[nxt] = fpw
+        nxt = 1 - nxt
+        prior = cache[nxt]
+        if prior != 0 and prior != fpw:
+            pfp = pair_hash(prior, fpw)
+            s, k = octa_subscript_key(np.array([pfp]), xt.keymask, xt.size)
+            recs.append(dict(kind=DISTINCT_OCTA, offset=int(wpriors[i]),
+                             sub=int(s[0]), key=int(k[0])))
+            n_distinct += 1
+        s, k = octa_subscript_key(np.array([fpw]), xt.keymask, xt.size)
+        recs.append(dict(kind=DISTINCT_OCTA, offset=int(wstarts[i]),
+                         sub=int(s[0]), key=int(k[0])))
+        s, k = octa_subscript_key(np.array([fpw]), dt.keymask, dt.size)
+        recs.append(dict(kind=DELTA_OCTA, offset=int(wstarts[i]),
+                         sub=int(s[0]), key=int(k[0])))
+        n_delta += 1
+        n_distinct += 1
+        if n_delta >= MAX_SCORING_HITS or n_distinct >= MAX_SCORING_HITS - 1:
+            break
+
+    for i in range(len(qpos)):
+        recs.append(dict(kind=QUAD, offset=int(qpos[i]), sub=int(qsub[i]),
+                         key=int(qkey[i]), fp=int(qfps[i])))
+    return recs
+
+
+def _pack_cjk_span(span: ScriptSpan, tables: ScoringTables):
+    """Unigram + bigram candidates of one RTypeCJK span."""
+    lens = utf8_len_of_cps(span.cps)
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    prop = tables.cjk_uni_prop[np.minimum(span.cps, 0x10FFFF)]
+    sel = (prop > 0) & (starts >= 1) & (starts < span.text_bytes)
+    if int(sel.sum()) > MAX_SCORING_HITS:
+        return None  # multi-round span -> scalar fallback
+    recs = []
+    for e, p in zip(ends[sel].tolist(), prop[sel].tolist()):
+        recs.append(dict(kind=UNI, offset=int(e), direct=int(p)))
+
+    len2 = lens[:-1] + lens[1:]
+    ok = (len2 >= 6) & (starts[:-1] >= 1) & (starts[:-1] < span.text_bytes)
+    idx = np.flatnonzero(ok)
+    if len(idx):
+        fps = bi_hash_v2(span.buf, starts[idx], len2[idx])
+        bt, xt = tables.cjkdeltabi, tables.distinctbi
+        bsub, bkey = quad_subscript_key(fps, bt.keymask, bt.size)
+        xsub, xkey = quad_subscript_key(fps, xt.keymask, xt.size)
+        for j, i in enumerate(idx.tolist()):
+            recs.append(dict(kind=BI_DELTA, offset=int(starts[i]),
+                             sub=int(bsub[j]), key=int(bkey[j])))
+            if not xt.empty:
+                recs.append(dict(kind=BI_DISTINCT, offset=int(starts[i]),
+                                 sub=int(xsub[j]), key=int(xkey[j])))
+    return recs
+
+
+# Linear merge priority at equal offsets (LinearizeAll order: delta,
+# distinct, base; seed always first)
+_PRIORITY = {SEED: -1, DELTA_OCTA: 0, BI_DELTA: 0, DISTINCT_OCTA: 1,
+             BI_DISTINCT: 1, QUAD: 2, UNI: 2}
+
+
+def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
+               max_slots: int = 2048, max_chunks: int = 64,
+               max_direct: int = 4) -> PackedBatch:
+    B = len(texts)
+    L, C = max_slots, max_chunks
+    out = PackedBatch(
+        kind=np.zeros((B, L), np.int8),
+        offset=np.zeros((B, L), np.int32),
+        sub=np.zeros((B, L), np.int32),
+        key=np.zeros((B, L), np.uint32),
+        fp=np.zeros((B, L), np.uint32),
+        direct=np.zeros((B, L), np.uint32),
+        chunk_base=np.zeros((B, L), np.int32),
+        span_start=np.zeros((B, L), np.int32),
+        span_end_off=np.zeros((B, L), np.int32),
+        side=np.zeros((B, L), np.int8),
+        cjk=np.zeros((B, L), np.int8),
+        script=np.zeros((B, L), np.int16),
+        chunk_script=np.zeros((B, C), np.int16),
+        chunk_cjk=np.zeros((B, C), np.int8),
+        chunk_side=np.zeros((B, C), np.int8),
+        direct_adds=np.zeros((B, max_direct, 2), np.int32),
+        text_bytes=np.zeros(B, np.int32),
+        fallback=np.zeros(B, bool),
+        n_docs=B,
+    )
+
+    for b, text in enumerate(texts):
+        spans = segment_text(text, tables)
+        slot = 0
+        chunk_base = 0
+        n_direct = 0
+        total = 0
+        ok = True
+        for span in spans:
+            total += span.text_bytes
+            rtype = reg.rtype(span.ulscript)
+            # Squeeze-trigger documents take the scalar path (rare/spam)
+            if rtype not in (RTYPE_NONE, RTYPE_ONE) and \
+                    (TEST_THRESH >> 1) < span.text_bytes and \
+                    cheap_squeeze_trigger_test(span.buf.tobytes(),
+                                               span.text_bytes):
+                ok = False
+                break
+            if rtype in (RTYPE_NONE, RTYPE_ONE):
+                if n_direct >= max_direct:
+                    ok = False
+                    break
+                out.direct_adds[b, n_direct] = (
+                    reg.default_language(span.ulscript), span.text_bytes)
+                n_direct += 1
+                continue
+            if span.text_bytes <= 1:
+                continue
+            cjk = rtype == RTYPE_CJK
+            recs = _pack_cjk_span(span, tables) if cjk \
+                else _pack_quad_span(span, tables)
+            if recs is None:
+                ok = False
+                break
+            recs.append(dict(kind=SEED, offset=1,
+                             direct=_seed_langprob(reg, span.ulscript)))
+            recs.sort(key=lambda r: (r["offset"], _PRIORITY[r["kind"]]))
+            # Worst-case chunk count if every base candidate hits
+            n_base_max = sum(1 for r in recs if r["kind"] in BASE_KINDS)
+            span_chunks = max(1, -(-n_base_max //
+                                   (50 if cjk else 20)) + 1)
+            if slot + len(recs) > L or chunk_base + span_chunks > C:
+                ok = False
+                break
+            side = 0 if span.ulscript == ULSCRIPT_LATIN else 1
+            for r in recs:
+                out.kind[b, slot] = r["kind"]
+                out.offset[b, slot] = r["offset"]
+                out.sub[b, slot] = r.get("sub", 0)
+                out.key[b, slot] = r.get("key", 0)
+                out.fp[b, slot] = r.get("fp", 0)
+                out.direct[b, slot] = r.get("direct", 0)
+                out.chunk_base[b, slot] = chunk_base
+                out.span_end_off[b, slot] = span.text_bytes
+                out.side[b, slot] = side
+                out.cjk[b, slot] = cjk
+                out.script[b, slot] = span.ulscript
+                slot += 1
+            start = slot - len(recs)
+            out.span_start[b, start:slot] = start
+            out.chunk_script[b, chunk_base:chunk_base + span_chunks] = \
+                span.ulscript
+            out.chunk_cjk[b, chunk_base:chunk_base + span_chunks] = cjk
+            out.chunk_side[b, chunk_base:chunk_base + span_chunks] = side
+            chunk_base += span_chunks
+        out.text_bytes[b] = total
+        out.fallback[b] = not ok
+    return out
