@@ -45,26 +45,21 @@ class LanguageDetector:
         self.tables = tables or load_tables()
         self.registry = reg or default_registry
         self.flags = flags
-        self._batch_engine = None  # lazily built JAX engine; False = absent
+        self._batch_engine = None  # lazily built batched JAX engine
 
     def detect(self, text: str) -> DetectionResult:
         r = detect_scalar(text, self.tables, self.registry, self.flags)
         return DetectionResult.from_scalar(r, self.registry)
 
     def detect_batch(self, texts: list[str]) -> list[DetectionResult]:
-        engine = self._get_batch_engine()
-        if not engine:
-            return [self.detect(t) for t in texts]
-        return engine.detect_batch(texts)
+        rs = self._get_batch_engine().detect_batch(texts)
+        return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
     def _get_batch_engine(self):
         if self._batch_engine is None:
-            try:
-                from .models.ngram import NgramBatchEngine
-                self._batch_engine = NgramBatchEngine(self.tables,
-                                                      self.registry)
-            except ImportError:
-                self._batch_engine = False  # don't re-attempt per call
+            from .models.ngram import NgramBatchEngine
+            self._batch_engine = NgramBatchEngine(self.tables, self.registry,
+                                                  self.flags)
         return self._batch_engine
 
 

@@ -1,0 +1,3 @@
+from .ngram import NgramBatchEngine
+
+__all__ = ["NgramBatchEngine"]

@@ -1,0 +1,152 @@
+"""Batched n-gram detection engine: the TPU hot path.
+
+Pipeline per batch (the TPU redesign of DetectLanguageSummaryV2,
+compact_lang_det_impl.cc:1707-2106):
+
+  host   pack_batch      texts -> fixed-shape candidate tensors
+  device score_batch     probes + totes + chunk summaries, one jitted program
+  host   _doc_epilogue   DocTote replay + close pairs + unreliable removal +
+                         summary language (O(1) per doc, scalar-exact)
+
+Documents the packer flags (squeeze triggers, slot overflow) and documents
+failing the recursion gate (impl.cc:1978-1991) fall back to the scalar
+engine, which performs the reference's re-score recursion. Everything else
+is batched: the result agrees with `detect_scalar` on every document
+(tests/test_batch_agreement.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH,
+                             GOOD_LANG1_PERCENT, GOOD_LANG1AND2_PERCENT,
+                             SHORT_TEXT_THRESH, DocTote, ScalarResult,
+                             calc_summary_lang, detect_scalar,
+                             extract_lang_etc, refine_close_pairs,
+                             remove_unreliable)
+from ..ops.device_tables import DeviceTables
+from ..ops.score import score_batch
+from ..preprocess.pack import PackedBatch, pack_batch
+from ..registry import Registry, registry as default_registry
+from ..tables import ScoringTables, load_tables
+
+# Per-slot / per-chunk arrays shipped to the device
+_DEVICE_FIELDS = ("kind", "offset", "sub", "key", "fp", "direct",
+                  "chunk_base", "span_start", "span_end_off", "side", "cjk",
+                  "chunk_script", "chunk_side")
+
+# Flags the device path supports. FLAG_FINISH and FLAG_BEST_EFFORT only
+# alter the host epilogue / packer gate; every other flag changes span
+# preprocessing or scoring dispatch (squeeze, repeat-strip, score-as-quads)
+# and routes the whole batch to the scalar engine.
+_DEVICE_OK_FLAGS = FLAG_FINISH | FLAG_BEST_EFFORT
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class NgramBatchEngine:
+    """Batched detector over a table artifact.
+
+    Batches are padded to power-of-two document counts so jit compiles a
+    small, reusable set of programs (static [B, L] shapes).
+    """
+
+    def __init__(self, tables: ScoringTables | None = None,
+                 reg: Registry | None = None, flags: int = 0,
+                 max_slots: int = 2048, max_chunks: int = 64):
+        self.tables = tables or load_tables()
+        self.reg = reg or default_registry
+        self.flags = flags
+        self.max_slots = max_slots
+        self.max_chunks = max_chunks
+        self.dt = DeviceTables.from_host(self.tables, self.reg)
+
+    # -- device dispatch ----------------------------------------------------
+
+    def score_packed(self, packed: PackedBatch) -> dict:
+        """Run the jitted device program over a packed batch; returns host
+        numpy chunk-summary arrays."""
+        p = {k: jnp.asarray(getattr(packed, k)) for k in _DEVICE_FIELDS}
+        out = score_batch(self.dt, p)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    # -- public API ---------------------------------------------------------
+
+    def detect_batch(self, texts: list[str]) -> list[ScalarResult]:
+        if not texts:
+            return []
+        if self.flags & ~_DEVICE_OK_FLAGS:
+            return [detect_scalar(t, self.tables, self.reg, self.flags)
+                    for t in texts]
+        bsz = _next_pow2(len(texts))
+        padded = list(texts) + [""] * (bsz - len(texts))
+        packed = pack_batch(padded, self.tables, self.reg,
+                            max_slots=self.max_slots,
+                            max_chunks=self.max_chunks, flags=self.flags)
+        out = self.score_packed(packed)
+        results = []
+        for b, text in enumerate(texts):
+            if packed.fallback[b]:
+                results.append(detect_scalar(text, self.tables, self.reg,
+                                             self.flags))
+                continue
+            r = self._doc_epilogue(packed, out, b)
+            if r is None:  # failed the good-answer gate: scalar recursion
+                r = detect_scalar(text, self.tables, self.reg, self.flags)
+            results.append(r)
+        return results
+
+    # -- exact host epilogue ------------------------------------------------
+
+    def _doc_epilogue(self, packed: PackedBatch, out: dict,
+                      b: int) -> ScalarResult | None:
+        """DocTote replay in chunk-id (= span) order, then the document
+        post-processing pipeline, byte-identical to detect_scalar
+        (impl.cc:1956-2106). Returns None when the good-answer gate fails
+        and the reference would recurse."""
+        doc_tote = DocTote()
+        direct = {int(cid): (int(lang), int(nb))
+                  for cid, lang, nb in packed.direct_adds[b] if cid >= 0}
+        real = out["chunk_real"][b]
+        lang1 = out["chunk_lang1"][b]
+        cbytes = out["chunk_bytes"][b]
+        score1 = out["chunk_score1"][b]
+        crel = out["chunk_rel"][b]
+        for c in range(len(real)):
+            if c in direct:
+                lang, nb = direct[c]
+                doc_tote.add(lang, nb, nb, 100)
+            elif real[c]:
+                doc_tote.add(int(lang1[c]), int(cbytes[c]), int(score1[c]),
+                             int(crel[c]))
+        total_text_bytes = int(packed.text_bytes[b])
+        flags = self.flags
+
+        refine_close_pairs(self.reg, doc_tote)
+        doc_tote.sort()
+        lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
+            doc_tote, total_text_bytes)
+
+        good = (flags & FLAG_FINISH) or total <= SHORT_TEXT_THRESH or \
+            (is_reliable and percent3[0] >= GOOD_LANG1_PERCENT) or \
+            (is_reliable and
+             percent3[0] + percent3[1] >= GOOD_LANG1AND2_PERCENT)
+        if not good:
+            return None
+
+        if not (flags & FLAG_BEST_EFFORT):
+            remove_unreliable(self.reg, doc_tote)
+        doc_tote.sort()
+        lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
+            doc_tote, total_text_bytes)
+        summary, reliable = calc_summary_lang(self.reg, lang3, percent3,
+                                              total, is_reliable, flags)
+        return ScalarResult(summary_lang=summary, language3=lang3,
+                            percent3=percent3, normalized_score3=ns3,
+                            text_bytes=total, is_reliable=reliable)

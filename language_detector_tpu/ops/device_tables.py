@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,13 +16,14 @@ from ..registry import Registry
 from ..tables import NgramTable, ScoringTables
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DeviceNgramTable:
     buckets: jnp.ndarray   # [size, 4] uint32
     ind: jnp.ndarray       # [n] uint32
-    size_one: int
-    size: int
-    keymask: int
+    size_one: int = dataclasses.field(metadata=dict(static=True))
+    size: int = dataclasses.field(metadata=dict(static=True))
+    keymask: int = dataclasses.field(metadata=dict(static=True))
 
     @classmethod
     def from_host(cls, t: NgramTable) -> "DeviceNgramTable":
@@ -30,6 +32,7 @@ class DeviceNgramTable:
                    size_one=t.size_one, size=t.size, keymask=t.keymask)
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DeviceTables:
     quadgram: DeviceNgramTable
@@ -46,7 +49,7 @@ class DeviceTables:
     close_set: jnp.ndarray         # [614] int32 close-set id
     closest_alt: jnp.ndarray       # [614] int32 closest alternate (or 26)
     is_figs: jnp.ndarray           # [614] bool
-    quad2_enabled: bool
+    quad2_enabled: bool = dataclasses.field(metadata=dict(static=True))
 
     @classmethod
     def from_host(cls, t: ScoringTables, reg: Registry) -> "DeviceTables":

@@ -1,7 +1,8 @@
-"""Batched device scoring: packed candidates -> per-document results.
+"""Batched device scoring: packed candidates -> per-chunk summaries.
 
-The entire hot path of detection runs here as one jitted program of
-fixed-shape tensor ops over a [B, L] candidate batch:
+The hot path of detection (compact_lang_det_impl.cc:1707-2106 ->
+cldutil.cc:315-533) runs here as one jitted program of fixed-shape tensor
+ops over a [B, L] candidate batch:
 
   1. 4-way-associative table probes               (vectorized gathers)
   2. quad repeat filter                            (lax.scan, tiny state)
@@ -9,20 +10,14 @@ fixed-shape tensor ops over a [B, L] candidate batch:
   4. chunk assignment                              (closed-form ranks)
   5. chunk totes over 256 per-script languages     (segment sums)
   6. top-2 + reliability per chunk                 (top_k + elementwise)
-  7. document accumulation over 614 languages      (scatter adds)
-  8. close pairs, unreliable-language removal,
-     top-3 extraction, summary language            (vectorized [B, 614])
+  7. chunk summaries [B, C]                        (lang1/bytes/score/rel)
 
-Semantics follow the scalar engine (engine_scalar.py, itself validated
-against the compiled reference) with two documented approximations, both
-exercised by tests/test_batch_agreement.py:
-  - the 24-slot DocTote's set-associative eviction is replaced by dense
-    accumulation (divergence only for documents with many languages);
-  - tie-breaks in doc-level sorting use language id, not insertion order.
+The per-document epilogue (DocTote replay, close pairs, unreliable-language
+removal, summary language — all O(1) per doc) runs on the host in
+models/ngram.py, reusing the oracle-validated scalar code, so the batched
+path agrees with the scalar engine exactly (tests/test_batch_agreement.py).
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +31,6 @@ PAD, SEED, QUAD, UNI, DELTA_OCTA, DISTINCT_OCTA, BI_DELTA, BI_DISTINCT = \
 
 CHUNK_QUADS = 20
 CHUNK_UNIS = 50
-UNKNOWN = 26
-TG_UNKNOWN = 25
-ENGLISH = 0
-MIN_RELIABLE_KEEP = 41
 MAX_BOOST_RANKS = 256
 
 
@@ -110,16 +101,6 @@ def _chunk_of_rank(r, n_quota, chunksize):
     return jnp.where(in_full, r // c, k_full + tail_chunk)
 
 
-def _n_chunks(n_quota, chunksize):
-    c = chunksize
-    n = n_quota
-    k_full = jnp.where(n < 2 * c, 0, (n - 2 * c) // c + 1)
-    tail = n - k_full * c
-    tail_chunks = jnp.where(tail == 0, 0,
-                            jnp.where(tail < c + (c >> 1), 1, 2))
-    return jnp.maximum(k_full + tail_chunks, 1)  # dummy chunk when no bases
-
-
 def _decode3(lp):
     """langprob -> pslangs [.., 3] and group row index for qprob decode."""
     lp = lp.astype(jnp.uint32)
@@ -155,8 +136,8 @@ def _lscript4(script):
                      jnp.where(script == 3, 1, jnp.where(script == 6, 2, 3)))
 
 
-@functools.partial(jax.jit, static_argnames=("num_langs",))
-def score_batch(dt: DeviceTables, p: dict, num_langs: int = 614):
+@jax.jit
+def score_batch(dt: DeviceTables, p: dict):
     """Score one packed batch; p holds the PackedBatch arrays as jnp."""
     kind = p["kind"].astype(jnp.int32)            # [B, L]
     B, L = kind.shape
@@ -183,8 +164,6 @@ def score_batch(dt: DeviceTables, p: dict, num_langs: int = 614):
                                   span_begin)
 
     # ---- 3. langprob resolution ------------------------------------------
-    q_idx = jnp.where(kv_quad != 0, kv_quad & nk(dt.quadgram),
-                      kv_quad2 & nk(dt.quadgram2))
     use2 = kv_quad == 0
     qa1, qb1 = _resolve_base(dt.quadgram, kv_quad & nk(dt.quadgram))
     qa2, qb2 = _resolve_base(dt.quadgram2, kv_quad2 & nk(dt.quadgram2))
@@ -328,10 +307,7 @@ def score_batch(dt: DeviceTables, p: dict, num_langs: int = 614):
         bps].add(bval)
     scores = scores + bseg_scores
 
-    # group-in-use mask: any add (hits or boosts) touches pslang's group
-    used = jnp.zeros((B, C, 256), bool)
-    hit_ps = jnp.where((valid_a & (ps_a[..., 0] >= 0))[..., None] &
-                       (ps_a > 0), ps_a, 0)
+    # group-in-use mask: any add (hits or boosts) touches pslang's group;
     # scatter group marks via segment_max on 4-slot groups
     def mark(ps, ok):
         seg = (flat_chunk[..., None] * 64 + (ps >> 2)).reshape(-1)
@@ -341,7 +317,7 @@ def score_batch(dt: DeviceTables, p: dict, num_langs: int = 614):
                                    num_segments=(B * C + 1) * 64)
 
     groups = mark(ps_a, valid_a) | mark(ps_b, valid_b)
-    groups = groups[:B * C].reshape(B, C, 64)
+    groups = groups[:B * C * 64].reshape(B, C, 64)
     bgroups = jnp.zeros((B, C, 64), jnp.int32)
     bgroups = bgroups.at[
         jnp.arange(B)[:, None, None, None],
@@ -410,221 +386,14 @@ def score_batch(dt: DeviceTables, p: dict, num_langs: int = 614):
     rs = _reliability_expected(actual_kb, expected_kb)
     crel = jnp.minimum(rd, rs)
 
-    # ---- 7. document accumulation ----------------------------------------
-    NL = num_langs
-    lang_scatter = jnp.where(real, lang1, NL)
-    flat_doc = (jnp.arange(B)[:, None] * (NL + 1) + lang_scatter).reshape(-1)
-
-    def doc_sum(val):
-        return jax.ops.segment_sum(
-            jnp.where(real, val, 0).reshape(-1), flat_doc,
-            num_segments=B * (NL + 1)).reshape(B, NL + 1)[:, :NL]
-
-    d_bytes = doc_sum(cbytes)
-    d_score = doc_sum(s1)
-    d_rel = doc_sum(crel * cbytes)
-
-    # RTypeNone/One spans: default language credited 1 point/byte, rel 100
-    da_lang = p["direct_adds"][..., 0].astype(jnp.int32)       # [B, 4]
-    da_bytes = p["direct_adds"][..., 1].astype(jnp.int32)
-    da_ok = da_bytes > 0
-    da_target = jnp.where(da_ok, da_lang, NL)
-    flat_da = (jnp.arange(B)[:, None] * (NL + 1) + da_target).reshape(-1)
-
-    def da_sum(val):
-        return jax.ops.segment_sum(
-            val.reshape(-1), flat_da,
-            num_segments=B * (NL + 1)).reshape(B, NL + 1)[:, :NL]
-
-    d_bytes = d_bytes + da_sum(da_bytes)
-    d_score = d_score + da_sum(da_bytes)
-    d_rel = d_rel + da_sum(100 * da_bytes)
-
-    total_bytes = p["text_bytes"].astype(jnp.int32)
-
-    return doc_postprocess(dt, d_bytes, d_score, d_rel, total_bytes,
-                           num_langs)
-
-
-def doc_postprocess(dt: DeviceTables, d_bytes, d_score, d_rel, total_bytes,
-                    num_langs=614):
-    """Close pairs -> gate extract -> remove unreliable -> summary language
-    (compact_lang_det_impl.cc:1956-2106), dense over [B, num_langs]."""
-    B = d_bytes.shape[0]
-    NL = num_langs
-    langs = jnp.arange(NL)
-
-    # ---- close pairs: winner takes the set's bytes/score/rel -------------
-    cs = dt.close_set[:NL]
-    present = d_bytes > 0
-    for set_id in range(1, 10):
-        members = (cs == set_id) & present
-        set_bytes = jnp.sum(jnp.where(members, d_bytes, 0), axis=1,
-                            keepdims=True)
-        set_score = jnp.sum(jnp.where(members, d_score, 0), axis=1,
-                            keepdims=True)
-        set_rel = jnp.sum(jnp.where(members, d_rel, 0), axis=1,
-                          keepdims=True)
-        any2 = jnp.sum(members.astype(jnp.int32), axis=1,
-                       keepdims=True) >= 2
-        winner_key = jnp.where(members, d_bytes * NL + (NL - 1 - langs), -1)
-        winner = jnp.argmax(winner_key, axis=1)[:, None]
-        is_winner = langs[None, :] == winner
-        d_bytes = jnp.where(any2 & members,
-                            jnp.where(is_winner, set_bytes, 0), d_bytes)
-        d_score = jnp.where(any2 & members,
-                            jnp.where(is_winner, set_score, 0), d_score)
-        d_rel = jnp.where(any2 & members,
-                          jnp.where(is_winner, set_rel, 0), d_rel)
-        present = d_bytes > 0
-
-    def extract(db, ds, dr, total):
-        """ExtractLangEtc over dense doc arrays."""
-        skip = (langs[None, :] == UNKNOWN)
-        key = jnp.where((db > 0) & ~skip, db * NL + (NL - 1 - langs), -1)
-        top, topl = jax.lax.top_k(key, 3)
-        lang3 = jnp.where(top >= 0, topl, UNKNOWN)
-        bc3 = jnp.where(top >= 0, jnp.take_along_axis(db, topl, axis=1), 0)
-        rel3 = jnp.where(
-            top >= 0,
-            jnp.take_along_axis(dr, topl, axis=1) //
-            jnp.maximum(jnp.take_along_axis(db, topl, axis=1), 1), 0)
-        sc3 = jnp.where(top >= 0, jnp.take_along_axis(ds, topl, axis=1), 0)
-        ns3 = jnp.where(bc3 > 0, (sc3 << 10) // jnp.maximum(bc3, 1), 0)
-        total = jnp.maximum(total, bc3.sum(axis=1))
-        div = jnp.maximum(total, 1)[:, None]
-        p0 = bc3[:, :1] * 100 // div
-        p1 = (bc3[:, :1] + bc3[:, 1:2]) * 100 // div
-        p2 = bc3.sum(axis=1, keepdims=True) * 100 // div
-        pc0, pc1, pc2 = p0, p1 - p0, p2 - p1
-        bump1 = pc1 < pc2
-        pc1 = jnp.where(bump1, pc1 + 1, pc1)
-        pc2 = jnp.where(bump1, pc2 - 1, pc2)
-        bump0 = pc0 < pc1
-        pc0 = jnp.where(bump0, pc0 + 1, pc0)
-        pc1 = jnp.where(bump0, pc1 - 1, pc1)
-        percent3 = jnp.concatenate([pc0, pc1, pc2], axis=1)
-        reliable = (lang3[:, 0] != UNKNOWN) & \
-            (rel3[:, 0] >= MIN_RELIABLE_KEEP)
-        ignore = 100 - percent3.sum(axis=1)
-        reliable = reliable & (ignore <= 20)
-        return lang3, percent3, rel3, ns3, total, reliable
-
-    lang3_pre, percent3_pre, _, _, total_pre, reliable_pre = extract(
-        d_bytes, d_score, d_rel, total_bytes)
-
-    # decision gate (impl.cc:1978-1991)
-    gate_ok = (total_pre <= 256) | \
-        (reliable_pre & (percent3_pre[:, 0] >= 70)) | \
-        (reliable_pre &
-         ((percent3_pre[:, 0] + percent3_pre[:, 1]) >= 93))
-
-    # ---- remove unreliable languages -------------------------------------
-    relpct = d_rel // jnp.maximum(d_bytes, 1)
-    weak = (d_bytes > 0) & (relpct < MIN_RELIABLE_KEEP)
-    alt = dt.closest_alt[:NL][None, :] * jnp.ones((B, 1), jnp.int32)
-    alt_bytes = jnp.take_along_axis(d_bytes, alt, axis=1)
-    alt_rel = jnp.take_along_axis(d_rel, alt, axis=1)
-    alt_relpct = alt_rel // jnp.maximum(alt_bytes, 1)
-    can_merge = weak & (alt != UNKNOWN) & (alt_bytes > 0) & \
-        (jnp.take_along_axis(weak.astype(jnp.int32), alt, axis=1) == 0)
-    # merge direction: into the more reliable side (ties -> lower id wins
-    # toward lang when lang < alt, mirroring impl.cc:1036-1041)
-    into_alt = can_merge & ((alt_relpct > relpct) |
-                            ((alt_relpct == relpct) & (alt < langs[None, :])))
-    into_self = can_merge & ~into_alt
-    newpct = jnp.maximum(jnp.maximum(relpct, alt_relpct), MIN_RELIABLE_KEEP)
-    newbytes = d_bytes + alt_bytes
-    # apply into_alt merges: move self into alt
-    move_bytes = jnp.zeros_like(d_bytes)
-    move_bytes = move_bytes.at[jnp.arange(B)[:, None], alt].add(
-        jnp.where(into_alt, d_bytes, 0))
-    merged_to_alt = jnp.take_along_axis(
-        jnp.where(into_alt, 1, 0), jnp.argsort(alt, axis=1), axis=1)
-    # For simplicity apply symmetric updates via masks (validated by
-    # agreement tests; chains of merges are approximated)
-    rcv_bytes = jnp.zeros_like(d_bytes).at[
-        jnp.arange(B)[:, None], alt].add(jnp.where(into_alt, d_bytes, 0))
-    rcv_from = jnp.zeros_like(d_bytes).at[
-        jnp.arange(B)[:, None], alt].max(jnp.where(into_alt, 1, 0))
-    # replicate the reference quirk: merged slot's score becomes newbytes
-    d_score2 = jnp.where(into_self, newbytes,
-                         jnp.where(into_alt, 0, d_score))
-    d_score2 = jnp.where(rcv_from > 0, d_bytes + rcv_bytes, d_score2)
-    d_rel2 = jnp.where(into_self, newpct * newbytes,
-                       jnp.where(into_alt, 0, d_rel))
-    alt_newpct = jnp.maximum(
-        jnp.maximum(relpct, alt_relpct), MIN_RELIABLE_KEEP)
-    rcv_pct = jnp.zeros_like(d_rel).at[
-        jnp.arange(B)[:, None], alt].max(jnp.where(into_alt, alt_newpct, 0))
-    d_rel2 = jnp.where(rcv_from > 0, rcv_pct * (d_bytes + rcv_bytes), d_rel2)
-    d_bytes2 = jnp.where(into_alt, 0, d_bytes)
-    # NOTE: the reference stores merged byte totals in score_, not value_
-    # (impl.cc:1052); d_bytes2 keeps the original quirk by NOT adding
-    # rcv_bytes to the winner's byte count.
-    keep_bytes = jnp.where(into_self, d_bytes, d_bytes2)
-
-    relpct2 = d_rel2 // jnp.maximum(keep_bytes, 1)
-    still_weak = (keep_bytes > 0) & (relpct2 < MIN_RELIABLE_KEEP) & \
-        ~into_self & (rcv_from == 0)
-    final_bytes = jnp.where(still_weak, 0, keep_bytes)
-    final_score = jnp.where(still_weak, 0, d_score2)
-    final_rel = jnp.where(still_weak, 0, d_rel2)
-
-    lang3, percent3, rel3, ns3, total, reliable = extract(
-        final_bytes, final_score, final_rel, total_bytes)
-
-    # ---- summary language (CalcSummaryLang, impl.cc:1414-1522) -----------
-    summary, sum_reliable = _calc_summary(dt, lang3, percent3, total,
-                                          reliable)
-    return dict(summary_lang=summary, lang3=lang3, percent3=percent3,
-                ns3=ns3, text_bytes=total,
-                is_reliable=reliable & sum_reliable, gate_ok=gate_ok)
-
-
-def _calc_summary(dt: DeviceTables, lang3, percent3, total, is_reliable):
-    l0, l1, l2 = lang3[:, 0], lang3[:, 1], lang3[:, 2]
-    p0, p1, p2 = percent3[:, 0], percent3[:, 1], percent3[:, 2]
-    figs = dt.is_figs
-
-    # TG_UNKNOWN ("Ignore") removal: shift actives up
-    ign0 = l0 == TG_UNKNOWN
-    ign1 = l1 == TG_UNKNOWN
-    ign2 = l2 == TG_UNKNOWN
-    ignore_pct = jnp.where(ign0, p0, 0) + jnp.where(ign1, p1, 0) + \
-        jnp.where(ign2, p2, 0)
-    a0 = jnp.where(ign0, l1, l0)
-    a0p = jnp.where(ign0, p1, p0)
-    a1 = jnp.where(ign0, l2, jnp.where(ign1, l2, l1))
-    a1p = jnp.where(ign0, p2, jnp.where(ign1, p2, p1))
-    summary = jnp.where(ign0 | ign1 | ign2,
-                        a0, l0)
-    return_pct = jnp.where(ign0 | ign1 | ign2,
-                           (p0 * 100) // (101 - ignore_pct), p0)
-    reliable = ~(p0 < 2)
-    reliable = jnp.where((ign0 | ign1 | ign2) & (a0p < 2), False, reliable)
-
-    second_bytes = (total * a1p) // 100
-    en_boiler = (a0 == ENGLISH) & (a1 != ENGLISH) & (a1 != UNKNOWN) & \
-        (a1p >= 17) & (second_bytes >= 15)
-    figs_boiler = figs[a0] & ~(figs[a1] | (a1 == ENGLISH)) & \
-        (a1 != UNKNOWN) & (a1p >= 20) & (second_bytes >= 15)
-    demote = en_boiler | figs_boiler
-    ignore2 = ignore_pct + jnp.where(demote, a0p, 0)
-    summary = jnp.where(demote, a1, summary)
-    return_pct = jnp.where(demote, (a1p * 100) // (101 - ignore2),
-                           return_pct)
-    reliable = jnp.where(demote & (a1p < 2), False, reliable)
-
-    second_en = ~demote & (a1 == ENGLISH) & (a0 != ENGLISH)
-    second_figs = ~demote & figs[a1] & ~(figs[a0] | (a0 == ENGLISH))
-    ignore3 = ignore2 + jnp.where(second_en | second_figs, a1p, 0)
-    return_pct = jnp.where(second_en | second_figs,
-                           (a0p * 100) // (101 - ignore3), return_pct)
-
-    summary = jnp.where(return_pct < 26, UNKNOWN, summary)
-    reliable = jnp.where(return_pct < 26, False, reliable)
-    reliable = jnp.where(return_pct < 51, False, reliable)
-    ignore_final = 100 - (p0 + p1 + p2)
-    reliable = jnp.where(ignore_final > 20, False, reliable)
-    return summary, reliable & is_reliable
+    # ---- 7. chunk summary outputs ----------------------------------------
+    # The document epilogue (DocTote replay, close pairs, unreliable-language
+    # removal, summary language) runs on the host over these [B, C] arrays,
+    # reusing the oracle-validated scalar code (models/ngram.py). Chunk ids
+    # are allocated in span order by the packer, so replaying chunks by id
+    # reproduces the scalar engine's DocTote insertion order exactly.
+    return dict(
+        chunk_lang1=lang1, chunk_lang2=lang2, chunk_bytes=cbytes,
+        chunk_score1=s1, chunk_score2=s2, chunk_grams=grams,
+        chunk_rel=crel, chunk_rel_delta=rd, chunk_rel_score=rs,
+        chunk_real=real)

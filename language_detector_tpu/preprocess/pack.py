@@ -56,7 +56,9 @@ class PackedBatch:
     chunk_script: np.ndarray  # int16 ULScript of the chunk's span
     chunk_cjk: np.ndarray     # int8
     chunk_side: np.ndarray    # int8
-    # Direct doc-tote adds for RTypeNone/One spans [B, 4, 2] (lang, bytes)
+    # Direct doc-tote adds for RTypeNone/One spans [B, 4, 3]
+    # (chunk_id, lang, bytes): each add owns a chunk id so the host epilogue
+    # can replay all doc-tote adds in original span order.
     direct_adds: np.ndarray
     # Per-doc [B]
     text_bytes: np.ndarray    # int32 total scored text bytes
@@ -164,7 +166,13 @@ _PRIORITY = {SEED: -1, DELTA_OCTA: 0, BI_DELTA: 0, DISTINCT_OCTA: 1,
 
 def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
                max_slots: int = 2048, max_chunks: int = 64,
-               max_direct: int = 4) -> PackedBatch:
+               max_direct: int = 4, flags: int = 0) -> PackedBatch:
+    """Pack a batch for device scoring.
+
+    `flags` are the engine's scoring flags: FLAG_FINISH (bit 0,
+    compact_lang_det_impl.h:31) disables the squeeze-trigger fallback test,
+    matching the scalar engine's recursion guard."""
+    from ..engine_scalar import FLAG_FINISH
     B = len(texts)
     L, C = max_slots, max_chunks
     out = PackedBatch(
@@ -183,7 +191,7 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
         chunk_script=np.zeros((B, C), np.int16),
         chunk_cjk=np.zeros((B, C), np.int8),
         chunk_side=np.zeros((B, C), np.int8),
-        direct_adds=np.zeros((B, max_direct, 2), np.int32),
+        direct_adds=np.full((B, max_direct, 3), -1, np.int32),
         text_bytes=np.zeros(B, np.int32),
         fallback=np.zeros(B, bool),
         n_docs=B,
@@ -199,20 +207,23 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
         for span in spans:
             total += span.text_bytes
             rtype = reg.rtype(span.ulscript)
-            # Squeeze-trigger documents take the scalar path (rare/spam)
-            if rtype not in (RTYPE_NONE, RTYPE_ONE) and \
+            # Squeeze-trigger documents take the scalar path (rare/spam);
+            # the scalar engine tests every span (impl.cc:1866-1901).
+            if not (flags & FLAG_FINISH) and \
                     (TEST_THRESH >> 1) < span.text_bytes and \
                     cheap_squeeze_trigger_test(span.buf.tobytes(),
                                                span.text_bytes):
                 ok = False
                 break
             if rtype in (RTYPE_NONE, RTYPE_ONE):
-                if n_direct >= max_direct:
+                if n_direct >= max_direct or chunk_base >= C:
                     ok = False
                     break
                 out.direct_adds[b, n_direct] = (
-                    reg.default_language(span.ulscript), span.text_bytes)
+                    chunk_base, reg.default_language(span.ulscript),
+                    span.text_bytes)
                 n_direct += 1
+                chunk_base += 1
                 continue
             if span.text_bytes <= 1:
                 continue

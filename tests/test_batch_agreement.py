@@ -1,0 +1,105 @@
+"""Device/batched engine vs scalar engine agreement.
+
+The batched TPU path (preprocess/pack.py -> ops/score.py -> host epilogue in
+models/ngram.py) must produce byte-identical results to the scalar engine
+(engine_scalar.py, itself oracle-parity-tested) on every document: the 402
+reference golden paragraphs, randomized mixed-script composites, and the
+fallback/edge paths (spam squeezing, empty and tiny inputs).
+
+All batches use one fixed [64, 2048] shape so the scoring program compiles
+once per session (cached persistently in .jax_cache/).
+"""
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from golden_data import golden_pairs  # noqa: E402
+
+BATCH = 64
+
+
+@pytest.fixture(scope="session")
+def engine():
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine()
+
+
+def _result_tuple(r):
+    return (r.summary_lang, tuple(r.language3), tuple(r.percent3),
+            tuple(r.normalized_score3), r.text_bytes, r.is_reliable)
+
+
+def _assert_batch_agrees(engine, texts):
+    from language_detector_tpu.engine_scalar import detect_scalar
+    padded = texts + [""] * (-len(texts) % BATCH)
+    got = []
+    for i in range(0, len(padded), BATCH):
+        got.extend(engine.detect_batch(padded[i:i + BATCH]))
+    bad = []
+    for i, t in enumerate(texts):
+        want = detect_scalar(t, engine.tables, engine.reg)
+        if _result_tuple(got[i]) != _result_tuple(want):
+            bad.append((i, t[:60], _result_tuple(got[i]),
+                        _result_tuple(want)))
+    assert not bad, f"{len(bad)} disagreements, first: {bad[0]}"
+
+
+def _golden_texts():
+    pairs = golden_pairs()
+    if not pairs:
+        pytest.skip("reference snapshot unavailable")
+    return [t.decode("utf-8", errors="replace") for _, _, t in pairs]
+
+
+def test_golden_agreement(engine):
+    """Device == scalar on every reference golden paragraph."""
+    _assert_batch_agrees(engine, _golden_texts())
+
+
+def test_random_mixed_script_agreement(engine):
+    """Composites spliced from random golden fragments: multi-span,
+    multi-script documents, including CJK+Latin mixes."""
+    texts = _golden_texts()
+    rng = random.Random(20260729)
+    docs = []
+    for _ in range(BATCH):
+        parts = []
+        for _ in range(rng.randint(1, 4)):
+            t = texts[rng.randrange(len(texts))]
+            lo = rng.randrange(max(1, len(t) - 200))
+            parts.append(t[lo:lo + rng.randint(40, 200)])
+        docs.append(" ".join(parts))
+    _assert_batch_agrees(engine, docs)
+
+
+def test_fallback_spam_agreement(engine):
+    """Squeeze-trigger (repetitive) documents flag the scalar fallback in the
+    packer and still agree end-to-end."""
+    from language_detector_tpu.preprocess.pack import pack_batch
+    spam = ("buy cheap now " * 400).strip()
+    docs = [spam, "word " * 600, "The quick brown fox. " + "spam ham " * 300]
+    packed = pack_batch(docs, engine.tables, engine.reg)
+    assert packed.fallback.any(), "expected at least one fallback doc"
+    _assert_batch_agrees(engine, docs)
+
+
+def test_edge_inputs_agreement(engine):
+    """Empty, whitespace, single-char, digits, emoji, long-word inputs."""
+    docs = ["", " ", "\n\t ", "a", "123 456 789", "!!! ??? ...",
+            "🎉🎊🎈 🎉🎊🎈", "x" * 300,
+            "word " + "a" * 50 + " end",
+            "Ceci est un petit texte en français pour vérifier les accents."]
+    _assert_batch_agrees(engine, docs)
+
+
+def test_gate_failure_recursion_agreement(engine):
+    """Documents failing the good-answer gate (impl.cc:1978-1991) take the
+    scalar recursion and still agree."""
+    texts = _golden_texts()
+    # Mixed-language composites routinely land under the 70%/93% gates.
+    docs = [texts[i][:150] + " " + texts[(i * 7 + 3) % len(texts)][:150]
+            for i in range(0, 48)]
+    _assert_batch_agrees(engine, docs)
