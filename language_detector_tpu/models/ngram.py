@@ -59,13 +59,25 @@ class NgramBatchEngine:
 
     def __init__(self, tables: ScoringTables | None = None,
                  reg: Registry | None = None, flags: int = 0,
-                 max_slots: int = 2048, max_chunks: int = 64):
+                 max_slots: int = 2048, max_chunks: int = 64,
+                 mesh=None):
+        """mesh: optional jax.sharding.Mesh with a "batch" axis; when given,
+        batches shard over it data-parallel (parallel/mesh.py) and the
+        batch size rounds up to a multiple of the mesh size."""
         self.tables = tables or load_tables()
         self.reg = reg or default_registry
         self.flags = flags
         self.max_slots = max_slots
         self.max_chunks = max_chunks
         self.dt = DeviceTables.from_host(self.tables, self.reg)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import sharded_score_fn
+            self._score_fn = sharded_score_fn(mesh)
+            self._mesh_size = mesh.devices.size
+        else:
+            self._score_fn = score_batch
+            self._mesh_size = 1
 
     # -- device dispatch ----------------------------------------------------
 
@@ -73,7 +85,7 @@ class NgramBatchEngine:
         """Run the jitted device program over a packed batch; returns host
         numpy chunk-summary arrays."""
         p = {k: jnp.asarray(getattr(packed, k)) for k in _DEVICE_FIELDS}
-        out = score_batch(self.dt, p)
+        out = self._score_fn(self.dt, p)
         return {k: np.asarray(v) for k, v in out.items()}
 
     # -- public API ---------------------------------------------------------
@@ -85,6 +97,7 @@ class NgramBatchEngine:
             return [detect_scalar(t, self.tables, self.reg, self.flags)
                     for t in texts]
         bsz = _next_pow2(len(texts))
+        bsz += -bsz % self._mesh_size  # divisible over the mesh axis
         padded = list(texts) + [""] * (bsz - len(texts))
         packed = pack_batch(padded, self.tables, self.reg,
                             max_slots=self.max_slots,

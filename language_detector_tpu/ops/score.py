@@ -136,9 +136,12 @@ def _lscript4(script):
                      jnp.where(script == 3, 1, jnp.where(script == 6, 2, 3)))
 
 
-@jax.jit
-def score_batch(dt: DeviceTables, p: dict):
-    """Score one packed batch; p holds the PackedBatch arrays as jnp."""
+def score_batch_impl(dt: DeviceTables, p: dict):
+    """Score one packed batch; p holds the PackedBatch arrays as jnp.
+
+    Pure fixed-shape function of the batch: safe under jit, vmap-free
+    (already batched), and shard_map over the leading document axis
+    (documents are independent; every reduction is doc-local)."""
     kind = p["kind"].astype(jnp.int32)            # [B, L]
     B, L = kind.shape
     C = p["chunk_script"].shape[1]
@@ -397,3 +400,6 @@ def score_batch(dt: DeviceTables, p: dict):
         chunk_score1=s1, chunk_score2=s2, chunk_grams=grams,
         chunk_rel=crel, chunk_rel_delta=rd, chunk_rel_score=rs,
         chunk_real=real)
+
+
+score_batch = jax.jit(score_batch_impl)

@@ -1,0 +1,3 @@
+from .mesh import batch_mesh, sharded_score_fn
+
+__all__ = ["batch_mesh", "sharded_score_fn"]

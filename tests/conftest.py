@@ -1,13 +1,16 @@
-"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+"""Test configuration.
 
-Multi-chip hardware is unavailable in CI; all parallelism tests run against
-XLA's host-platform device partitioning, the same mechanism the driver's
-dryrun_multichip check uses.
+Single-device tests run on whatever backend jax picked at startup (the one
+real TPU chip here; plain CPU elsewhere). Multi-device sharding tests
+(test_sharding.py) spawn a subprocess with an 8-device virtual CPU mesh —
+env vars cannot retarget this process because the platform plugin imports
+jax before pytest starts.
 """
 import os
 import subprocess
 from pathlib import Path
 
+# Effective only where jax is not pre-imported at interpreter startup.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault(
     "XLA_FLAGS",

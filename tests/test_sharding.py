@@ -1,0 +1,65 @@
+"""Data-parallel mesh sharding: correctness over multiple devices.
+
+The 8-device run uses a virtual CPU mesh in a subprocess (the current
+process's backend is pinned to the single real chip by the platform plugin,
+so --xla_force_host_platform_device_count must be set before jax imports).
+This is the same mechanism the driver's dryrun_multichip check uses.
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _cpu_mesh_env(n: int) -> dict:
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU platform plugin out
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count={n}")
+    env["JAX_COMPILATION_CACHE_DIR"] = str(REPO / ".jax_cache")
+    return env
+
+
+def test_dryrun_multichip_8dev():
+    """__graft_entry__.dryrun_multichip(8): one sharded step over an
+    8-device mesh, scalar-exact results."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=REPO, env=_cpu_mesh_env(8), capture_output=True, text=True,
+        timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip ok" in r.stdout
+
+
+def test_sharded_equals_unsharded():
+    """shard_map over the batch axis returns the same chunk summaries as the
+    single-device program (4-device virtual CPU mesh)."""
+    code = """
+import numpy as np
+import __graft_entry__ as g
+from language_detector_tpu.models.ngram import NgramBatchEngine
+from language_detector_tpu.parallel.mesh import batch_mesh
+
+texts = g._TINY_TEXTS
+single = NgramBatchEngine(max_slots=256, max_chunks=16)
+packed = __import__('language_detector_tpu.preprocess.pack',
+                    fromlist=['pack_batch']).pack_batch(
+    texts, single.tables, single.reg, max_slots=256, max_chunks=16)
+a = single.score_packed(packed)
+sharded = NgramBatchEngine(max_slots=256, max_chunks=16, mesh=batch_mesh(4))
+b = sharded.score_packed(packed)
+for k in a:
+    assert np.array_equal(a[k], b[k]), k
+print("sharded==unsharded ok")
+"""
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       env=_cpu_mesh_env(4), capture_output=True, text=True,
+                       timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sharded==unsharded ok" in r.stdout
