@@ -1,0 +1,99 @@
+"""End-to-end throughput benchmark: prints ONE JSON line.
+
+Measures the batched detection pipeline (host pack -> device score -> host
+epilogue) in docs/sec on the available accelerator, and the stage split for
+diagnosis. vs_baseline is measured throughput / per-chip target, where the
+target is the BASELINE.json north star (1M docs/sec on v5e-8 = 125K
+docs/sec/chip at ~200-byte service documents).
+"""
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+PER_CHIP_TARGET = 1_000_000 / 8  # docs/sec (BASELINE.json north star, v5e-8)
+
+# Self-contained corpus: service-sized snippets in several scripts; padded
+# with index salt so quad repeat filters see realistic variety.
+_SEEDS = [
+    "The quick brown fox jumps over the lazy dog near the river bank today",
+    "Le gouvernement a annoncé de nouvelles mesures pour aider les familles",
+    "Der Hund läuft schnell durch den großen Wald und findet einen Knochen",
+    "El rápido zorro marrón salta sobre el perro perezoso cerca del río",
+    "Быстрая коричневая лиса прыгает через ленивую собаку сегодня утром",
+    "こんにちは世界。今日はとても良い天気ですね。散歩に行きましょう。",
+    "Η γρήγορη καφέ αλεπού πηδά πάνω από το τεμπέλικο σκυλί σήμερα",
+    "De snelle bruine vos springt over de luie hond bij de rivier vandaag",
+    "Il veloce volpe marrone salta sopra il cane pigro vicino al fiume",
+    "A rápida raposa marrom pula sobre o cachorro preguiçoso perto do rio",
+]
+
+
+def make_corpus(n: int) -> list:
+    """n service-like documents (~150-250 bytes) cycling scripts; word
+    order varies deterministically so the squeeze/repeat predictors see
+    natural text, not synthetic repetition."""
+    import random
+    rng = random.Random(42)
+    vocab = [s.split() for s in _SEEDS]
+    out = []
+    for i in range(n):
+        words = list(vocab[i % len(_SEEDS)])
+        rng.shuffle(words)
+        k = 18 + (i * 7) % 14
+        out.append(" ".join((words * 3)[:k]))
+    return out
+
+
+def bench(batch_size: int = 1024, n_batches: int = 4) -> dict:
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    from language_detector_tpu.preprocess.pack import pack_batch
+
+    eng = NgramBatchEngine()
+    docs = make_corpus(batch_size)
+    total_bytes = sum(len(d.encode()) for d in docs)
+
+    # Warm-up: compile + device transfer paths
+    eng.detect_batch(docs)
+
+    t0 = time.time()
+    for _ in range(n_batches):
+        results = eng.detect_batch(docs)
+    t_e2e = (time.time() - t0) / n_batches
+
+    # Stage split (one batch, informational)
+    t0 = time.time()
+    packed = pack_batch(docs, eng.tables, eng.reg, flags=eng.flags)
+    t_pack = time.time() - t0
+    t0 = time.time()
+    out = eng.score_packed(packed)
+    t_score = time.time() - t0
+    t0 = time.time()
+    for b in range(batch_size):
+        eng._doc_epilogue(packed, out, b)
+    t_epi = time.time() - t0
+
+    docs_sec = batch_size / t_e2e
+    return dict(
+        metric="batch_detect_throughput",
+        value=round(docs_sec, 1),
+        unit="docs/sec",
+        vs_baseline=round(docs_sec / PER_CHIP_TARGET, 4),
+        detail=dict(
+            batch_size=batch_size,
+            doc_bytes_avg=round(total_bytes / batch_size, 1),
+            mb_sec=round(total_bytes / t_e2e / 1e6, 2),
+            pack_ms=round(t_pack * 1e3, 1),
+            score_ms=round(t_score * 1e3, 1),
+            epilogue_ms=round(t_epi * 1e3, 1),
+            e2e_ms=round(t_e2e * 1e3, 1),
+            summary_sample=results[0].summary_lang,
+        ),
+    )
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench()))

@@ -31,16 +31,16 @@ from ..preprocess.pack import PackedBatch, pack_batch
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
-# Per-slot / per-chunk arrays shipped to the device
-_DEVICE_FIELDS = ("kind", "offset", "sub", "key", "fp", "direct",
-                  "chunk_base", "span_start", "span_end_off", "side", "cjk",
-                  "chunk_script", "chunk_side")
-
 # Flags the device path supports. FLAG_FINISH and FLAG_BEST_EFFORT only
 # alter the host epilogue / packer gate; every other flag changes span
 # preprocessing or scoring dispatch (squeeze, repeat-strip, score-as-quads)
 # and routes the whole batch to the scalar engine.
 _DEVICE_OK_FLAGS = FLAG_FINISH | FLAG_BEST_EFFORT
+
+# Candidate kinds carrying a raw fingerprint / direct payload in wire lane
+# w0 (everything else carries a precomputed (sub, key) pair)
+from ..preprocess.pack import (BI_DELTA, BI_DISTINCT, PAD, QUAD,  # noqa: E402
+                               SEED, UNI)
 
 
 def _next_pow2(n: int) -> int:
@@ -48,6 +48,51 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def _bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two >= n within [lo, hi] (shape bucketing: a small
+    set of compiled programs covers every batch)."""
+    b = lo
+    while b < n and b < hi:
+        b <<= 1
+    return b
+
+
+def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int) -> dict:
+    """PackedBatch -> minimal device wire format (see score_batch_impl).
+
+    Slices slot/chunk axes down to the smallest power-of-two bucket that
+    holds every used slot: short service documents ship a few hundred bytes
+    instead of the worst-case 40KB-document layout."""
+    used_slots = max(int(packed.n_slots.max(initial=1)), 1)
+    used_chunks = max(int(packed.n_chunks.max(initial=1)), 1)
+    L = _bucket(used_slots, 64, max_slots)
+    C = _bucket(used_chunks, 8, max_chunks)
+
+    kind = packed.kind[:, :L]
+    is_fp_kind = (kind == QUAD) | (kind == BI_DELTA) | (kind == BI_DISTINCT)
+    is_direct = (kind == SEED) | (kind == UNI)
+    w0 = np.where(is_fp_kind, packed.fp[:, :L],
+                  np.where(is_direct, packed.direct[:, :L],
+                           packed.sub[:, :L].astype(np.uint32)))
+    w1 = np.where(is_fp_kind | is_direct, np.uint32(0), packed.key[:, :L])
+    return dict(
+        slots_u8=np.stack(
+            [kind.astype(np.uint8), packed.side[:, :L].astype(np.uint8),
+             packed.cjk[:, :L].astype(np.uint8),
+             packed.chunk_base[:, :L].astype(np.uint8)], axis=-1),
+        slots_u16=np.stack(
+            [packed.offset[:, :L].astype(np.uint16),
+             packed.span_start[:, :L].astype(np.uint16),
+             packed.span_end_off[:, :L].astype(np.uint16)], axis=-1),
+        slots_u32=np.stack([w0.astype(np.uint32), w1.astype(np.uint32)],
+                           axis=-1),
+        chunk_u8=np.stack(
+            [packed.chunk_script[:, :C].astype(np.uint8),
+             packed.chunk_cjk[:, :C].astype(np.uint8),
+             packed.chunk_side[:, :C].astype(np.uint8)], axis=-1),
+    )
 
 
 class NgramBatchEngine:
@@ -81,12 +126,11 @@ class NgramBatchEngine:
 
     # -- device dispatch ----------------------------------------------------
 
-    def score_packed(self, packed: PackedBatch) -> dict:
-        """Run the jitted device program over a packed batch; returns host
-        numpy chunk-summary arrays."""
-        p = {k: jnp.asarray(getattr(packed, k)) for k in _DEVICE_FIELDS}
-        out = self._score_fn(self.dt, p)
-        return {k: np.asarray(v) for k, v in out.items()}
+    def score_packed(self, packed: PackedBatch) -> np.ndarray:
+        """Run the jitted device program over a packed batch; returns the
+        [B, C, 5] stacked chunk-summary array on host."""
+        p = to_wire(packed, self.max_slots, self.max_chunks)
+        return np.asarray(self._score_fn(self.dt, p))
 
     # -- public API ---------------------------------------------------------
 
@@ -117,7 +161,7 @@ class NgramBatchEngine:
 
     # -- exact host epilogue ------------------------------------------------
 
-    def _doc_epilogue(self, packed: PackedBatch, out: dict,
+    def _doc_epilogue(self, packed: PackedBatch, out: np.ndarray,
                       b: int) -> ScalarResult | None:
         """DocTote replay in chunk-id (= span) order, then the document
         post-processing pipeline, byte-identical to detect_scalar
@@ -126,18 +170,14 @@ class NgramBatchEngine:
         doc_tote = DocTote()
         direct = {int(cid): (int(lang), int(nb))
                   for cid, lang, nb in packed.direct_adds[b] if cid >= 0}
-        real = out["chunk_real"][b]
-        lang1 = out["chunk_lang1"][b]
-        cbytes = out["chunk_bytes"][b]
-        score1 = out["chunk_score1"][b]
-        crel = out["chunk_rel"][b]
-        for c in range(len(real)):
+        rows = out[b]  # [C, 5] lang1, bytes, score1, rel, real
+        for c in range(rows.shape[0]):
             if c in direct:
                 lang, nb = direct[c]
                 doc_tote.add(lang, nb, nb, 100)
-            elif real[c]:
-                doc_tote.add(int(lang1[c]), int(cbytes[c]), int(score1[c]),
-                             int(crel[c]))
+            elif rows[c, 4]:
+                doc_tote.add(int(rows[c, 0]), int(rows[c, 1]),
+                             int(rows[c, 2]), int(rows[c, 3]))
         total_text_bytes = int(packed.text_bytes[b])
         flags = self.flags
 

@@ -136,35 +136,67 @@ def _lscript4(script):
                      jnp.where(script == 3, 1, jnp.where(script == 6, 2, 3)))
 
 
-def score_batch_impl(dt: DeviceTables, p: dict):
-    """Score one packed batch; p holds the PackedBatch arrays as jnp.
+def _quad_sub_key(table, fp):
+    """Derive bucket subscript + probe key from a 32-bit fingerprint
+    (cldutil_shared.h:380-386); geometry is static per table."""
+    sub = ((fp + (fp >> jnp.uint32(12))) &
+           jnp.uint32(table.size - 1)).astype(jnp.int32)
+    return sub, fp & jnp.uint32(table.keymask)
 
-    Pure fixed-shape function of the batch: safe under jit, vmap-free
-    (already batched), and shard_map over the leading document axis
-    (documents are independent; every reduction is doc-local)."""
-    kind = p["kind"].astype(jnp.int32)            # [B, L]
+
+def score_batch_impl(dt: DeviceTables, p: dict):
+    """Score one packed batch into stacked chunk summaries.
+
+    p is the wire format built by models/ngram.py (minimum bytes over the
+    host->device link):
+      slots_u8  [B, L, 4] kind, side, cjk, chunk_base
+      slots_u16 [B, L, 3] offset, span_start, span_end_off
+      slots_u32 [B, L, 2] w0, w1 by kind: SEED/UNI -> (direct, 0);
+                QUAD / BI_* -> (fingerprint, 0), sub/key derived on device;
+                *_OCTA -> (precomputed sub, key) (40-bit hash needs uint64)
+      chunk_u8  [B, C, 3] script, cjk, side
+
+    Pure fixed-shape function: safe under jit and shard_map over the
+    leading document axis (documents are independent; every reduction is
+    doc-local)."""
+    kind = p["slots_u8"][..., 0].astype(jnp.int32)            # [B, L]
+    side = p["slots_u8"][..., 1].astype(jnp.int32)
     B, L = kind.shape
-    C = p["chunk_script"].shape[1]
-    offset = p["offset"].astype(jnp.int32)
-    sub = p["sub"].astype(jnp.int32)
-    key = p["key"].astype(jnp.uint32)
+    C = p["chunk_u8"].shape[1]
+    offset = p["slots_u16"][..., 0].astype(jnp.int32)
+    span_start = p["slots_u16"][..., 1].astype(jnp.int32)
+    span_end_off = p["slots_u16"][..., 2].astype(jnp.int32)
+    chunk_base = p["slots_u8"][..., 3].astype(jnp.int32)
+    cjk = p["slots_u8"][..., 2].astype(jnp.int32)
+    w0 = p["slots_u32"][..., 0].astype(jnp.uint32)
+    w1 = p["slots_u32"][..., 1].astype(jnp.uint32)
+    chunk_script = p["chunk_u8"][..., 0].astype(jnp.int32)
+    chunk_side = p["chunk_u8"][..., 2].astype(jnp.int32)
+    direct = w0
+    fp = w0
 
     # ---- 1. table probes -------------------------------------------------
-    kv_quad = _probe(dt.quadgram, sub, key)
-    kv_quad2 = _probe(dt.quadgram2, sub, key) if dt.quad2_enabled else \
-        jnp.zeros_like(kv_quad)
+    sub_q1, key_q1 = _quad_sub_key(dt.quadgram, fp)
+    kv_quad = _probe(dt.quadgram, sub_q1, key_q1)
+    if dt.quad2_enabled:
+        sub_q2, key_q2 = _quad_sub_key(dt.quadgram2, fp)
+        kv_quad2 = _probe(dt.quadgram2, sub_q2, key_q2)
+    else:
+        kv_quad2 = jnp.zeros_like(kv_quad)
+    sub, key = w0.astype(jnp.int32), w1   # octa records carry sub/key
     kv_delta = _probe(dt.deltaocta, sub, key)
     kv_dist = _probe(dt.distinctocta, sub, key)
-    kv_bid = _probe(dt.cjkdeltabi, sub, key)
-    kv_bix = _probe(dt.distinctbi, sub, key)
+    sub_bd, key_bd = _quad_sub_key(dt.cjkdeltabi, fp)
+    sub_bx, key_bx = _quad_sub_key(dt.distinctbi, fp)
+    kv_bid = _probe(dt.cjkdeltabi, sub_bd, key_bd)
+    kv_bix = _probe(dt.distinctbi, sub_bx, key_bx)
 
     nk = lambda t: jnp.uint32(~np.uint32(t.keymask))  # noqa: E731
 
     # ---- 2. quad repeat filter (needs hit knowledge) ---------------------
     quad_hit = (kind == QUAD) & ((kv_quad != 0) | (kv_quad2 != 0))
-    span_begin = jnp.arange(L)[None, :] == p["span_start"]
-    keep_quad = _quad_filter_scan(p["fp"].astype(jnp.uint32), quad_hit,
-                                  span_begin)
+    span_begin = jnp.arange(L)[None, :] == span_start
+    keep_quad = _quad_filter_scan(fp, quad_hit, span_begin)
 
     # ---- 3. langprob resolution ------------------------------------------
     use2 = kv_quad == 0
@@ -173,7 +205,7 @@ def score_batch_impl(dt: DeviceTables, p: dict):
     quad_lp_a = jnp.where(use2, qa2, qa1)
     quad_lp_b = jnp.where(use2, qb2, qb1)
     uni_lp_a, uni_lp_b = _resolve_base(dt.cjkcompat,
-                                       p["direct"].astype(jnp.uint32))
+                                       direct)
     n_do = len(dt.deltaocta.ind)
     n_xo = len(dt.distinctocta.ind)
     n_bd = len(dt.cjkdeltabi.ind)
@@ -191,7 +223,7 @@ def score_batch_impl(dt: DeviceTables, p: dict):
     lp_a = jnp.select(
         [kind == SEED, kind == QUAD, kind == UNI, kind == DELTA_OCTA,
          kind == DISTINCT_OCTA, kind == BI_DELTA, kind == BI_DISTINCT],
-        [p["direct"].astype(jnp.uint32), quad_lp_a, uni_lp_a,
+        [direct, quad_lp_a, uni_lp_a,
          jnp.where(kv_delta != 0, lp_delta, 0),
          jnp.where(kv_dist != 0, lp_dist, 0),
          jnp.where(kv_bid != 0, lp_bid, 0),
@@ -217,28 +249,28 @@ def score_batch_impl(dt: DeviceTables, p: dict):
 
     # ---- 4. chunk assignment ---------------------------------------------
     span_key = (jnp.arange(B)[:, None] * L +
-                p["span_start"].astype(jnp.int32))  # [B, L]
+                span_start)  # [B, L]
     flat_span = span_key.reshape(-1)
     n_records = jax.ops.segment_sum(
         base_record.reshape(-1).astype(jnp.int32), flat_span,
         num_segments=B * L).reshape(B, L)
     n_span_records = n_records[
-        jnp.arange(B)[:, None], p["span_start"].astype(jnp.int32)]
+        jnp.arange(B)[:, None], span_start]
 
     cum_entries = jnp.cumsum(entry_contrib, axis=1)
-    start_idx = p["span_start"].astype(jnp.int32)
+    start_idx = span_start
     cum_at_start = jnp.take_along_axis(cum_entries, start_idx, axis=1)
     contrib_at_start = jnp.take_along_axis(entry_contrib, start_idx, axis=1)
     cb_incl = cum_entries - cum_at_start + contrib_at_start
     cb_excl = cb_incl - entry_contrib  # consumed strictly before this slot
 
-    chunksize = jnp.where(p["cjk"] > 0, CHUNK_UNIS, CHUNK_QUADS)
+    chunksize = jnp.where(cjk > 0, CHUNK_UNIS, CHUNK_QUADS)
     quota = jnp.maximum(n_span_records, 0)
     # clip rank so overflow lands in the final chunk (forced end boundary)
     r = jnp.clip(cb_excl, 0, jnp.maximum(quota - 1, 0))
     local_chunk = jnp.where(quota == 0, 0,
                             _chunk_of_rank(r, quota, chunksize))
-    chunk_id = p["chunk_base"].astype(jnp.int32) + local_chunk
+    chunk_id = chunk_base + local_chunk
     chunk_id = jnp.clip(chunk_id, 0, C - 1)
 
     slot_valid = valid_a & (kind != PAD)
@@ -264,7 +296,6 @@ def score_batch_impl(dt: DeviceTables, p: dict):
 
     # Distinct-word rotating boosts: per doc per side, ranks of distinct hits
     is_distinct = ((kind == DISTINCT_OCTA) | (kind == BI_DISTINCT)) & valid_a
-    side = p["side"].astype(jnp.int32)
     d_latn = is_distinct & (side == 0)
     d_othr = is_distinct & (side == 1)
     cum_latn = jnp.cumsum(d_latn.astype(jnp.int32), axis=1)
@@ -290,7 +321,7 @@ def score_batch_impl(dt: DeviceTables, p: dict):
 
     dk_latn = chunk_cum(cum_latn)
     dk_othr = chunk_cum(cum_othr)
-    chunk_side = p["chunk_side"].astype(jnp.int32)       # [B, C]
+    # chunk_side: [B, C]
     dk = jnp.where(chunk_side == 0, dk_latn, dk_othr)
     src = jnp.where(chunk_side[..., None] == 0, lps_latn[:, None, :],
                     lps_othr[:, None, :])                # [B, C, R+1]
@@ -341,7 +372,7 @@ def score_batch_impl(dt: DeviceTables, p: dict):
         slot_valid.astype(jnp.int32).reshape(-1), flat_chunk_f,
         num_segments=B * C + 1)[:B * C].reshape(B, C)
     span_end = jax.ops.segment_max(
-        jnp.where(slot_valid, p["span_end_off"].astype(jnp.int32), 0)
+        jnp.where(slot_valid, span_end_off, 0)
         .reshape(-1), flat_chunk_f,
         num_segments=B * C + 1)[:B * C].reshape(B, C)
     span_of_chunk = jax.ops.segment_max(
@@ -368,7 +399,7 @@ def score_batch_impl(dt: DeviceTables, p: dict):
     k1 = jnp.where(top2[..., 0] >= 0, k1, 0)
     k2 = jnp.where(top2[..., 1] >= 0, k2, 0)
 
-    script = p["chunk_script"].astype(jnp.int32)
+    script = chunk_script
     rtype = dt.lang_rtype_default[script, 0]
     deflang = dt.lang_rtype_default[script, 1]
     side_idx = jnp.where(script == 1, 0, 1)
@@ -390,16 +421,18 @@ def score_batch_impl(dt: DeviceTables, p: dict):
     crel = jnp.minimum(rd, rs)
 
     # ---- 7. chunk summary outputs ----------------------------------------
-    # The document epilogue (DocTote replay, close pairs, unreliable-language
-    # removal, summary language) runs on the host over these [B, C] arrays,
-    # reusing the oracle-validated scalar code (models/ngram.py). Chunk ids
-    # are allocated in span order by the packer, so replaying chunks by id
+    # One stacked [B, C, 5] array (a single device->host transfer). The
+    # document epilogue (DocTote replay, close pairs, unreliable-language
+    # removal, summary language) runs on the host over it, reusing the
+    # oracle-validated scalar code (models/ngram.py). Chunk ids are
+    # allocated in span order by the packer, so replaying chunks by id
     # reproduces the scalar engine's DocTote insertion order exactly.
-    return dict(
-        chunk_lang1=lang1, chunk_lang2=lang2, chunk_bytes=cbytes,
-        chunk_score1=s1, chunk_score2=s2, chunk_grams=grams,
-        chunk_rel=crel, chunk_rel_delta=rd, chunk_rel_score=rs,
-        chunk_real=real)
+    return jnp.stack(
+        [lang1, cbytes, s1, crel, real.astype(jnp.int32)], axis=-1)
+
+
+# Lane order of the stacked score_batch output
+OUT_LANG1, OUT_BYTES, OUT_SCORE1, OUT_REL, OUT_REAL = range(5)
 
 
 score_batch = jax.jit(score_batch_impl)

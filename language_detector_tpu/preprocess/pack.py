@@ -63,6 +63,8 @@ class PackedBatch:
     # Per-doc [B]
     text_bytes: np.ndarray    # int32 total scored text bytes
     fallback: np.ndarray      # bool: needs scalar path
+    n_slots: np.ndarray       # int32 slots used (for wire-shape bucketing)
+    n_chunks: np.ndarray      # int32 chunk ids allocated
     n_docs: int
 
 
@@ -85,8 +87,6 @@ def _pack_quad_span(span: ScriptSpan, tables: ScoringTables):
         return None  # multi-round span -> scalar fallback
     qfps = quad_hash_v2(span.buf, qpos, qlens) if len(qpos) else \
         np.zeros(0, np.uint32)
-    qt = tables.quadgram
-    qsub, qkey = quad_subscript_key(qfps, qt.keymask, qt.size)
 
     wstarts, wlens, wpriors = word_positions(span.buf, 1, limit)
     wfps = octa_hash40(span.buf, wstarts, wlens) if len(wstarts) else \
@@ -123,8 +123,7 @@ def _pack_quad_span(span: ScriptSpan, tables: ScoringTables):
             break
 
     for i in range(len(qpos)):
-        recs.append(dict(kind=QUAD, offset=int(qpos[i]), sub=int(qsub[i]),
-                         key=int(qkey[i]), fp=int(qfps[i])))
+        recs.append(dict(kind=QUAD, offset=int(qpos[i]), fp=int(qfps[i])))
     return recs
 
 
@@ -146,15 +145,15 @@ def _pack_cjk_span(span: ScriptSpan, tables: ScoringTables):
     idx = np.flatnonzero(ok)
     if len(idx):
         fps = bi_hash_v2(span.buf, starts[idx], len2[idx])
-        bt, xt = tables.cjkdeltabi, tables.distinctbi
-        bsub, bkey = quad_subscript_key(fps, bt.keymask, bt.size)
-        xsub, xkey = quad_subscript_key(fps, xt.keymask, xt.size)
+        xt = tables.distinctbi
+        # Bigram records carry the raw 32-bit fingerprint; per-table
+        # sub/key derive on device (ops/score.py _quad_sub_key).
         for j, i in enumerate(idx.tolist()):
             recs.append(dict(kind=BI_DELTA, offset=int(starts[i]),
-                             sub=int(bsub[j]), key=int(bkey[j])))
+                             fp=int(fps[j])))
             if not xt.empty:
                 recs.append(dict(kind=BI_DISTINCT, offset=int(starts[i]),
-                                 sub=int(xsub[j]), key=int(xkey[j])))
+                                 fp=int(fps[j])))
     return recs
 
 
@@ -194,6 +193,8 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
         direct_adds=np.full((B, max_direct, 3), -1, np.int32),
         text_bytes=np.zeros(B, np.int32),
         fallback=np.zeros(B, bool),
+        n_slots=np.zeros(B, np.int32),
+        n_chunks=np.zeros(B, np.int32),
         n_docs=B,
     )
 
@@ -266,4 +267,6 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
             chunk_base += span_chunks
         out.text_bytes[b] = total
         out.fallback[b] = not ok
+        out.n_slots[b] = slot
+        out.n_chunks[b] = chunk_base
     return out

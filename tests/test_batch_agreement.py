@@ -103,3 +103,58 @@ def test_gate_failure_recursion_agreement(engine):
     docs = [texts[i][:150] + " " + texts[(i * 7 + 3) % len(texts)][:150]
             for i in range(0, 48)]
     _assert_batch_agrees(engine, docs)
+
+
+def test_chunk_level_parity(engine):
+    """Device chunk summaries == the scalar engine's DocTote.add sequence.
+
+    Sharper than end-to-end agreement: catches probe/summary bugs that
+    cancel out in document totals (e.g. a missing table lookup on one
+    record kind). Covers CJK (uni+bigram), Latin (quad+octa) and
+    mixed-script documents."""
+    import numpy as np
+    from language_detector_tpu.engine_scalar import (DocTote, ScoringContext,
+                                                     score_one_span)
+    from language_detector_tpu.preprocess.pack import pack_batch
+    from language_detector_tpu.preprocess.segment import segment_text
+
+    texts = _golden_texts()
+    rng = random.Random(7)
+    docs = [t for t in (texts[i] for i in range(0, len(texts), 9))][:48]
+    docs += [texts[3][:120] + " " + texts[-5][:120] for _ in range(4)]
+    docs += [""] * (-len(docs) % BATCH)
+
+    packed = pack_batch(docs, engine.tables, engine.reg)
+    out = engine.score_packed(packed)
+
+    class RecordingTote(DocTote):
+        def __init__(self):
+            super().__init__()
+            self.adds = []
+
+        def add(self, lang, nbytes, score, reliability):
+            self.adds.append((lang, nbytes, score, reliability))
+            super().add(lang, nbytes, score, reliability)
+
+    for b, text in enumerate(docs):
+        if packed.fallback[b]:
+            continue
+        tote = RecordingTote()
+        ctx = ScoringContext(tables=engine.tables, registry=engine.reg)
+        for span in segment_text(text, engine.tables):
+            if span.text_bytes <= 1 and \
+                    engine.reg.rtype(span.ulscript) not in (0, 1):
+                continue
+            score_one_span(ctx, span, tote)
+        direct = {int(cid): (int(lang), int(nb))
+                  for cid, lang, nb in packed.direct_adds[b] if cid >= 0}
+        got = []
+        rows = out[b]
+        for c in range(rows.shape[0]):
+            if c in direct:
+                lang, nb = direct[c]
+                got.append((lang, nb, nb, 100))
+            elif rows[c, 4]:
+                got.append(tuple(int(x) for x in rows[c, :4]))
+        assert got == tote.adds, \
+            f"doc {b}: {got[:6]} != {tote.adds[:6]} ({text[:50]!r})"
