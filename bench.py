@@ -48,9 +48,8 @@ def make_corpus(n: int) -> list:
     return out
 
 
-def bench(batch_size: int = 1024, n_batches: int = 4) -> dict:
+def bench(batch_size: int = 4096, n_batches: int = 4) -> dict:
     from language_detector_tpu.models.ngram import NgramBatchEngine
-    from language_detector_tpu.preprocess.pack import pack_batch
 
     eng = NgramBatchEngine()
     docs = make_corpus(batch_size)
@@ -66,7 +65,7 @@ def bench(batch_size: int = 1024, n_batches: int = 4) -> dict:
 
     # Stage split (one batch, informational)
     t0 = time.time()
-    packed = pack_batch(docs, eng.tables, eng.reg, flags=eng.flags)
+    packed = eng._pack(docs, eng.tables, eng.reg, flags=eng.flags)
     t_pack = time.time() - t0
     t0 = time.time()
     out = eng.score_packed(packed)

@@ -60,7 +60,9 @@ def _bucket(n: int, lo: int, hi: int) -> int:
 
 
 def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int) -> dict:
-    """PackedBatch -> minimal device wire format (see score_batch_impl).
+    """PackedBatch -> minimal device wire format (see score_batch_impl):
+    9 bytes per slot, 5 per chunk. Per-slot side/cjk/span metadata is
+    derived on device from chunk_base + chunk metadata.
 
     Slices slot/chunk axes down to the smallest power-of-two bucket that
     holds every used slot: short service documents ship a few hundred bytes
@@ -70,28 +72,18 @@ def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int) -> dict:
     L = _bucket(used_slots, 64, max_slots)
     C = _bucket(used_chunks, 8, max_chunks)
 
-    kind = packed.kind[:, :L]
-    is_fp_kind = (kind == QUAD) | (kind == BI_DELTA) | (kind == BI_DISTINCT)
-    is_direct = (kind == SEED) | (kind == UNI)
-    w0 = np.where(is_fp_kind, packed.fp[:, :L],
-                  np.where(is_direct, packed.direct[:, :L],
-                           packed.sub[:, :L].astype(np.uint32)))
-    w1 = np.where(is_fp_kind | is_direct, np.uint32(0), packed.key[:, :L])
     return dict(
         slots_u8=np.stack(
-            [kind.astype(np.uint8), packed.side[:, :L].astype(np.uint8),
-             packed.cjk[:, :L].astype(np.uint8),
-             packed.chunk_base[:, :L].astype(np.uint8)], axis=-1),
-        slots_u16=np.stack(
-            [packed.offset[:, :L].astype(np.uint16),
-             packed.span_start[:, :L].astype(np.uint16),
-             packed.span_end_off[:, :L].astype(np.uint16)], axis=-1),
-        slots_u32=np.stack([w0.astype(np.uint32), w1.astype(np.uint32)],
-                           axis=-1),
+            [packed.kind[:, :L].astype(np.uint8),
+             packed.chunk_base[:, :L].astype(np.uint8),
+             packed.fp_hi[:, :L]], axis=-1),
+        slots_u16=packed.offset[:, :L].astype(np.uint16),
+        slots_u32=np.ascontiguousarray(packed.fp[:, :L]),
         chunk_u8=np.stack(
             [packed.chunk_script[:, :C].astype(np.uint8),
              packed.chunk_cjk[:, :C].astype(np.uint8),
              packed.chunk_side[:, :C].astype(np.uint8)], axis=-1),
+        chunk_u16=packed.chunk_span_end[:, :C].astype(np.uint16),
     )
 
 
@@ -123,6 +115,9 @@ class NgramBatchEngine:
         else:
             self._score_fn = score_batch
             self._mesh_size = 1
+        from .. import native
+        self._pack = native.pack_batch_native if native.available() \
+            else pack_batch
 
     # -- device dispatch ----------------------------------------------------
 
@@ -143,7 +138,7 @@ class NgramBatchEngine:
         bsz = _next_pow2(len(texts))
         bsz += -bsz % self._mesh_size  # divisible over the mesh axis
         padded = list(texts) + [""] * (bsz - len(texts))
-        packed = pack_batch(padded, self.tables, self.reg,
+        packed = self._pack(padded, self.tables, self.reg,
                             max_slots=self.max_slots,
                             max_chunks=self.max_chunks, flags=self.flags)
         out = self.score_packed(packed)

@@ -1,0 +1,153 @@
+"""Native (C++) host runtime: the batch packer.
+
+Loads libldtpack.so (built on demand from packer.cc) and exposes
+`pack_batch_native`, an array-for-array drop-in for the Python
+preprocess.pack.pack_batch (tests/test_native_pack.py asserts equality).
+Falls back gracefully: `available()` is False when no compiler/library
+exists and callers keep using the Python packer.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from ..registry import Registry, ULSCRIPT_LATIN
+from ..tables import ScoringTables
+from ..preprocess.pack import PackedBatch
+
+_DIR = Path(__file__).parent
+_SO = _DIR / "libldtpack.so"
+
+_lib = None
+_init_keepalive: list = []
+
+
+def _build() -> bool:
+    try:
+        subprocess.run([str(_DIR / "build.sh")], check=True,
+                       capture_output=True, timeout=120)
+        return _SO.exists()
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO.exists() and not _build():
+        _lib = False
+        return _lib
+    lib = ctypes.CDLL(str(_SO))
+    lib.ldt_init.restype = None
+    lib.ldt_pack_batch.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+_initialized_for: tuple = ()
+
+
+def _ptr(a: np.ndarray, dtype):
+    assert a.dtype == dtype and a.flags.c_contiguous
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _ensure_init(tables: ScoringTables, reg: Registry):
+    """Upload table pointers once per (tables, registry) pair."""
+    global _initialized_for
+    key = (id(tables), id(reg))
+    if _initialized_for == key:
+        return
+    lib = _load()
+    seed_lp = np.zeros(reg.num_scripts, np.uint32)
+    for s in range(reg.num_scripts):
+        lang = reg.default_language(s)
+        seed_lp[s] = np.uint32(
+            reg.per_script_number(ULSCRIPT_LATIN, lang) << 8)
+    rtype = np.ascontiguousarray(reg.ulscript_rtype.astype(np.int32))
+    deflang = np.ascontiguousarray(
+        reg.ulscript_default_lang.astype(np.int32))
+    script_of = np.ascontiguousarray(tables.script_of_cp, dtype=np.uint8)
+    lower = np.arange(0x110000, dtype=np.uint32)
+    lower[tables.lower_pairs[:, 0]] = tables.lower_pairs[:, 1]
+    cjk_prop = np.ascontiguousarray(tables.cjk_uni_prop, dtype=np.uint8)
+    _init_keepalive.clear()
+    _init_keepalive.extend([seed_lp, rtype, deflang, script_of, lower,
+                            cjk_prop])
+    lib.ldt_init(
+        _ptr(script_of, np.uint8), _ptr(lower, np.uint32),
+        _ptr(cjk_prop, np.uint8), _ptr(rtype, np.int32),
+        _ptr(deflang, np.int32), _ptr(seed_lp, np.uint32),
+        ctypes.c_int32(reg.num_scripts),
+        ctypes.c_int32(1 if tables.distinctbi.empty else 0))
+    _initialized_for = key
+
+
+def pack_batch_native(texts: list[str], tables: ScoringTables,
+                      reg: Registry, max_slots: int = 2048,
+                      max_chunks: int = 64, max_direct: int = 4,
+                      flags: int = 0, n_threads: int = 0) -> PackedBatch:
+    """Native twin of preprocess.pack.pack_batch (same output contract)."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native packer unavailable")
+    _ensure_init(tables, reg)
+
+    B, L, C, D = len(texts), max_slots, max_chunks, max_direct
+    enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
+    bounds = np.zeros(B + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=bounds[1:])
+    blob = np.frombuffer(b"".join(enc), dtype=np.uint8) if bounds[-1] \
+        else np.zeros(1, np.uint8)
+    blob = np.ascontiguousarray(blob)
+
+    out = PackedBatch(
+        kind=np.zeros((B, L), np.int8),
+        offset=np.zeros((B, L), np.int32),
+        fp=np.zeros((B, L), np.uint32),
+        fp_hi=np.zeros((B, L), np.uint8),
+        chunk_base=np.zeros((B, L), np.int32),
+        span_start=np.zeros((B, L), np.int32),
+        span_end_off=np.zeros((B, L), np.int32),
+        side=np.zeros((B, L), np.int8),
+        cjk=np.zeros((B, L), np.int8),
+        script=np.zeros((B, L), np.int16),
+        chunk_script=np.zeros((B, C), np.int16),
+        chunk_cjk=np.zeros((B, C), np.int8),
+        chunk_side=np.zeros((B, C), np.int8),
+        chunk_span_end=np.zeros((B, C), np.int32),
+        direct_adds=np.full((B, D, 3), -1, np.int32),
+        text_bytes=np.zeros(B, np.int32),
+        fallback=np.zeros(B, bool),
+        n_slots=np.zeros(B, np.int32),
+        n_chunks=np.zeros(B, np.int32),
+        n_docs=B,
+    )
+    if n_threads <= 0:
+        import os
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.ldt_pack_batch(
+        _ptr(blob, np.uint8), _ptr(bounds, np.int64),
+        ctypes.c_int32(B), ctypes.c_int32(L), ctypes.c_int32(C),
+        ctypes.c_int32(D), ctypes.c_int32(flags),
+        ctypes.c_int32(n_threads),
+        _ptr(out.kind, np.int8), _ptr(out.offset, np.int32),
+        _ptr(out.fp, np.uint32), _ptr(out.fp_hi, np.uint8),
+        _ptr(out.chunk_base, np.int32), _ptr(out.span_start, np.int32),
+        _ptr(out.span_end_off, np.int32), _ptr(out.side, np.int8),
+        _ptr(out.cjk, np.int8), _ptr(out.script, np.int16),
+        _ptr(out.chunk_script, np.int16), _ptr(out.chunk_cjk, np.int8),
+        _ptr(out.chunk_side, np.int8), _ptr(out.chunk_span_end, np.int32),
+        out.direct_adds.ctypes.data_as(ctypes.c_void_p),
+        _ptr(out.text_bytes, np.int32),
+        out.fallback.ctypes.data_as(ctypes.c_void_p),
+        _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
+    return out

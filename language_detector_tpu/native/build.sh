@@ -1,0 +1,6 @@
+#!/bin/bash
+# Build the native packer shared library.
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -shared -fPIC -std=c++17 -o libldtpack.so packer.cc -lpthread
+echo "built $(pwd)/libldtpack.so"

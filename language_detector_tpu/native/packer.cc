@@ -1,0 +1,611 @@
+// Native host-side batch packer: UTF-8 texts -> fixed-shape candidate
+// tensors for the TPU scorer.
+//
+// C++ twin of preprocess/{segment,grams,hashing,squeeze,pack}.py — the
+// byte-level, inherently sequential front half of detection (reference:
+// getonescriptspan.cc:799 scanner, cldutil_shared.cc:107-386 hashes,
+// cldutil.cc:315-533 gram scans, compact_lang_det_impl.cc:541-971 squeeze
+// predictor). The Python packer is the behavioral spec (itself
+// oracle-parity-tested); tests/test_native_pack.py asserts array-for-array
+// equality between the two.
+//
+// Build: native/build.sh  ->  libldtpack.so (loaded via ctypes).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- candidate kinds (preprocess/pack.py) ----
+enum Kind : int8_t {
+  PAD = 0, SEED = 1, QUAD = 2, UNI = 3,
+  DELTA_OCTA = 4, DISTINCT_OCTA = 5, BI_DELTA = 6, BI_DISTINCT = 7
+};
+
+constexpr int kMaxScoringHits = 1000;       // scoreonescriptspan.h:93
+constexpr int kMaxSpanPutBytes = 40960 - 32;  // getonescriptspan.h:29-32
+constexpr int kSoftSpanPutBytes = kMaxSpanPutBytes - 32;
+constexpr int kTailPad = 32;
+constexpr int kSqueezeTestThresh = 4096;    // kCheapSqueezeTestThresh
+constexpr int kSqueezeTestLen = 256;
+constexpr int kPredictionTableSize = 4096;
+constexpr int kUlScriptInherited = 40;
+constexpr int kUlScriptLatin = 1;
+
+// ---- global tables (ldt_init; backing arrays owned by Python) ----
+struct Ctx {
+  const uint8_t* script_of_cp;   // [0x110000]
+  const uint32_t* lower_map;     // [0x110000]
+  const uint8_t* cjk_prop;       // [0x110000]
+  const int32_t* rtype;          // [n_scripts]
+  const int32_t* deflang;        // [n_scripts]
+  const uint32_t* seed_lp;       // [n_scripts]
+  int n_scripts;
+  int distinctbi_empty;
+};
+Ctx g;
+
+// ---- byte-class advance tables (cldutil_shared.h:462, cldutil.cc:49-99) --
+struct AdvTables {
+  int8_t but_space[256];   // 0 for <=0x20; 1/2/3/4 by UTF-8 lead
+  int8_t one[256];
+  int8_t space_vowel[256]; // 1 on space/ASCII-vowel/continuation/ctrl
+  AdvTables() {
+    for (int i = 0; i < 256; i++) {
+      but_space[i] = i <= 0x20 ? 0 : i < 0xC0 ? 1 : i < 0xE0 ? 2
+                     : i < 0xF0 ? 3 : 4;
+      one[i] = i < 0xC0 ? 1 : i < 0xE0 ? 2 : i < 0xF0 ? 3 : 4;
+      space_vowel[i] = (i <= 0x20) || (i >= 0x80 && i < 0xC0);
+    }
+    for (const char* v = "AEIOUaeiou"; *v; v++)
+      space_vowel[(uint8_t)*v] = 1;
+  }
+};
+const AdvTables adv;
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t w;
+  std::memcpy(&w, p, 4);  // little-endian hosts only (x86/arm64)
+  return w;
+}
+
+constexpr uint32_t kPreSpace = 0x00004444;   // cldutil_shared.cc:41
+constexpr uint32_t kPostSpace = 0x44440000;
+const uint32_t kWordMask[4] = {0xFFFFFFFFu, 0x000000FFu, 0x0000FFFFu,
+                               0x00FFFFFFu};
+
+// QuadHashV2 (cldutil_shared.cc:196; preprocess/hashing.py quad_hash_v2)
+uint32_t quad_hash(const uint8_t* buf, int64_t pos, int64_t len) {
+  if (len == 0) return 0;
+  uint32_t prepost = (buf[pos - 1] == 0x20 ? kPreSpace : 0) |
+                     (buf[pos + len] == 0x20 ? kPostSpace : 0);
+  uint32_t mask = kWordMask[len & 3];
+  if (len <= 4) {
+    uint32_t w0 = load32(buf + pos) & mask;
+    w0 ^= w0 >> 3;
+    return w0 ^ prepost;
+  }
+  uint32_t w0 = load32(buf + pos);
+  w0 ^= w0 >> 3;
+  if (len <= 8) {
+    uint32_t w1 = load32(buf + pos + 4) & mask;
+    w1 ^= w1 << 4;
+    return (w0 ^ prepost) + w1;
+  }
+  uint32_t w1 = load32(buf + pos + 4);
+  w1 ^= w1 << 4;
+  uint32_t w2 = load32(buf + pos + 8) & mask;
+  w2 ^= w2 << 2;
+  return (w0 ^ prepost) + w1 + w2;
+}
+
+// OctaHash40 (cldutil_shared.cc:348; hashing.py octa_hash40)
+const int kOctaShift[6] = {3, -4, -2, 8, 4, 6};
+
+uint64_t octa_hash40(const uint8_t* buf, int64_t pos, int64_t len,
+                     int64_t buflen) {
+  if (len == 0) return 0;
+  uint64_t prepost = (buf[pos - 1] == 0x20 ? kPreSpace : 0) |
+                     (buf[pos + len] == 0x20 ? kPostSpace : 0);
+  uint64_t mask = kWordMask[len & 3];
+  int ngroups = (int)((len - 1) >> 2);
+  if (ngroups > 5) ngroups = 5;
+  uint64_t word0 = 0, csum = 0;
+  for (int gidx = 0; gidx <= ngroups; gidx++) {
+    int64_t gpos = pos + 4 * gidx;
+    if (gpos > buflen - 4) gpos = buflen - 4;  // clip like the Python spec
+    uint64_t w = load32(buf + gpos);
+    if (gidx == ngroups) w &= mask;
+    csum += w;
+    int s = kOctaShift[gidx];
+    uint64_t mixed = s > 0 ? (w ^ (w >> s)) : (w ^ (w << -s));
+    word0 += mixed;
+  }
+  csum += csum >> 17;
+  csum += csum >> 9;
+  csum = (csum & 0xFF) << 32;
+  return (word0 ^ prepost) + csum;
+}
+
+// BiHashV2 (cldutil_shared.cc:107; hashing.py bi_hash_v2)
+uint32_t bi_hash(const uint8_t* buf, int64_t pos, int64_t len) {
+  if (len == 0) return 0;
+  uint32_t mask = kWordMask[len & 3];
+  if (len <= 4) {
+    uint32_t w0 = load32(buf + pos) & mask;
+    w0 ^= w0 >> 3;
+    return w0;
+  }
+  uint32_t w0 = load32(buf + pos);
+  w0 ^= w0 >> 3;
+  uint32_t w1 = load32(buf + pos + 4) & mask;
+  w1 ^= w1 << 18;
+  return w0 + w1;
+}
+
+// PairHash (cldutil_shared.cc:384)
+inline uint64_t pair_hash(uint64_t a, uint64_t b) {
+  return ((a >> 13) | (a << 51)) + b;
+}
+
+// ---- squeeze trigger (compact_lang_det_impl.cc:541-605, :952-971) ----
+int count_spaces4(const uint8_t* buf, int len) {
+  int n = len & ~3, c = 0;
+  for (int i = 0; i < n; i++) c += buf[i] == 0x20;
+  return c;
+}
+
+bool cheap_squeeze_trigger(const uint8_t* buf, int src_len) {
+  const int testsize = kSqueezeTestLen;
+  if (src_len < testsize) return false;
+  if (count_spaces4(buf, testsize) >= testsize * 25 / 100) return true;
+  // CountPredictedBytes with a fresh 12-bit-hash table
+  std::vector<int64_t> tbl(kPredictionTableSize, 0);
+  int predicted = 0, h = 0, i = 0;
+  while (i < testsize) {
+    uint8_t c0 = buf[i];
+    int64_t c;
+    int incr;
+    if (c0 < 0xC0) { c = c0; incr = 1; }
+    else if ((c0 & 0xE0) == 0xC0) { c = (c0 << 8) | buf[i + 1]; incr = 2; }
+    else if ((c0 & 0xF0) == 0xE0) {
+      c = ((int64_t)c0 << 16) | (buf[i + 1] << 8) | buf[i + 2]; incr = 3;
+    } else {
+      c = ((int64_t)c0 << 24) | ((int64_t)buf[i + 1] << 16) |
+          (buf[i + 2] << 8) | buf[i + 3];
+      incr = 4;
+    }
+    i += incr;
+    if (tbl[h] == c) predicted += incr;
+    tbl[h] = c;
+    h = ((h << 4) ^ (int)c) & 0xFFF;
+  }
+  return predicted >= testsize * 67 / 100;
+}
+
+// ---- segmentation (preprocess/segment.py segment_text) ----
+struct Span {
+  std::vector<uint8_t> buf;      // ' ' + lowered letters + "   \0" + pad
+  std::vector<uint32_t> cps;     // decoded buf codepoints + trailing space
+  int text_bytes;
+  int ulscript;
+};
+
+inline int u8len_of(uint32_t cp) {
+  return cp < 0x80 ? 1 : cp < 0x800 ? 2 : cp < 0x10000 ? 3 : 4;
+}
+
+inline void u8encode(uint32_t cp, std::vector<uint8_t>* out) {
+  if (cp < 0x80) out->push_back((uint8_t)cp);
+  else if (cp < 0x800) {
+    out->push_back(0xC0 | (cp >> 6));
+    out->push_back(0x80 | (cp & 0x3F));
+  } else if (cp < 0x10000) {
+    out->push_back(0xE0 | (cp >> 12));
+    out->push_back(0x80 | ((cp >> 6) & 0x3F));
+    out->push_back(0x80 | (cp & 0x3F));
+  } else {
+    out->push_back(0xF0 | (cp >> 18));
+    out->push_back(0x80 | ((cp >> 12) & 0x3F));
+    out->push_back(0x80 | ((cp >> 6) & 0x3F));
+    out->push_back(0x80 | (cp & 0x3F));
+  }
+}
+
+// Decode valid UTF-8 (input comes from a Python str).
+void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
+  int i = 0;
+  while (i < len) {
+    uint8_t c = s[i];
+    if (c < 0x80) { out->push_back(c); i += 1; }
+    else if (c < 0xE0) {
+      out->push_back(((c & 0x1F) << 6) | (s[i + 1] & 0x3F));
+      i += 2;
+    } else if (c < 0xF0) {
+      out->push_back(((c & 0x0F) << 12) | ((s[i + 1] & 0x3F) << 6) |
+                     (s[i + 2] & 0x3F));
+      i += 3;
+    } else {
+      out->push_back(((c & 0x07) << 18) | ((s[i + 1] & 0x3F) << 12) |
+                     ((s[i + 2] & 0x3F) << 6) | (s[i + 3] & 0x3F));
+      i += 4;
+    }
+  }
+}
+
+void build_span(const std::vector<uint32_t>& cur, int ulscript,
+                std::vector<Span>* out) {
+  Span sp;
+  sp.ulscript = ulscript;
+  sp.cps.reserve(cur.size() + 2);
+  sp.cps.push_back(0x20);
+  for (uint32_t cp : cur) sp.cps.push_back(cp);
+  sp.buf.reserve(cur.size() * 2 + kTailPad + 4);
+  for (uint32_t cp : sp.cps) u8encode(cp, &sp.buf);
+  sp.text_bytes = (int)sp.buf.size();
+  sp.buf.push_back(0x20); sp.buf.push_back(0x20); sp.buf.push_back(0x20);
+  sp.buf.resize(sp.text_bytes + kTailPad, 0);
+  sp.cps.push_back(0x20);
+  out->push_back(std::move(sp));
+}
+
+void segment_text(const uint8_t* text, int text_len,
+                  std::vector<Span>* spans) {
+  std::vector<uint32_t> cps;
+  cps.reserve(text_len);
+  u8decode(text, text_len, &cps);
+  const int n = (int)cps.size();
+  if (n == 0) return;
+
+  std::vector<uint8_t> script(n);
+  std::vector<uint32_t> lower(n);
+  std::vector<int8_t> u8l(n);
+  std::vector<int64_t> byte_before(n + 1);
+  int64_t acc = 0;
+  for (int i = 0; i < n; i++) {
+    uint32_t cp = cps[i] > 0x10FFFF ? 0x10FFFF : cps[i];
+    script[i] = g.script_of_cp[cp];
+    lower[i] = g.lower_map[cp];
+    u8l[i] = (int8_t)u8len_of(cp);
+    byte_before[i] = acc;
+    acc += u8l[i];
+  }
+  byte_before[n] = acc;
+  const int64_t total_bytes = acc;
+
+  int i = 0;
+  while (i < n) {
+    int64_t remaining = total_bytes - byte_before[i];
+    int soft_limit = kSoftSpanPutBytes;
+    if (remaining >= kMaxSpanPutBytes && remaining < 2 * kMaxSpanPutBytes)
+      soft_limit = (int)(remaining / 2);
+    while (i < n && script[i] == 0) i++;
+    if (i >= n) break;
+    const int spanscript = script[i];
+    std::vector<uint32_t> cur;
+    int put = 1;
+
+    while (i < n) {
+      // letter run
+      while (i < n) {
+        int sc = script[i];
+        if (sc == 0) break;
+        if (sc != spanscript && sc != kUlScriptInherited) {
+          // one embedded foreign letter allowed when the next char is
+          // Common or back in-script (getonescriptspan.cc:900-930)
+          int sc2 = i + 1 < n ? script[i + 1] : 0;
+          if (sc2 != 0 && sc2 != spanscript) break;
+        }
+        cur.push_back(lower[i]);
+        put += u8l[i];
+        i++;
+        if (put >= kMaxSpanPutBytes) break;
+      }
+      // non-letter run -> single space
+      cur.push_back(0x20);
+      put += 1;
+      while (i < n && script[i] == 0) i++;
+      if (i >= n) break;
+      if (script[i] != spanscript && script[i] != kUlScriptInherited) break;
+      if (put >= soft_limit) break;
+    }
+    if (cur.size() > 1) build_span(cur, spanscript, spans);
+  }
+}
+
+// ---- per-span candidate records (preprocess/pack.py) ----
+struct Rec {
+  int32_t offset;
+  int8_t kind;
+  int8_t prio;     // merge priority at equal offsets
+  uint8_t fp_hi;   // octa hash bits 32-39
+  int8_t pad_;
+  uint32_t fp;     // fingerprint low 32 / seed langprob / uni class
+};
+
+inline int8_t prio_of(int8_t kind) {
+  switch (kind) {
+    case SEED: return -1;
+    case DELTA_OCTA: case BI_DELTA: return 0;
+    case DISTINCT_OCTA: case BI_DISTINCT: return 1;
+    default: return 2;  // QUAD, UNI
+  }
+}
+
+// Quad + word candidates in linear merge order; false => scalar fallback
+bool pack_quad_span(const Span& sp, std::vector<Rec>* recs) {
+  const uint8_t* b = sp.buf.data();
+  const int64_t buflen = (int64_t)sp.buf.size();
+  const int limit = sp.text_bytes;
+
+  // quad positions (grams.py quad_positions: 2-char steps, word-end jump,
+  // space/vowel skip; cldutil.cc:338-395)
+  std::vector<int32_t> qpos, qlen;
+  {
+    int64_t src = 1;
+    if (b[src] == 0x20) src++;
+    while (src < limit) {
+      int64_t e = src;
+      e += adv.but_space[b[e]];
+      e += adv.but_space[b[e]];
+      int64_t mid = e;
+      e += adv.but_space[b[e]];
+      e += adv.but_space[b[e]];
+      qpos.push_back((int32_t)src);
+      qlen.push_back((int32_t)(e - src));
+      src = b[e] == 0x20 ? e : mid;
+      if (src < limit) src += adv.space_vowel[b[src]];
+      else src = limit;
+    }
+  }
+  if ((int)qpos.size() > kMaxScoringHits) return false;  // multi-round span
+
+  // word records with hash-only repeat filter + pairs (cldutil.cc:459-502)
+  {
+    int64_t src = 1;
+    if (b[src] == 0x20) src++;
+    uint64_t cache[2] = {0, 0};
+    int nxt = 0;
+    int n_delta = 0, n_distinct = 0;
+    int64_t srclimit = limit + 1;
+    int charcount = 0;
+    int64_t prior_word_start = src, word_start = src, word_end = word_start;
+    while (src < srclimit) {
+      if (b[src] == 0x20) {
+        if (word_end > word_start) {
+          int64_t wlen = word_end - word_start;
+          uint64_t fpw = octa_hash40(b, word_start, wlen, buflen);
+          if (fpw != cache[0] && fpw != cache[1]) {
+            cache[nxt] = fpw;
+            nxt = 1 - nxt;
+            uint64_t prior = cache[nxt];
+            if (prior != 0 && prior != fpw) {
+              uint64_t pfp = pair_hash(prior, fpw);
+              recs->push_back({(int32_t)prior_word_start, DISTINCT_OCTA, 0,
+                               (uint8_t)(pfp >> 32), 0, (uint32_t)pfp});
+              n_distinct++;
+            }
+            recs->push_back({(int32_t)word_start, DISTINCT_OCTA, 0,
+                             (uint8_t)(fpw >> 32), 0, (uint32_t)fpw});
+            recs->push_back({(int32_t)word_start, DELTA_OCTA, 0,
+                             (uint8_t)(fpw >> 32), 0, (uint32_t)fpw});
+            n_delta++;
+            n_distinct++;
+            if (n_delta >= kMaxScoringHits ||
+                n_distinct >= kMaxScoringHits - 1)
+              break;
+          }
+        }
+        charcount = 0;
+        prior_word_start = word_start;
+        word_start = src + 1;
+        word_end = word_start;
+      } else {
+        charcount++;
+      }
+      src += adv.one[b[src]];
+      if (charcount <= 8) word_end = src;
+    }
+  }
+
+  for (size_t i = 0; i < qpos.size(); i++) {
+    uint32_t fp = quad_hash(b, qpos[i], qlen[i]);
+    recs->push_back({qpos[i], QUAD, 0, 0, 0, fp});
+  }
+  return true;
+}
+
+bool pack_cjk_span(const Span& sp, std::vector<Rec>* recs) {
+  const int n = (int)sp.cps.size();
+  std::vector<int64_t> starts(n), ends(n);
+  int64_t acc = 0;
+  for (int i = 0; i < n; i++) {
+    starts[i] = acc;
+    acc += u8len_of(sp.cps[i]);
+    ends[i] = acc;
+  }
+  int n_uni = 0;
+  for (int i = 0; i < n; i++) {
+    uint32_t cp = sp.cps[i] > 0x10FFFF ? 0x10FFFF : sp.cps[i];
+    uint8_t prop = g.cjk_prop[cp];
+    if (prop > 0 && starts[i] >= 1 && starts[i] < sp.text_bytes) n_uni++;
+  }
+  if (n_uni > kMaxScoringHits) return false;  // multi-round span
+  for (int i = 0; i < n; i++) {
+    uint32_t cp = sp.cps[i] > 0x10FFFF ? 0x10FFFF : sp.cps[i];
+    uint8_t prop = g.cjk_prop[cp];
+    if (prop > 0 && starts[i] >= 1 && starts[i] < sp.text_bytes)
+      recs->push_back({(int32_t)ends[i], UNI, 0, 0, 0, prop});
+  }
+  for (int i = 0; i + 1 < n; i++) {
+    int64_t len2 = ends[i + 1] - starts[i];
+    if (len2 >= 6 && starts[i] >= 1 && starts[i] < sp.text_bytes) {
+      uint32_t fp = bi_hash(sp.buf.data(), starts[i], len2);
+      recs->push_back({(int32_t)starts[i], BI_DELTA, 0, 0, 0, fp});
+      if (!g.distinctbi_empty)
+        recs->push_back({(int32_t)starts[i], BI_DISTINCT, 0, 0, 0, fp});
+    }
+  }
+  return true;
+}
+
+// ---- per-document packing (pack.py pack_batch body) ----
+struct Out {
+  int8_t* kind; int32_t* offset; uint32_t* fp; uint8_t* fp_hi;
+  int32_t* chunk_base; int32_t* span_start;
+  int32_t* span_end_off; int8_t* side; int8_t* cjk; int16_t* script;
+  int16_t* chunk_script; int8_t* chunk_cjk; int8_t* chunk_side;
+  int32_t* chunk_span_end;
+  int32_t* direct_adds; int32_t* text_bytes; uint8_t* fallback;
+  int32_t* n_slots; int32_t* n_chunks;
+  int L, C, D, flags;
+};
+
+void pack_one_doc(const uint8_t* text, int text_len, int b, const Out& o) {
+  std::vector<Span> spans;
+  segment_text(text, text_len, &spans);
+
+  const int L = o.L, C = o.C;
+  int8_t* kind = o.kind + (int64_t)b * L;
+  int32_t* offset = o.offset + (int64_t)b * L;
+  uint32_t* fp = o.fp + (int64_t)b * L;
+  uint8_t* fp_hi = o.fp_hi + (int64_t)b * L;
+  int32_t* chunk_base_a = o.chunk_base + (int64_t)b * L;
+  int32_t* span_start_a = o.span_start + (int64_t)b * L;
+  int32_t* span_end_a = o.span_end_off + (int64_t)b * L;
+  int8_t* side_a = o.side + (int64_t)b * L;
+  int8_t* cjk_a = o.cjk + (int64_t)b * L;
+  int16_t* script_a = o.script + (int64_t)b * L;
+  int16_t* cscript = o.chunk_script + (int64_t)b * C;
+  int8_t* ccjk = o.chunk_cjk + (int64_t)b * C;
+  int8_t* cside = o.chunk_side + (int64_t)b * C;
+  int32_t* cspanend = o.chunk_span_end + (int64_t)b * C;
+  int32_t* dadds = o.direct_adds + (int64_t)b * o.D * 3;
+
+  int slot = 0, chunk_base = 0, n_direct = 0;
+  int64_t total = 0;
+  bool ok = true;
+  std::vector<Rec> recs;
+  for (const Span& sp : spans) {
+    total += sp.text_bytes;
+    int rt = sp.ulscript < g.n_scripts ? g.rtype[sp.ulscript] : 0;
+    if (!(o.flags & 1) && sp.text_bytes > (kSqueezeTestThresh >> 1) &&
+        cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
+      ok = false;  // squeeze-trigger doc -> scalar path (FLAG_FINISH skips)
+      break;
+    }
+    if (rt == 0 || rt == 1) {  // RTypeNone/One: direct doc-tote add
+      if (n_direct >= o.D || chunk_base >= C) { ok = false; break; }
+      dadds[n_direct * 3 + 0] = chunk_base;
+      dadds[n_direct * 3 + 1] = g.deflang[sp.ulscript];
+      dadds[n_direct * 3 + 2] = sp.text_bytes;
+      n_direct++;
+      chunk_base++;
+      continue;
+    }
+    if (sp.text_bytes <= 1) continue;
+    const bool cjk = rt == 3;
+    recs.clear();
+    bool fits = cjk ? pack_cjk_span(sp, &recs) : pack_quad_span(sp, &recs);
+    if (!fits) { ok = false; break; }
+    recs.push_back({1, SEED, 0, 0, 0,
+                    sp.ulscript < g.n_scripts ? g.seed_lp[sp.ulscript] : 0});
+    for (size_t i = 0; i < recs.size(); i++)
+      recs[i].prio = prio_of(recs[i].kind);
+    std::stable_sort(recs.begin(), recs.end(),
+                     [](const Rec& a, const Rec& c) {
+                       if (a.offset != c.offset) return a.offset < c.offset;
+                       return a.prio < c.prio;
+                     });
+    int n_base_max = 0;
+    for (const Rec& r : recs)
+      n_base_max += (r.kind == SEED || r.kind == QUAD || r.kind == UNI);
+    int chunksize = cjk ? 50 : 20;
+    int span_chunks = 1 + (n_base_max + chunksize - 1) / chunksize;
+    if (span_chunks < 1) span_chunks = 1;
+    if (slot + (int)recs.size() > L || chunk_base + span_chunks > C) {
+      ok = false;
+      break;
+    }
+    int8_t side = sp.ulscript == kUlScriptLatin ? 0 : 1;
+    int start = slot;
+    for (const Rec& r : recs) {
+      kind[slot] = r.kind;
+      offset[slot] = r.offset;
+      fp[slot] = r.fp;
+      fp_hi[slot] = r.fp_hi;
+      chunk_base_a[slot] = chunk_base;
+      span_start_a[slot] = start;
+      span_end_a[slot] = sp.text_bytes;
+      side_a[slot] = side;
+      cjk_a[slot] = cjk;
+      script_a[slot] = (int16_t)sp.ulscript;
+      slot++;
+    }
+    for (int c = chunk_base; c < chunk_base + span_chunks; c++) {
+      cscript[c] = (int16_t)sp.ulscript;
+      ccjk[c] = cjk;
+      cside[c] = side;
+      cspanend[c] = sp.text_bytes;
+    }
+    chunk_base += span_chunks;
+  }
+  o.text_bytes[b] = (int32_t)total;
+  o.fallback[b] = !ok;
+  o.n_slots[b] = slot;
+  o.n_chunks[b] = chunk_base;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ldt_init(const uint8_t* script_of_cp, const uint32_t* lower_map,
+              const uint8_t* cjk_prop, const int32_t* rtype,
+              const int32_t* deflang, const uint32_t* seed_lp,
+              int32_t n_scripts, int32_t distinctbi_empty) {
+  g = Ctx{script_of_cp, lower_map, cjk_prop, rtype, deflang, seed_lp,
+          n_scripts, distinctbi_empty};
+}
+
+// texts: concatenated UTF-8 docs; bounds[i]..bounds[i+1] delimit doc i.
+void ldt_pack_batch(const uint8_t* texts, const int64_t* bounds,
+                    int32_t n_docs, int32_t L, int32_t C, int32_t D,
+                    int32_t flags, int32_t n_threads,
+                    int8_t* kind, int32_t* offset, uint32_t* fp,
+                    uint8_t* fp_hi,
+                    int32_t* chunk_base, int32_t* span_start,
+                    int32_t* span_end_off, int8_t* side, int8_t* cjk,
+                    int16_t* script, int16_t* chunk_script,
+                    int8_t* chunk_cjk, int8_t* chunk_side,
+                    int32_t* chunk_span_end,
+                    int32_t* direct_adds, int32_t* text_bytes,
+                    uint8_t* fallback, int32_t* n_slots,
+                    int32_t* n_chunks) {
+  Out o{kind, offset, fp, fp_hi, chunk_base, span_start,
+        span_end_off, side, cjk, script, chunk_script, chunk_cjk,
+        chunk_side, chunk_span_end, direct_adds, text_bytes, fallback,
+        n_slots, n_chunks, L, C, D, flags};
+  auto work = [&](int lo, int hi) {
+    for (int b = lo; b < hi; b++)
+      pack_one_doc(texts + bounds[b], (int)(bounds[b + 1] - bounds[b]), b,
+                   o);
+  };
+  if (n_threads <= 1 || n_docs < 2 * n_threads) {
+    work(0, n_docs);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int per = (n_docs + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    int lo = t * per, hi = std::min(n_docs, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

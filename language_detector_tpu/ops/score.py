@@ -144,36 +144,62 @@ def _quad_sub_key(table, fp):
     return sub, fp & jnp.uint32(table.keymask)
 
 
+def _octa_sub_key(table, lo, hi):
+    """Derive bucket subscript + probe key from a 40-bit fingerprint
+    carried as (low 32, bits 32-39), exactly matching
+    hashing.octa_subscript_key (cldutil_shared.h:389-397) in pure uint32
+    arithmetic: only fingerprint bits 0..35 reach the subscript/key for
+    any table geometry <= 2^28 buckets."""
+    sum_lo = lo + ((lo >> jnp.uint32(12)) | (hi << jnp.uint32(20)))
+    sub = (sum_lo & jnp.uint32(table.size - 1)).astype(jnp.int32)
+    key = ((lo >> jnp.uint32(4)) | (hi << jnp.uint32(28))) & \
+        jnp.uint32(table.keymask)
+    return sub, key
+
+
 def score_batch_impl(dt: DeviceTables, p: dict):
     """Score one packed batch into stacked chunk summaries.
 
-    p is the wire format built by models/ngram.py (minimum bytes over the
+    p is the wire format built by models/ngram.py (9 bytes/slot over the
     host->device link):
-      slots_u8  [B, L, 4] kind, side, cjk, chunk_base
-      slots_u16 [B, L, 3] offset, span_start, span_end_off
-      slots_u32 [B, L, 2] w0, w1 by kind: SEED/UNI -> (direct, 0);
-                QUAD / BI_* -> (fingerprint, 0), sub/key derived on device;
-                *_OCTA -> (precomputed sub, key) (40-bit hash needs uint64)
+      slots_u8  [B, L, 3] kind, chunk_base, fp_hi (octa hash bits 32-39)
+      slots_u16 [B, L]    span-buffer offset
+      slots_u32 [B, L]    fingerprint low 32 bits (quad/bi/octa) or direct
+                          payload (seed langprob, uni compat class)
       chunk_u8  [B, C, 3] script, cjk, side
+      chunk_u16 [B, C]    span end offset
 
-    Pure fixed-shape function: safe under jit and shard_map over the
-    leading document axis (documents are independent; every reduction is
-    doc-local)."""
+    Every per-table bucket subscript and probe key derives on device; the
+    per-slot side/cjk/span-start metadata derives from chunk_base + chunk
+    metadata. Pure fixed-shape function: safe under jit and shard_map over
+    the leading document axis (documents are independent; every reduction
+    is doc-local)."""
     kind = p["slots_u8"][..., 0].astype(jnp.int32)            # [B, L]
-    side = p["slots_u8"][..., 1].astype(jnp.int32)
+    chunk_base = p["slots_u8"][..., 1].astype(jnp.int32)
+    fp_hi = p["slots_u8"][..., 2].astype(jnp.uint32)
     B, L = kind.shape
     C = p["chunk_u8"].shape[1]
-    offset = p["slots_u16"][..., 0].astype(jnp.int32)
-    span_start = p["slots_u16"][..., 1].astype(jnp.int32)
-    span_end_off = p["slots_u16"][..., 2].astype(jnp.int32)
-    chunk_base = p["slots_u8"][..., 3].astype(jnp.int32)
-    cjk = p["slots_u8"][..., 2].astype(jnp.int32)
-    w0 = p["slots_u32"][..., 0].astype(jnp.uint32)
-    w1 = p["slots_u32"][..., 1].astype(jnp.uint32)
+    offset = p["slots_u16"].astype(jnp.int32)
+    w0 = p["slots_u32"].astype(jnp.uint32)
     chunk_script = p["chunk_u8"][..., 0].astype(jnp.int32)
+    chunk_cjk = p["chunk_u8"][..., 1].astype(jnp.int32)
     chunk_side = p["chunk_u8"][..., 2].astype(jnp.int32)
     direct = w0
     fp = w0
+
+    # Per-slot metadata from chunk metadata: chunk_base is constant within
+    # a span and strictly increases across spans, so span starts are the
+    # slots where it changes; side/cjk gather from the span's first chunk.
+    pad = kind == PAD
+    cb_prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, jnp.int32), chunk_base[:, :-1]], axis=1)
+    span_begin = (chunk_base != cb_prev) & ~pad
+    span_start = jax.lax.cummax(
+        jnp.where(span_begin, jnp.arange(L)[None, :], 0), axis=1)
+    side = jnp.take_along_axis(chunk_side, chunk_base, axis=1)
+    cjk = jnp.take_along_axis(chunk_cjk, chunk_base, axis=1)
+    span_end_off = jnp.take_along_axis(
+        p["chunk_u16"].astype(jnp.int32), chunk_base, axis=1)
 
     # ---- 1. table probes -------------------------------------------------
     sub_q1, key_q1 = _quad_sub_key(dt.quadgram, fp)
@@ -183,9 +209,10 @@ def score_batch_impl(dt: DeviceTables, p: dict):
         kv_quad2 = _probe(dt.quadgram2, sub_q2, key_q2)
     else:
         kv_quad2 = jnp.zeros_like(kv_quad)
-    sub, key = w0.astype(jnp.int32), w1   # octa records carry sub/key
-    kv_delta = _probe(dt.deltaocta, sub, key)
-    kv_dist = _probe(dt.distinctocta, sub, key)
+    sub_o, key_o = _octa_sub_key(dt.deltaocta, w0, fp_hi)
+    kv_delta = _probe(dt.deltaocta, sub_o, key_o)
+    sub_x, key_x = _octa_sub_key(dt.distinctocta, w0, fp_hi)
+    kv_dist = _probe(dt.distinctocta, sub_x, key_x)
     sub_bd, key_bd = _quad_sub_key(dt.cjkdeltabi, fp)
     sub_bx, key_bx = _quad_sub_key(dt.distinctbi, fp)
     kv_bid = _probe(dt.cjkdeltabi, sub_bd, key_bd)
@@ -195,7 +222,6 @@ def score_batch_impl(dt: DeviceTables, p: dict):
 
     # ---- 2. quad repeat filter (needs hit knowledge) ---------------------
     quad_hit = (kind == QUAD) & ((kv_quad != 0) | (kv_quad2 != 0))
-    span_begin = jnp.arange(L)[None, :] == span_start
     keep_quad = _quad_filter_scan(fp, quad_hit, span_begin)
 
     # ---- 3. langprob resolution ------------------------------------------

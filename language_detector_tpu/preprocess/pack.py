@@ -22,8 +22,7 @@ from ..registry import (RTYPE_CJK, RTYPE_MANY, RTYPE_NONE, RTYPE_ONE,
                         ULSCRIPT_LATIN, Registry)
 from ..tables import ScoringTables
 from .grams import MAX_SCORING_HITS, quad_positions, word_positions
-from .hashing import (bi_hash_v2, octa_hash40, octa_subscript_key, pair_hash,
-                      quad_hash_v2, quad_subscript_key)
+from .hashing import bi_hash_v2, octa_hash40, pair_hash, quad_hash_v2
 from .segment import ScriptSpan, segment_text, utf8_len_of_cps
 from .squeeze import TEST_THRESH, cheap_squeeze_trigger_test
 
@@ -42,10 +41,9 @@ class PackedBatch:
     # Per-slot arrays [B, L]
     kind: np.ndarray          # int8 candidate kind
     offset: np.ndarray        # int32 span-buffer offset
-    sub: np.ndarray           # int32 bucket subscript (table by kind)
-    key: np.ndarray           # uint32 probe key
-    fp: np.ndarray            # uint32 quad fingerprint (repeat filter)
-    direct: np.ndarray        # uint32 direct payload (seed langprob/uni class)
+    fp: np.ndarray            # uint32 fingerprint low 32 bits / direct
+                              # payload (seed langprob, uni compat class)
+    fp_hi: np.ndarray         # uint8 bits 32-39 of the 40-bit octa hash
     chunk_base: np.ndarray    # int32 first chunk id of the slot's span
     span_start: np.ndarray    # int32 first slot index of the slot's span
     span_end_off: np.ndarray  # int32 span end offset (dummy entry offset)
@@ -56,6 +54,7 @@ class PackedBatch:
     chunk_script: np.ndarray  # int16 ULScript of the chunk's span
     chunk_cjk: np.ndarray     # int8
     chunk_side: np.ndarray    # int8
+    chunk_span_end: np.ndarray  # int32 span end offset of the chunk's span
     # Direct doc-tote adds for RTypeNone/One spans [B, 4, 3]
     # (chunk_id, lang, bytes): each add owns a chunk id so the host epilogue
     # can replay all doc-tote adds in original span order.
@@ -92,11 +91,12 @@ def _pack_quad_span(span: ScriptSpan, tables: ScoringTables):
     wfps = octa_hash40(span.buf, wstarts, wlens) if len(wstarts) else \
         np.zeros(0, np.uint64)
 
-    # Hash-only octa repeat filter + pair hashes (cldutil.cc:459-502)
+    # Hash-only octa repeat filter + pair hashes (cldutil.cc:459-502).
+    # Records carry the 40-bit fingerprint (low 32 + high 8); the device
+    # derives each table's bucket subscript and key (ops/score.py).
     recs = []
     cache = [np.uint64(0), np.uint64(0)]
     nxt = 0
-    dt, xt = tables.deltaocta, tables.distinctocta
     n_delta = n_distinct = 0
     for i in range(len(wfps)):
         fpw = wfps[i]
@@ -106,17 +106,15 @@ def _pack_quad_span(span: ScriptSpan, tables: ScoringTables):
         nxt = 1 - nxt
         prior = cache[nxt]
         if prior != 0 and prior != fpw:
-            pfp = pair_hash(prior, fpw)
-            s, k = octa_subscript_key(np.array([pfp]), xt.keymask, xt.size)
+            pfp = int(pair_hash(prior, fpw))
             recs.append(dict(kind=DISTINCT_OCTA, offset=int(wpriors[i]),
-                             sub=int(s[0]), key=int(k[0])))
+                             fp=pfp & 0xFFFFFFFF, fp_hi=(pfp >> 32) & 0xFF))
             n_distinct += 1
-        s, k = octa_subscript_key(np.array([fpw]), xt.keymask, xt.size)
+        w = int(fpw)
         recs.append(dict(kind=DISTINCT_OCTA, offset=int(wstarts[i]),
-                         sub=int(s[0]), key=int(k[0])))
-        s, k = octa_subscript_key(np.array([fpw]), dt.keymask, dt.size)
+                         fp=w & 0xFFFFFFFF, fp_hi=(w >> 32) & 0xFF))
         recs.append(dict(kind=DELTA_OCTA, offset=int(wstarts[i]),
-                         sub=int(s[0]), key=int(k[0])))
+                         fp=w & 0xFFFFFFFF, fp_hi=(w >> 32) & 0xFF))
         n_delta += 1
         n_distinct += 1
         if n_delta >= MAX_SCORING_HITS or n_distinct >= MAX_SCORING_HITS - 1:
@@ -177,10 +175,8 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
     out = PackedBatch(
         kind=np.zeros((B, L), np.int8),
         offset=np.zeros((B, L), np.int32),
-        sub=np.zeros((B, L), np.int32),
-        key=np.zeros((B, L), np.uint32),
         fp=np.zeros((B, L), np.uint32),
-        direct=np.zeros((B, L), np.uint32),
+        fp_hi=np.zeros((B, L), np.uint8),
         chunk_base=np.zeros((B, L), np.int32),
         span_start=np.zeros((B, L), np.int32),
         span_end_off=np.zeros((B, L), np.int32),
@@ -190,6 +186,7 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
         chunk_script=np.zeros((B, C), np.int16),
         chunk_cjk=np.zeros((B, C), np.int8),
         chunk_side=np.zeros((B, C), np.int8),
+        chunk_span_end=np.zeros((B, C), np.int32),
         direct_adds=np.full((B, max_direct, 3), -1, np.int32),
         text_bytes=np.zeros(B, np.int32),
         fallback=np.zeros(B, bool),
@@ -248,10 +245,8 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
             for r in recs:
                 out.kind[b, slot] = r["kind"]
                 out.offset[b, slot] = r["offset"]
-                out.sub[b, slot] = r.get("sub", 0)
-                out.key[b, slot] = r.get("key", 0)
-                out.fp[b, slot] = r.get("fp", 0)
-                out.direct[b, slot] = r.get("direct", 0)
+                out.fp[b, slot] = r.get("fp", r.get("direct", 0))
+                out.fp_hi[b, slot] = r.get("fp_hi", 0)
                 out.chunk_base[b, slot] = chunk_base
                 out.span_end_off[b, slot] = span.text_bytes
                 out.side[b, slot] = side
@@ -260,10 +255,11 @@ def pack_batch(texts: list[str], tables: ScoringTables, reg: Registry,
                 slot += 1
             start = slot - len(recs)
             out.span_start[b, start:slot] = start
-            out.chunk_script[b, chunk_base:chunk_base + span_chunks] = \
-                span.ulscript
-            out.chunk_cjk[b, chunk_base:chunk_base + span_chunks] = cjk
-            out.chunk_side[b, chunk_base:chunk_base + span_chunks] = side
+            sl = slice(chunk_base, chunk_base + span_chunks)
+            out.chunk_script[b, sl] = span.ulscript
+            out.chunk_cjk[b, sl] = cjk
+            out.chunk_side[b, sl] = side
+            out.chunk_span_end[b, sl] = span.text_bytes
             chunk_base += span_chunks
         out.text_bytes[b] = total
         out.fallback[b] = not ok

@@ -1,0 +1,92 @@
+"""Native (C++) packer == Python packer, array for array.
+
+The Python packer (preprocess/pack.py) is the behavioral spec — itself
+validated against the scalar engine and oracle. The native packer
+(native/packer.cc) must reproduce every output array exactly on goldens,
+random composites, CJK, and edge inputs.
+"""
+import dataclasses
+import random
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from golden_data import golden_pairs  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def native_mod():
+    from language_detector_tpu import native
+    if not native.available():
+        pytest.skip("native packer unavailable (no compiler)")
+    return native
+
+
+@pytest.fixture(scope="session")
+def tables_reg():
+    from language_detector_tpu.registry import registry
+    from language_detector_tpu.tables import load_tables
+    return load_tables(), registry
+
+
+def _assert_packed_equal(texts, tables, reg, native_mod, **kw):
+    from language_detector_tpu.preprocess.pack import pack_batch
+    a = pack_batch(texts, tables, reg, **kw)
+    b = native_mod.pack_batch_native(texts, tables, reg, **kw)
+    for f in dataclasses.fields(a):
+        if f.name == "n_docs":
+            continue
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        assert np.array_equal(va, vb), \
+            f"{f.name} differs at {np.argwhere(np.asarray(va) != vb)[:5]}"
+
+
+def _golden_texts():
+    pairs = golden_pairs()
+    if not pairs:
+        pytest.skip("reference snapshot unavailable")
+    return [t.decode("utf-8", errors="replace") for _, _, t in pairs]
+
+
+def test_goldens(tables_reg, native_mod):
+    _assert_packed_equal(_golden_texts(), *tables_reg, native_mod)
+
+
+def test_random_composites(tables_reg, native_mod):
+    texts = _golden_texts()
+    rng = random.Random(99)
+    docs = []
+    for _ in range(64):
+        parts = []
+        for _ in range(rng.randint(1, 5)):
+            t = texts[rng.randrange(len(texts))]
+            lo = rng.randrange(max(1, len(t) - 300))
+            parts.append(t[lo:lo + rng.randint(10, 300)])
+        docs.append(" ".join(parts))
+    _assert_packed_equal(docs, *tables_reg, native_mod)
+
+
+def test_edge_inputs(tables_reg, native_mod):
+    docs = ["", " ", "a", "\n", "🎉🎊 fiesta", "123 !!!",
+            "x" * 5000, ("word " * 2000).strip(),
+            "Ğİıquick brown fox ÄÖÜ ß straße",
+            "日本語とEnglishの混在テキスト mixed script",
+            "а б в г д е ж з и к л м н о п",
+            "́̂ combining-first", "ab" * 30000]
+    _assert_packed_equal(docs, *tables_reg, native_mod)
+
+
+def test_flags_finish(tables_reg, native_mod):
+    docs = [("spam ham " * 600).strip(), "normal short text here"]
+    _assert_packed_equal(docs, *tables_reg, native_mod, flags=1)
+    _assert_packed_equal(docs, *tables_reg, native_mod, flags=0)
+
+
+def test_small_capacities(tables_reg, native_mod):
+    """Overflow -> fallback decisions must match at tight capacities."""
+    texts = _golden_texts()[:48]
+    _assert_packed_equal(texts, *tables_reg, native_mod,
+                         max_slots=128, max_chunks=8, max_direct=1)
