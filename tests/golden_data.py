@@ -3,13 +3,19 @@
 Reads unittest_data.h from the read-only reference snapshot at test time
 (kept out of the repo); tests depending on it skip when the snapshot is
 absent. Handles C string concatenation, hex/octal escapes, and commented-out
-entries.
+entries. Expected labels come from the authoritative kTestPair tables in
+cld2_unittest.cc / cld2_unittest_full.cc ({LANG_ENUM, kTeststr_*} rows,
+cld2_unittest_full.cc:48-270), resolved through the registry's C enum names —
+not from the kTeststr_* variable names, whose prefixes are lossy
+(kTeststr_zh_Hant pairs with CHINESE_T, kTeststr_xx_Bugi with X_Buginese).
 """
 import re
 from functools import lru_cache
 from pathlib import Path
 
 DATA_H = Path("/root/reference/cld2/internal/unittest_data.h")
+UNITTESTS = [Path("/root/reference/cld2/internal/cld2_unittest_full.cc"),
+             Path("/root/reference/cld2/internal/cld2_unittest.cc")]
 
 _ESC = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "'": "'"}
 
@@ -48,6 +54,27 @@ def _unescape(lit: str) -> bytes:
 
 
 @lru_cache(maxsize=1)
+def expected_labels() -> dict:
+    """kTeststr name -> expected ISO code, from the kTestPair tables."""
+    from language_detector_tpu.registry import registry
+
+    cname_to_code = {str(c): str(registry.lang_code[i])
+                     for i, c in enumerate(registry.lang_cname)}
+    out = {}
+    for path in UNITTESTS:
+        if not path.exists():
+            continue
+        for line in path.read_text(encoding="utf-8").splitlines():
+            line = line.strip()
+            if line.startswith("//"):
+                continue
+            m = re.match(r"\{(\w+),\s*kTeststr_(\w+)\}", line)
+            if m and m.group(1) in cname_to_code:
+                out.setdefault(m.group(2), cname_to_code[m.group(1)])
+    return out
+
+
+@lru_cache(maxsize=1)
 def golden_pairs() -> list:
     """[(name, expected_lang_code, text_bytes)] from unittest_data.h."""
     if not DATA_H.exists():
@@ -56,6 +83,7 @@ def golden_pairs() -> list:
     # Strip line comments so commented-out variants are ignored
     src = "\n".join(l for l in src.splitlines()
                     if not l.lstrip().startswith("//"))
+    labels = expected_labels()
     out = []
     for m in re.finditer(
             r'const char\*\s+kTeststr_(\w+)\s*=\s*((?:"(?:[^"\\]|\\.)*"\s*)+);',
@@ -65,7 +93,12 @@ def golden_pairs() -> list:
         text = b"".join(_unescape(l) for l in lits)
         if name == "version":
             continue
-        # name pattern: <langcode>_<Script>[digit]
-        lang = name.split("_")[0]
+        # kTestPair labels are per base name; the numbered variants
+        # (kTeststr_ar2 etc.) share the base entry's language.
+        base = name.rstrip("0123456789")
+        lang = labels.get(name) or labels.get(base)
+        if lang is None:
+            # Not in any kTestPair table: fall back to the name prefix
+            lang = name.split("_")[0]
         out.append((name, lang, text))
     return out
