@@ -52,15 +52,26 @@ class LanguageDetector:
         return DetectionResult.from_scalar(r, self.registry)
 
     def detect_batch(self, texts: list[str]) -> list[DetectionResult]:
-        rs = self._get_batch_engine().detect_batch(texts)
+        eng = self._get_batch_engine()
+        if eng is None:  # no usable accelerator backend: scalar per doc
+            return [self.detect(t) for t in texts]
+        rs = eng.detect_batch(texts)
         return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
     def _get_batch_engine(self):
         if self._batch_engine is None:
-            from .models.ngram import NgramBatchEngine
-            self._batch_engine = NgramBatchEngine(self.tables, self.registry,
-                                                  self.flags)
-        return self._batch_engine
+            try:
+                from .models.ngram import NgramBatchEngine
+                self._batch_engine = NgramBatchEngine(
+                    self.tables, self.registry, self.flags)
+            except (ImportError, RuntimeError) as e:
+                # jax missing or accelerator backend failed to initialize;
+                # anything else (bad tables, shape bugs) propagates loudly
+                import warnings
+                warnings.warn(f"batched engine unavailable ({e!r}); "
+                              "falling back to scalar detection")
+                self._batch_engine = False
+        return self._batch_engine or None
 
 
 _default_detector: LanguageDetector | None = None

