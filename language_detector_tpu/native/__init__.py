@@ -23,6 +23,7 @@ _SO = _DIR / "libldtpack.so"
 
 _lib = None
 _init_keepalive: list = []
+_lock = __import__("threading").Lock()
 
 
 def _build() -> bool:
@@ -34,18 +35,37 @@ def _build() -> bool:
         return False
 
 
+_SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_epilogue_batch",
+            "ldt_flatten_wire")
+
+
+def _try_load_all():
+    """CDLL + symbol check; None when any entry point is missing (stale
+    .so built from an older source set)."""
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        for sym in _SYMBOLS:
+            getattr(lib, sym).restype = None
+        return lib
+    except (OSError, AttributeError):
+        return None
+
+
 def _load():
     global _lib
-    if _lib is not None:
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = _try_load_all() if _SO.exists() else None
+        if lib is None:
+            # missing or stale: rebuild once, then retry
+            try:
+                _SO.unlink(missing_ok=True)
+            except OSError:
+                pass
+            lib = _try_load_all() if _build() else None
+        _lib = lib if lib is not None else False
         return _lib
-    if not _SO.exists() and not _build():
-        _lib = False
-        return _lib
-    lib = ctypes.CDLL(str(_SO))
-    lib.ldt_init.restype = None
-    lib.ldt_pack_batch.restype = None
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:
@@ -61,34 +81,41 @@ def _ptr(a: np.ndarray, dtype):
 
 
 def _ensure_init(tables: ScoringTables, reg: Registry):
-    """Upload table pointers once per (tables, registry) pair."""
+    """Upload table pointers once per (tables, registry) pair. Holds
+    strong references to the actual objects (not ids — CPython recycles
+    addresses) and serializes re-init across threads."""
     global _initialized_for
-    key = (id(tables), id(reg))
-    if _initialized_for == key:
+    key = (tables, reg)
+    if _initialized_for and _initialized_for[0] is tables and \
+            _initialized_for[1] is reg:
         return
     lib = _load()
-    seed_lp = np.zeros(reg.num_scripts, np.uint32)
-    for s in range(reg.num_scripts):
-        lang = reg.default_language(s)
-        seed_lp[s] = np.uint32(
-            reg.per_script_number(ULSCRIPT_LATIN, lang) << 8)
-    rtype = np.ascontiguousarray(reg.ulscript_rtype.astype(np.int32))
-    deflang = np.ascontiguousarray(
-        reg.ulscript_default_lang.astype(np.int32))
-    script_of = np.ascontiguousarray(tables.script_of_cp, dtype=np.uint8)
-    lower = np.arange(0x110000, dtype=np.uint32)
-    lower[tables.lower_pairs[:, 0]] = tables.lower_pairs[:, 1]
-    cjk_prop = np.ascontiguousarray(tables.cjk_uni_prop, dtype=np.uint8)
-    _init_keepalive.clear()
-    _init_keepalive.extend([seed_lp, rtype, deflang, script_of, lower,
-                            cjk_prop])
-    lib.ldt_init(
-        _ptr(script_of, np.uint8), _ptr(lower, np.uint32),
-        _ptr(cjk_prop, np.uint8), _ptr(rtype, np.int32),
-        _ptr(deflang, np.int32), _ptr(seed_lp, np.uint32),
-        ctypes.c_int32(reg.num_scripts),
-        ctypes.c_int32(1 if tables.distinctbi.empty else 0))
-    _initialized_for = key
+    with _lock:
+        if _initialized_for and _initialized_for[0] is tables and \
+                _initialized_for[1] is reg:
+            return
+        seed_lp = np.zeros(reg.num_scripts, np.uint32)
+        for s in range(reg.num_scripts):
+            lang = reg.default_language(s)
+            seed_lp[s] = np.uint32(
+                reg.per_script_number(ULSCRIPT_LATIN, lang) << 8)
+        rtype = np.ascontiguousarray(reg.ulscript_rtype.astype(np.int32))
+        deflang = np.ascontiguousarray(
+            reg.ulscript_default_lang.astype(np.int32))
+        script_of = np.ascontiguousarray(tables.script_of_cp, dtype=np.uint8)
+        lower = np.arange(0x110000, dtype=np.uint32)
+        lower[tables.lower_pairs[:, 0]] = tables.lower_pairs[:, 1]
+        cjk_prop = np.ascontiguousarray(tables.cjk_uni_prop, dtype=np.uint8)
+        _init_keepalive.clear()
+        _init_keepalive.extend([seed_lp, rtype, deflang, script_of, lower,
+                                cjk_prop])
+        lib.ldt_init(
+            _ptr(script_of, np.uint8), _ptr(lower, np.uint32),
+            _ptr(cjk_prop, np.uint8), _ptr(rtype, np.int32),
+            _ptr(deflang, np.int32), _ptr(seed_lp, np.uint32),
+            ctypes.c_int32(reg.num_scripts),
+            ctypes.c_int32(1 if tables.distinctbi.empty else 0))
+        _initialized_for = key
 
 
 def pack_batch_native(texts: list[str], tables: ScoringTables,
@@ -151,3 +178,93 @@ def pack_batch_native(texts: list[str], tables: ScoringTables,
         out.fallback.ctypes.data_as(ctypes.c_void_p),
         _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
     return out
+
+
+# -- batched document epilogue (epilogue.cc) --------------------------------
+
+_epi_reg_cache: tuple = ()  # single slot: (registry object, arrays)
+
+
+def _epilogue_reg_arrays(reg: Registry):
+    """close_set / closest_alt / is_figs as flat arrays, cached for the
+    last-used registry object (held by strong reference — never key by
+    id(), CPython recycles addresses)."""
+    global _epi_reg_cache
+    if _epi_reg_cache and _epi_reg_cache[0] is reg:
+        return _epi_reg_cache[1]
+    n = reg.num_languages
+    close = np.zeros(n, np.int32)
+    for lang in range(n):
+        close[lang] = reg.close_set(lang)
+    alt = np.full(n, 26, np.int32)
+    alt[:len(reg.closest_alt_lang)] = reg.closest_alt_lang.astype(np.int32)
+    figs = np.zeros(n, np.uint8)
+    for code in ("fr", "it", "de", "es"):
+        figs[reg.code_to_lang[code]] = 1
+    arrays = (close, alt, figs)
+    _epi_reg_cache = (reg, arrays)
+    return arrays
+
+
+def epilogue_batch_native(rows: np.ndarray, direct_adds: np.ndarray,
+                          text_bytes: np.ndarray, skip: np.ndarray,
+                          flags: int, reg: Registry) -> np.ndarray:
+    """Batched DocTote replay + document post-processing (epilogue.cc),
+    the C++ twin of models/ngram.py _doc_epilogue.
+
+    rows: [B, C, 5] int32 chunk summaries from the device scorer.
+    direct_adds: [B, D, 3] int32 (chunk_id, lang, bytes; -1 = pad).
+    skip: [B] bool - packer-fallback docs the caller resolves via the
+    scalar engine regardless.
+    Returns [B, 14] int64: summary, lang3[3], percent3[3], ns3[3],
+    text_bytes, is_reliable, need_scalar, pad."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native epilogue unavailable")
+    B, C, _ = rows.shape
+    D = direct_adds.shape[1]
+    close, alt, figs = _epilogue_reg_arrays(reg)
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    direct = np.ascontiguousarray(direct_adds, dtype=np.int32)
+    tb = np.ascontiguousarray(text_bytes, dtype=np.int32)
+    sk = np.ascontiguousarray(skip, dtype=np.uint8)
+    out = np.zeros((B, 14), np.int64)
+    lib.ldt_epilogue_batch(
+        _ptr(rows, np.int32), _ptr(direct, np.int32), _ptr(tb, np.int32),
+        _ptr(sk, np.uint8), ctypes.c_int32(B), ctypes.c_int32(C),
+        ctypes.c_int32(D), ctypes.c_int32(flags),
+        _ptr(close, np.int32), _ptr(alt, np.int32), _ptr(figs, np.uint8),
+        ctypes.c_int32(len(close)), _ptr(out, np.int64))
+    return out
+
+
+def flatten_wire_native(packed: PackedBatch, C: int, n_shards: int,
+                        N: int) -> dict:
+    """Dense PackedBatch -> flat ragged device wire (ldt_flatten_wire,
+    epilogue.cc). Same contract as the numpy path in models/ngram.py
+    to_wire, minus the l_iota dummy the caller adds."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native library unavailable")
+    B, Ls = packed.kind.shape
+    Cs = packed.chunk_script.shape[1]
+    w0 = np.zeros((n_shards, N), np.uint32)
+    w1 = np.zeros((n_shards, N), np.uint32)
+    chunks = np.zeros((B, C), np.uint32)
+    span_cb = np.zeros((B, C), np.uint8)
+    doc_start = np.zeros(B, np.int32)
+    n_slots = np.ascontiguousarray(packed.n_slots, dtype=np.int32)
+    lib.ldt_flatten_wire(
+        _ptr(packed.kind, np.int8), _ptr(packed.offset, np.int32),
+        _ptr(packed.fp, np.uint32), _ptr(packed.fp_hi, np.uint8),
+        _ptr(packed.chunk_base, np.int32), _ptr(packed.span_start, np.int32),
+        _ptr(packed.chunk_script, np.int16), _ptr(packed.chunk_cjk, np.int8),
+        _ptr(packed.chunk_side, np.int8),
+        _ptr(packed.chunk_span_end, np.int32),
+        _ptr(n_slots, np.int32),
+        ctypes.c_int32(B), ctypes.c_int32(Ls), ctypes.c_int32(Cs),
+        ctypes.c_int32(C), ctypes.c_int32(n_shards), ctypes.c_int32(N),
+        _ptr(w0, np.uint32), _ptr(w1, np.uint32), _ptr(chunks, np.uint32),
+        _ptr(span_cb, np.uint8), _ptr(doc_start, np.int32))
+    return dict(w0=w0, w1=w1, chunks=chunks, span_cb=span_cb,
+                doc_start=doc_start, n_slots=n_slots)

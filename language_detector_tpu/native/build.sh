@@ -2,5 +2,5 @@
 # Build the native packer shared library.
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -shared -fPIC -std=c++17 -o libldtpack.so packer.cc -lpthread
+g++ -O2 -shared -fPIC -std=c++17 -o libldtpack.so packer.cc epilogue.cc -lpthread
 echo "built $(pwd)/libldtpack.so"

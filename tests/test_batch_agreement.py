@@ -158,3 +158,17 @@ def test_chunk_level_parity(engine):
                 got.append(tuple(int(x) for x in rows[c, :4]))
         assert got == tote.adds, \
             f"doc {b}: {got[:6]} != {tote.adds[:6]} ({text[:50]!r})"
+
+
+def test_detect_many_matches_detect_batch(engine):
+    """The pipelined multi-batch entry point (fetch thread + pend
+    rotation) returns exactly what per-batch detection returns, in order,
+    including a final partial chunk and fallback/gate-failing docs."""
+    texts = _golden_texts()[:100] + ["", "tiny", "a b " * 400]
+    want = []
+    for i in range(0, len(texts), BATCH):
+        want.extend(engine.detect_batch(texts[i:i + BATCH]))
+    got = engine.detect_many(texts, batch_size=BATCH)
+    assert len(got) == len(texts)
+    assert [_result_tuple(r) for r in got] == \
+        [_result_tuple(r) for r in want]

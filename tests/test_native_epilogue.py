@@ -1,0 +1,131 @@
+"""C++ epilogue (native/epilogue.cc) vs the Python document epilogue.
+
+The native path must agree with models/ngram.py _doc_epilogue (itself
+pinned to the scalar engine by test_batch_agreement) on every document:
+real texts through the full pipeline, plus randomized chunk summaries that
+exercise DocTote eviction, close-pair merges, unreliable removal, and the
+summary-language edge cases far beyond what natural text reaches.
+"""
+import numpy as np
+import pytest
+
+from language_detector_tpu import native
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.models.ngram import NgramBatchEngine
+from language_detector_tpu.preprocess.pack import pack_batch
+from language_detector_tpu.registry import registry
+from language_detector_tpu.tables import ScoringTables
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+TEXTS = [
+    "The quick brown fox jumps over the lazy dog near the river bank",
+    "Le gouvernement a annoncé de nouvelles mesures pour aider les familles",
+    "Der Hund läuft schnell durch den großen Wald und findet einen Knochen",
+    "こんにちは世界。今日はとても良い天気ですね。散歩に行きましょう。",
+    "Привет мир, это предложение написано на русском языке для теста",
+    "मैं आज बाजार गया और कुछ फल खरीदे क्योंकि वे ताजा थे",
+    "Short",
+    "",
+    "Mixed language text avec du français and English zusammen gemischt",
+    "ไปโรงเรียนทุกวันเพื่อเรียนหนังสือและพบเพื่อน",
+]
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return NgramBatchEngine(ScoringTables.load(), registry)
+
+
+def _python_results(eng, texts, packed, out):
+    results = []
+    for b, text in enumerate(texts):
+        if packed.fallback[b]:
+            results.append(detect_scalar(text, eng.tables, eng.reg,
+                                         eng.flags))
+            continue
+        r = eng._doc_epilogue(packed, out, b)
+        if r is None:
+            r = detect_scalar(text, eng.tables, eng.reg, eng.flags)
+        results.append(r)
+    return results
+
+
+def test_native_epilogue_real_texts(eng):
+    texts = TEXTS * 3
+    packed = eng._pack(texts, eng.tables, eng.reg,
+                       max_slots=eng.max_slots, max_chunks=eng.max_chunks,
+                       flags=eng.flags)
+    out = eng.score_packed(packed)
+    want = _python_results(eng, texts, packed, out)
+    got = eng._epilogue_native(texts, packed, out)
+    assert [dataclass_tuple(r) for r in got] == \
+        [dataclass_tuple(r) for r in want]
+
+
+def dataclass_tuple(r):
+    return (r.summary_lang, r.language3, r.percent3, r.normalized_score3,
+            r.text_bytes, r.is_reliable)
+
+
+def test_native_epilogue_randomized(eng):
+    """Synthetic chunk summaries: random languages/bytes/scores/reliability
+    hammer the DocTote eviction + merge paths."""
+    rng = np.random.default_rng(7)
+    B, C, D = 256, 8, 4
+    langs = rng.integers(0, 200, (B, C)).astype(np.int32)
+    nbytes = rng.integers(0, 2000, (B, C)).astype(np.int32)
+    scores = rng.integers(0, 4000, (B, C)).astype(np.int32)
+    rel = rng.integers(0, 101, (B, C)).astype(np.int32)
+    real = (rng.random((B, C)) < 0.8).astype(np.int32)
+    rows = np.stack([langs, nbytes, scores, rel, real], axis=-1)
+    direct = np.full((B, D, 3), -1, np.int32)
+    # a third of docs get one direct add on a random chunk id
+    for b in range(0, B, 3):
+        direct[b, 0] = (int(rng.integers(0, C)),
+                        int(rng.integers(0, 200)),
+                        int(rng.integers(1, 500)))
+    text_bytes = rng.integers(0, 20000, B).astype(np.int32)
+    skip = np.zeros(B, bool)
+
+    ep = native.epilogue_batch_native(rows, direct, text_bytes, skip,
+                                      0, registry)
+
+    from language_detector_tpu.engine_scalar import (
+        FLAG_FINISH, GOOD_LANG1_PERCENT, GOOD_LANG1AND2_PERCENT,
+        SHORT_TEXT_THRESH, DocTote, calc_summary_lang, extract_lang_etc,
+        refine_close_pairs, remove_unreliable)
+    for b in range(B):
+        doc = DocTote()
+        dmap = {int(c): (int(l), int(n)) for c, l, n in direct[b] if c >= 0}
+        for c in range(C):
+            if c in dmap:
+                lang, nb = dmap[c]
+                doc.add(lang, nb, nb, 100)
+            elif rows[b, c, 4]:
+                doc.add(int(rows[b, c, 0]), int(rows[b, c, 1]),
+                        int(rows[b, c, 2]), int(rows[b, c, 3]))
+        refine_close_pairs(registry, doc)
+        doc.sort()
+        lang3, percent3, rel3, ns3, total, is_rel = extract_lang_etc(
+            doc, int(text_bytes[b]))
+        good = total <= SHORT_TEXT_THRESH or \
+            (is_rel and percent3[0] >= GOOD_LANG1_PERCENT) or \
+            (is_rel and percent3[0] + percent3[1] >= GOOD_LANG1AND2_PERCENT)
+        if not good:
+            assert ep[b, 12] == 1, b
+            continue
+        assert ep[b, 12] == 0, b
+        remove_unreliable(registry, doc)
+        doc.sort()
+        lang3, percent3, rel3, ns3, total, is_rel = extract_lang_etc(
+            doc, int(text_bytes[b]))
+        summary, reliable = calc_summary_lang(registry, lang3, percent3,
+                                              total, is_rel, 0)
+        assert ep[b, 0] == summary, b
+        assert list(ep[b, 1:4]) == lang3, b
+        assert list(ep[b, 4:7]) == percent3, b
+        assert [float(x) for x in ep[b, 7:10]] == ns3, b
+        assert ep[b, 10] == total, b
+        assert bool(ep[b, 11]) == (is_rel and reliable), b
