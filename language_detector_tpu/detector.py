@@ -24,6 +24,9 @@ class DetectionResult:
     is_reliable: bool
     top3: list                # [(code, percent, normalized_score)] * 3
     text_bytes: int
+    # bytes of the longest interchange-valid UTF-8 prefix; set by the
+    # CheckUTF8 entry points (compact_lang_det.h:168+ *CheckUTF8 contract)
+    valid_prefix_bytes: int | None = None
 
     @classmethod
     def from_scalar(cls, r: ScalarResult, reg: Registry) -> "DetectionResult":
@@ -47,9 +50,49 @@ class LanguageDetector:
         self.flags = flags
         self._batch_engine = None  # lazily built batched JAX engine
 
-    def detect(self, text: str) -> DetectionResult:
-        r = detect_scalar(text, self.tables, self.registry, self.flags)
+    def detect(self, text: str,
+               is_plain_text: bool = True) -> DetectionResult:
+        r = detect_scalar(text, self.tables, self.registry, self.flags,
+                          is_plain_text=is_plain_text)
         return DetectionResult.from_scalar(r, self.registry)
+
+    def span_interchange_valid(self, data: bytes) -> int:
+        """Length of the longest structurally-valid, interchange-valid
+        UTF-8 prefix (SpanInterchangeValid, compact_lang_det_impl.cc:74-80
+        over the utf8acceptinterchange scanner)."""
+        import numpy as np
+        try:
+            text = data.decode("utf-8")
+            struct_ok = len(data)
+        except UnicodeDecodeError as e:
+            struct_ok = e.start
+            text = data[:e.start].decode("utf-8")
+        if not text:
+            return 0
+        cps = np.frombuffer(text.encode("utf-32-le"), np.uint32)
+        ok = self.tables.interchange_ok[cps] != 0
+        if ok.all():
+            return struct_ok
+        bad = int(np.argmin(ok))
+        return len(text[:bad].encode("utf-8"))
+
+    def detect_bytes(self, data: bytes, is_plain_text: bool = True,
+                     check_utf8: bool = True) -> DetectionResult:
+        """Detect raw UTF-8 bytes. With check_utf8 (the reference's
+        *CheckUTF8 entry points, compact_lang_det.cc:317), input that is
+        not fully interchange-valid answers UNKNOWN with
+        valid_prefix_bytes set instead of laundering bad bytes."""
+        valid = self.span_interchange_valid(data)
+        if check_utf8 and valid < len(data):
+            return DetectionResult(
+                language=self.registry.code(UNKNOWN_LANGUAGE),
+                language_id=UNKNOWN_LANGUAGE, is_reliable=False,
+                top3=[(self.registry.code(UNKNOWN_LANGUAGE), 0, 0.0)] * 3,
+                text_bytes=0, valid_prefix_bytes=valid)
+        r = self.detect(data.decode("utf-8", errors="replace"),
+                        is_plain_text=is_plain_text)
+        r.valid_prefix_bytes = valid
+        return r
 
     def detect_batch(self, texts: list[str]) -> list[DetectionResult]:
         eng = self._get_batch_engine()

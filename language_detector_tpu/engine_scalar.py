@@ -681,12 +681,16 @@ def _respan(text_bytes: bytes, ulscript: int) -> ScriptSpan:
 
 def detect_scalar(text: str, tables: ScoringTables | None = None,
                   reg: Registry | None = None,
-                  flags: int = 0) -> ScalarResult:
+                  flags: int = 0, is_plain_text: bool = True) -> ScalarResult:
     """Full-document detection (DetectLanguageSummaryV2,
     compact_lang_det_impl.cc:1707-2106), including the squeeze/repeat
-    anti-spam recursion."""
+    anti-spam recursion. is_plain_text=False strips HTML tags / expands
+    entities first (preprocess/html.py)."""
     tables = tables or load_tables()
     reg = reg or default_registry
+    if not is_plain_text:
+        from .preprocess.html import clean_html
+        text, _ = clean_html(text, tables)
     ctx = ScoringContext(tables=tables, registry=reg, flags=flags)
     doc_tote = DocTote()
     total_text_bytes = 0

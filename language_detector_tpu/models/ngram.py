@@ -178,6 +178,11 @@ class NgramBatchEngine:
         from .. import native
         self._pack = native.pack_batch_native if native.available() \
             else pack_batch
+        # Running totals for observability (service /metrics): batches
+        # scored, packer-fallback docs, and docs that failed the
+        # good-answer gate into the scalar recursion
+        self.stats = {"batches": 0, "fallback_docs": 0,
+                      "scalar_recursion_docs": 0}
 
     # -- device dispatch ----------------------------------------------------
 
@@ -243,6 +248,8 @@ class NgramBatchEngine:
         copy (detect_many's fetch thread)."""
         out = np.asarray(fut.result()) if hasattr(fut, "result") \
             else np.asarray(fut)
+        self.stats["batches"] += 1
+        self.stats["fallback_docs"] += int(packed.fallback.sum())
         from .. import native
         if native.available():
             return self._epilogue_native(texts, packed, out)
@@ -254,6 +261,7 @@ class NgramBatchEngine:
                 continue
             r = self._doc_epilogue(packed, out, b)
             if r is None:  # failed the good-answer gate: scalar recursion
+                self.stats["scalar_recursion_docs"] += 1
                 r = detect_scalar(text, self.tables, self.reg, self.flags)
             results.append(r)
         return results
@@ -271,6 +279,8 @@ class NgramBatchEngine:
         for b, text in enumerate(texts):
             row = ep[b]
             if row[12]:  # need_scalar
+                if not packed.fallback[b]:
+                    self.stats["scalar_recursion_docs"] += 1
                 results.append(detect_scalar(text, self.tables, self.reg,
                                              self.flags))
                 continue

@@ -70,13 +70,22 @@ def _decode_utf32(text: str) -> np.ndarray:
 
 
 def segment_text(text: str,
-                 tables: ScoringTables | None = None) -> list[ScriptSpan]:
+                 tables: ScoringTables | None = None,
+                 is_plain_text: bool = True) -> list[ScriptSpan]:
     """Split text into per-script spans of lowercased letters.
+
+    is_plain_text=False first strips HTML tags and expands entities
+    (preprocess/html.py), the separated-concerns equivalent of the
+    reference scanner's inline tag state machine (getonescriptspan.cc
+    :150-196, :393-480).
 
     (The reference computes a 160KB textlimit, compact_lang_det_impl.cc:1811,
     but never consults it in this version; the whole document is scanned.)
     """
     tables = tables or load_tables()
+    if not is_plain_text:
+        from .html import clean_html
+        text, _ = clean_html(text, tables)
     cps = _decode_utf32(text)
     if len(cps) == 0:
         return []

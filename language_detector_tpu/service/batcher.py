@@ -1,0 +1,81 @@
+"""Request batcher: many concurrent HTTP requests -> few large device
+batches.
+
+The reference calls the detector once per item inside the handler loop
+(handlers.go:133-186, one cgo call each); the TPU redesign accumulates
+items from all in-flight requests and dispatches them as one batch
+(SURVEY.md §3.1), trading a small queueing delay for device efficiency.
+A single worker thread drains the queue, flushing when `max_batch` items
+are pending or `max_delay_ms` has passed since the oldest undispatched
+item arrived.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+
+
+class Batcher:
+    """Deadline/size-batched dispatcher over a detection engine."""
+
+    def __init__(self, detect_fn, max_batch: int = 4096,
+                 max_delay_ms: float = 5.0):
+        self._detect = detect_fn          # list[str] -> list[results]
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1e3
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ldt-batcher")
+        self._thread.start()
+
+    def submit(self, texts: list) -> Future:
+        """Queue one request's texts; resolves to their results (in
+        order) once a batch containing them completes."""
+        fut: Future = Future()
+        self._q.put((texts, fut))
+        return fut
+
+    def close(self):
+        self._stop.set()
+        self._q.put(None)  # wake the worker
+        self._thread.join(timeout=5)
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self):
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                continue
+            pending = [item]
+            n = len(item[0])
+            # accumulate until deadline or size cap
+            import time
+            deadline = time.monotonic() + self.max_delay
+            while n < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                pending.append(nxt)
+                n += len(nxt[0])
+            texts = [t for ts, _ in pending for t in ts]
+            try:
+                results = self._detect(texts)
+            except Exception as e:  # noqa: BLE001 - fail every waiter
+                for _, fut in pending:
+                    if not fut.cancelled():
+                        fut.set_exception(e)
+                continue
+            i = 0
+            for ts, fut in pending:
+                if not fut.cancelled():
+                    fut.set_result(results[i:i + len(ts)])
+                i += len(ts)

@@ -1,0 +1,345 @@
+"""HTTP JSON batch detection service.
+
+Behavior-compatible rebuild of the reference Go microservice (main.go,
+handlers.go) over the batched TPU engine:
+
+  GET  /   -> canned usage JSON                    (main.go:41-60, :150)
+  POST /   -> {"request": [{"text": ...}, ...]} ->
+              {"response": [{"iso6391code": ..., "name": ...}, ...]}
+              (handlers.go:105-186); per-item "Missing text key" errors
+              keep the batch going with overall HTTP 400; an unmapped
+              language code answers name "Unknown" with HTTP 203
+  else     -> 404 {"error": "Not found"}
+
+Request validation mirrors GetRequests (handlers.go:33-69): Content-Type
+must be application/json (400), the body is truncated at 1 MB before
+parsing, and invalid JSON answers 400. @mention / http link words are
+stripped before detection (StripExtras, handlers.go:198-210).
+
+Metrics: Prometheus text format on a second port (main.go:137-147 series,
+plus TPU-batch gauges: fallback-document count and batch flushes), and a
+throughput log line every 1000 objects (main.go:209-218).
+
+Ports come from LISTEN_PORT / PROMETHEUS_PORT env vars (main.go:91-116).
+Run: python -m language_detector_tpu.service.server
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from .batcher import Batcher
+
+BODY_LIMIT_BYTES = 1_000_000            # main.go:59
+OBJECTS_PER_LOG = 1000                  # main.go:61
+
+USAGE = {
+    "result": {
+        "id": "language-detector",
+        "name": "language-detector",
+        "description": "Determine language code from text",
+        "in": {"text": {"type": "string"}},
+        "out": {"iso6391code": {"type": "string"},
+                "name": {"type": "string"}},
+    }
+}
+
+_CODES_FILE = Path(__file__).parent / "cld_codes.json"
+
+
+def strip_extras(text: str) -> str:
+    """Remove @mentions and links, which skew detection
+    (StripExtras, handlers.go:198-210; note the trailing space the
+    word-join loop leaves behind)."""
+    kept = [w for w in text.split()
+            if not (w.startswith("@") or w.startswith("http"))]
+    return "".join(w + " " for w in kept)
+
+
+class Metrics:
+    """Prometheus-style counters (main.go:137-147) + TPU batch stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {
+            "augmentation_requests_total": 0,
+            "augmentation_invalid_requests_total": 0,
+            "augmentation_request_duration_milliseconds": 0.0,
+            "augmentation_errors_logged_total": 0,
+            "ldt_batch_flushes_total": 0,
+            "ldt_fallback_documents_total": 0,
+        }
+        self.objects = {"successful": 0, "unsuccessful": 0}
+        self.languages: dict = {}
+
+    def inc(self, name: str, amount: float = 1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + amount
+
+    def inc_object(self, status: str):
+        with self._lock:
+            self.objects[status] += 1
+
+    def inc_language(self, name: str):
+        with self._lock:
+            self.languages[name] = self.languages.get(name, 0) + 1
+
+    def render(self) -> str:
+        with self._lock:
+            lines = []
+            for k, v in sorted(self.counters.items()):
+                lines.append(f"# TYPE {k} counter")
+                lines.append(f"{k} {v}")
+            lines.append("# TYPE augmentation_objects_processed_total "
+                         "counter")
+            for status, v in sorted(self.objects.items()):
+                lines.append('augmentation_objects_processed_total'
+                             f'{{status="{status}"}} {v}')
+            lines.append("# TYPE augmentation_detected_language counter")
+            for lang, v in sorted(self.languages.items()):
+                lines.append('augmentation_detected_language'
+                             f'{{language="{lang}"}} {v}')
+            return "\n".join(lines) + "\n"
+
+
+class DetectorService:
+    """Engine + batcher + metrics shared by all handler threads."""
+
+    def __init__(self, max_batch: int = 4096, max_delay_ms: float = 5.0,
+                 use_device: bool = True):
+        self.metrics = Metrics()
+        self.known = json.loads(_CODES_FILE.read_text())
+        self._num_processed = 0
+        self._window_start = time.time()
+        self._detect = self._make_detect(use_device)
+        self.batcher = Batcher(self._detect, max_batch=max_batch,
+                               max_delay_ms=max_delay_ms)
+
+    def _make_detect(self, use_device: bool):
+        from ..registry import registry
+        self._registry = registry
+        if use_device:
+            try:
+                from ..models.ngram import NgramBatchEngine
+                eng = NgramBatchEngine()
+                self._engine = eng
+                metrics = self.metrics
+
+                def detect(texts):
+                    before = dict(eng.stats)
+                    results = eng.detect_batch(texts)
+                    metrics.inc("ldt_batch_flushes_total",
+                                eng.stats["batches"] - before["batches"])
+                    metrics.inc("ldt_fallback_documents_total",
+                                (eng.stats["fallback_docs"] -
+                                 before["fallback_docs"]) +
+                                (eng.stats["scalar_recursion_docs"] -
+                                 before["scalar_recursion_docs"]))
+                    return [registry.code(r.summary_lang) for r in results]
+                return detect
+            except (ImportError, RuntimeError):
+                pass
+        from ..engine_scalar import detect_scalar
+        from ..tables import load_tables
+        tables = load_tables()
+        self._engine = None
+
+        def detect(texts):
+            return [registry.code(
+                detect_scalar(t, tables, registry).summary_lang)
+                for t in texts]
+        return detect
+
+    def detect_codes(self, texts: list) -> list:
+        fut = self.batcher.submit(texts)
+        return fut.result(timeout=60)
+
+    def log_processed(self):
+        """Throughput log every OBJECTS_PER_LOG objects (main.go:209)."""
+        self._num_processed += 1
+        if self._num_processed >= OBJECTS_PER_LOG:
+            took = time.time() - self._window_start
+            rate = OBJECTS_PER_LOG / max(took, 1e-9)
+            print(json.dumps({
+                "msg": f"Processed {OBJECTS_PER_LOG} objects in "
+                       f"{took:.3f}s ({rate:.2f} per second)",
+                "took": f"{took:.3f}s",
+                "throughput": f"{rate:.2f}"}), flush=True)
+            self._num_processed = 0
+            self._window_start = time.time()
+
+
+class Handler(BaseHTTPRequestHandler):
+    service: DetectorService  # injected by make_server
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers ------------------------------------------------------------
+
+    def _send_json(self, status: int, payload: bytes):
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_error_json(self, message: str, status: int):
+        self.service.metrics.inc("augmentation_errors_logged_total")
+        self._send_json(status,
+                        json.dumps({"error": message}).encode())
+
+    def log_message(self, fmt, *args):  # quiet access log
+        pass
+
+    # -- routes -------------------------------------------------------------
+
+    def do_GET(self):
+        t0 = time.time()
+        if self.path in ("/", ""):
+            self._send_json(200, json.dumps(USAGE).encode())
+        else:
+            self.service.metrics.inc("augmentation_invalid_requests_total")
+            self._send_json(404, b'{"error":"Not found"}')
+        self._finish_metrics(t0)
+
+    def do_POST(self):
+        t0 = time.time()
+        body = self._consume_body()  # always drain: keep-alive stays sane
+        if self.path not in ("/", ""):
+            self.service.metrics.inc("augmentation_invalid_requests_total")
+            self._send_json(404, b'{"error":"Not found"}')
+            self._finish_metrics(t0)
+            return
+        self._detector(body)
+        self._finish_metrics(t0)
+
+    def _finish_metrics(self, t0: float):
+        m = self.service.metrics
+        m.inc("augmentation_requests_total")
+        m.inc("augmentation_request_duration_milliseconds",
+              (time.time() - t0) * 1e3)
+
+    def _consume_body(self) -> bytes:
+        """Read the request body, truncated at 1 MB, draining any excess
+        so a keep-alive connection stays in sync (handlers.go:43 LimitReader
+        semantics; Go's net/http drains automatically, http.server doesn't)."""
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(min(length, BODY_LIMIT_BYTES))
+        left = length - len(body)
+        while left > 0:
+            chunk = self.rfile.read(min(left, 65536))
+            if not chunk:
+                break
+            left -= len(chunk)
+        return body
+
+    def _parse_body(self, body: bytes):
+        """Content-Type check + JSON parse (handlers.go:33-69)."""
+        m = self.service.metrics
+        if self.headers.get("Content-Type") != "application/json":
+            m.inc("augmentation_invalid_requests_total")
+            self._send_error_json(
+                "Content-Type must be set to application/json", 400)
+            return None
+        try:
+            return json.loads(body)
+        except json.JSONDecodeError:
+            m.inc("augmentation_invalid_requests_total")
+            self._send_error_json(
+                "Unable to parse request - invalid JSON detected", 400)
+            return None
+
+    def _detector(self, body: bytes):
+        """LanguageDetectorHandler (handlers.go:105-186)."""
+        svc = self.service
+        m = svc.metrics
+        doc = self._parse_body(body)
+        if doc is None:
+            m.inc_object("unsuccessful")
+            return
+        if not isinstance(doc, dict) or "request" not in doc:
+            m.inc("augmentation_invalid_requests_total")
+            self._send_error_json(
+                "Unable to parse request - invalid JSON detected", 400)
+            return
+        requests = doc["request"]
+        if not isinstance(requests, list):
+            requests = []
+
+        status = 200
+        responses = []
+        texts, slots = [], []
+        for i, item in enumerate(requests):
+            if not isinstance(item, dict) or "text" not in item:
+                m.inc_object("unsuccessful")
+                responses.append({"error": "Missing text key"})
+                status = 400
+                continue
+            texts.append(strip_extras(str(item["text"])))
+            slots.append(i)
+            responses.append(None)
+
+        codes = svc.detect_codes(texts) if texts else []
+        for i, code in zip(slots, codes):
+            name = svc.known.get(code)
+            if name is None:
+                name = "Unknown"
+                if status == 200:
+                    status = 203
+            responses[i] = {"iso6391code": code, "name": name}
+            m.inc_language(name)
+            m.inc_object("successful")
+            svc.log_processed()
+
+        self._send_json(status, json.dumps(
+            {"response": responses}).encode())
+
+
+class MetricsHandler(BaseHTTPRequestHandler):
+    service: DetectorService
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        body = self.service.metrics.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def make_server(port: int = 0, metrics_port: int = 0,
+                service: DetectorService | None = None):
+    """Build (but don't run) the HTTP + metrics servers; port 0 picks
+    ephemeral ports (tests)."""
+    svc = service or DetectorService()
+    handler = type("BoundHandler", (Handler,), {"service": svc})
+    httpd = ThreadingHTTPServer(("", port), handler)
+    mhandler = type("BoundMetricsHandler", (MetricsHandler,),
+                    {"service": svc})
+    metricsd = ThreadingHTTPServer(("", metrics_port), mhandler)
+    return httpd, metricsd, svc
+
+
+def main():
+    port = int(os.environ.get("LISTEN_PORT", 3000))
+    metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
+    httpd, metricsd, svc = make_server(port, metrics_port)
+    threading.Thread(target=metricsd.serve_forever, daemon=True).start()
+    print(json.dumps({"msg": f"language-detector listening on :{port}, "
+                             f"metrics on :{metrics_port}"}), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.batcher.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,9 @@ class ScoringTables:
     lg_prob: np.ndarray           # [240, 8] uint8 quantized log-prob decode
     script_of_cp: np.ndarray      # [0x110000] uint8 letter -> ULScript (0=not)
     lower_pairs: np.ndarray       # [n, 2] uint32 (cp, lowercase cp)
+    interchange_ok: np.ndarray    # [0x110000] uint8 interchange-valid flag
+    entity_names: np.ndarray      # [265] str HTML entity names (sorted)
+    entity_values: np.ndarray     # [265] int32 entity codepoints
 
     @classmethod
     def load(cls, path: Path = _DATA,
@@ -124,6 +127,9 @@ class ScoringTables:
             lg_prob=z["lg_prob_v2"],
             script_of_cp=z["script_of_cp"],
             lower_pairs=z["lower_pairs"],
+            interchange_ok=z["interchange_ok"],
+            entity_names=z["entity_names"],
+            entity_values=z["entity_values"],
         )
 
 

@@ -69,23 +69,24 @@ def oracle():
     return lib
 
 
-def oracle_detect(lib, text: bytes, flags: int = 0):
+def oracle_detect(lib, text: bytes, flags: int = 0,
+                  is_plain_text: bool = True):
     """Helper: run full oracle detection, return (summary_code, top3, reliable)."""
     l3 = (ctypes.c_int * 3)()
     p3 = (ctypes.c_int * 3)()
     s3 = (ctypes.c_double * 3)()
     tb = ctypes.c_int()
     rel = ctypes.c_int()
-    lang = lib.o_detect(text, len(text), 1, flags, l3, p3, s3,
-                        ctypes.byref(tb), ctypes.byref(rel))
+    lang = lib.o_detect(text, len(text), 1 if is_plain_text else 0, flags,
+                        l3, p3, s3, ctypes.byref(tb), ctypes.byref(rel))
     top3 = [(lib.o_lang_code(l3[i]).decode(), p3[i], s3[i]) for i in range(3)]
     return (lib.o_lang_code(lang).decode(), lang, top3, bool(rel.value),
             tb.value)
 
 
-def oracle_spans(lib, text: bytes):
+def oracle_spans(lib, text: bytes, is_plain_text: bool = True):
     """Helper: iterate the oracle's script-span scanner."""
-    h = lib.o_scanner_new(text, len(text), 1)
+    h = lib.o_scanner_new(text, len(text), 1 if is_plain_text else 0)
     out = ctypes.create_string_buffer(40960 + 16)
     n = ctypes.c_int()
     sc = ctypes.c_int()

@@ -38,8 +38,14 @@ def test_golden_accuracy_floor():
 
     Context: the reference snapshot is missing its quadgram data files, so
     the compiled reference itself scores only 56/402 here; the trained
-    tables (tools/train_quad_tables.py) recover Latin/Cyrillic/etc.
-    detection to ~65%."""
+    tables (tools/train_quad_tables.py: octa-word + CLDR vocabulary,
+    sweep-selected hyperparameters) recover detection to ~75.6%
+    (docs/eval_goldens_r03.txt). The gate sits just under that. About 5%
+    of the suite is unreachable from clean vocabulary (Zawgyi-encoded
+    Burmese, the X_BORK_BORK_BORK joke languages, Arabic-script Tajik,
+    languages with no vocabulary source); the rest of the gap to the
+    >=99% north star needs running-text n-gram statistics that no corpus
+    in this environment provides."""
     from language_detector_tpu.tables import ScoringTables
     prod = ScoringTables.load()
     hits = 0
@@ -51,4 +57,4 @@ def test_golden_accuracy_floor():
         if got == lang or (got, lang) == ("hmn", "blu"):  # same language
             hits += 1
     assert total > 100
-    assert hits / total > 0.60, f"accuracy {hits}/{total}"
+    assert hits / total > 0.72, f"accuracy {hits}/{total}"

@@ -1,0 +1,78 @@
+"""HTML path parity vs the reference scanner (is_plain_text=false).
+
+Span-level: our clean-then-segment pipeline (preprocess/html.py +
+segment.py) must produce the same lowercased span text and scripts as the
+reference's inline tag state machine + entity expansion
+(getonescriptspan.cc:150-196, :393-480), on synthetic HTML and on the
+reference's own docs/a_little_french_test_input.html.
+"""
+from pathlib import Path
+
+import pytest
+
+from language_detector_tpu.preprocess.segment import segment_text
+
+from conftest import oracle_detect, oracle_spans
+
+FRENCH_HTML = Path("/root/reference/cld2/docs/a_little_french_test_input.html")
+
+HTML_TEXTS = [
+    "<html><body><p>Hello world this is English</p></body></html>",
+    "Plain start <b>bold words</b> and <i>italic ones</i> here",
+    "caf&eacute; fran&ccedil;ais &agrave; l&#39;heure &#xE9;t&eacute;",
+    "<!-- a comment with English words inside --> visible text only",
+    "<script>var x = 'code noise';</script> real sentence here",
+    "<script src=x>alert(1)</script> attributed script tag",
+    "<style>body { color: red; }</style> styled text after",
+    "a < b but also x > y inequalities",
+    "<a href=\"http://x.example/path?q=1&lang=en\">le lien</a> suite du texte",
+    "<div class='unterminated",
+    "text with &amp; and &lt;tags&gt; escaped &unknownent; kept",
+    "<p lang=\"fr\">Ceci est une phrase française assez longue.</p>",
+    "R&D department results &NotAnEntity works",
+    "&#120; &#x79; &#122; numeric entities",
+    "<<double open then text",
+]
+
+
+def _spans_mine(text: str):
+    return [(sp.text, sp.ulscript)
+            for sp in segment_text(text, is_plain_text=False)]
+
+
+@pytest.mark.parametrize("text", HTML_TEXTS)
+def test_html_span_parity(oracle, text):
+    ref = oracle_spans(oracle, text.encode("utf-8"), is_plain_text=False)
+    mine = _spans_mine(text)
+    assert len(mine) == len(ref), (mine, ref)
+    for (mt, ms), (rt, rs) in zip(mine, ref):
+        assert ms == rs, (mt, rt, rs)
+        assert mt == rt, (mt, rt)
+
+
+def test_french_html_file_span_parity(oracle):
+    if not FRENCH_HTML.exists():
+        pytest.skip("reference snapshot unavailable")
+    raw = FRENCH_HTML.read_bytes()
+    text = raw.decode("utf-8", errors="replace")
+    ref = oracle_spans(oracle, text.encode("utf-8"), is_plain_text=False)
+    mine = _spans_mine(text)
+    assert len(mine) == len(ref), (len(mine), len(ref))
+    for (mt, ms), (rt, rs) in zip(mine, ref):
+        assert ms == rs
+        assert mt == rt
+
+
+def test_french_html_detection_parity(oracle, base_tables):
+    """Full-document HTML detection agrees with the oracle."""
+    if not FRENCH_HTML.exists():
+        pytest.skip("reference snapshot unavailable")
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.registry import registry
+    text = FRENCH_HTML.read_bytes().decode("utf-8", errors="replace")
+    code, _, top3, reliable, tb = oracle_detect(
+        oracle, text.encode("utf-8"), is_plain_text=False)
+    r = detect_scalar(text, base_tables, is_plain_text=False)
+    assert registry.code(r.summary_lang) == code
+    assert r.is_reliable == reliable
+    assert r.text_bytes == tb

@@ -120,9 +120,63 @@ def collect_cldr_words(reg) -> list:
     return [(phrase, lang, q) for (lang, phrase), q in best.items()]
 
 
+def collect_mo_phrases(reg) -> list:
+    """[(phrase, lang_id, qprob)] from gettext catalogs (.mo) shipped
+    inside installed packages (humanize etc.): translated UI sentences
+    rich in the function words the octa delta tables deliberately omit
+    (the reference's quad tables covered them)."""
+    import gettext
+    import site
+    out = []
+    seen = set()
+    roots = [Path(p) for p in site.getsitepackages()]
+    for root in roots:
+        for mo in root.glob("*/locale/*/LC_MESSAGES/*.mo"):
+            code = mo.parent.parent.name.split("_")[0]
+            code = ALIASES.get(code, code)
+            if code in SKIP_LANGS:
+                continue
+            lang = reg.code_to_lang.get(code)
+            if lang is None:
+                continue
+            try:
+                cat = gettext.GNUTranslations(mo.open("rb"))._catalog
+            except Exception:
+                continue
+            for msg in cat.values():
+                for s in (msg if isinstance(msg, (list, tuple)) else [msg]):
+                    phrase = _clean_phrase(s)
+                    if not phrase or len(phrase) > 120:
+                        continue
+                    k = (lang, phrase)
+                    if k not in seen:
+                        seen.add(k)
+                        out.append((phrase, lang, 8))
+    return out
+
+
+# English function words (sklearn's ENGLISH_STOP_WORDS is itself the
+# classic Glasgow IR list): the delta-octa word source systematically
+# lacks them because the reference's real quadgram tables already scored
+# them (so they never made the "delta" cut).
+def collect_english_stopwords(reg) -> list:
+    try:
+        from sklearn.feature_extraction.text import ENGLISH_STOP_WORDS
+    except ImportError:
+        return []
+    lang = reg.code_to_lang.get("en")
+    if lang is None:
+        return []
+    return [(w, lang, 9) for w in sorted(ENGLISH_STOP_WORDS)]
+
+
 def main():
     from language_detector_tpu.registry import registry
     words = collect_cldr_words(registry)
+    mo = collect_mo_phrases(registry)
+    sw = collect_english_stopwords(registry)
+    print(f"mo phrases: {len(mo)}; en stopwords: {len(sw)}")
+    words = words + mo + sw
     import collections
     per_lang = collections.Counter(lang for _, lang, _ in words)
     print(f"phrases: {len(words)} across {len(per_lang)} languages")

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Per-language precision/recall/F evaluation harness.
+
+The TPU rebuild of the reference's offline evaluator (scoreutf8text.cc:547,
+whose published outputs are cld2/docs/evaluate_cld2_large_20140122.txt
+etc.): detect every labeled document, tally per-language
+correct/wrong-got/wrong-missed counts, and print per-language
+precision/recall/F plus the _Totals_Known aggregate row and the top
+confusions per language.
+
+Input: a TSV of "code<TAB>text" lines (--corpus), or the reference golden
+suite by default (tests/golden_data.py). Detection runs on the batched
+engine when an accelerator is available, else the scalar engine.
+
+Usage:
+  python3 tools/eval_corpus.py [--corpus file.tsv] [--out docs/eval.txt]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+from language_detector_tpu.registry import registry  # noqa: E402
+from language_detector_tpu.tables import ScoringTables  # noqa: E402
+
+# label aliases: the golden labels use a few codes our newer registry
+# renames (tests/test_golden_parity.py applies the same equivalence)
+ALIASES = {("hmn", "blu"): True}
+
+
+def load_pairs(path: str | None):
+    if path:
+        pairs = []
+        for line in Path(path).read_text().splitlines():
+            if "\t" in line:
+                code, text = line.split("\t", 1)
+                pairs.append((code.strip(), text))
+        return pairs
+    from golden_data import golden_pairs
+    return [(lang, raw.decode("utf-8", errors="replace"))
+            for _, lang, raw in golden_pairs()]
+
+
+def detect_all(texts, tables):
+    try:
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        eng = NgramBatchEngine(tables, registry)
+        return [registry.code(r.summary_lang)
+                for r in eng.detect_many(texts, batch_size=4096)]
+    except (ImportError, RuntimeError):
+        from language_detector_tpu.engine_scalar import detect_scalar
+        return [registry.code(detect_scalar(t, tables, registry)
+                              .summary_lang) for t in texts]
+
+
+def evaluate(pairs, tables) -> str:
+    texts = [t for _, t in pairs]
+    t0 = time.time()
+    got = detect_all(texts, tables)
+    took = time.time() - t0
+
+    per_lang = collections.defaultdict(lambda: dict(correct=0, got=0,
+                                                    actual=0))
+    confusion = collections.defaultdict(collections.Counter)
+    for (want, _), g in zip(pairs, got):
+        hit = g == want or (g, want) in ALIASES
+        per_lang[want]["actual"] += 1
+        per_lang[g]["got"] += 1
+        if hit:
+            per_lang[want]["correct"] += 1
+        else:
+            confusion[want][g] += 1
+
+    lines = []
+    lines.append(f"Evaluation over {len(pairs)} labeled documents "
+                 f"({len(per_lang)} languages), "
+                 f"{len(pairs)/max(took,1e-9):.0f} docs/sec")
+    lines.append("")
+    lines.append(f"{'Language':12s} {'Precision':>9s} {'Recall':>8s} "
+                 f"{'F':>7s} {'N':>6s}  Top confusions")
+    tot_c = tot_g = tot_a = 0
+    for code in sorted(per_lang):
+        d = per_lang[code]
+        if d["actual"] == 0:
+            continue  # only appears as a wrong guess
+        prec = d["correct"] / d["got"] if d["got"] else 0.0
+        rec = d["correct"] / d["actual"]
+        f = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        conf = " ".join(f"{g}={n}" for g, n in
+                        confusion[code].most_common(5))
+        lines.append(f"{code:12s} {prec*100:8.2f}% {rec*100:7.2f}% "
+                     f"{f:7.4f} {d['actual']:6d}  {conf}")
+        tot_c += d["correct"]
+        tot_g += d["got"]
+        tot_a += d["actual"]
+    prec = tot_c / tot_g if tot_g else 0.0
+    rec = tot_c / tot_a if tot_a else 0.0
+    f = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    lines.append("")
+    lines.append(f"{'_Totals_Known':12s} {prec*100:8.2f}% {rec*100:7.2f}% "
+                 f"{f:7.4f} {tot_a:6d}")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=None,
+                    help="TSV code<TAB>text (default: golden suite)")
+    ap.add_argument("--quad-tables", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    tables = ScoringTables.load(quad_path=args.quad_tables)
+    pairs = load_pairs(args.corpus)
+    report = evaluate(pairs, tables)
+    print(report)
+    if args.out:
+        Path(args.out).write_text(report)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,19 @@ def parse_closest_alt(cnames):
     return np.array(ids, dtype=np.int32)
 
 
+def parse_entities():
+    """Parse kNameToEntity (generated_entities.cc:26+) into parallel
+    name/value arrays (sorted by name upstream, kept sorted here for the
+    runtime's binary/dict lookup)."""
+    src = (REF_IMPL.parent / "generated_entities.cc").read_text()
+    body = re.search(r"kNameToEntity\[kNameToEntitySize\] = \{(.*?)\};",
+                     src, re.S).group(1)
+    pairs = re.findall(r'\{"([^"]+)",\s*(\d+)\}', body)
+    names = np.array([n for n, _ in pairs])
+    values = np.array([int(v) for _, v in pairs], dtype=np.int32)
+    return names, values
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=str(HERE.parent.parent /
@@ -91,6 +104,13 @@ def main():
         out[k] = np.array(strings[k])
 
     out["closest_alt_lang"] = parse_closest_alt(strings["lang_cname"])
+    out["interchange_ok"] = arrays["interchange_ok"]
+
+    # HTML entity table (kNameToEntity, generated_entities.cc — generated
+    # DATA like the scoring tables; parsed from source text)
+    names, values = parse_entities()
+    out["entity_names"] = names
+    out["entity_values"] = values
 
     out_path = Path(args.out)
     out_path.parent.mkdir(parents=True, exist_ok=True)

@@ -66,6 +66,7 @@ extern const Language kULScriptToDefaultLang[];
 // From prop_dump.cc (separate TU: macro-heavy DFA headers clash otherwise)
 int ScriptNumOfCodepoint(int cp);
 int LowercaseCodepoint(int cp, unsigned char* out_utf8, int* out_len);
+int InterchangeValidCodepoint(int cp);
 
 using namespace CLD2;
 
@@ -188,6 +189,18 @@ static void DumpScriptAndLower() {
             lower_pairs.size() / 4);
 }
 
+// Interchange-validity bitmap per codepoint (utf8acceptinterchange.h via
+// the reference scanner; surrogates invalid by construction).
+static void DumpInterchange() {
+  const int kMaxCp = 0x110000;
+  std::vector<uint8> ok(kMaxCp, 0);
+  for (int cp = 0; cp < kMaxCp; ++cp) {
+    if (cp >= 0xD800 && cp < 0xE000) continue;
+    ok[cp] = static_cast<uint8>(InterchangeValidCodepoint(cp));
+  }
+  WriteBlob("interchange_ok", ok.data(), ok.size(), "uint8", ok.size());
+}
+
 int main(int argc, char** argv) {
   if (argc != 2) { fprintf(stderr, "usage: %s outdir\n", argv[0]); return 1; }
   g_outdir = argv[1];
@@ -244,6 +257,7 @@ int main(int argc, char** argv) {
 
   DumpCjkUniProp();
   DumpScriptAndLower();
+  DumpInterchange();
 
   fclose(g_manifest);
   fprintf(stderr, "extracted tables to %s\n", g_outdir.c_str());

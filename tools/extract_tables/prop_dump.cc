@@ -80,3 +80,32 @@ int LowercaseCodepoint(int cp, unsigned char* out_utf8, int* out_len) {
   *out_len = bytes_filled;
   return DecodeUtf8(reinterpret_cast<unsigned char*>(outbuf), bytes_filled);
 }
+
+// Third macro environment: the interchange-validity scanner table.
+#undef X__
+#undef RJ_
+#undef S1_
+#undef S2_
+#undef S3_
+#undef S21
+#undef S31
+#undef S32
+#undef T1_
+#undef T2_
+#undef S11
+#undef SP_
+#undef D__
+#undef RJA
+
+#include "utf8acceptinterchange.h"
+
+// 1 if the codepoint is interchange-valid per the reference scanner
+// (utf8acceptinterchange.h; SpanInterchangeValid, compact_lang_det_impl.cc:74).
+int InterchangeValidCodepoint(int cp) {
+  unsigned char buf[8];
+  int len = EncodeUtf8(cp, buf);
+  StringPiece sp(reinterpret_cast<const char*>(buf), len);
+  int consumed = 0;
+  CLD2::UTF8GenericScan(&CLD2::utf8acceptinterchange_obj, sp, &consumed);
+  return consumed == len;
+}

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Generate the service's ISO-639 code -> English name map.
+
+Mirrors the reference's data/gen_codes.py pipeline (which capitalized the
+uppercase CLD2 language-name table into data/cld_codes.json, 164 entries):
+walk the registry's (code, name) pairs, keep codes the service should
+answer with, capitalize names, and fail on conflicting names per code.
+tests/test_service.py diffs the output against the reference's JSON when
+the snapshot is present.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from language_detector_tpu.registry import registry  # noqa: E402
+
+OUT = REPO / "language_detector_tpu/service/cld_codes.json"
+
+
+def main():
+    langs: dict = {}
+    for lang in range(registry.num_languages):
+        code = registry.code(lang)
+        name = registry.name(lang)
+        if not code or code in ("un", "xxx", "none"):
+            continue
+        # the reference maps both Chinese variants to "Chinese"
+        # (data/cld_codes.json zh / zh-Hant rows), and used the older
+        # table's names for two codes our newer registry renames
+        if code == "zh-Hant":
+            name = "Chinese"
+        elif code == "ny":
+            name = "Chichewa"
+        elif code == "tlh":
+            name = "Klingon"
+        pretty = name.capitalize()
+        if code in langs and langs[code] != pretty:
+            raise SystemExit(f"conflicting names for {code}: "
+                             f"{langs[code]} vs {pretty}")
+        langs[code] = pretty
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(langs, indent=4, separators=(",", ": "),
+                              sort_keys=True) + "\n")
+    print(f"wrote {OUT} ({len(langs)} codes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -216,38 +216,45 @@ def quads_of_word(word: str):
 
 # Quantization hyperparameters, selected by sweep on the golden suite
 # (tools/sweep_quad_tables.py). The model: per-language quad distributions
-# P(g|lang) with Bayesian shrinkage toward the global distribution (SHRINK =
-# pseudo-mass as a fraction of the median language mass — small corpora get
-# pulled to the background so they cannot claim common quads), quantized as
-# PMI against the global distribution (BASE + SLOPE * log2(P(g|lang)/P(g)))
-# onto CLD2's 1..12 log-scale, with ~x3 steps between ranked languages.
-SHRINK = 0.5
+# P(g|lang), optionally shrunk toward the size-unbiased background
+# (SHRINK = pseudo-mass as a fraction of the median language mass; 0 =
+# raw), scaled to mean-language-mass weight units, and quantized by
+# dominance (BASE + SLOPE * log2(1 + w1/(rest + ALPHA))) onto CLD2's
+# 1..12 log-scale, with ~x3 steps between ranked languages and the top
+# class clipped to HI_CAP (lower caps keep trained quads from shouting
+# over the real reference word tables).
+SHRINK = 0.0
+ALPHA = 5.0
 BASE = 5
 SLOPE = 2.0
+HI_CAP = 12
 
 
-def quantize_top3(probs: list, g_share: float, lg_prob: np.ndarray,
-                  base: float = None, slope: float = None) -> tuple:
-    """[(lang, P(g|lang))] sorted desc + global share P(g) ->
-    (pslangs[3], prob_subscript).
+def quantize_top3(weights: list, total_weight: float, lg_prob: np.ndarray,
+                  alpha: float = None, base: float = None,
+                  slope: float = None, hi_cap: int = None) -> tuple:
+    """[(lang, weight)] sorted desc -> (pslangs[3], prob_subscript).
 
-    The top qprob encodes distinctiveness as pointwise mutual information:
-    a quad far more likely under its top language than globally scores
-    high; a quad shared across languages scores near the base. Lower ranks
-    step down by the ~x3 log-ratio semantics of CLD2's quantized scale.
-    Chooses the kLgProbV2Tbl row (hi, lo) plus the group whose mid value
-    best matches the middle weight (table layout, cldutil_shared.h:42-61).
+    The top qprob encodes distinctiveness: a quad dominated by one
+    language scores high (CLD2's quantized log-ratio semantics, +1 ~ x3);
+    a quad shared across languages spreads. Chooses the kLgProbV2Tbl row
+    (hi, lo) plus the group whose mid value best matches the middle
+    weight (table layout, cldutil_shared.h:42-61).
     """
+    alpha = ALPHA if alpha is None else alpha
     base = BASE if base is None else base
     slope = SLOPE if slope is None else slope
-    top = probs[:3]
-    s1 = top[0][1]
-    pmi = np.log2(max(s1 / g_share, 1e-6))
-    hi = int(np.clip(round(base + slope * pmi), 2, 12))
+    hi_cap = HI_CAP if hi_cap is None else hi_cap
+    top = weights[:3]
+    w1 = top[0][1]
+    rest = max(total_weight - w1 + alpha, 1e-3)
+    dominance = w1 / rest
+    hi = int(np.clip(round(base + slope * np.log2(1 + dominance)), 2,
+                     hi_cap))
     qs = [hi]
-    for lang, s in top[1:]:
+    for lang, w in top[1:]:
         # log-ratio below the winner, one step per ~x3
-        q = hi - round(np.log2(max(s1 / max(s, 1e-12), 1)) / np.log2(3))
+        q = hi - round(np.log2(max(w1 / max(w, 1e-9), 1)) / np.log2(3))
         qs.append(int(np.clip(q, 1, hi)))
     lo = qs[-1] if len(qs) >= 2 else hi
     row = BACKMAP[hi] + (lo - 1)
@@ -266,16 +273,18 @@ def quantize_top3(probs: list, g_share: float, lg_prob: np.ndarray,
 
 
 def build_table(fp_entries: dict, bucketcount: int, keymask: int,
-                lg_prob: np.ndarray, base: float = None,
-                slope: float = None):
-    """Pack (fp -> (ranked [(lang, P(g|lang))], P(g), priority)) into CLD2
-    bucket + indirect arrays."""
+                lg_prob: np.ndarray, alpha: float = None,
+                base: float = None, slope: float = None,
+                hi_cap: int = None):
+    """Pack (fp -> (ranked [(lang, weight)], total_weight, priority)) into
+    CLD2 bucket + indirect arrays."""
     # Deduplicate langprob payloads
     langprob_index: dict = {}
     singles: list = []
     entries = []  # (fp, priority, langprob)
-    for fp, (ranked, g_share, priority) in fp_entries.items():
-        pslangs, row = quantize_top3(ranked, g_share, lg_prob, base, slope)
+    for fp, (ranked, total_w, priority) in fp_entries.items():
+        pslangs, row = quantize_top3(ranked, total_w, lg_prob, alpha,
+                                     base, slope, hi_cap)
         lp = ((pslangs[2] & 0xFF) << 24) | ((pslangs[1] & 0xFF) << 16) | \
              ((pslangs[0] & 0xFF) << 8) | (row & 0xFF)
         entries.append((fp, priority, lp))
@@ -312,22 +321,28 @@ def build_table(fp_entries: dict, bucketcount: int, keymask: int,
 
 
 def collect_cldr_phrases(tables, reg):
-    """[(phrase, [(lang, q)])] from babel CLDR locale data
-    (tools/cldr_vocab.py), restricted to quadgram-scored (RTypeMany)
-    scripts."""
-    from cldr_vocab import collect_cldr_words
+    """[(phrase, [(lang, q)], cls)] from babel CLDR locale data ('cldr'),
+    package gettext catalogs ('mo'), and the English stop-word list
+    ('ensw') (tools/cldr_vocab.py), restricted to quadgram-scored
+    (RTypeMany) scripts."""
+    from cldr_vocab import (collect_cldr_words, collect_english_stopwords,
+                            collect_mo_phrases)
     script_of = tables.script_of_cp
     rtype = reg.ulscript_rtype
     out = []
-    for phrase, lang, q in collect_cldr_words(reg):
-        sc = 0
-        for ch in phrase:
-            sc = int(script_of[min(ord(ch), 0x10FFFF)])
-            if sc:
-                break
-        if sc <= 0 or sc >= len(rtype) or int(rtype[sc]) != 2:  # RTypeMany
-            continue
-        out.append((phrase, [(lang, q)]))
+    sources = [(collect_cldr_words(reg), "cldr"),
+               (collect_mo_phrases(reg), "mo"),
+               (collect_english_stopwords(reg), "ensw")]
+    for items, cls in sources:
+        for phrase, lang, q in items:
+            sc = 0
+            for ch in phrase:
+                sc = int(script_of[min(ord(ch), 0x10FFFF)])
+                if sc:
+                    break
+            if sc <= 0 or sc >= len(rtype) or int(rtype[sc]) != 2:
+                continue
+            out.append((phrase, [(lang, q)], cls))
     return out
 
 
@@ -340,22 +355,36 @@ def collect_corpus(tables, reg):
     for word, langs, sw in collect_training_words(tables, reg):
         cls = "octa" if sw >= 1.0 else "distinct"
         items.append((quads_of_word(word), langs, cls))
-    for phrase, langs in collect_cldr_phrases(tables, reg):
-        items.append((quads_of_phrase(phrase), langs, "cldr"))
+    for phrase, langs, cls in collect_cldr_phrases(tables, reg):
+        items.append((quads_of_phrase(phrase), langs, cls))
     return items
 
 
 def train(tables, reg, corpus, buckets: int = 65536,
-          cldr_weight: float = 1.0, distinct_weight: float = 0.3,
-          shrink: float = SHRINK, base: float = BASE, slope: float = SLOPE,
-          lang_bias: dict | None = None, verbose: bool = True) -> dict:
+          cldr_weight: float = 2.0, distinct_weight: float = 0.3,
+          shrink: float = SHRINK, alpha: float = ALPHA, base: float = BASE,
+          slope: float = SLOPE, hi_cap: int = HI_CAP,
+          mo_weight: float = 0.0, ensw_weight: float = 0.0,
+          prior_pow: float = 0.0, lang_bias: dict | None = None,
+          verbose: bool = True) -> dict:
     """Accumulate the collected corpus into a packed quadgram table set.
 
     lang_bias: optional per-language multiplicative calibration on
     P(g|lang) (hook for error-driven win-rate calibration sweeps).
     Returns the npz-ready array dict (see main for the artifact contract).
+
+    Defaults reflect the sweep results (tools/sweep_quad_tables.py,
+    golden suite): cldr_weight 2.0 peaks at 75.6%; the gettext-catalog
+    and English-stop-word sources measurably HURT (-2% / -0.5%) despite
+    adding function words, so they default off; 131072/32768 buckets
+    both lose to 65536; win-rate calibration and expected-score
+    regeneration from synthetic docs were tried and rejected
+    (tools/calibrate_quad_tables.py: dev accuracy saturates at 95% while
+    golden accuracy stays flat -- the remaining gap is vocabulary-vs-
+    running-text distribution mismatch, not class priors).
     """
-    src_w = {"octa": 1.0, "distinct": distinct_weight, "cldr": cldr_weight}
+    src_w = {"octa": 1.0, "distinct": distinct_weight,
+             "cldr": cldr_weight, "mo": mo_weight, "ensw": ensw_weight}
 
     fp_scores: dict = collections.defaultdict(dict)
     for fps, langs, cls in corpus:
@@ -371,36 +400,51 @@ def train(tables, reg, corpus, buckets: int = 65536,
     if verbose:
         print(f"distinct quadgram fingerprints: {len(fp_scores)}")
 
-    # Per-language quad distributions with Bayesian shrinkage toward the
-    # background distribution: P(g|lang) = (w + m*G_g) / (T_lang + m),
-    # where G_g is the *uniform language mixture* background
-    # mean_lang(w_g,lang / T_lang) — size-unbiased, so PMI against it is
-    # meaningful for small and large languages alike. The pseudo-mass m
-    # (shrink * median language mass) keeps tiny training corpora from
-    # claiming common quads (a 40-word language would otherwise assign
-    # huge conditional probability to e.g. "_the").
+    # Per-language quad distributions: p(g|lang) = w / T_lang, with
+    # optional Bayesian shrinkage toward the size-unbiased background
+    # G_g = mean_lang(w_g,lang / T_lang) using pseudo-mass m = shrink *
+    # median language mass (keeps tiny training corpora from claiming
+    # common quads). Scaled back to mean-language-mass weight units so
+    # the dominance quantizer's absolute ALPHA pseudocount keeps its
+    # historical meaning.
     lang_total = collections.Counter()
     for langw in fp_scores.values():
         for lang, w in langw.items():
             lang_total[lang] += w
     n_langs = len(lang_total)
+    mean_total = float(np.mean(list(lang_total.values())))
     m = shrink * float(np.median(list(lang_total.values())))
-    bias = lang_bias or {}
+    bias = dict(lang_bias or {})
+    if prior_pow > 0:
+        # Language prior from training-data richness: vocabulary size is
+        # a (crude) proxy for real-world text volume, so well-resourced
+        # languages win ties on shared quads against tiny ones (e.g.
+        # English vs Interlingua on "_the"). Partially undoes the
+        # per-language mass normalization, at quantization time only.
+        med = float(np.median(list(lang_total.values())))
+        for lang, t in lang_total.items():
+            bias[lang] = bias.get(lang, 1.0) * (t / med) ** prior_pow
 
     fp_entries: dict = {}
     for fp, langw in fp_scores.items():
-        g_share = sum(w / lang_total[lang]
-                      for lang, w in langw.items()) / n_langs
-        probs = [(lang, (w + m * g_share) / (lang_total[lang] + m) *
-                  bias.get(lang, 1.0))
-                 for lang, w in langw.items()]
-        probs.sort(key=lambda kv: -kv[1])
-        fp_entries[fp] = (probs, g_share, sum(langw.values()))
+        raw_total = sum(langw.values())
+        if m > 0:
+            g_share = sum(w / lang_total[lang]
+                          for lang, w in langw.items()) / n_langs
+        else:
+            g_share = 0.0
+        ws = [(lang,
+               (w + m * g_share) / (lang_total[lang] + m) * mean_total *
+               bias.get(lang, 1.0))
+              for lang, w in langw.items()]
+        ws.sort(key=lambda kv: -kv[1])
+        fp_entries[fp] = (ws, sum(w for _, w in ws), raw_total)
 
     # >=32K buckets use a 2-byte key (cldutil.cc:103-105 comment)
     keymask = 0xFFFF0000 if buckets >= 32768 else 0xFFFFF000
     bucket_arr, ind, size_one, filled, dropped = build_table(
-        fp_entries, buckets, keymask, tables.lg_prob, base, slope)
+        fp_entries, buckets, keymask, tables.lg_prob, alpha, base, slope,
+        hi_cap)
     if verbose:
         print(f"buckets {buckets} filled {filled} dropped {dropped} "
               f"indirect {size_one}")
@@ -427,7 +471,7 @@ def train(tables, reg, corpus, buckets: int = 65536,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--buckets", type=int, default=65536)
-    ap.add_argument("--cldr-weight", type=float, default=1.0,
+    ap.add_argument("--cldr-weight", type=float, default=2.0,
                     help="source weight multiplier for CLDR phrases "
                          "(0 disables the CLDR source)")
     ap.add_argument("--shrink", type=float, default=SHRINK)
