@@ -50,10 +50,13 @@ class LanguageDetector:
         self.flags = flags
         self._batch_engine = None  # lazily built batched JAX engine
 
-    def detect(self, text: str,
-               is_plain_text: bool = True) -> DetectionResult:
+    def detect(self, text: str, is_plain_text: bool = True,
+               hints=None) -> DetectionResult:
+        """hints: optional hints.CLDHints (content-language / TLD /
+        encoding / explicit language priors; ExtDetectLanguageSummary
+        contract, compact_lang_det.h:168+)."""
         r = detect_scalar(text, self.tables, self.registry, self.flags,
-                          is_plain_text=is_plain_text)
+                          is_plain_text=is_plain_text, hints=hints)
         return DetectionResult.from_scalar(r, self.registry)
 
     def span_interchange_valid(self, data: bytes) -> int:
@@ -77,7 +80,8 @@ class LanguageDetector:
         return len(text[:bad].encode("utf-8"))
 
     def detect_bytes(self, data: bytes, is_plain_text: bool = True,
-                     check_utf8: bool = True) -> DetectionResult:
+                     check_utf8: bool = True,
+                     hints=None) -> DetectionResult:
         """Detect raw UTF-8 bytes. With check_utf8 (the reference's
         *CheckUTF8 entry points, compact_lang_det.cc:317), input that is
         not fully interchange-valid answers UNKNOWN with
@@ -90,7 +94,7 @@ class LanguageDetector:
                 top3=[(self.registry.code(UNKNOWN_LANGUAGE), 0, 0.0)] * 3,
                 text_bytes=0, valid_prefix_bytes=valid)
         r = self.detect(data.decode("utf-8", errors="replace"),
-                        is_plain_text=is_plain_text)
+                        is_plain_text=is_plain_text, hints=hints)
         r.valid_prefix_bytes = valid
         return r
 

@@ -229,11 +229,24 @@ class ScoringContext:
     distinct_boost_latn: LangBoosts = dataclasses.field(default_factory=LangBoosts)
     distinct_boost_othr: LangBoosts = dataclasses.field(default_factory=LangBoosts)
     ulscript: int = 0
+    hint_boosts: object = None  # hints.HintBoosts from apply_hints, or None
 
     def distinct_boost(self) -> LangBoosts:
         if self.ulscript == ULSCRIPT_LATIN:
             return self.distinct_boost_latn
         return self.distinct_boost_othr
+
+    def prior_boosts(self) -> list:
+        if self.hint_boosts is None:
+            return ()
+        return self.hint_boosts.boost_latn if \
+            self.ulscript == ULSCRIPT_LATIN else self.hint_boosts.boost_othr
+
+    def prior_whacks(self) -> list:
+        if self.hint_boosts is None:
+            return ()
+        return self.hint_boosts.whack_latn if \
+            self.ulscript == ULSCRIPT_LATIN else self.hint_boosts.whack_othr
 
 
 def resolve_indirect(ind: int, base_obj: NgramTable,
@@ -396,11 +409,19 @@ def _score_round(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
                 tote.score_count += 1
             if types[i] == DISTINCTHIT:
                 ctx.distinct_boost().add(lp)
-        # Distinct-word rotating boosts (ScoreBoosts, scoreonescriptspan.cc:140)
+        # ScoreBoosts (scoreonescriptspan.cc:125-152): hint prior boosts,
+        # then distinct-word rotating boosts, then close-set whacks
+        for lp in ctx.prior_boosts():
+            if lp > 0:
+                for pslang, qprob in decode_langprob(lp, lg):
+                    tote.add(pslang, qprob)
         for lp in ctx.distinct_boost().langprob:
             if lp > 0:
                 for pslang, qprob in decode_langprob(lp, lg):
                     tote.add(pslang, qprob)
+        for lp in ctx.prior_whacks():
+            if lp > 0:
+                tote.score[(lp >> 8) & 0xFF] = 0  # ZeroPSLang
 
         lo_off = int(offs[lo_i])
         hi_off = int(offs[hi_i]) if hi_i < nlin else end_off
@@ -681,17 +702,24 @@ def _respan(text_bytes: bytes, ulscript: int) -> ScriptSpan:
 
 def detect_scalar(text: str, tables: ScoringTables | None = None,
                   reg: Registry | None = None,
-                  flags: int = 0, is_plain_text: bool = True) -> ScalarResult:
+                  flags: int = 0, is_plain_text: bool = True,
+                  hints=None, _hint_boosts=None) -> ScalarResult:
     """Full-document detection (DetectLanguageSummaryV2,
     compact_lang_det_impl.cc:1707-2106), including the squeeze/repeat
     anti-spam recursion. is_plain_text=False strips HTML tags / expands
-    entities first (preprocess/html.py)."""
+    entities first (preprocess/html.py). hints is an optional
+    hints.CLDHints; HTML lang= attributes are always scanned for
+    non-plain text (ApplyHints, impl.cc:1587)."""
     tables = tables or load_tables()
     reg = reg or default_registry
+    if _hint_boosts is None and (hints is not None or not is_plain_text):
+        from .hints import apply_hints
+        _hint_boosts = apply_hints(text, is_plain_text, hints, tables, reg)
     if not is_plain_text:
         from .preprocess.html import clean_html
         text, _ = clean_html(text, tables)
-    ctx = ScoringContext(tables=tables, registry=reg, flags=flags)
+    ctx = ScoringContext(tables=tables, registry=reg, flags=flags,
+                         hint_boosts=_hint_boosts)
     doc_tote = DocTote()
     total_text_bytes = 0
     if flags & FLAG_REPEATS:
@@ -708,7 +736,9 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
             # (impl.cc:1866-1901)
             if cheap_squeeze_trigger_test(span.buf.tobytes(),
                                           span.text_bytes):
-                return detect_scalar(text, tables, reg, flags | FLAG_SQUEEZE)
+                return detect_scalar(text, tables, reg,
+                                     flags | FLAG_SQUEEZE,
+                                     _hint_boosts=_hint_boosts)
         if flags & FLAG_REPEATS:
             # Remove repeated words (impl.cc:1905-1918)
             stripped = cheap_rep_words(span.buf.tobytes(), span.text_bytes,
@@ -733,7 +763,8 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
         extra = FLAG_TOP40 | FLAG_REPEATS | FLAG_FINISH
         if total < SHORT_TEXT_THRESH:
             extra |= FLAG_SHORT | FLAG_USE_WORDS
-        return detect_scalar(text, tables, reg, flags | extra)
+        return detect_scalar(text, tables, reg, flags | extra,
+                             _hint_boosts=_hint_boosts)
 
     if not (flags & FLAG_BEST_EFFORT):
         remove_unreliable(reg, doc_tote)

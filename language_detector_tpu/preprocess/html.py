@@ -111,12 +111,14 @@ def _read_entity(text: str, i: int, entities: dict) -> tuple:
 def _nl_or_gt_class(c: str) -> bool:
     """True for '>' and the CR/NL byte classes of kCharToSub
     (getonescriptspan.cc:81-103): ASCII whitespace/digits/punctuation
-    other than the special tag chars, plus UTF-8 continuation bytes."""
+    other than the special tag chars. Non-ASCII characters present their
+    UTF-8 LEAD byte (0xC2..) to the reference state machine, which is PL
+    class — ordinary-tag routing."""
     if c == ">" or c in "\r\n":
         return True
     o = ord(c)
     if o >= 0x80:
-        return o < 0xC0
+        return False
     return not c.isalpha() and c not in "!\"&'-/<>"
 
 

@@ -226,8 +226,11 @@ class Handler(BaseHTTPRequestHandler):
         """Read the request body, truncated at 1 MB, draining any excess
         so a keep-alive connection stays in sync (handlers.go:43 LimitReader
         semantics; Go's net/http drains automatically, http.server doesn't)."""
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        body = self.rfile.read(min(length, BODY_LIMIT_BYTES))
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = 0  # malformed header: empty body -> 400 invalid JSON
+        body = self.rfile.read(min(max(length, 0), BODY_LIMIT_BYTES))
         left = length - len(body)
         while left > 0:
             chunk = self.rfile.read(min(left, 65536))

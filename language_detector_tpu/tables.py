@@ -73,6 +73,17 @@ class ScoringTables:
     interchange_ok: np.ndarray    # [0x110000] uint8 interchange-valid flag
     entity_names: np.ndarray      # [265] str HTML entity names (sorted)
     entity_values: np.ndarray     # [265] int32 entity codepoints
+    # Hint lookup tables (compact_lang_det_hint_code.cc:102-940 data)
+    langtag1_keys: np.ndarray     # [213] str long lang= tags
+    langtag1_prior1: np.ndarray   # [213] int32 packed OneCLDLangPrior
+    langtag1_prior2: np.ndarray
+    langtag2_keys: np.ndarray     # [257] str short lang codes
+    langtag2_prior1: np.ndarray
+    langtag2_prior2: np.ndarray
+    tld_hint_keys: np.ndarray     # [181] str TLDs
+    tld_hint_prior1: np.ndarray
+    tld_hint_prior2: np.ndarray
+    encoding_names: np.ndarray    # [76] str Encoding enum names, in order
 
     @classmethod
     def load(cls, path: Path = _DATA,
@@ -130,6 +141,11 @@ class ScoringTables:
             interchange_ok=z["interchange_ok"],
             entity_names=z["entity_names"],
             entity_values=z["entity_values"],
+            **{k: z[k] for k in (
+                "langtag1_keys", "langtag1_prior1", "langtag1_prior2",
+                "langtag2_keys", "langtag2_prior1", "langtag2_prior2",
+                "tld_hint_keys", "tld_hint_prior1", "tld_hint_prior2",
+                "encoding_names")},
         )
 
 

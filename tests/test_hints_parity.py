@@ -1,0 +1,144 @@
+"""Hints engine parity vs the oracle's ExtDetectLanguageSummary.
+
+Covers the four CLDHints channels (content-language, TLD, encoding,
+explicit language) and HTML lang= attribute scanning, on texts where the
+hint matters (close pairs, short ambiguous snippets) and where it must
+not override clear evidence (compact_lang_det_hint_code.cc:1394-1508,
+ApplyHints impl.cc:1587-1684).
+"""
+import ctypes
+
+import pytest
+
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.hints import (CLDHints, apply_hints,
+                                         get_lang_tags_from_html)
+from language_detector_tpu.registry import UNKNOWN_LANGUAGE, registry
+from language_detector_tpu.tables import load_tables
+
+
+def oracle_detect_hints(lib, text: bytes, flags: int = 0,
+                        is_plain_text: bool = True,
+                        content_language: bytes = b"", tld: bytes = b"",
+                        encoding: int = 75,  # UNKNOWN_ENCODING
+                        language: int = UNKNOWN_LANGUAGE):
+    lib.o_detect_hints.restype = ctypes.c_int
+    l3 = (ctypes.c_int * 3)()
+    p3 = (ctypes.c_int * 3)()
+    s3 = (ctypes.c_double * 3)()
+    tb = ctypes.c_int()
+    rel = ctypes.c_int()
+    lang = lib.o_detect_hints(text, len(text), 1 if is_plain_text else 0,
+                              flags, content_language, tld, encoding,
+                              language, l3, p3, s3, ctypes.byref(tb),
+                              ctypes.byref(rel))
+    return (lang, [int(l3[i]) for i in range(3)],
+            [int(p3[i]) for i in range(3)], bool(rel.value), tb.value)
+
+
+TEXT_ID_MS = "ini rumah besar kami yang baru dan sangat cantik sekali"
+TEXT_HR = "ovo je velika kuća i lijepo je vrijeme danas u gradu"
+TEXT_EN = ("this is a simple english sentence with common words that "
+           "should be detected without any trouble at all")
+
+CASES = [
+    # (text, plain, kwargs)
+    (TEXT_ID_MS, True, dict(tld=b"my")),
+    (TEXT_ID_MS, True, dict(tld=b"id")),
+    (TEXT_ID_MS, True, dict(content_language=b"ms")),
+    (TEXT_ID_MS, True, dict(language=registry.code_to_lang["ms"])),
+    (TEXT_HR, True, dict(content_language=b"sr")),
+    (TEXT_HR, True, dict(tld=b"rs")),
+    (TEXT_EN, True, dict(tld=b"fr")),    # clear evidence beats weak hint
+    (TEXT_EN, True, dict(content_language=b"fr")),
+    ("short text", True, dict(content_language=b"de")),
+    ("short text", True, dict(language=registry.code_to_lang["nl"])),
+    ('<html lang="sr"><p>' + TEXT_HR + "</p></html>", False, dict()),
+    # hr (Latin-only) must not whack Serbian in the Cyrillic list
+    # (AddOneWhack script condition, impl.cc:1541-1561)
+    ("Београд је главни град Србије и највећи град у земљи данас", True,
+     dict(content_language=b"hr")),
+    # >4 whacks per script exercise the rotating overwrite
+    (TEXT_HR, True, dict(content_language=b"sr,no")),
+    ('<meta http-equiv="content-language" content="ms"><p>' +
+     TEXT_ID_MS + "</p>", False, dict()),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=range(len(CASES)))
+def test_hinted_detection_parity(oracle, base_tables, case):
+    text, plain, kw = case
+    want = oracle_detect_hints(oracle, text.encode(), is_plain_text=plain,
+                               content_language=kw.get("content_language",
+                                                       b""),
+                               tld=kw.get("tld", b""),
+                               language=kw.get("language",
+                                               UNKNOWN_LANGUAGE))
+    hints = CLDHints(
+        content_language_hint=kw.get("content_language", b"").decode()
+        or None,
+        tld_hint=kw.get("tld", b"").decode() or None,
+        language_hint=kw.get("language", UNKNOWN_LANGUAGE))
+    r = detect_scalar(text, base_tables, registry, 0,
+                      is_plain_text=plain, hints=hints)
+    assert r.summary_lang == want[0], (registry.code(r.summary_lang),
+                                       registry.code(want[0]))
+    assert r.language3 == want[1]
+    assert r.percent3 == want[2]
+    assert r.is_reliable == want[3]
+
+
+def test_encoding_hint_parity(oracle, base_tables):
+    """Encoding-family hints (SetCLDEncodingHint)."""
+    tables = load_tables()
+    names = [str(n) for n in tables.encoding_names]
+    for enc_name, text in [("CHINESE_GB", "短文"), ("JAPANESE_EUC_JP", "短文"),
+                           ("KOREAN_EUC_KR", "短文")]:
+        enc = names.index(enc_name)
+        want = oracle_detect_hints(oracle, text.encode(), encoding=enc)
+        r = detect_scalar(text, base_tables, registry, 0,
+                          hints=CLDHints(encoding_hint=enc_name))
+        assert r.summary_lang == want[0], (enc_name,
+                                           registry.code(r.summary_lang),
+                                           registry.code(want[0]))
+
+
+def test_lang_tag_scanner():
+    """GetLangTagsFromHtml normalization behaviors."""
+    assert get_lang_tags_from_html('<html lang="fr">') == "fr"
+    assert get_lang_tags_from_html("<html lang='pt-BR'>") == "pt-br"
+    assert get_lang_tags_from_html('<div xml:lang="DE_de">x</div>') \
+        == "de-de"
+    # unquoted attribute values match (the reference's FindAfter needs a
+    # trailing space, which a closing quote prevents — quoted values are
+    # faithfully NOT matched, quirk of hint_code.cc:1328-1352)
+    assert get_lang_tags_from_html(
+        '<meta http-equiv=content-language content="es, en" x=y>') \
+        == "es,en"
+    assert get_lang_tags_from_html(
+        '<meta http-equiv="content-language" content="es, en">') == ""
+    # skipped elements contribute nothing
+    assert get_lang_tags_from_html('<a lang="it" href=x>') == ""
+    assert get_lang_tags_from_html('<script lang="js">') == ""
+    # duplicates collapse
+    assert get_lang_tags_from_html(
+        '<p lang="fr"></p><p lang="fr"></p>') == "fr"
+
+
+def test_apply_hints_whacks():
+    """A single hinted close-set member whacks its rivals."""
+    tables = load_tables()
+    hb = apply_hints("", True,
+                     CLDHints(language_hint=registry.code_to_lang["id"]),
+                     tables, registry)
+    assert hb.boost_latn  # INDONESIAN boost
+    assert hb.whack_latn  # MALAY suppressed
+    # tld=id carries a paired negative MALAY prior, so both close-set
+    # members are present and no whack fires (ApplyHints counts priors
+    # regardless of weight sign, impl.cc:1660-1666)
+    hb2 = apply_hints("", True, CLDHints(tld_hint="id"), tables, registry)
+    assert hb2.boost_latn and not hb2.whack_latn
+    hb3 = apply_hints("", True,
+                      CLDHints(content_language_hint="id,ms"), tables,
+                      registry)
+    assert not hb3.whack_latn  # both of the set hinted: no whack

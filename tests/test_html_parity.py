@@ -32,6 +32,9 @@ HTML_TEXTS = [
     "R&D department results &NotAnEntity works",
     "&#120; &#x79; &#122; numeric entities",
     "<<double open then text",
+    # script followed by a non-ASCII char: UTF-8 lead byte is PL class,
+    # so this is an ordinary tag and the content stays visible
+    "<script« attr>hidden words</script> le texte visible ici",
 ]
 
 

@@ -71,6 +71,62 @@ def parse_entities():
     return names, values
 
 
+def parse_hint_tables(cnames):
+    """Parse the hand-curated hint data tables out of
+    compact_lang_det_hint_code.cc: lang-tag lookup tables 1 (long tags,
+    :102) and 2 (short codes, :348), the TLD table (:647), and the
+    encoding enum names (public/encodings.h). Priors are packed
+    OneCLDLangPrior values: language id | (weight << 10)."""
+    src = (REF_IMPL.parent / "compact_lang_det_hint_code.cc").read_text()
+    cname_to_id = {c: i for i, c in enumerate(cnames)}
+
+    def parse_prior(expr):
+        expr = expr.strip()
+        if expr == "0":
+            return 0
+        m = re.match(r"(\w+)\s*([+-])\s*W(\d+)$", expr)
+        assert m, expr
+        w = int(m.group(3)) * (1 if m.group(2) == "+" else -1)
+        return cname_to_id[m.group(1)] + (w << 10)  # weight may be negative
+
+    def table_body(name):
+        body = re.search(name + r"\[\w+\] = \{(.*?)\n\};", src,
+                         re.S).group(1)
+        # strip line comments (incl. commented-out entries)
+        return re.sub(r"//[^\n]*", "", body)
+
+    out = {}
+    for key, name, has_code in [
+            ("langtag1", "kCLDLangTagsHintTable1", True),
+            ("langtag2", "kCLDLangTagsHintTable2", True),
+            ("tld_hint", "kCLDTLDHintTable", False)]:
+        body = table_body(name)
+        if has_code:
+            rows = re.findall(
+                r'\{"([^"]+)",\s*"[^"]*",\s*([^,}]+?)\s*'
+                r'(?:,\s*([^,}]+?)\s*)?\}', body)
+        else:
+            rows = re.findall(
+                r'\{"([^"]+)",\s*([^,}]+?)\s*(?:,\s*([^,}]+?)\s*)?\}', body)
+        keys = np.array([r[0] for r in rows])
+        p1 = np.array([parse_prior(r[1]) for r in rows], dtype=np.int32)
+        p2 = np.array([parse_prior(r[2] or "0") for r in rows],
+                      dtype=np.int32)
+        out[f"{key}_keys"] = keys
+        out[f"{key}_prior1"] = p1
+        out[f"{key}_prior2"] = p2
+
+    enc_src = (REF_IMPL.parent.parent / "public/encodings.h").read_text()
+    body = re.search(r"enum Encoding \{(.*?)\};", enc_src, re.S).group(1)
+    body = re.sub(r"//[^\n]*", "", body)
+    names = []
+    for m in re.finditer(r"(\w+)\s*=\s*(\d+)", body):
+        assert int(m.group(2)) == len(names), (m.group(1), len(names))
+        names.append(m.group(1))
+    out["encoding_names"] = np.array(names)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=str(HERE.parent.parent /
@@ -111,6 +167,7 @@ def main():
     names, values = parse_entities()
     out["entity_names"] = names
     out["entity_values"] = values
+    out.update(parse_hint_tables(strings["lang_cname"]))
 
     out_path = Path(args.out)
     out_path.parent.mkdir(parents=True, exist_ok=True)

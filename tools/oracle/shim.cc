@@ -82,3 +82,39 @@ const char* o_lang_code(int lang) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---- hinted detection parity ---------------------------------------------
+// Same as o_detect but with explicit CLDHints fields (NULL/empty = unset).
+int o_detect_hints(const char* text, int len, int is_plain_text, int flags,
+                   const char* content_language_hint, const char* tld_hint,
+                   int encoding_hint, int language_hint,
+                   int* lang3, int* percent3, double* score3,
+                   int* text_bytes, int* is_reliable) {
+  Language language3[3];
+  int pct3[3];
+  double ns3[3];
+  int tb = 0;
+  bool rel = false;
+  CLDHints hints;
+  hints.content_language_hint =
+      (content_language_hint && content_language_hint[0]) ?
+      content_language_hint : NULL;
+  hints.tld_hint = (tld_hint && tld_hint[0]) ? tld_hint : NULL;
+  hints.encoding_hint = encoding_hint;
+  hints.language_hint = static_cast<Language>(language_hint);
+  Language summary = ExtDetectLanguageSummary(
+      text, len, is_plain_text != 0, &hints, flags,
+      language3, pct3, ns3, NULL, &tb, &rel);
+  for (int i = 0; i < 3; ++i) {
+    lang3[i] = static_cast<int>(language3[i]);
+    percent3[i] = pct3[i];
+    score3[i] = ns3[i];
+  }
+  *text_bytes = tb;
+  *is_reliable = rel ? 1 : 0;
+  return static_cast<int>(summary);
+}
+
+}  // extern "C"
