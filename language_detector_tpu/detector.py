@@ -27,6 +27,9 @@ class DetectionResult:
     # bytes of the longest interchange-valid UTF-8 prefix; set by the
     # CheckUTF8 entry points (compact_lang_det.h:168+ *CheckUTF8 contract)
     valid_prefix_bytes: int | None = None
+    # per-range results: [(offset, bytes, iso_code)] covering the original
+    # input when requested (ResultChunkVector, compact_lang_det.h:147-154)
+    chunks: list | None = None
 
     @classmethod
     def from_scalar(cls, r: ScalarResult, reg: Registry) -> "DetectionResult":
@@ -37,6 +40,8 @@ class DetectionResult:
             top3=[(reg.code(l), p, s) for l, p, s in
                   zip(r.language3, r.percent3, r.normalized_score3)],
             text_bytes=r.text_bytes,
+            chunks=None if r.chunks is None else
+            [(c.offset, c.bytes, reg.code(c.lang1)) for c in r.chunks],
         )
 
 
@@ -51,12 +56,15 @@ class LanguageDetector:
         self._batch_engine = None  # lazily built batched JAX engine
 
     def detect(self, text: str, is_plain_text: bool = True,
-               hints=None) -> DetectionResult:
+               hints=None, return_chunks: bool = False) -> DetectionResult:
         """hints: optional hints.CLDHints (content-language / TLD /
         encoding / explicit language priors; ExtDetectLanguageSummary
-        contract, compact_lang_det.h:168+)."""
+        contract, compact_lang_det.h:168+). return_chunks additionally
+        fills `.chunks` with per-byte-range languages over the original
+        input (the ResultChunkVector overload, compact_lang_det.h:380)."""
         r = detect_scalar(text, self.tables, self.registry, self.flags,
-                          is_plain_text=is_plain_text, hints=hints)
+                          is_plain_text=is_plain_text, hints=hints,
+                          want_chunks=return_chunks)
         return DetectionResult.from_scalar(r, self.registry)
 
     def span_interchange_valid(self, data: bytes) -> int:

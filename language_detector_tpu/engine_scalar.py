@@ -21,7 +21,8 @@ from .preprocess.grams import (DUAL_TABLE_FLAG, HitList, get_bi_hits,
                                get_octa_hits, get_quad_hits, get_uni_hits)
 from .preprocess.segment import ScriptSpan, segment_text
 from .preprocess.squeeze import (PREDICTION_TABLE_SIZE, TEST_THRESH,
-                                 cheap_rep_words, cheap_squeeze,
+                                 cheap_rep_words, cheap_rep_words_overwrite,
+                                 cheap_squeeze, cheap_squeeze_overwrite,
                                  cheap_squeeze_trigger_test)
 from .registry import (ENGLISH, RTYPE_CJK, RTYPE_MANY, RTYPE_NONE, RTYPE_ONE,
                        TG_UNKNOWN_LANGUAGE, ULSCRIPT_LATIN, UNKNOWN_LANGUAGE,
@@ -222,6 +223,18 @@ class LangBoosts:
 
 
 @dataclasses.dataclass
+class ResultChunk:
+    """Per-range result (compact_lang_det.h:147-154): byte range of the
+    ORIGINAL input and its detected language."""
+    offset: int
+    bytes: int
+    lang1: int
+
+
+UNRELIABLE_PERCENT_THRESHOLD = 75  # scoreonescriptspan.cc:33
+
+
+@dataclasses.dataclass
 class ScoringContext:
     tables: ScoringTables
     registry: Registry
@@ -230,6 +243,10 @@ class ScoringContext:
     distinct_boost_othr: LangBoosts = dataclasses.field(default_factory=LangBoosts)
     ulscript: int = 0
     hint_boosts: object = None  # hints.HintBoosts from apply_hints, or None
+    # per-chunk records for the result vector, or None when not wanted:
+    # (span, round_id, lo_off, nbytes, lang1, lang2, rel_delta, rel_score)
+    chunk_records: list | None = None
+    round_id: int = 0
 
     def distinct_boost(self) -> LangBoosts:
         if self.ulscript == ULSCRIPT_LATIN:
@@ -360,6 +377,7 @@ def score_span_hits(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
                                             next_offset)
         _score_round(ctx, span, score_cjk, base, delta, distinct, doc_tote,
                      letter_offset, next_offset)
+        ctx.round_id += 1
         if next_offset <= letter_offset:
             break  # no forward progress possible
         letter_offset = next_offset
@@ -428,6 +446,10 @@ def _score_round(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
         cs = _make_chunk_summary(ctx, tote, lo_off, hi_off - lo_off)
         doc_tote.add(cs.lang1, cs.bytes, cs.score1,
                      min(cs.reliability_delta, cs.reliability_score))
+        if ctx.chunk_records is not None:
+            ctx.chunk_records.append(
+                (span, ctx.round_id, lo_off, cs.bytes, cs.lang1, cs.lang2,
+                 cs.reliability_delta, cs.reliability_score, False))
 
 
 def _make_chunk_summary(ctx: ScoringContext, tote: Tote, offset: int,
@@ -483,6 +505,14 @@ def score_one_span(ctx: ScoringContext, span: ScriptSpan, doc_tote: DocTote):
     if rtype in (RTYPE_NONE, RTYPE_ONE):
         lang = reg.default_language(span.ulscript)
         doc_tote.add(lang, span.text_bytes, span.text_bytes, 100)
+        if ctx.chunk_records is not None:
+            # JustOneItemToVector (scoreonescriptspan.cc:513-548): offsets
+            # map straight through ItemToVector — no word-boundary trim,
+            # no reliability/close-set relabeling
+            ctx.chunk_records.append(
+                (span, ctx.round_id, 1, span.text_bytes - 1, lang,
+                 UNKNOWN_LANGUAGE, 100, 100, True))
+            ctx.round_id += 1
     else:
         score_span_hits(ctx, span, rtype == RTYPE_CJK, doc_tote)
 
@@ -686,10 +716,14 @@ class ScalarResult:
     normalized_score3: list
     text_bytes: int
     is_reliable: bool
+    chunks: list | None = None  # ResultChunk vector when requested
 
 
-def _respan(text_bytes: bytes, ulscript: int) -> ScriptSpan:
-    """Rebuild a ScriptSpan around squeezed/stripped span text."""
+def _respan(text_bytes: bytes, ulscript: int,
+            src_idx: np.ndarray | None = None) -> ScriptSpan:
+    """Rebuild a ScriptSpan around squeezed/stripped span text. src_idx is
+    carried through only for the length-preserving Overwrite rewrites,
+    where byte offsets still map to the original input."""
     buf = np.zeros(len(text_bytes) + 32, dtype=np.uint8)
     buf[:len(text_bytes)] = np.frombuffer(text_bytes, dtype=np.uint8)
     buf[len(text_bytes):len(text_bytes) + 3] = 0x20
@@ -697,13 +731,112 @@ def _respan(text_bytes: bytes, ulscript: int) -> ScriptSpan:
         text_bytes.decode("utf-8", errors="replace").encode("utf-32-le"),
         dtype=np.uint32)
     return ScriptSpan(buf=buf, text_bytes=len(text_bytes), ulscript=ulscript,
-                      cps=np.concatenate([cps, [0x20]]).astype(np.uint32))
+                      cps=np.concatenate([cps, [0x20]]).astype(np.uint32),
+                      src_idx=src_idx)
+
+
+def build_result_chunks(orig_text: str, records: list, reg: Registry,
+                        html_offsets=None) -> list:
+    """Chunk records -> merged ResultChunk vector over ORIGINAL byte
+    offsets (SummaryBufferToVector scoreonescriptspan.cc:389-509 +
+    ItemToVector :341-378 + FinishResultVector impl.cc:1688-1704).
+
+    Offset mapping composes the span src_idx arrays (span-buffer byte ->
+    segmenter-input char), the optional HTML clean-text offset map
+    (clean char -> original char), and the original text's char->byte
+    cumsum — the index-array equivalent of the reference's composed
+    OffsetMaps (offsetmap.cc:428-496)."""
+    raw = orig_text.encode("utf-8")
+    cps = np.frombuffer(orig_text.encode("utf-32-le"), np.uint32)
+    from .preprocess.segment import utf8_len_of_cps
+    byte_of_char = np.zeros(len(cps) + 1, np.int64)
+    if len(cps):
+        np.cumsum(utf8_len_of_cps(cps), out=byte_of_char[1:])
+
+    def map_back(span, off):
+        src = int(span.src_idx[min(off, len(span.src_idx) - 1)])
+        if html_offsets is not None:
+            src = int(html_offsets[min(src, len(html_offsets) - 1)]) \
+                if len(html_offsets) else 0
+        return int(byte_of_char[min(src, len(byte_of_char) - 1)])
+
+    # Raw mapped starts first: the reference's continuous offset maps make
+    # consecutive chunks contiguous (each ends where the next begins), so
+    # a chunk's end is the next chunk's mapped start.
+    raw_starts = [map_back(span, lo)
+                  for span, _, lo, *_ in records]
+    vec: list = []
+    for i, (span, rid, lo, nbytes, lang1, lang2, rd, rs, is_one) in \
+            enumerate(records):
+        mapped_offset = raw_starts[i]
+        # Trim back to a word boundary (scoreonescriptspan.cc:419-460);
+        # JustOneItem records skip the trim (scoreonescriptspan.cc:513-548)
+        if mapped_offset > 0 and not is_one:
+            prior_size = vec[-1].bytes if vec else 0
+            n_limit = min(prior_size - 3, mapped_offset, 12)
+            n = 0
+            while n < n_limit and raw[mapped_offset - n - 1] >= 0x41:
+                n += 1
+            if n >= n_limit:
+                n = 0
+            if n < n_limit and \
+                    raw[mapped_offset - n - 1:mapped_offset - n] in \
+                    (b"'", b'"', b"#", b"@"):
+                n += 1
+            if n > 0 and vec:
+                vec[-1].bytes -= n
+                mapped_offset -= n
+        end = raw_starts[i + 1] if i + 1 < len(records) \
+            else map_back(span, lo + nbytes)
+        mapped_len = end - mapped_offset
+
+        new_lang = lang1
+        if not is_one:
+            # reliability / close-set relabeling (SummaryBufferToVector,
+            # scoreonescriptspan.cc:462-505); JustOneItem records bypass it
+            rd_bad = rd < UNRELIABLE_PERCENT_THRESHOLD
+            rs_bad = rs < UNRELIABLE_PERCENT_THRESHOLD
+            prior_lang = vec[-1].lang1 if vec else UNKNOWN_LANGUAGE
+            if prior_lang == lang1:
+                rd_bad = False
+            if _same_close_set(reg, lang1, prior_lang):
+                new_lang = prior_lang
+                rd_bad = False
+            if _same_close_set(reg, lang1, lang2) and prior_lang == lang2:
+                new_lang = prior_lang
+                rd_bad = False
+            # next chunk's lang1, within the same hitbuffer round only
+            next_lang = records[i + 1][4] if i + 1 < len(records) and \
+                records[i + 1][1] == rid else UNKNOWN_LANGUAGE
+            if rd_bad and prior_lang == lang2 and next_lang == lang2:
+                new_lang = prior_lang
+                rd_bad = False
+            if rd_bad or rs_bad:
+                new_lang = UNKNOWN_LANGUAGE
+
+        # ItemToVector: extend the prior entry on same language
+        if vec and vec[-1].lang1 == new_lang:
+            vec[-1].bytes = (mapped_offset + mapped_len) - vec[-1].offset
+        else:
+            vec.append(ResultChunk(offset=mapped_offset, bytes=mapped_len,
+                                   lang1=new_lang))
+
+    # FinishResultVector: cover [0, len) exactly
+    if vec:
+        if vec[0].offset > 0:
+            vec[0].bytes += vec[0].offset
+            vec[0].offset = 0
+        last = vec[-1]
+        if last.offset + last.bytes < len(raw):
+            last.bytes = len(raw) - last.offset
+    return vec
 
 
 def detect_scalar(text: str, tables: ScoringTables | None = None,
                   reg: Registry | None = None,
                   flags: int = 0, is_plain_text: bool = True,
-                  hints=None, _hint_boosts=None) -> ScalarResult:
+                  hints=None, want_chunks: bool = False,
+                  _hint_boosts=None, _vec_src=None) -> ScalarResult:
     """Full-document detection (DetectLanguageSummaryV2,
     compact_lang_det_impl.cc:1707-2106), including the squeeze/repeat
     anti-spam recursion. is_plain_text=False strips HTML tags / expands
@@ -715,11 +848,25 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
     if _hint_boosts is None and (hints is not None or not is_plain_text):
         from .hints import apply_hints
         _hint_boosts = apply_hints(text, is_plain_text, hints, tables, reg)
-    if not is_plain_text:
-        from .preprocess.html import clean_html
-        text, _ = clean_html(text, tables)
+    if _vec_src is None:
+        orig_text = text
+        html_offsets = None
+        if not is_plain_text:
+            from .preprocess.html import clean_html
+            text, html_offsets = clean_html(text, tables)
+        # Recursive passes receive the already-cleaned text plus this
+        # mapping context so result chunks always cover the ORIGINAL input
+        _vec_src = (orig_text, html_offsets)
+    else:
+        orig_text, html_offsets = _vec_src
+    # When chunks are wanted, squeeze/repeat-strip switch to the
+    # length-preserving Overwrite rewrites so span offsets keep mapping to
+    # the original input (impl.cc:1856-1862, :1908-1916) — detection then
+    # scores the dotted text, exactly as the reference's vector path does.
+    collect = want_chunks
     ctx = ScoringContext(tables=tables, registry=reg, flags=flags,
-                         hint_boosts=_hint_boosts)
+                         hint_boosts=_hint_boosts,
+                         chunk_records=[] if collect else None)
     doc_tote = DocTote()
     total_text_bytes = 0
     if flags & FLAG_REPEATS:
@@ -728,8 +875,13 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
     for span in segment_text(text, tables):
         if flags & FLAG_SQUEEZE:
             # Remove repetitive or mostly-space chunks (impl.cc:1852-1864)
-            squeezed = cheap_squeeze(span.buf.tobytes(), span.text_bytes)
-            span = _respan(squeezed, span.ulscript)
+            if collect:
+                dotted = cheap_squeeze_overwrite(span.buf.tobytes(),
+                                                 span.text_bytes)
+                span = _respan(dotted, span.ulscript, src_idx=span.src_idx)
+            else:
+                squeezed = cheap_squeeze(span.buf.tobytes(), span.text_bytes)
+                span = _respan(squeezed, span.ulscript)
         elif (TEST_THRESH >> 1) < span.text_bytes and \
                 not (flags & FLAG_FINISH):
             # Should the whole doc be re-scanned with squeezing on?
@@ -738,12 +890,21 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
                                           span.text_bytes):
                 return detect_scalar(text, tables, reg,
                                      flags | FLAG_SQUEEZE,
-                                     _hint_boosts=_hint_boosts)
+                                     want_chunks=want_chunks,
+                                     _hint_boosts=_hint_boosts,
+                                     _vec_src=_vec_src)
         if flags & FLAG_REPEATS:
             # Remove repeated words (impl.cc:1905-1918)
-            stripped = cheap_rep_words(span.buf.tobytes(), span.text_bytes,
-                                       rep_hash, predict_tbl)
-            span = _respan(stripped, span.ulscript)
+            if collect:
+                dotted = cheap_rep_words_overwrite(
+                    span.buf.tobytes(), span.text_bytes, rep_hash,
+                    predict_tbl)
+                span = _respan(dotted, span.ulscript, src_idx=span.src_idx)
+            else:
+                stripped = cheap_rep_words(span.buf.tobytes(),
+                                           span.text_bytes,
+                                           rep_hash, predict_tbl)
+                span = _respan(stripped, span.ulscript)
         score_one_span(ctx, span, doc_tote)
         total_text_bytes += span.text_bytes
 
@@ -764,7 +925,8 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
         if total < SHORT_TEXT_THRESH:
             extra |= FLAG_SHORT | FLAG_USE_WORDS
         return detect_scalar(text, tables, reg, flags | extra,
-                             _hint_boosts=_hint_boosts)
+                             want_chunks=want_chunks,
+                             _hint_boosts=_hint_boosts, _vec_src=_vec_src)
 
     if not (flags & FLAG_BEST_EFFORT):
         remove_unreliable(reg, doc_tote)
@@ -773,6 +935,9 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
         doc_tote, total_text_bytes)
     summary, reliable = calc_summary_lang(reg, lang3, percent3, total,
                                           is_reliable, flags)
+    chunks = build_result_chunks(orig_text, ctx.chunk_records, reg,
+                                 html_offsets) if collect else None
     return ScalarResult(summary_lang=summary, language3=lang3,
                         percent3=percent3, normalized_score3=ns3,
-                        text_bytes=total, is_reliable=reliable)
+                        text_bytes=total, is_reliable=reliable,
+                        chunks=chunks)

@@ -50,6 +50,10 @@ class ScriptSpan:
     text_bytes: int        # length counted like the reference: 1 + letters
     ulscript: int          # ULScript id
     cps: np.ndarray        # decoded codepoints of buf[:text_bytes+1]
+    # source char index per buffer byte [text_bytes + 1]: maps span-buffer
+    # offsets back to the segment_text input (the per-range results
+    # equivalent of the reference's composed OffsetMaps, offsetmap.cc)
+    src_idx: np.ndarray | None = None
 
     @property
     def text(self) -> bytes:
@@ -122,6 +126,7 @@ def segment_text(text: str,
             break
         spanscript = script[i]
         cur: list[int] = []
+        cur_src: list[int] = []
         put = 1  # leading space, counted like the reference's put cursor
 
         # Alternate letter runs and non-letter runs (single space each)
@@ -141,12 +146,14 @@ def segment_text(text: str,
                     if sc2 != 0 and sc2 != spanscript:
                         break  # genuine script change: span ends here
                 cur.append(lower_cps[i])
+                cur_src.append(i)
                 put += u8len[i]
                 i += 1
                 if put >= MAX_SPAN_PUT_BYTES:
                     break  # buffer full (truncated span)
             # --- non-letter run -> single separating space ---
             cur.append(0x20)
+            cur_src.append(min(i, n - 1))
             put += 1
             while i < n and script[i] == 0:
                 i += 1
@@ -158,17 +165,27 @@ def segment_text(text: str,
                 break  # almost-full buffer: stop at this word boundary
 
         if len(cur) > 1:
-            spans.append(_build_span(cur, spanscript))
+            spans.append(_build_span(cur, spanscript, cur_src))
     return spans
 
 
-def _build_span(span_cps: list[int], ulscript: int) -> ScriptSpan:
+def _build_span(span_cps: list[int], ulscript: int,
+                src: list[int] | None = None) -> ScriptSpan:
     cps = np.array([0x20] + span_cps, dtype=np.uint32)
     text = cps.tobytes().decode("utf-32-le").encode("utf-8")
     buf = np.zeros(len(text) + _TAIL_PAD, dtype=np.uint8)
     buf[:len(text)] = np.frombuffer(text, dtype=np.uint8)
     buf[len(text):len(text) + 3] = 0x20  # trailing "   " then NULs
+    src_idx = None
+    if src is not None:
+        # span-buffer byte -> source char: repeat each cp's source index
+        # by its encoded length (leading space inherits the first letter)
+        lens = utf8_len_of_cps(cps).astype(np.int64)
+        per_cp = np.array([src[0] if src else 0] + src, dtype=np.int32)
+        src_idx = np.repeat(per_cp, lens)
+        src_idx = np.concatenate([src_idx, src_idx[-1:]])
     # text_bytes counts the leading space + letters (reference convention:
     # scriptspan.text[0]==' ' and text[text_bytes]==' ').
     return ScriptSpan(buf=buf, text_bytes=len(text), ulscript=int(ulscript),
-                      cps=np.concatenate([cps, [0x20]]).astype(np.uint32))
+                      cps=np.concatenate([cps, [0x20]]).astype(np.uint32),
+                      src_idx=src_idx)

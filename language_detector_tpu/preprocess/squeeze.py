@@ -153,6 +153,43 @@ def cheap_squeeze(buf: bytes, src_len: int,
     return bytes(b[:dst])
 
 
+def cheap_squeeze_overwrite(buf: bytes, src_len: int,
+                            chunksize: int = CHUNK_SIZE) -> bytes:
+    """Length-preserving squeeze: overwrite dropped chunks with '.' instead
+    of compacting, so span-buffer offsets still map back to the original
+    text for the result-chunk vector (CheapSqueezeInplaceOverwrite,
+    compact_lang_det_impl.cc:869-940)."""
+    b = bytearray(buf[:src_len + 4])
+    hash_state = [0]
+    tbl = np.zeros(PREDICTION_TABLE_SIZE, dtype=np.int64)
+    space_thresh = (chunksize * SPACES_THRESH_PERCENT) // 100
+    predict_thresh = (chunksize * PREDICT_THRESH_PERCENT) // 100
+    skipping = False
+    src = 1  # always keep the leading space
+    while src < src_len:
+        length = min(chunksize, src_len - src)
+        while (b[src + length] & 0xC0) == 0x80:  # UTF-8 boundary
+            length += 1
+        space_n = count_spaces4(b, src, length)
+        predb_n = count_predicted_bytes(b, src, length, hash_state, tbl)
+        if space_n >= space_thresh or predb_n >= predict_thresh:
+            if not skipping:
+                # keep->skip transition: dot back to a space
+                n = _backscan_to_space(b, src)
+                b[src - n:src] = b"." * n
+                skipping = True
+            b[src:src + length] = b"." * length
+            b[src + length - 1] = 0x20
+        elif skipping:
+            # skip->keep transition: dot forward to a space
+            n = _forwardscan_to_space(b, src, length)
+            if n > 1:
+                b[src:src + n - 1] = b"." * (n - 1)
+            skipping = False
+        src += length
+    return bytes(b[:src_len])
+
+
 def cheap_rep_words(buf: bytes, src_len: int, hash_state: list,
                     tbl: np.ndarray) -> bytes:
     """Drop words with more than half their bytes predicted
@@ -197,3 +234,45 @@ def cheap_rep_words(buf: bytes, src_len: int, hash_state: list,
         h = ((h << 4) ^ c) & 0xFFF
     hash_state[0] = h
     return bytes(dst)
+
+
+def cheap_rep_words_overwrite(buf: bytes, src_len: int, hash_state: list,
+                              tbl: np.ndarray) -> bytes:
+    """Length-preserving variant: overwrite well-predicted words with '.'
+    so result-vector offset maps survive (CheapRepWordsInplaceOverwrite,
+    compact_lang_det_impl.cc:696-770)."""
+    b = bytearray(buf[:src_len])
+    h = hash_state[0]
+    word_start = 0
+    good_predict = 0
+    word_len = 0
+    src = 0
+    while src < src_len:
+        c = b[src]
+        if c == 0x20:
+            if good_predict * 2 > word_len:
+                b[word_start:src] = b"." * (src - word_start)
+            word_start = src + 1
+            good_predict = 0
+            word_len = 0
+        incr = 1
+        if c < 0xC0:
+            pass
+        elif (c & 0xE0) == 0xC0:
+            c = (c << 8) | b[src + 1]
+            incr = 2
+        elif (c & 0xF0) == 0xE0:
+            c = (c << 16) | (b[src + 1] << 8) | b[src + 2]
+            incr = 3
+        else:
+            c = ((c << 24) | (b[src + 1] << 16) | (b[src + 2] << 8) |
+                 b[src + 3])
+            incr = 4
+        src += incr
+        word_len += incr
+        if tbl[h] == c:
+            good_predict += incr
+        tbl[h] = c
+        h = ((h << 4) ^ c) & 0xFFF
+    hash_state[0] = h
+    return bytes(b)

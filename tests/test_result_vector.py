@@ -1,0 +1,106 @@
+"""Per-range result-chunk vector parity: scalar engine vs the oracle's
+ExtDetectLanguageSummary(resultchunkvector) overload.
+
+Covers SummaryBufferToVector (scoreonescriptspan.cc:389-509), ItemToVector
+(:341-378), FinishResultVector (compact_lang_det_impl.cc:1688-1704), and the
+offset-preserving Overwrite squeeze variants (impl.cc:696-940) that the
+vector path switches to.
+
+The oracle snapshot has no quadgram tables, so parity texts exercise the
+CJK / script-only / octagram paths it can actually score.
+"""
+import ctypes
+
+import pytest
+
+from language_detector_tpu.engine_scalar import detect_scalar
+from language_detector_tpu.registry import registry
+
+
+def oracle_vector(lib, text: bytes, flags: int = 0,
+                  is_plain_text: bool = True, cap: int = 128):
+    offs = (ctypes.c_int * cap)()
+    byts = (ctypes.c_int * cap)()
+    langs = (ctypes.c_int * cap)()
+    n = lib.o_detect_vector(text, len(text), 1 if is_plain_text else 0,
+                            flags, offs, byts, langs, cap)
+    return [(offs[i], byts[i], langs[i]) for i in range(n)]
+
+
+TEXTS = [
+    # single-script CJK / script-only
+    (True, "国民の大多数が内閣を支持し、集団的自衛権の行使を認める判断を歓迎した。"),
+    (True, "한국어는 한글을 사용하는 언어이며 대한민국의 공용어입니다."),
+    (True, "ελληνικά γλώσσα είναι πολύ όμορφη και έχει μεγάλη ιστορία"),
+    (True, "ภาษาไทยเป็นภาษาที่สวยงามและมีประวัติศาสตร์ยาวนาน"),
+    # mixed scripts -> multiple ranges
+    (True, "国民の大多数が内閣を支持し ελληνικά γλώσσα είναι πολύ όμορφη "
+           "集団的自衛権の行使を認める判断を歓迎した。"),
+    (True, "ภาษาไทยเป็นภาษา 中华人民共和国是世界上人口最多的国家 "
+           "ქართული ენა ძალიან ლამაზია"),
+    (True, "This is English text mixed with 日本語のテキストです。"
+           "東京は日本の首都 and back to English words again."),
+    (True, "Это русский текст и ภาษาไทยเป็นภาษาที่สวยงาม и ещё русский"),
+    # degenerate
+    (True, ""),
+    (True, "   "),
+    (True, "a"),
+    (True, "12345 67890 !!! ???"),
+    # HTML path (composed clean-text offset map)
+    (False, "<html><body><p>国民の大多数が内閣を支持し</p>"
+            "<p>ελληνικά γλώσσα είναι πολύ όμορφη</p></body></html>"),
+    (False, "<div lang=ja>日本語のテキストです。東京は日本の首都</div>"
+            " plain tail ภาษาไทยเป็นภาษา"),
+    (True, "한국어는 한글을 &amp; 사용하는 언어이며"),
+    # short letter run abutting an RTYPE_ONE span: JustOneItem records
+    # must skip the word-boundary trim / relabeling (scoreonescriptspan.cc
+    # :513-548 vs :419-505)
+    (True, "ελληνικά γλώσσα αβγქართული ენა ძალიან ლამაზია და საინტერესო"),
+    (True, "ελληνικά γλώσσα @ქართული ენა ძალიან ლამაზია და საინტერესო"),
+    (True, "abcქართული ენა ძალიან ლამაზია და საინტერესო ისტორია აქვს"),
+    # squeeze-trigger texts -> Overwrite variants must keep offsets exact
+    (True, "国民の大多数が内閣を支持し、集団的自衛権の行使を認める判断を歓迎した。" * 20),
+    (True, "ελληνικά γλώσσα είναι " * 50 + " ภาษาไทยเป็นภาษาที่สวยงาม " * 30),
+    (False, "<p>" + "ελληνικά γλώσσα είναι " * 60 + "</p><p>"
+            + "ภาษาไทยเป็นภาษาที่สวยงาม " * 40 + "</p>"),
+    (True, "დიდი ისტორია " * 100),
+    (True, "国民の大多数が " * 200 + "한국어는 한글을 " * 100),
+]
+
+
+@pytest.mark.parametrize("is_plain,text",
+                         TEXTS, ids=[repr(t[:28]) for _, t in TEXTS])
+def test_result_vector_parity(oracle, base_tables, is_plain, text):
+    want = oracle_vector(oracle, text.encode("utf-8"),
+                         is_plain_text=is_plain)
+    r = detect_scalar(text, base_tables, is_plain_text=is_plain,
+                      want_chunks=True)
+    got = [(c.offset, c.bytes, c.lang1) for c in (r.chunks or [])]
+    assert got == want, (text[:60],
+                         [(o, b, registry.code(l)) for o, b, l in got],
+                         [(o, b, registry.code(l)) for o, b, l in want])
+
+
+def test_vector_covers_input(base_tables):
+    """FinishResultVector contract: chunks tile [0, len) exactly."""
+    text = "This is English text mixed with 日本語のテキストです。and back."
+    r = detect_scalar(text, base_tables, want_chunks=True)
+    raw = text.encode("utf-8")
+    pos = 0
+    for c in r.chunks:
+        assert c.offset == pos
+        assert c.bytes > 0
+        pos += c.bytes
+    assert pos == len(raw)
+
+
+def test_detector_api_chunks(base_tables):
+    from language_detector_tpu.detector import LanguageDetector
+    det = LanguageDetector(tables=base_tables)
+    r = det.detect("国民の大多数が内閣を支持し ελληνικά γλώσσα είναι",
+                   return_chunks=True)
+    assert r.chunks is not None and len(r.chunks) >= 2
+    codes = [c[2] for c in r.chunks]
+    assert "ja" in codes and "el" in codes
+    # default path leaves chunks unset
+    assert det.detect("hello world").chunks is None

@@ -118,3 +118,30 @@ int o_detect_hints(const char* text, int len, int is_plain_text, int flags,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---- result-chunk vector parity ------------------------------------------
+// Fills up to max_chunks (offset, bytes, lang) triples; returns the count.
+int o_detect_vector(const char* text, int len, int is_plain_text, int flags,
+                    int* offsets, int* bytes, int* langs, int max_chunks) {
+  Language language3[3];
+  int pct3[3];
+  double ns3[3];
+  int tb = 0;
+  bool rel = false;
+  CLDHints hints = {NULL, NULL, UNKNOWN_ENCODING, UNKNOWN_LANGUAGE};
+  ResultChunkVector vec;
+  ExtDetectLanguageSummary(text, len, is_plain_text != 0, &hints, flags,
+                           language3, pct3, ns3, &vec, &tb, &rel);
+  int n = static_cast<int>(vec.size());
+  if (n > max_chunks) n = max_chunks;
+  for (int i = 0; i < n; ++i) {
+    offsets[i] = static_cast<int>(vec[i].offset);
+    bytes[i] = static_cast<int>(vec[i].bytes);
+    langs[i] = static_cast<int>(vec[i].lang1);
+  }
+  return n;
+}
+
+}  // extern "C"
