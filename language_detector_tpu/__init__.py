@@ -25,4 +25,4 @@ from .tables import ScoringTables, load_tables  # noqa: F401
 from .detector import LanguageDetector, DetectionResult, detect, detect_batch  # noqa: F401
 from .hints import CLDHints  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

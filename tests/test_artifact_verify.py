@@ -1,0 +1,60 @@
+"""Artifact verifier (tools/artifact_tool.py): the npz-artifact
+counterpart of the reference's cld2_dynamic_data_tool --verify round-trip
+(cld2_dynamic_data_tool.cc:51+, header contract cld2_dynamic_data.h:23-110).
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import artifact_tool  # noqa: E402
+
+
+def test_shipped_artifacts_verify():
+    assert artifact_tool.cmd_verify() == 0
+
+
+def test_structure_checks_catch_corruption(tmp_path, monkeypatch):
+    src = artifact_tool.DATA / "quad_tables.npz"
+    z = dict(np.load(src, allow_pickle=False))
+    # out-of-range indirect subscript in a filled slot
+    bad = dict(z)
+    buckets = z["quadgram_buckets"].copy()
+    filled = np.argwhere(buckets != 0)
+    r, c = filled[0]
+    keymask = int(z["quadgram_meta"][2])
+    buckets[r, c] = (buckets[r, c] & np.uint32(keymask)) | np.uint32(
+        len(z["quadgram_ind"]) + 5)
+    bad["quadgram_buckets"] = buckets
+    p = tmp_path / "quad_tables.npz"
+    np.savez(p, **bad)
+    errors = artifact_tool.check_structure(p)
+    assert any("indirect" in e for e in errors), errors
+
+    # non-power-of-two bucket count
+    bad2 = dict(z)
+    meta = z["quadgram_meta"].copy()
+    meta[1] = int(meta[1]) - 1
+    bad2["quadgram_meta"] = meta
+    p2 = tmp_path / "quad2" ; p2.mkdir()
+    f2 = p2 / "quad_tables.npz"
+    np.savez(f2, **bad2)
+    errors = artifact_tool.check_structure(f2)
+    assert any("power of two" in e or "!= bucket rows" in e
+               for e in errors), errors
+
+
+def test_manifest_detects_drift(tmp_path, monkeypatch):
+    import json
+    manifest = json.loads((artifact_tool.DATA / "MANIFEST.json").read_text())
+    name = "quad_tables.npz"
+    key = next(iter(manifest[name]["arrays"]))
+    manifest[name]["arrays"][key]["sha256"] = "0" * 64
+    mpath = tmp_path / "MANIFEST.json"
+    mpath.write_text(json.dumps(manifest))
+    monkeypatch.setattr(artifact_tool, "MANIFEST", mpath)
+    assert artifact_tool.cmd_verify() == 1

@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Table-artifact dump / verify tool.
+
+The TPU framework's model weights live in npz artifacts
+(language_detector_tpu/data/cld2_tables.npz + quad_tables.npz). This tool is
+the counterpart of the reference's cld2_dynamic_data_tool --dump/--verify
+(cld2_dynamic_data_tool.cc:51+, file contract cld2_dynamic_data.h:23-110):
+it prints the artifact "header" (per-array shape/dtype/checksum), checks
+structural invariants of every scoring table, and compares content hashes
+against the checked-in manifest so silent drift/corruption is caught.
+
+Usage:
+  python3 tools/artifact_tool.py --dump
+  python3 tools/artifact_tool.py --verify            # exit 1 on mismatch
+  python3 tools/artifact_tool.py --write-manifest    # refresh MANIFEST.json
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DATA = REPO / "language_detector_tpu" / "data"
+MANIFEST = DATA / "MANIFEST.json"
+ARTIFACTS = ("cld2_tables.npz", "quad_tables.npz")
+FORMAT_VERSION = 1
+
+# Ngram table prefixes per artifact (CLD2TableSummary equivalents,
+# cld2tablesummary.h:37-49)
+NGRAM_PREFIXES = {
+    "cld2_tables.npz": ("deltaocta", "distinctocta", "cjkdeltabi",
+                        "distinctbi", "cjkcompat"),
+    "quad_tables.npz": ("quadgram",),
+}
+
+
+def _sha(a: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
+def describe(path: Path) -> dict:
+    z = np.load(path, allow_pickle=False)
+    return {
+        "format_version": FORMAT_VERSION,
+        "arrays": {k: {"shape": list(z[k].shape), "dtype": str(z[k].dtype),
+                       "sha256": _sha(z[k])}
+                   for k in sorted(z.files)},
+    }
+
+
+def check_structure(path: Path) -> list[str]:
+    """Structural invariants of the scoring tables (the bits the runtime
+    assumes without checking on the hot path)."""
+    errors: list[str] = []
+    z = np.load(path, allow_pickle=False)
+
+    def err(msg):
+        errors.append(f"{path.name}: {msg}")
+
+    for prefix in NGRAM_PREFIXES.get(path.name, ()):
+        missing = [k for k in ("meta", "buckets", "ind")
+                   if f"{prefix}_{k}" not in z.files]
+        if missing:
+            err(f"missing {', '.join(f'{prefix}_{k}' for k in missing)}")
+            continue
+        meta = z[f"{prefix}_meta"]
+        buckets = z[f"{prefix}_buckets"]
+        ind = z[f"{prefix}_ind"]
+        size_one, size, keymask = int(meta[0]), int(meta[1]), int(meta[2])
+        if buckets.dtype != np.uint32 or buckets.ndim != 2 \
+                or buckets.shape[1] != 4:
+            err(f"{prefix}_buckets must be [n,4] uint32, "
+                f"got {buckets.shape} {buckets.dtype}")
+            continue
+        if size != buckets.shape[0]:
+            err(f"{prefix} meta size {size} != bucket rows "
+                f"{buckets.shape[0]}")
+        if size & (size - 1):
+            err(f"{prefix} bucket count {size} not a power of two")
+        # 0xFFFFFFFF appears on the empty dummy table
+        # (generated_distinct_bi_0.cc equivalent)
+        if keymask not in (0xFFFFF000, 0xFFFF0000, 0xFFFFFF00, 0xFFFFFFFF):
+            err(f"{prefix} unexpected keymask {keymask:#x}")
+        if ind.dtype != np.uint32:
+            err(f"{prefix}_ind must be uint32")
+        # size_one == 0 is legal: every entry is then a two-word pair
+        # (cjkcompat's direct-indexed layout)
+        if not 0 <= size_one <= len(ind):
+            err(f"{prefix} size_one {size_one} out of range "
+                f"(indirect len {len(ind)})")
+        # every non-empty slot's indirect subscript must be resolvable:
+        # subscripts >= size_one consume TWO consecutive indirect words
+        # (LinearizeAll convention, scoreonescriptspan.cc:936-964)
+        subs = (buckets & ~np.uint32(keymask)).ravel()
+        subs = subs[buckets.ravel() != 0]
+        if len(subs):
+            two = subs[subs >= size_one]
+            if subs.max(initial=0) >= len(ind):
+                err(f"{prefix} indirect subscript {int(subs.max())} >= "
+                    f"indirect len {len(ind)}")
+            elif len(two) and int(two.max()) + 1 >= len(ind):
+                err(f"{prefix} two-word subscript {int(two.max())} "
+                    f"overruns indirect array")
+
+    if path.name == "cld2_tables.npz":
+        for k, n in (("script_of_cp", 0x110000), ("cjk_uni_prop", 0x110000),
+                     ("interchange_ok", 0x110000)):
+            if k not in z.files:
+                err(f"missing {k}")
+            elif z[k].shape[0] != n:
+                err(f"{k} must cover {n} codepoints, got {z[k].shape}")
+        if "lg_prob_v2" in z.files and z["lg_prob_v2"].shape != (240, 8):
+            err(f"lg_prob_v2 must be [240,8] (kLgProbV2Tbl), "
+                f"got {z['lg_prob_v2'].shape}")
+        if "avg_delta_octa_score" in z.files \
+                and z["avg_delta_octa_score"].shape != (614, 4):
+            err("avg_delta_octa_score must be [614,4] "
+                "(kAvgDeltaOctaScore, 614 langs x 4 script4)")
+    if path.name == "quad_tables.npz":
+        if "expected_score_override" in z.files \
+                and z["expected_score_override"].shape != (614, 4):
+            err("expected_score_override must be [614,4]")
+    return errors
+
+
+def cmd_dump() -> int:
+    for name in ARTIFACTS:
+        path = DATA / name
+        if not path.exists():
+            print(f"{name}: MISSING")
+            continue
+        d = describe(path)
+        print(f"{name} ({path.stat().st_size // 1024} KB, "
+              f"format v{d['format_version']})")
+        for k, info in d["arrays"].items():
+            print(f"  {k:28} {str(info['shape']):>16} {info['dtype']:>8} "
+                  f"{info['sha256'][:12]}")
+    return 0
+
+
+def cmd_verify() -> int:
+    errors: list[str] = []
+    manifest = json.loads(MANIFEST.read_text()) if MANIFEST.exists() else None
+    if manifest is None:
+        errors.append(f"manifest missing: {MANIFEST}")
+    for name in ARTIFACTS:
+        path = DATA / name
+        if not path.exists():
+            # quad_tables.npz is an optional trained add-on -- but once
+            # the manifest records it, absence is drift, not an option
+            if name == "quad_tables.npz" and not (manifest
+                                                  and name in manifest):
+                continue
+            errors.append(f"{name}: artifact missing")
+            continue
+        errors.extend(check_structure(path))
+        if manifest and name in manifest:
+            want = manifest[name]["arrays"]
+            got = describe(path)["arrays"]
+            for k in want.keys() - got.keys():
+                errors.append(f"{name}: array {k} missing")
+            for k in got.keys() - want.keys():
+                errors.append(f"{name}: unexpected array {k}")
+            for k in want.keys() & got.keys():
+                if want[k] != got[k]:
+                    errors.append(
+                        f"{name}: {k} drifted "
+                        f"(manifest {want[k]['sha256'][:12]} != "
+                        f"file {got[k]['sha256'][:12]})")
+    if errors:
+        for e in errors:
+            print(f"VERIFY FAIL: {e}")
+        return 1
+    print("artifact verify OK")
+    return 0
+
+
+def cmd_write_manifest() -> int:
+    manifest = {name: describe(DATA / name)
+                for name in ARTIFACTS if (DATA / name).exists()}
+    MANIFEST.write_text(json.dumps(manifest, indent=1, sort_keys=True)
+                        + "\n")
+    print(f"wrote {MANIFEST}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--dump", action="store_true")
+    g.add_argument("--verify", action="store_true")
+    g.add_argument("--write-manifest", action="store_true")
+    args = ap.parse_args()
+    if args.dump:
+        return cmd_dump()
+    if args.verify:
+        return cmd_verify()
+    return cmd_write_manifest()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

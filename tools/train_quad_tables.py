@@ -225,7 +225,7 @@ def quads_of_word(word: str):
 # over the real reference word tables).
 SHRINK = 0.0
 ALPHA = 5.0
-BASE = 5
+BASE = 3      # sweep r03: base 3-4 beats 5 (306 vs 304/402 goldens)
 SLOPE = 2.0
 HI_CAP = 12
 
@@ -366,7 +366,7 @@ def train(tables, reg, corpus, buckets: int = 65536,
           slope: float = SLOPE, hi_cap: int = HI_CAP,
           mo_weight: float = 0.0, ensw_weight: float = 0.0,
           prior_pow: float = 0.0, lang_bias: dict | None = None,
-          verbose: bool = True) -> dict:
+          close_pool: float = 0.0, verbose: bool = True) -> dict:
     """Accumulate the collected corpus into a packed quadgram table set.
 
     lang_bias: optional per-language multiplicative calibration on
@@ -407,6 +407,32 @@ def train(tables, reg, corpus, buckets: int = 65536,
     # common quads). Scaled back to mean-language-mass weight units so
     # the dominance quantizer's absolute ALPHA pseudocount keeps its
     # historical meaning.
+    if close_pool > 0:
+        # Close-set quadgram pooling: CLD2's design separates close pairs
+        # ({bs,hr,sr}, {no,nn,da}, {id,ms}, ...) with distinct WORDS, not
+        # quadgrams -- its real tables list close-set members at
+        # near-equal probability per quad. Our per-language training data
+        # instead lets one member dominate shared quads, so pull every
+        # member up to close_pool * the set's max weight and let the
+        # authentic distinct-octa evidence + RefineScoredClosePairs
+        # decide (lang_script.cc:258 close sets, impl.cc:1154-1203).
+        cs_members: dict = collections.defaultdict(list)
+        for code, lang in reg.code_to_lang.items():
+            cs = reg.close_set(lang)
+            if cs:
+                cs_members[cs].append(lang)
+        for langw in fp_scores.values():
+            active = {reg.close_set(l) for l in langw} - {0}
+            for cs in active:
+                members = cs_members[cs]
+                mx = max(langw.get(m, 0.0) for m in members)
+                if mx <= 0:
+                    continue
+                floor = close_pool * mx
+                for m in members:
+                    if langw.get(m, 0.0) < floor:
+                        langw[m] = floor
+
     lang_total = collections.Counter()
     for langw in fp_scores.values():
         for lang, w in langw.items():
