@@ -48,7 +48,7 @@ def make_corpus(n: int) -> list:
     return out
 
 
-def bench(batch_size: int = 8192, n_batches: int = 4) -> dict:
+def bench(batch_size: int = 8192, n_batches: int = 8) -> dict:
     from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
 
     eng = NgramBatchEngine()
@@ -73,7 +73,9 @@ def bench(batch_size: int = 8192, n_batches: int = 4) -> dict:
     t_wire = time.time() - t0
     t0 = time.time()
     import numpy as np
-    out = np.asarray(eng._score_fn(eng.dt, p))
+    from language_detector_tpu.ops.score import unpack_resolved_out
+    out = unpack_resolved_out(np.asarray(eng._score_fn(eng.dt, p)),
+                              p["cmeta"])
     t_score = time.time() - t0
     t0 = time.time()
     if _native_ok():

@@ -3,7 +3,8 @@
 Pipeline per batch (the TPU redesign of DetectLanguageSummaryV2,
 compact_lang_det_impl.cc:1707-2106):
 
-  host   pack_batch      texts -> fixed-shape candidate tensors
+  host   pack_resolve    texts -> resolved hit wire (C++: segmentation,
+                         hashing, table probes, repeat cache, chunking)
   device score_batch     probes + totes + chunk summaries, one jitted program
   host   _doc_epilogue   DocTote replay + close pairs + unreliable removal +
                          summary language (O(1) per doc, scalar-exact)
@@ -26,8 +27,7 @@ from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH,
                              extract_lang_etc, refine_close_pairs,
                              remove_unreliable)
 from ..ops.device_tables import DeviceTables
-from ..ops.score import score_batch
-from ..preprocess.pack import PackedBatch, pack_batch
+from ..ops.score import score_resolved, unpack_resolved_out
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
@@ -53,98 +53,42 @@ def _bucket(n: int, lo: int, hi: int) -> int:
     return b
 
 
-def to_wire(packed: PackedBatch, max_slots: int, max_chunks: int,
-            n_shards: int = 1) -> dict:
-    """PackedBatch -> flat ragged device wire (see score_batch_impl):
-    8 bytes per USED slot + 5 per chunk + 8 per doc. Pad slots are never
-    shipped; the device reconstructs the dense [B, L] layout with two
-    gathers. Per-slot side/cjk/span metadata derives on device from the
-    span-begin bit + span->chunk_base map + chunk metadata.
-
-    Word layouts (keep in sync with ops/score.py):
-      w1 slot meta:  offset(16) | fp_hi(8) | kind(3) | span_begin(1)
-      chunk meta:    span_end(16) | script(7) | cjk(1) | side(1)
+def to_wire(rb, max_slots: int, max_chunks: int, n_shards: int = 1) -> dict:
+    """ResolvedBatch -> flat ragged device wire (see score_resolved_impl):
+    3 bytes per RESOLVED hit (u16 cat_ind2 index + u8 doc-local chunk id)
+    + 5 bytes per chunk + 8 per doc. Misses, offsets, and fingerprints
+    never cross the host->device link — the native packer already probed
+    the tables, ran the quad repeat cache, assigned chunks, and rotated
+    the distinct-boost lists (packer.cc ldt_pack_resolve).
 
     n_shards: leading shard axis size for shard_map data parallelism; docs
     split into contiguous equal groups, each flattened separately with
     shard-local doc_start offsets (parallel/mesh.py shards every leaf on
     axis 0)."""
-    B, Lfull = packed.kind.shape
+    B, Lfull = rb.idx.shape
     assert B % n_shards == 0, (B, n_shards)
-    assert max_chunks <= 256, "chunk ids must fit the span_cb u8 lane"
-    used_slots = max(int(packed.n_slots.max(initial=1)), 1)
-    used_chunks = max(int(packed.n_chunks.max(initial=1)), 1)
+    assert max_chunks <= 256, "chunk ids must fit the u8 wire lane"
+    used_slots = max(int(rb.n_slots.max(initial=1)), 1)
+    used_chunks = max(int(rb.n_chunks.max(initial=1)), 1)
     L = _bucket(used_slots, 64, max_slots)
     C = _bucket(used_chunks, 8, max_chunks)
 
     D = n_shards
     Bd = B // D
-    n_slots = packed.n_slots.astype(np.int32)
+    n_slots = rb.n_slots.astype(np.int32)
     per_shard_total = n_slots.reshape(D, Bd).sum(axis=1)
-    N = _bucket(max(int(per_shard_total.max()), 1), 4096,
-                max(Bd * max_slots, 4096))
+    # 32K-slot granularity: resolved slots are ~36/doc, so power-of-two
+    # bucketing would ship up to 2x padding over the slow host->device
+    # link; 32K steps cap waste at ~96KB while keeping the compiled
+    # program set small
+    N = max(4096, -int(per_shard_total.max()) // 32768 * -32768)
 
     from .. import native
-    if native.available():
-        # C++ flatten (native/epilogue.cc): one linear pass; the numpy
-        # path below costs ~20x more at large B on a single-core host.
-        # The 16-bit offset lane is safe by construction (span buffers are
-        # capped at 40,928 bytes; packer enforces the cap upstream).
-        wire = native.flatten_wire_native(packed, C, D, N)
-        wire["l_iota"] = np.zeros(L, np.uint8)
-        return wire
-
-    offs = packed.offset[:, :L]
-    if offs.size and int(offs.max(initial=0)) >= 1 << 16:
-        raise ValueError("slot offset exceeds the 16-bit wire lane "
-                         "(span buffers are capped at 40,928 bytes)")
-
-    li = np.arange(L)
-    used = li[None, :] < packed.n_slots[:, None]               # [B, L]
-    span_begin = (packed.span_start[:, :L] == li[None, :]) & used & \
-        (packed.kind[:, :L] != 0)
-    w1 = (offs.astype(np.uint32) |
-          (packed.fp_hi[:, :L].astype(np.uint32) << 16) |
-          (packed.kind[:, :L].astype(np.uint32) << 24) |
-          (span_begin.astype(np.uint32) << 27))
-    w0 = packed.fp[:, :L]
-
-    # span s -> first chunk id (u8): scatter span-begin slots' chunk_base
-    # into span order
-    span_cb = np.zeros((B, C), np.uint8)
-    rows, cols = np.nonzero(span_begin)
-    if len(rows):
-        s_ord = np.cumsum(span_begin, axis=1)[rows, cols] - 1
-        span_cb[rows, s_ord] = packed.chunk_base[:, :L][rows, cols]
-
-    chunks = (packed.chunk_span_end[:, :C].astype(np.uint32) |
-              (packed.chunk_script[:, :C].astype(np.uint32) << 16) |
-              (packed.chunk_cjk[:, :C].astype(np.uint32) << 23) |
-              (packed.chunk_side[:, :C].astype(np.uint32) << 24))
-
-    # Flatten used slots per shard; every shard pads to one power-of-two N
-    per_shard = n_slots.reshape(D, Bd)
-    starts = np.cumsum(per_shard, axis=1, dtype=np.int64) - per_shard
-    w0_flat = np.zeros((D, N), np.uint32)
-    w1_flat = np.zeros((D, N), np.uint32)
-    used_d = used.reshape(D, Bd, L)
-    w0_d = w0.reshape(D, Bd, L)
-    w1_d = w1.reshape(D, Bd, L)
-    for d in range(D):
-        sel = used_d[d]
-        n = int(per_shard[d].sum())
-        w0_flat[d, :n] = w0_d[d][sel]
-        w1_flat[d, :n] = w1_d[d][sel]
-
-    return dict(
-        w0=w0_flat,
-        w1=w1_flat,
-        chunks=chunks,
-        span_cb=span_cb,
-        doc_start=starts.astype(np.int32).reshape(B),
-        n_slots=n_slots,
-        l_iota=np.zeros(L, np.uint8),
-    )
+    wire = native.flatten_resolved_native(rb, D, N)
+    wire["cmeta"] = np.ascontiguousarray(rb.cmeta[:, :C])
+    wire["cscript"] = np.ascontiguousarray(rb.cscript[:, :C])
+    wire["l_iota"] = np.zeros(L, np.uint8)
+    return wire
 
 
 class NgramBatchEngine:
@@ -173,25 +117,32 @@ class NgramBatchEngine:
             self._score_fn = sharded_score_fn(mesh)
             self._mesh_size = mesh.devices.size
         else:
-            self._score_fn = score_batch
+            self._score_fn = score_resolved
             self._mesh_size = 1
         from .. import native
-        self._pack = native.pack_batch_native if native.available() \
-            else pack_batch
+        if not native.available():
+            raise RuntimeError(
+                "batched engine requires the native packer "
+                "(language_detector_tpu/native/build.sh); "
+                "use detect_scalar without it")
+        self._pack = native.pack_resolve_native
         # Running totals for observability (service /metrics): batches
         # scored, packer-fallback docs, and docs that failed the
         # good-answer gate into the scalar recursion
         self.stats = {"batches": 0, "fallback_docs": 0,
                       "scalar_recursion_docs": 0}
+        import threading
+        self._stats_lock = threading.Lock()
 
     # -- device dispatch ----------------------------------------------------
 
-    def score_packed(self, packed: PackedBatch) -> np.ndarray:
-        """Run the jitted device program over a packed batch; returns the
+    def score_packed(self, rb) -> np.ndarray:
+        """Run the jitted device program over a ResolvedBatch; returns the
         [B, C, 5] stacked chunk-summary array on host."""
-        p = to_wire(packed, self.max_slots, self.max_chunks,
+        p = to_wire(rb, self.max_slots, self.max_chunks,
                     n_shards=self._mesh_size)
-        return np.asarray(self._score_fn(self.dt, p))
+        out = np.asarray(self._score_fn(self.dt, p))
+        return unpack_resolved_out(out, p["cmeta"])
 
     # -- public API ---------------------------------------------------------
 
@@ -206,31 +157,33 @@ class NgramBatchEngine:
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 8192) -> list[ScalarResult]:
-        """Multi-batch detection with host/device pipelining. The device
-        backend executes lazily at result-fetch time, so a dedicated
-        fetch thread forces batch N's execution (blocking RPC, GIL
-        released) while the main thread packs batch N+1 and runs batch
-        N-1's epilogue. Sustained-throughput entry point for the service
-        layer and bench."""
+        """Multi-batch detection with host/device pipelining: the main
+        thread packs + dispatches batch N+1 while pool workers force
+        batch N's device execution and run its epilogue (both the C++
+        pack and epilogue release the GIL). Sustained-throughput entry
+        point for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
         from concurrent.futures import ThreadPoolExecutor
         results: list[ScalarResult] = []
-        pend = None
-        with ThreadPoolExecutor(1) as fetcher:
+        pending: list = []
+        # two workers: batch N's device fetch + epilogue overlap batch
+        # N+1's C++ packing on the main thread (both release the GIL)
+        with ThreadPoolExecutor(2) as pool:
             for i in range(0, len(texts), batch_size):
                 chunk = texts[i:i + batch_size]
                 packed, fut = self._dispatch(chunk)
-                fetch = fetcher.submit(np.asarray, fut)
-                if pend is not None:
-                    results.extend(self._finish(*pend))
-                pend = (chunk, packed, fetch)
-            results.extend(self._finish(*pend))
+                pending.append(pool.submit(self._finish, chunk, packed,
+                                           fut))
+                while len(pending) > 2:
+                    results.extend(pending.pop(0).result())
+            for f in pending:
+                results.extend(f.result())
         return results
 
     def _dispatch(self, texts: list[str]):
         """Pack + launch the device program asynchronously; returns
-        (packed, device future)."""
+        (packed, (cmeta, device future))."""
         bsz = _next_pow2(len(texts))
         bsz += -bsz % self._mesh_size  # divisible over the mesh axis
         padded = list(texts) + [""] * (bsz - len(texts))
@@ -239,17 +192,18 @@ class NgramBatchEngine:
                             max_chunks=self.max_chunks, flags=self.flags)
         p = to_wire(packed, self.max_slots, self.max_chunks,
                     n_shards=self._mesh_size)
-        return packed, self._score_fn(self.dt, p)
+        return packed, (p["cmeta"], self._score_fn(self.dt, p))
 
-    def _finish(self, texts: list[str], packed: PackedBatch,
+    def _finish(self, texts: list[str], packed,
                 fut) -> list[ScalarResult]:
-        """Fetch the device result and run the document epilogue. `fut`
-        is a device array or a concurrent Future resolving to its host
-        copy (detect_many's fetch thread)."""
-        out = np.asarray(fut.result()) if hasattr(fut, "result") \
-            else np.asarray(fut)
-        self.stats["batches"] += 1
-        self.stats["fallback_docs"] += int(packed.fallback.sum())
+        """Fetch the device result ((cmeta, device array)) and run the
+        document epilogue. Runs on detect_many's worker pool, so stats
+        updates take the lock."""
+        cmeta, dev = fut
+        out = unpack_resolved_out(np.asarray(dev), cmeta)
+        with self._stats_lock:
+            self.stats["batches"] += 1
+            self.stats["fallback_docs"] += int(packed.fallback.sum())
         from .. import native
         if native.available():
             return self._epilogue_native(texts, packed, out)
@@ -261,12 +215,13 @@ class NgramBatchEngine:
                 continue
             r = self._doc_epilogue(packed, out, b)
             if r is None:  # failed the good-answer gate: scalar recursion
-                self.stats["scalar_recursion_docs"] += 1
+                with self._stats_lock:
+                    self.stats["scalar_recursion_docs"] += 1
                 r = detect_scalar(text, self.tables, self.reg, self.flags)
             results.append(r)
         return results
 
-    def _epilogue_native(self, texts: list[str], packed: PackedBatch,
+    def _epilogue_native(self, texts: list[str], packed,
                          out: np.ndarray) -> list[ScalarResult]:
         """Batched C++ epilogue (native/epilogue.cc); docs flagged
         need_scalar (packer fallback or failed good-answer gate) take the
@@ -280,7 +235,8 @@ class NgramBatchEngine:
             row = ep[b]
             if row[12]:  # need_scalar
                 if not packed.fallback[b]:
-                    self.stats["scalar_recursion_docs"] += 1
+                    with self._stats_lock:
+                        self.stats["scalar_recursion_docs"] += 1
                 results.append(detect_scalar(text, self.tables, self.reg,
                                              self.flags))
                 continue
@@ -296,7 +252,7 @@ class NgramBatchEngine:
 
     # -- exact host epilogue ------------------------------------------------
 
-    def _doc_epilogue(self, packed: PackedBatch, out: np.ndarray,
+    def _doc_epilogue(self, packed, out: np.ndarray,
                       b: int) -> ScalarResult | None:
         """DocTote replay in chunk-id (= span) order, then the document
         post-processing pipeline, byte-identical to detect_scalar

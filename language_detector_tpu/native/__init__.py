@@ -9,6 +9,7 @@ exists and callers keep using the Python packer.
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import subprocess
 from pathlib import Path
 
@@ -36,7 +37,8 @@ def _build() -> bool:
 
 
 _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_epilogue_batch",
-            "ldt_flatten_wire")
+            "ldt_flatten_wire", "ldt_init_tables", "ldt_pack_resolve",
+            "ldt_flatten_resolved")
 
 
 def _try_load_all():
@@ -115,6 +117,22 @@ def _ensure_init(tables: ScoringTables, reg: Registry):
             _ptr(deflang, np.int32), _ptr(seed_lp, np.uint32),
             ctypes.c_int32(reg.num_scripts),
             ctypes.c_int32(1 if tables.distinctbi.empty else 0))
+        # host resolution tables (packer.cc resolve path); HostTables is
+        # cached per (tables, reg) so the pointers stay alive with it
+        from ..ops.device_tables import host_tables
+        ht = host_tables(tables, reg)
+        _init_keepalive.append(ht)
+        lib.ldt_init_tables(
+            _ptr(ht.cat_buckets, np.uint32), _ptr(ht.cat_ind2, np.uint32),
+            ctypes.c_int64(len(ht.cat_ind)),
+            _ptr(ht.bucket_off, np.int64), _ptr(ht.size, np.uint32),
+            _ptr(ht.keymask, np.uint32), _ptr(ht.ind_off, np.int32),
+            _ptr(ht.size_one, np.int32), _ptr(ht.probes, np.uint8),
+            ctypes.c_int64(ht.q2.bucket_off),
+            ctypes.c_uint32(ht.q2.size), ctypes.c_uint32(ht.q2.keymask),
+            ctypes.c_int32(ht.q2.ind_off), ctypes.c_int32(ht.q2.size_one),
+            ctypes.c_int32(1 if ht.q2_enabled else 0),
+            ctypes.c_int32(ht.seed_ind_base))
         _initialized_for = key
 
 
@@ -178,6 +196,97 @@ def pack_batch_native(texts: list[str], tables: ScoringTables,
         out.fallback.ctypes.data_as(ctypes.c_void_p),
         _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
     return out
+
+
+# -- resolved-wire packing (packer.cc ldt_pack_resolve) ---------------------
+
+
+@dataclasses.dataclass
+class ResolvedBatch:
+    """Host output of the resolve packer: dense per-doc resolved slots +
+    chunk metadata + everything the document epilogue needs."""
+    idx: np.ndarray          # [B, L] u16 cat_ind2 indices
+    chk: np.ndarray          # [B, L] u8 doc-local chunk ids
+    cmeta: np.ndarray        # [B, C] u32 cbytes|grams|side|real
+    cscript: np.ndarray      # [B, C] u8
+    direct_adds: np.ndarray  # [B, D, 3] i32
+    text_bytes: np.ndarray   # [B] i32
+    fallback: np.ndarray     # [B] bool
+    n_slots: np.ndarray      # [B] i32
+    n_chunks: np.ndarray     # [B] i32
+    n_docs: int = 0
+
+
+def pack_resolve_native(texts: list[str], tables: ScoringTables,
+                        reg: Registry, max_slots: int = 2048,
+                        max_chunks: int = 64, max_direct: int = 4,
+                        flags: int = 0, n_threads: int = 0) -> ResolvedBatch:
+    """texts -> resolved wire inputs (table probes, repeat filter, chunk
+    assignment, and distinct boosts all done in C++; see packer.cc)."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native packer unavailable")
+    _ensure_init(tables, reg)
+
+    B, L, C, D = len(texts), max_slots, max_chunks, max_direct
+    enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
+    bounds = np.zeros(B + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=bounds[1:])
+    blob = np.frombuffer(b"".join(enc), dtype=np.uint8) if bounds[-1] \
+        else np.zeros(1, np.uint8)
+    blob = np.ascontiguousarray(blob)
+
+    out = ResolvedBatch(
+        idx=np.zeros((B, L), np.uint16),
+        chk=np.zeros((B, L), np.uint8),
+        cmeta=np.zeros((B, C), np.uint32),
+        cscript=np.zeros((B, C), np.uint8),
+        direct_adds=np.full((B, D, 3), -1, np.int32),
+        text_bytes=np.zeros(B, np.int32),
+        fallback=np.zeros(B, bool),
+        n_slots=np.zeros(B, np.int32),
+        n_chunks=np.zeros(B, np.int32),
+        n_docs=B,
+    )
+    if n_threads <= 0:
+        import os
+        # oversubscribe modestly: the per-doc work mixes pointer-chasing
+        # probes with byte scans, and cgroup-limited cpu counts underreport
+        n_threads = min(16, 2 * (os.cpu_count() or 1) + 6)
+    lib.ldt_pack_resolve(
+        _ptr(blob, np.uint8), _ptr(bounds, np.int64),
+        ctypes.c_int32(B), ctypes.c_int32(L), ctypes.c_int32(C),
+        ctypes.c_int32(D), ctypes.c_int32(flags),
+        ctypes.c_int32(n_threads),
+        _ptr(out.idx, np.uint16), _ptr(out.chk, np.uint8),
+        _ptr(out.cmeta, np.uint32), _ptr(out.cscript, np.uint8),
+        out.direct_adds.ctypes.data_as(ctypes.c_void_p),
+        _ptr(out.text_bytes, np.int32),
+        out.fallback.ctypes.data_as(ctypes.c_void_p),
+        _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
+    return out
+
+
+def flatten_resolved_native(rb: ResolvedBatch, n_shards: int,
+                            N: int) -> dict:
+    """Dense ResolvedBatch slots -> flat ragged [n_shards, N] wire leaves
+    (idx, chk, doc_start)."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native library unavailable")
+    B, L = rb.idx.shape
+    idx_flat = np.zeros((n_shards, N), np.uint16)
+    chk_flat = np.zeros((n_shards, N), np.uint8)
+    doc_start = np.zeros(B, np.int32)
+    n_slots = np.ascontiguousarray(rb.n_slots, dtype=np.int32)
+    lib.ldt_flatten_resolved(
+        _ptr(rb.idx, np.uint16), _ptr(rb.chk, np.uint8),
+        _ptr(n_slots, np.int32), ctypes.c_int32(B), ctypes.c_int32(L),
+        ctypes.c_int32(n_shards), ctypes.c_int32(N),
+        _ptr(idx_flat, np.uint16), _ptr(chk_flat, np.uint8),
+        _ptr(doc_start, np.int32))
+    return dict(idx=idx_flat, chk=chk_flat, doc_start=doc_start,
+                n_slots=n_slots)
 
 
 # -- batched document epilogue (epilogue.cc) --------------------------------

@@ -559,9 +559,427 @@ void pack_one_doc(const uint8_t* text, int text_len, int b, const Out& o) {
   o.n_chunks[b] = chunk_base;
 }
 
+// ---- host-side table resolution (the device program's stages 1-4,
+// ops/score.py) ------------------------------------------------------------
+//
+// The scoring tables are a few MB and host-cache-resident, so the 4-way
+// associative probes (QuadHashV3Lookup4 / OctaHashV3Lookup4,
+// cldutil_shared.h:403-454), the quad repeat cache (cldutil.cc:334-367),
+// chunk assignment (ChunkAll, scoreonescriptspan.cc:978-1031), and the
+// rotating distinct-boost lists (AddDistinctBoost2, :112-121) all run here
+// during packing. The wire then carries only RESOLVED hits: a u16 index
+// into the device's concatenated indirect array + a u8 doc-local chunk id
+// (3 bytes/slot vs 8, and misses never cross the host->device link).
+
+struct ResTables {
+  const uint32_t* cat_buckets;  // [rows][4] all tables' buckets
+  const uint32_t* cat_ind;      // concatenated indirect arrays
+  int64_t n_ind;
+  // per-kind geometry (DeviceTables.kind_tbl)
+  int64_t bucket_off[8];
+  uint32_t size[8], keymask[8];
+  int32_t ind_off[8], size_one[8];
+  uint8_t probes[8];
+  // dual quadgram table
+  int64_t q2_bucket_off;
+  uint32_t q2_size, q2_keymask;
+  int32_t q2_ind_off, q2_size_one;
+  int q2_enabled;
+  int32_t seed_ind_base;  // cat_ind2 index of script 0's seed langprob
+};
+ResTables rt;
+bool rt_ready = false;
+
+inline uint32_t probe4(const uint32_t* row, uint32_t key, uint32_t keymask) {
+  for (int s = 0; s < 4; s++)
+    if (((row[s] ^ key) & keymask) == 0) return row[s];
+  return 0;
+}
+
+// Resolve one candidate exactly as the device program did (ops/score.py
+// stages 2-3): word A at the indirect address, word B only for QUAD/UNI
+// double entries. A zero word A makes the whole candidate inactive for
+// every kind except UNI (whose word B scores independently). Returns
+// (a_nonzero, b_nonzero, ia); emitted indices are ia / ia + 1.
+struct Resolved { bool a, b; int32_t ia; };
+
+inline Resolved resolve_rec(const Rec& r) {
+  int kind = r.kind;
+  if (kind == UNI) {
+    // direct double entry (cjkcompat: size_one == 0)
+    int32_t ia = rt.ind_off[UNI] + 2 * (int32_t)r.fp - rt.size_one[UNI];
+    return {rt.cat_ind[ia] != 0, rt.cat_ind[ia + 1] != 0, ia};
+  }
+  uint32_t fp = r.fp, size = rt.size[kind], keymask = rt.keymask[kind];
+  uint32_t sub, key;
+  if (kind == DELTA_OCTA || kind == DISTINCT_OCTA) {
+    uint32_t hi = r.fp_hi;
+    sub = (fp + ((fp >> 12) | (hi << 20))) & (size - 1);
+    key = ((fp >> 4) | (hi << 28)) & keymask;
+  } else {
+    sub = (fp + (fp >> 12)) & (size - 1);
+    key = fp & keymask;
+  }
+  uint32_t kv = probe4(rt.cat_buckets + 4 * (rt.bucket_off[kind] + sub),
+                       key, keymask);
+  int32_t io = rt.ind_off[kind], so = rt.size_one[kind];
+  if (kv == 0 && kind == QUAD && rt.q2_enabled) {
+    uint32_t sub2 = (fp + (fp >> 12)) & (rt.q2_size - 1);
+    kv = probe4(rt.cat_buckets + 4 * (rt.q2_bucket_off + sub2),
+                fp & rt.q2_keymask, rt.q2_keymask);
+    io = rt.q2_ind_off;
+    so = rt.q2_size_one;
+    keymask = rt.q2_keymask;
+  }
+  if (kv == 0) return {false, false, 0};
+  int32_t ind_raw = (int32_t)(kv & ~keymask);
+  if (ind_raw < so) {
+    int32_t ia = io + ind_raw;
+    return {rt.cat_ind[ia] != 0, false, ia};
+  }
+  int32_t ia = io + 2 * ind_raw - so;
+  // word B scores only for QUAD/UNI doubles (device lp_b gating)
+  bool b = kind == QUAD && rt.cat_ind[ia + 1] != 0;
+  return {rt.cat_ind[ia] != 0, b, ia};
+}
+
+// Closed-form ChunkAll boundary rule (ops/score.py _chunk_of_rank;
+// scoreonescriptspan.cc:994-1003)
+inline int chunk_of_rank(int r, int n_quota, int c) {
+  int k_full = n_quota < 2 * c ? 0 : (n_quota - 2 * c) / c + 1;
+  int tail = n_quota - k_full * c;
+  if (r < k_full * c) return r / c;
+  int tr = r - k_full * c;
+  bool tail_single = tail < c + (c >> 1);
+  int half = (tail + 1) >> 1;
+  return k_full + (tail_single ? 0 : (tr >= half ? 1 : 0));
+}
+
+// Resolved-wire per-doc output views
+struct ROut {
+  uint16_t* idx;      // [B, L] cat_ind2 indices
+  uint8_t* chk;       // [B, L] doc-local chunk ids
+  uint32_t* cmeta;    // [B, C] cbytes(16) | grams(12) | side<<28 | real<<29
+  uint8_t* cscript;   // [B, C]
+  int32_t* direct_adds;
+  int32_t* text_bytes;
+  uint8_t* fallback;
+  int32_t* n_slots;
+  int32_t* n_chunks;
+  int L, C, D, flags;
+};
+
+void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
+                          const ROut& o) {
+  std::vector<Span> spans;
+  segment_text(text, text_len, &spans);
+
+  const int L = o.L, C = o.C;
+  uint16_t* idx = o.idx + (int64_t)b * L;
+  uint8_t* chk = o.chk + (int64_t)b * L;
+  uint32_t* cmeta = o.cmeta + (int64_t)b * C;
+  uint8_t* cscript = o.cscript + (int64_t)b * C;
+  int32_t* dadds = o.direct_adds + (int64_t)b * o.D * 3;
+
+  // per-chunk accumulators
+  int32_t c_grams[256];
+  int32_t c_lo[256], c_span_end[256];
+  int16_t c_span[256];
+  int8_t c_side[256], c_real[256];
+  std::memset(c_grams, 0, sizeof(c_grams));
+  for (int c = 0; c < C && c < 256; c++) {
+    c_lo[c] = 1 << 30; c_span_end[c] = 0;
+    c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
+  }
+
+  // per-doc rotating distinct-boost lists (idx into cat_ind; 0 = empty)
+  int32_t boosts[2][4] = {{0, 0, 0, 0}, {0, 0, 0, 0}};
+  int bptr[2] = {0, 0};
+
+  int slot = 0, chunk_base = 0, n_direct = 0, span_no = 0;
+  int64_t total = 0;
+  bool ok = true;
+  std::vector<Rec> recs;
+
+  // emit the pending chunk's boost adds (list state at its last slot)
+  auto flush_boosts = [&](int c) {
+    if (c < 0 || !c_real[c]) return;
+    int side = c_side[c];
+    for (int s = 0; s < 4; s++) {
+      if (boosts[side][s] && slot < L) {
+        idx[slot] = (uint16_t)boosts[side][s];
+        chk[slot] = (uint8_t)c;
+        slot++;
+      }
+    }
+  };
+
+  int open_chunk = -1;  // chunk awaiting its boost flush
+  for (const Span& sp : spans) {
+    total += sp.text_bytes;
+    int rtv = sp.ulscript < g.n_scripts ? g.rtype[sp.ulscript] : 0;
+    if (!(o.flags & 1) && sp.text_bytes > (kSqueezeTestThresh >> 1) &&
+        cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
+      ok = false;  // squeeze-trigger doc -> scalar path
+      break;
+    }
+    if (rtv == 0 || rtv == 1) {  // RTypeNone/One: direct doc-tote add
+      if (n_direct >= o.D || chunk_base >= C) { ok = false; break; }
+      dadds[n_direct * 3 + 0] = chunk_base;
+      dadds[n_direct * 3 + 1] = g.deflang[sp.ulscript];
+      dadds[n_direct * 3 + 2] = sp.text_bytes;
+      n_direct++;
+      chunk_base++;
+      continue;
+    }
+    if (sp.text_bytes <= 1) continue;
+    const bool cjk = rtv == 3;
+    recs.clear();
+    bool fits = cjk ? pack_cjk_span(sp, &recs) : pack_quad_span(sp, &recs);
+    if (!fits) { ok = false; break; }
+    recs.push_back({1, SEED, 0, 0, 0,
+                    sp.ulscript < g.n_scripts ? g.seed_lp[sp.ulscript] : 0});
+    for (size_t i = 0; i < recs.size(); i++)
+      recs[i].prio = prio_of(recs[i].kind);
+    std::stable_sort(recs.begin(), recs.end(),
+                     [](const Rec& a, const Rec& c) {
+                       if (a.offset != c.offset) return a.offset < c.offset;
+                       return a.prio < c.prio;
+                     });
+
+    // ---- pass 1: resolve + quad repeat filter; count quota/entries ----
+    // (device semantics, ops/score.py stages 2-4: cache tracks HIT quads
+    // with nonzero word A; quota counts kept quads + word-A-valid unis;
+    // entry ranks accumulate every valid base-kind langprob word)
+    struct RRec { int32_t offset; int32_t ia; int8_t a, b, kind, rec; };
+    static thread_local std::vector<RRec> rres;
+    rres.clear();
+    uint32_t qcache[2] = {0, 0};
+    int qnext = 0;
+    int quota = 0;
+    for (const Rec& r : recs) {
+      RRec rr{r.offset, 0, 0, 0, r.kind, 0};
+      if (r.kind == SEED) {
+        if (r.fp) {
+          rr.ia = rt.seed_ind_base + sp.ulscript;
+          rr.a = 1;
+        }
+      } else if (r.kind == QUAD) {
+        bool repeat = r.fp == qcache[0] || r.fp == qcache[1];
+        if (!repeat) {
+          Resolved rs = resolve_rec(r);
+          if (rs.a) {  // active: word A nonzero (keep_quad)
+            qcache[qnext] = r.fp;
+            qnext = 1 - qnext;
+            rr.ia = rs.ia;
+            rr.a = 1;
+            rr.b = rs.b;
+            rr.rec = 1;
+            quota++;
+          }
+        }
+      } else {
+        Resolved rs = resolve_rec(r);
+        rr.ia = rs.ia;
+        rr.a = rs.a;
+        rr.b = rs.b && r.kind == UNI;
+        if (r.kind == UNI && rs.a) { rr.rec = 1; quota++; }
+        // non-UNI kinds are inactive without word A
+        if (r.kind != UNI && !rs.a) { rr.a = 0; rr.b = 0; }
+      }
+      rres.push_back(rr);
+    }
+
+    // span chunk count from quota (device: n_span_records -> chunk grid)
+    int chunksize = cjk ? 50 : 20;
+    int span_chunks = quota <= 0 ? 1
+        : chunk_of_rank(quota - 1, quota, chunksize) + 1;
+    int emit = 0;
+    for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
+    if (slot + emit + 4 * span_chunks > L || chunk_base + span_chunks > C ||
+        chunk_base + span_chunks > 256) {
+      ok = false;
+      break;
+    }
+
+    // ---- pass 2: chunk assignment + emission + boosts ----
+    // Device-exact accounting (ops/score.py stages 4-8): entry RANKS
+    // consume a+b for base kinds regardless of word-A validity; scores,
+    // grams, lo_off, and chunk realness require word A (slot_valid).
+    int side = sp.ulscript == kUlScriptLatin ? 0 : 1;
+    int cum_entries = 0;  // consumed base entries, exclusive
+    for (const RRec& rr : rres) {
+      bool base_kind = rr.kind == SEED || rr.kind == QUAD ||
+                       rr.kind == UNI;
+      int contrib = base_kind ? rr.a + rr.b : 0;
+      if (!rr.a) {
+        cum_entries += contrib;  // UNI word-B rank quirk
+        continue;
+      }
+      int r_excl = cum_entries;
+      int rank = quota > 0 ? std::min(r_excl, quota - 1) : 0;
+      int local = quota > 0 ? chunk_of_rank(rank, quota, chunksize) : 0;
+      int c = chunk_base + local;
+      if (c != open_chunk) {
+        flush_boosts(open_chunk);
+        open_chunk = c;
+      }
+      idx[slot] = (uint16_t)rr.ia;
+      chk[slot] = (uint8_t)c;
+      slot++;
+      if (rr.b) {
+        idx[slot] = (uint16_t)(rr.ia + 1);
+        chk[slot] = (uint8_t)c;
+        slot++;
+      }
+      cum_entries += contrib;
+      if (base_kind) c_grams[c] += rr.a + rr.b;
+      if (rr.offset < c_lo[c]) c_lo[c] = rr.offset;
+      c_real[c] = 1;
+      c_side[c] = (int8_t)side;
+      c_span[c] = (int16_t)span_no;
+      c_span_end[c] = sp.text_bytes;
+      cscript[c] = (uint8_t)sp.ulscript;
+      // rotating distinct boost (device scan: update AFTER scoring the
+      // slot, state read by the chunk containing the slot)
+      if (rr.kind == DISTINCT_OCTA || rr.kind == BI_DISTINCT) {
+        boosts[side][bptr[side]] = rr.ia;
+        bptr[side] = (bptr[side] + 1) & 3;
+      }
+    }
+    // mark allocated-but-empty chunks of this span (runt grids)
+    for (int c = chunk_base; c < chunk_base + span_chunks; c++) {
+      if (c_span[c] < 0) {
+        c_span[c] = (int16_t)span_no;
+        c_span_end[c] = sp.text_bytes;
+        c_side[c] = (int8_t)side;
+        cscript[c] = (uint8_t)sp.ulscript;
+      }
+    }
+    chunk_base += span_chunks;
+    span_no++;
+  }
+  flush_boosts(open_chunk);
+
+  // ---- chunk byte ranges: hi = next real chunk's lo (same span) else
+  // span_end (device stages 8) ----
+  for (int c = 0; c < chunk_base && c < C; c++) {
+    if (!c_real[c]) {
+      cmeta[c] = 0;
+      continue;
+    }
+    int hi = c_span_end[c];
+    if (c + 1 < chunk_base && c_real[c + 1] && c_span[c + 1] == c_span[c])
+      hi = c_lo[c + 1];
+    int cbytes = hi > c_lo[c] ? hi - c_lo[c] : 0;
+    if (cbytes > 0xFFFF) cbytes = 0xFFFF;
+    int grams = c_grams[c] > 0xFFF ? 0xFFF : c_grams[c];
+    cmeta[c] = (uint32_t)cbytes | ((uint32_t)grams << 16) |
+               ((uint32_t)(c_side[c] & 1) << 28) | (1u << 29);
+  }
+  o.text_bytes[b] = (int32_t)total;
+  o.fallback[b] = !ok;
+  o.n_slots[b] = slot;
+  o.n_chunks[b] = chunk_base;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Table geometry + data for host-side resolution. Pointers are owned by
+// Python (DeviceTables host copies) and must outlive packing calls.
+void ldt_init_tables(const uint32_t* cat_buckets, const uint32_t* cat_ind,
+                     int64_t n_ind, const int64_t* bucket_off,
+                     const uint32_t* size, const uint32_t* keymask,
+                     const int32_t* ind_off, const int32_t* size_one,
+                     const uint8_t* probes, int64_t q2_bucket_off,
+                     uint32_t q2_size, uint32_t q2_keymask,
+                     int32_t q2_ind_off, int32_t q2_size_one,
+                     int32_t q2_enabled, int32_t seed_ind_base) {
+  rt.cat_buckets = cat_buckets;
+  rt.cat_ind = cat_ind;
+  rt.n_ind = n_ind;
+  for (int k = 0; k < 8; k++) {
+    rt.bucket_off[k] = bucket_off[k];
+    rt.size[k] = size[k];
+    rt.keymask[k] = keymask[k];
+    rt.ind_off[k] = ind_off[k];
+    rt.size_one[k] = size_one[k];
+    rt.probes[k] = probes[k];
+  }
+  rt.q2_bucket_off = q2_bucket_off;
+  rt.q2_size = q2_size;
+  rt.q2_keymask = q2_keymask;
+  rt.q2_ind_off = q2_ind_off;
+  rt.q2_size_one = q2_size_one;
+  rt.q2_enabled = q2_enabled;
+  rt.seed_ind_base = seed_ind_base;
+  rt_ready = true;
+}
+
+// texts -> resolved wire (dense per doc; caller flattens via
+// ldt_flatten_resolved). Requires ldt_init + ldt_init_tables.
+void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
+                      int32_t n_docs, int32_t L, int32_t C, int32_t D,
+                      int32_t flags, int32_t n_threads,
+                      uint16_t* idx, uint8_t* chk, uint32_t* cmeta,
+                      uint8_t* cscript, int32_t* direct_adds,
+                      int32_t* text_bytes, uint8_t* fallback,
+                      int32_t* n_slots, int32_t* n_chunks) {
+  if (!rt_ready) {
+    // ldt_init_tables was never called: flag every doc as fallback
+    // instead of dereferencing null table pointers
+    for (int b = 0; b < n_docs; b++) {
+      fallback[b] = 1;
+      n_slots[b] = 0;
+      n_chunks[b] = 0;
+      text_bytes[b] = 0;
+    }
+    return;
+  }
+  ROut o{idx, chk, cmeta, cscript, direct_adds, text_bytes, fallback,
+         n_slots, n_chunks, L, C, D, flags};
+  auto work = [&](int lo, int hi) {
+    for (int b = lo; b < hi; b++)
+      pack_resolve_one_doc(texts + bounds[b],
+                           (int)(bounds[b + 1] - bounds[b]), b, o);
+  };
+  if (n_threads <= 1 || n_docs < 2 * n_threads) {
+    work(0, n_docs);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int per = (n_docs + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    int lo = t * per, hi = std::min(n_docs, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Dense [B, L] resolved slots -> flat ragged [n_shards, N] wire.
+void ldt_flatten_resolved(const uint16_t* idx, const uint8_t* chk,
+                          const int32_t* n_slots, int32_t B, int32_t L,
+                          int32_t n_shards, int32_t N,
+                          uint16_t* idx_flat, uint8_t* chk_flat,
+                          int32_t* doc_start) {
+  int Bd = B / n_shards;
+  for (int d = 0; d < n_shards; d++) {
+    int64_t pos = 0;
+    for (int i = 0; i < Bd; i++) {
+      int b = d * Bd + i;
+      doc_start[b] = (int32_t)pos;
+      int n = n_slots[b];
+      std::memcpy(idx_flat + (int64_t)d * N + pos, idx + (int64_t)b * L,
+                  (size_t)n * sizeof(uint16_t));
+      std::memcpy(chk_flat + (int64_t)d * N + pos, chk + (int64_t)b * L,
+                  (size_t)n);
+      pos += n;
+    }
+  }
+}
 
 void ldt_init(const uint8_t* script_of_cp, const uint32_t* lower_map,
               const uint8_t* cjk_prop, const int32_t* rtype,

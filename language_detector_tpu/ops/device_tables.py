@@ -50,11 +50,104 @@ class Quad2Static:
     size_one: int
 
 
+@dataclasses.dataclass
+class HostTables:
+    """Host-side (numpy) view of the concatenated tables + geometry, shared
+    by DeviceTables.from_host and the native resolver (packer.cc
+    ldt_init_tables). cat_ind2 = cat_ind ++ per-script seed langprobs, so a
+    u16 wire index addresses every possible tote add, seeds included."""
+    cat_buckets: np.ndarray        # [rows, 4] u32
+    cat_ind: np.ndarray            # [n] u32
+    cat_ind2: np.ndarray           # [n + num_scripts] u32
+    bucket_off: np.ndarray         # [8] i64 per-kind first bucket row
+    size: np.ndarray               # [8] u32
+    keymask: np.ndarray            # [8] u32
+    ind_off: np.ndarray            # [8] i32
+    size_one: np.ndarray           # [8] i32
+    probes: np.ndarray             # [8] u8
+    q2: "Quad2Static" = None
+    q2_enabled: bool = False
+    seed_ind_base: int = 0
+
+
+_host_tables_cache: list = []  # [(tables, reg, HostTables)] single slot
+
+
+def host_tables(t: ScoringTables, reg: Registry) -> HostTables:
+    if _host_tables_cache and _host_tables_cache[0][0] is t \
+            and _host_tables_cache[0][1] is reg:
+        return _host_tables_cache[0][2]
+    tables = [t.quadgram, t.quadgram2, t.deltaocta, t.distinctocta,
+              t.cjkdeltabi, t.distinctbi, t.cjkcompat]
+    names = ["quadgram", "quadgram2", "deltaocta", "distinctocta",
+             "cjkdeltabi", "distinctbi", "cjkcompat"]
+    bucket_off, ind_off = {}, {}
+    b_parts, i_parts = [], []
+    row, ent = 0, 0
+    for name, tbl in zip(names, tables):
+        bucket_off[name] = row
+        ind_off[name] = ent
+        b_parts.append(tbl.buckets.reshape(-1, 4))
+        i_parts.append(tbl.ind)
+        row += tbl.buckets.reshape(-1, 4).shape[0]
+        ent += len(tbl.ind)
+    cat_buckets = np.ascontiguousarray(
+        np.concatenate(b_parts, axis=0).astype(np.uint32))
+    cat_ind = np.ascontiguousarray(np.concatenate(i_parts).astype(np.uint32))
+
+    # seed block: the per-script default-language langprob the packer's
+    # SEED records used to carry inline (LinearizeAll's weight-1 seed,
+    # scoreonescriptspan.cc:936-964)
+    from ..registry import ULSCRIPT_LATIN
+    seeds = np.zeros(reg.num_scripts, np.uint32)
+    for s in range(reg.num_scripts):
+        seeds[s] = np.uint32(
+            reg.per_script_number(ULSCRIPT_LATIN, reg.default_language(s))
+            << 8)
+    cat_ind2 = np.ascontiguousarray(np.concatenate([cat_ind, seeds]))
+    if len(cat_ind2) > 0xFFFF:
+        raise ValueError(
+            f"concatenated indirect arrays ({len(cat_ind2)} entries) "
+            "exceed the u16 resolved-wire index; shrink the tables or "
+            "widen the wire lane")
+
+    ko = np.zeros(8, np.int64)
+    ks = np.ones(8, np.uint32)
+    km = np.full(8, 0xFFFFFFFF, np.uint32)
+    ki = np.zeros(8, np.int32)
+    k1 = np.zeros(8, np.int32)
+    kp = np.zeros(8, np.uint8)
+    for kind, name in _KIND_TABLE.items():
+        tbl = dict(zip(names, tables))[name]
+        ko[kind] = bucket_off[name]
+        ks[kind] = tbl.size
+        km[kind] = tbl.keymask
+        ki[kind] = ind_off[name]
+        k1[kind] = tbl.size_one
+        kp[kind] = kind != UNI
+    q2 = t.quadgram2
+    ht = HostTables(
+        cat_buckets=cat_buckets, cat_ind=cat_ind, cat_ind2=cat_ind2,
+        bucket_off=ko, size=ks, keymask=km, ind_off=ki, size_one=k1,
+        probes=kp,
+        q2=Quad2Static(bucket_off=bucket_off["quadgram2"],
+                       size=int(q2.size), keymask=int(q2.keymask),
+                       ind_off=ind_off["quadgram2"],
+                       size_one=int(q2.size_one)),
+        q2_enabled=not q2.empty and q2.size != 0,
+        seed_ind_base=len(cat_ind),
+    )
+    _host_tables_cache.clear()
+    _host_tables_cache.append((t, reg, ht))
+    return ht
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DeviceTables:
     cat_buckets: jnp.ndarray       # [sum sizes, 4] u32 all bucket arrays
     cat_ind: jnp.ndarray           # [sum inds] u32 all indirect arrays
+    cat_ind2: jnp.ndarray          # cat_ind ++ per-script seed langprobs
     kind_tbl: KindTables
     lg_prob3: jnp.ndarray          # [240, 3] uint8: 3-entry qprob decode
     expected_score: jnp.ndarray    # [614, 4] int32
@@ -68,48 +161,19 @@ class DeviceTables:
 
     @classmethod
     def from_host(cls, t: ScoringTables, reg: Registry) -> "DeviceTables":
-        tables = [t.quadgram, t.quadgram2, t.deltaocta, t.distinctocta,
-                  t.cjkdeltabi, t.distinctbi, t.cjkcompat]
-        names = ["quadgram", "quadgram2", "deltaocta", "distinctocta",
-                 "cjkdeltabi", "distinctbi", "cjkcompat"]
-        bucket_off, ind_off = {}, {}
-        b_parts, i_parts = [], []
-        row, ent = 0, 0
-        for name, tbl in zip(names, tables):
-            bucket_off[name] = row
-            ind_off[name] = ent
-            b_parts.append(tbl.buckets.reshape(-1, 4))
-            i_parts.append(tbl.ind)
-            row += tbl.buckets.reshape(-1, 4).shape[0]
-            ent += len(tbl.ind)
-        cat_buckets = np.concatenate(b_parts, axis=0).astype(np.uint32)
-        cat_ind = np.concatenate(i_parts).astype(np.uint32)
+        ht = host_tables(t, reg)
+        cat_buckets, cat_ind = ht.cat_buckets, ht.cat_ind
 
         _validate_qprobs(t, cat_ind)
 
-        ko = np.zeros(8, np.int32)
-        ks = np.ones(8, np.uint32)
-        km = np.full(8, 0xFFFFFFFF, np.uint32)
-        ki = np.zeros(8, np.int32)
-        k1 = np.zeros(8, np.int32)
-        kp = np.zeros(8, bool)
-        for kind, name in _KIND_TABLE.items():
-            tbl = dict(zip(names, tables))[name]
-            ko[kind] = bucket_off[name]
-            ks[kind] = tbl.size
-            km[kind] = tbl.keymask
-            ki[kind] = ind_off[name]
-            k1[kind] = tbl.size_one
-            kp[kind] = kind != UNI
         kind_tbl = KindTables(
-            bucket_off=jnp.asarray(ko), size=jnp.asarray(ks),
-            keymask=jnp.asarray(km), ind_off=jnp.asarray(ki),
-            size_one=jnp.asarray(k1), probes=jnp.asarray(kp))
-        q2 = t.quadgram2
-        kind_tbl2 = Quad2Static(
-            bucket_off=bucket_off["quadgram2"], size=int(q2.size),
-            keymask=int(q2.keymask), ind_off=ind_off["quadgram2"],
-            size_one=int(q2.size_one))
+            bucket_off=jnp.asarray(ht.bucket_off.astype(np.int32)),
+            size=jnp.asarray(ht.size),
+            keymask=jnp.asarray(ht.keymask),
+            ind_off=jnp.asarray(ht.ind_off),
+            size_one=jnp.asarray(ht.size_one),
+            probes=jnp.asarray(ht.probes.astype(bool)))
+        kind_tbl2 = ht.q2
 
         close = np.zeros(reg.num_languages, np.int32)
         for lang in range(reg.num_languages):
@@ -124,6 +188,7 @@ class DeviceTables:
         return cls(
             cat_buckets=jnp.asarray(cat_buckets),
             cat_ind=jnp.asarray(cat_ind),
+            cat_ind2=jnp.asarray(ht.cat_ind2),
             kind_tbl=kind_tbl,
             lg_prob3=jnp.asarray(t.lg_prob[:, 5:8]),
             expected_score=jnp.asarray(
@@ -136,7 +201,7 @@ class DeviceTables:
             closest_alt=jnp.asarray(alt),
             is_figs=jnp.asarray(figs),
             kind_tbl2=kind_tbl2,
-            quad2_enabled=not q2.empty and q2.size != 0,
+            quad2_enabled=ht.q2_enabled,
         )
 
 

@@ -476,3 +476,139 @@ score_batch = jax.jit(score_batch_impl)
 # program (everything after the requested stage is dead-code-eliminated) —
 # tools/profile_score.py times these to attribute device cost per stage.
 score_batch_staged = jax.jit(score_batch_impl, static_argnames=("stage",))
+
+
+# ---------------------------------------------------------------------------
+# Resolved-wire scorer: the production path.
+#
+# The native packer (packer.cc ldt_pack_resolve) performs the table probes,
+# quad repeat cache, chunk assignment, and distinct-boost rotation on the
+# HOST (the tables are a few MB and cache-resident there), so the wire
+# carries only resolved hits — 3 bytes per slot (u16 index into the
+# concatenated indirect array + u8 doc-local chunk id) instead of 8, and
+# misses never cross the host->device link. The device keeps the dense
+# numeric core that actually benefits from the MXU: langprob decode,
+# per-chunk totes as one-hot matmuls, masked top-2, and the reliability
+# formulas (cldutil.cc:553-605).
+# ---------------------------------------------------------------------------
+
+# cmeta bit layout (keep in sync with packer.cc pack_resolve_one_doc):
+#   cbytes(16) | grams(12) << 16 | side << 28 | real << 29
+CM2_GRAMS_SHIFT = 16
+CM2_SIDE_SHIFT = 28
+CM2_REAL_SHIFT = 29
+# output word: lang1(10) | s1(14) << 10 | rel(7) << 24 | real << 31
+OUTW_S1_SHIFT = 10
+OUTW_REL_SHIFT = 24
+OUTW_REAL_SHIFT = 31
+
+
+def score_resolved_impl(dt: DeviceTables, p: dict):
+    """Score one resolved wire into packed chunk outputs [B, C, 2] u32.
+
+    p (built by models/ngram.py from ldt_pack_resolve):
+      idx       [S, N]  u16  cat_ind2 index per resolved hit
+      chk       [S, N]  u8   doc-local chunk id
+      doc_start [B]     i32  doc's first slot (shard-local)
+      n_slots   [B]     i32
+      cmeta     [B, C]  u32  chunk meta (see CM2_* layout)
+      cscript   [B, C]  u8   chunk ULScript
+      l_iota    [L]     u8   dense slot-axis length carrier
+
+    Every reduction is doc-local: safe under jit and shard_map over the
+    doc axis with zero collectives."""
+    idxf = p["idx"].reshape(-1)
+    chkf = p["chk"].reshape(-1)
+    N = idxf.shape[0]
+    doc_start = p["doc_start"].astype(jnp.int32)
+    n_slots = p["n_slots"].astype(jnp.int32)
+    B = doc_start.shape[0]
+    L = p["l_iota"].shape[0]
+    cmeta = p["cmeta"].astype(jnp.uint32)
+    C = cmeta.shape[1]
+
+    # dense [B, L] reconstruction (one gather pair)
+    li = jnp.arange(L, dtype=jnp.int32)
+    valid = li[None, :] < n_slots[:, None]
+    gidx = jnp.clip(doc_start[:, None] + li[None, :], 0, N - 1)
+    lp = jnp.where(valid, dt.cat_ind2[idxf[gidx].astype(jnp.int32)], 0)
+    chunk_id = jnp.where(valid, chkf[gidx].astype(jnp.int32), 0)
+
+    # decode + per-slot language contribution [B, L, 256]
+    ps, row = _decode3(lp)
+    q = dt.lg_prob3[row].astype(jnp.int32)                     # [B, L, 3]
+    iota256 = jnp.arange(256, dtype=jnp.int32)
+    lang_val = jnp.zeros((B, L, 256), jnp.bfloat16)
+    for j in range(3):
+        contrib = jnp.where(valid & (ps[..., j] > 0), q[..., j], 0)
+        lang_val = lang_val + jnp.where(
+            ps[..., j:j + 1] == iota256, contrib[..., None], 0
+        ).astype(jnp.bfloat16)
+
+    # chunk totes on the MXU
+    chunk_oh = ((chunk_id[:, None, :] == jnp.arange(C)[None, :, None]) &
+                valid[:, None, :])                             # [B, C, L]
+    scores = jnp.einsum("bcl,blk->bck", chunk_oh.astype(jnp.bfloat16),
+                        lang_val,
+                        preferred_element_type=jnp.float32).astype(jnp.int32)
+
+    # chunk meta decode
+    cbytes = (cmeta & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    grams = ((cmeta >> CM2_GRAMS_SHIFT) & jnp.uint32(0xFFF)) \
+        .astype(jnp.int32)
+    side = ((cmeta >> CM2_SIDE_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
+    real = ((cmeta >> CM2_REAL_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
+    script = p["cscript"].astype(jnp.int32)
+
+    # group-in-use top-2 (tote.cc:30-100 semantics; qprob >= 1 invariant
+    # validated at DeviceTables.from_host)
+    groups = jnp.any((scores > 0).reshape(B, C, 64, 4), axis=3)
+    slot_in_use = jnp.repeat(groups, 4, axis=2)
+    sortkey = jnp.where(slot_in_use, scores * 256 + (255 - iota256), -1)
+    k1 = jnp.argmax(sortkey, axis=-1)
+    top1 = jnp.take_along_axis(sortkey, k1[..., None], axis=-1)[..., 0]
+    sortkey2 = jnp.where(iota256 == k1[..., None], -1, sortkey)
+    k2 = jnp.argmax(sortkey2, axis=-1)
+    top2 = jnp.take_along_axis(sortkey2, k2[..., None], axis=-1)[..., 0]
+    s1 = jnp.where(top1 >= 0, top1 >> 8, 0)
+    s2 = jnp.where(top2 >= 0, top2 >> 8, 0)
+    k1 = jnp.where(top1 >= 0, k1, 0)
+    k2 = jnp.where(top2 >= 0, k2, 0)
+
+    # per-script language mapping (rtype<=1 spans never reach the device:
+    # the packer routes them through direct_adds)
+    lang1 = dt.plang_to_lang[side, k1]
+    lang2 = dt.plang_to_lang[side, k2]
+
+    actual_kb = jnp.where(cbytes > 0, (s1 << 10) // jnp.maximum(cbytes, 1),
+                          0)
+    expected_kb = dt.expected_score[lang1, _lscript4(script)]
+    rd = _reliability_delta(s1, s2, grams)
+    same_set = (dt.close_set[lang1] != 0) & \
+        (dt.close_set[lang1] == dt.close_set[lang2])
+    rd = jnp.where(same_set, 100, rd)
+    rs = _reliability_expected(actual_kb, expected_kb)
+    crel = jnp.minimum(rd, rs)
+
+    # single packed word per chunk: 32 bytes/doc device->host readback.
+    # s1 clips at 16383 — chunk totes are bounded far below (<= ~110
+    # entries x qprob 12 + 4x12 boosts); the batch-agreement suite pins
+    # exactness against the scalar engine.
+    return (lang1.astype(jnp.uint32) |
+            (jnp.clip(s1, 0, 0x3FFF).astype(jnp.uint32) << OUTW_S1_SHIFT) |
+            (jnp.clip(crel, 0, 127).astype(jnp.uint32) << OUTW_REL_SHIFT) |
+            (real.astype(jnp.uint32) << OUTW_REAL_SHIFT))
+
+
+score_resolved = jax.jit(score_resolved_impl)
+
+
+def unpack_resolved_out(out: np.ndarray, cmeta: np.ndarray) -> np.ndarray:
+    """Device output [B, C] u32 + host chunk meta -> the [B, C, 5] int32
+    chunk-summary layout the document epilogue consumes (OUT_* lanes)."""
+    lang1 = (out & 0x3FF).astype(np.int32)
+    s1 = ((out >> OUTW_S1_SHIFT) & 0x3FFF).astype(np.int32)
+    rel = ((out >> OUTW_REL_SHIFT) & 0x7F).astype(np.int32)
+    real = ((out >> OUTW_REAL_SHIFT) & 1).astype(np.int32)
+    cbytes = (cmeta & 0xFFFF).astype(np.int32)
+    return np.stack([lang1, cbytes, s1, rel, real], axis=-1)

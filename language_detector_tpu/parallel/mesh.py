@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.score import score_batch_impl
+from ..ops.score import score_resolved_impl
 
 BATCH_AXIS = "batch"
 
@@ -35,23 +35,20 @@ def batch_mesh(n_devices: int | None = None,
 
 
 def sharded_score_fn(mesh: Mesh):
-    """Jitted score_batch with the document axis sharded over the mesh.
+    """Jitted score_resolved with the document axis sharded over the mesh.
 
     Tables replicate (in_specs P()); every wire leaf shards on its leading
     axis (to_wire builds the flat slot arrays with one shard row per
     device and shard-local doc_start offsets) except the L-carrier dummy,
     which replicates. The body is communication-free: all reductions are
     document-local."""
-    # check_vma off: the repeat-filter lax.scan seeds its carry with
-    # unvarying zeros, which the varying-axis checker rejects even though
-    # the computation is per-document.
-    wire_specs = dict(w0=P(BATCH_AXIS), w1=P(BATCH_AXIS),
-                      chunks=P(BATCH_AXIS), span_cb=P(BATCH_AXIS),
+    wire_specs = dict(idx=P(BATCH_AXIS), chk=P(BATCH_AXIS),
                       doc_start=P(BATCH_AXIS), n_slots=P(BATCH_AXIS),
+                      cmeta=P(BATCH_AXIS), cscript=P(BATCH_AXIS),
                       l_iota=P())
-    fn = jax.shard_map(score_batch_impl, mesh=mesh,
+    fn = jax.shard_map(score_resolved_impl, mesh=mesh,
                        in_specs=(P(), wire_specs),
-                       out_specs=P(BATCH_AXIS), check_vma=False)
+                       out_specs=P(BATCH_AXIS))
     return jax.jit(fn)
 
 

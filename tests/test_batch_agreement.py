@@ -78,10 +78,9 @@ def test_random_mixed_script_agreement(engine):
 def test_fallback_spam_agreement(engine):
     """Squeeze-trigger (repetitive) documents flag the scalar fallback in the
     packer and still agree end-to-end."""
-    from language_detector_tpu.preprocess.pack import pack_batch
     spam = ("buy cheap now " * 400).strip()
     docs = [spam, "word " * 600, "The quick brown fox. " + "spam ham " * 300]
-    packed = pack_batch(docs, engine.tables, engine.reg)
+    packed = engine._pack(docs, engine.tables, engine.reg)
     assert packed.fallback.any(), "expected at least one fallback doc"
     _assert_batch_agrees(engine, docs)
 
@@ -115,7 +114,6 @@ def test_chunk_level_parity(engine):
     import numpy as np
     from language_detector_tpu.engine_scalar import (DocTote, ScoringContext,
                                                      score_one_span)
-    from language_detector_tpu.preprocess.pack import pack_batch
     from language_detector_tpu.preprocess.segment import segment_text
 
     texts = _golden_texts()
@@ -124,7 +122,9 @@ def test_chunk_level_parity(engine):
     docs += [texts[3][:120] + " " + texts[-5][:120] for _ in range(4)]
     docs += [""] * (-len(docs) % BATCH)
 
-    packed = pack_batch(docs, engine.tables, engine.reg)
+    packed = engine._pack(docs, engine.tables, engine.reg,
+                          max_slots=engine.max_slots,
+                          max_chunks=engine.max_chunks, flags=engine.flags)
     out = engine.score_packed(packed)
 
     class RecordingTote(DocTote):

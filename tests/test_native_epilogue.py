@@ -12,7 +12,6 @@ import pytest
 from language_detector_tpu import native
 from language_detector_tpu.engine_scalar import detect_scalar
 from language_detector_tpu.models.ngram import NgramBatchEngine
-from language_detector_tpu.preprocess.pack import pack_batch
 from language_detector_tpu.registry import registry
 from language_detector_tpu.tables import ScoringTables
 

@@ -48,9 +48,8 @@ from language_detector_tpu.parallel.mesh import batch_mesh
 
 texts = g._TINY_TEXTS
 single = NgramBatchEngine(max_slots=256, max_chunks=16)
-packed = __import__('language_detector_tpu.preprocess.pack',
-                    fromlist=['pack_batch']).pack_batch(
-    texts, single.tables, single.reg, max_slots=256, max_chunks=16)
+packed = single._pack(texts, single.tables, single.reg,
+                      max_slots=256, max_chunks=16)
 a = single.score_packed(packed)
 sharded = NgramBatchEngine(max_slots=256, max_chunks=16, mesh=batch_mesh(4))
 b = sharded.score_packed(packed)

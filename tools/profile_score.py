@@ -1,10 +1,15 @@
 #!/usr/bin/env python3
-"""Per-stage device timing for the batched scorer.
+"""Device timing for the resolved-wire scorer.
 
-Runs the bench corpus through score_batch with staged early returns
-(ops/score.py score_batch_staged): stage N compiles only the program prefix
-up to that stage, so t(N) - t(N-1) attributes device time to stage N.
-Results feed docs/PERF.md.
+Times the production program (ops/score.py score_resolved) over the bench
+corpus three ways — device-resident inputs (compute + readback), full
+round trip (transfer + compute + readback), and a trivial jit call (the
+backend's fixed dispatch latency) — so wire-size and compute changes can
+be attributed. Results feed docs/PERF.md.
+
+NOTE (axon backend): block_until_ready returns at dispatch, not at
+completion — only a host fetch (np.asarray) forces execution, so all
+timings go through a fetch.
 """
 from __future__ import annotations
 
@@ -12,79 +17,56 @@ import sys
 import time
 from pathlib import Path
 
+import numpy as np
+
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-STAGES = {
-    1: "dense reconstruct + table probes (gathers)",
-    2: "+ langprob resolution (gathers)",
-    3: "+ quad repeat filter / boost rotation (lax.scan)",
-    4: "+ chunk assignment (cumsums + masked reduce)",
-    5: "+ chunk totes (one-hot matmul)",
-    6: "+ distinct boosts (elementwise)",
-    0: "full program (double argmax + summaries)",
-}
 
-
-def main(batch_size: int = 4096, iters: int = 5):
+def main(batch_size: int = 8192, iters: int = 5):
     import jax
+    import jax.numpy as jnp
     from bench import make_corpus
     from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
-    from language_detector_tpu.ops.score import score_batch_staged
+    from language_detector_tpu.ops.score import score_resolved
 
     eng = NgramBatchEngine()
     docs = make_corpus(batch_size)
-    packed = eng._pack(docs, eng.tables, eng.reg,
-                       max_slots=eng.max_slots, max_chunks=eng.max_chunks,
-                       flags=eng.flags)
-    p = to_wire(packed, eng.max_slots, eng.max_chunks)
-    B = p["doc_start"].shape[0]
-    L = p["l_iota"].shape[0]
-    C = p["chunks"].shape[1]
-    print(f"wire shapes: B={B} L={L} C={C} N={p['w0'].shape[1]} "
-          f"({sum(a.nbytes for a in p.values())/1e6:.2f} MB)", flush=True)
+    t0 = time.time()
+    rb = eng._pack(docs, eng.tables, eng.reg, max_slots=eng.max_slots,
+                   max_chunks=eng.max_chunks, flags=eng.flags)
+    t_pack = time.time() - t0
+    p = to_wire(rb, eng.max_slots, eng.max_chunks)
+    print(f"wire: B={batch_size} N={p['idx'].shape[1]} "
+          f"avg_slots={rb.n_slots.mean():.1f} "
+          f"({sum(a.nbytes for a in p.values()) / 1e6:.2f} MB); "
+          f"pack {t_pack * 1e3:.1f} ms", flush=True)
 
-    # Device-resident inputs: time compute, not host->device transfer.
-    # NOTE (axon backend): block_until_ready returns at dispatch, not at
-    # completion — only a host fetch (np.asarray) forces execution, so all
-    # timings below time through a fetch of the stage's tiny checksum.
-    import numpy as np
-    pd = {k: jax.device_put(v) for k, v in p.items()}
-    jax.block_until_ready(list(pd.values()))
+    @jax.jit
+    def tiny(x):
+        return jnp.sum(x)
 
-    t_transfer = time.time()
+    x = jax.device_put(np.arange(1024, dtype=np.int32))
+    np.asarray(tiny(x))
+    t0 = time.time()
     for _ in range(iters):
-        d = {k: jax.device_put(v) for k, v in p.items()}
-        np.asarray(jnp_sum_probe(d))
-    t_transfer = (time.time() - t_transfer) / iters
-    print(f"host->device transfer (forced): {t_transfer*1e3:8.1f} ms",
-          flush=True)
+        np.asarray(tiny(x))
+    print(f"fixed dispatch latency:      {(time.time()-t0)/iters*1e3:8.1f} "
+          "ms", flush=True)
 
-    prev = 0.0
-    for stage in (1, 2, 3, 4, 5, 6, 0):
-        np.asarray(score_batch_staged(eng.dt, pd, stage=stage))  # compile
-        t0 = time.time()
-        for _ in range(iters):
-            np.asarray(score_batch_staged(eng.dt, pd, stage=stage))
-        dt = (time.time() - t0) / iters
-        print(f"stage {stage or 7}: {dt*1e3:8.1f} ms  "
-              f"(+{(dt-prev)*1e3:7.1f} ms)  {STAGES[stage]}", flush=True)
-        prev = dt
+    pd = {k: jax.device_put(v) for k, v in p.items()}
+    np.asarray(score_resolved(eng.dt, pd))  # compile
+    t0 = time.time()
+    for _ in range(iters):
+        np.asarray(score_resolved(eng.dt, pd))
+    print(f"compute + readback:          {(time.time()-t0)/iters*1e3:8.1f} "
+          "ms", flush=True)
 
-
-import jax.numpy as _jnp  # noqa: E402
-
-
-def jnp_sum_probe(d):
-    """Tiny reduction over every wire array: fetching it forces the
-    transfers to complete without paying a large readback."""
-    import jax
-    return _probe_jit(d)
-
-
-@__import__("functools").partial(__import__("jax").jit)
-def _probe_jit(d):
-    return sum(_jnp.sum(v.astype(_jnp.int32)) for v in d.values())
+    t0 = time.time()
+    for _ in range(iters):
+        np.asarray(score_resolved(eng.dt, p))
+    print(f"transfer+compute+readback:   {(time.time()-t0)/iters*1e3:8.1f} "
+          "ms", flush=True)
 
 
 if __name__ == "__main__":
