@@ -5,7 +5,7 @@ compact_lang_det_impl.cc:1707-2106):
 
   host   pack_resolve    texts -> resolved hit wire (C++: segmentation,
                          hashing, table probes, repeat cache, chunking)
-  device score_batch     probes + totes + chunk summaries, one jitted program
+  device score_resolved  langprob decode + chunk totes + top-2 + reliability
   host   _doc_epilogue   DocTote replay + close pairs + unreliable removal +
                          summary language (O(1) per doc, scalar-exact)
 

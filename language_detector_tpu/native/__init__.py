@@ -37,8 +37,7 @@ def _build() -> bool:
 
 
 _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_epilogue_batch",
-            "ldt_flatten_wire", "ldt_init_tables", "ldt_pack_resolve",
-            "ldt_flatten_resolved")
+            "ldt_init_tables", "ldt_pack_resolve", "ldt_flatten_resolved")
 
 
 def _try_load_all():
@@ -345,35 +344,3 @@ def epilogue_batch_native(rows: np.ndarray, direct_adds: np.ndarray,
         _ptr(close, np.int32), _ptr(alt, np.int32), _ptr(figs, np.uint8),
         ctypes.c_int32(len(close)), _ptr(out, np.int64))
     return out
-
-
-def flatten_wire_native(packed: PackedBatch, C: int, n_shards: int,
-                        N: int) -> dict:
-    """Dense PackedBatch -> flat ragged device wire (ldt_flatten_wire,
-    epilogue.cc). Same contract as the numpy path in models/ngram.py
-    to_wire, minus the l_iota dummy the caller adds."""
-    lib = _load()
-    if not lib:
-        raise RuntimeError("native library unavailable")
-    B, Ls = packed.kind.shape
-    Cs = packed.chunk_script.shape[1]
-    w0 = np.zeros((n_shards, N), np.uint32)
-    w1 = np.zeros((n_shards, N), np.uint32)
-    chunks = np.zeros((B, C), np.uint32)
-    span_cb = np.zeros((B, C), np.uint8)
-    doc_start = np.zeros(B, np.int32)
-    n_slots = np.ascontiguousarray(packed.n_slots, dtype=np.int32)
-    lib.ldt_flatten_wire(
-        _ptr(packed.kind, np.int8), _ptr(packed.offset, np.int32),
-        _ptr(packed.fp, np.uint32), _ptr(packed.fp_hi, np.uint8),
-        _ptr(packed.chunk_base, np.int32), _ptr(packed.span_start, np.int32),
-        _ptr(packed.chunk_script, np.int16), _ptr(packed.chunk_cjk, np.int8),
-        _ptr(packed.chunk_side, np.int8),
-        _ptr(packed.chunk_span_end, np.int32),
-        _ptr(n_slots, np.int32),
-        ctypes.c_int32(B), ctypes.c_int32(Ls), ctypes.c_int32(Cs),
-        ctypes.c_int32(C), ctypes.c_int32(n_shards), ctypes.c_int32(N),
-        _ptr(w0, np.uint32), _ptr(w1, np.uint32), _ptr(chunks, np.uint32),
-        _ptr(span_cb, np.uint8), _ptr(doc_start, np.int32))
-    return dict(w0=w0, w1=w1, chunks=chunks, span_cb=span_cb,
-                doc_start=doc_start, n_slots=n_slots)

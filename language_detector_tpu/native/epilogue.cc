@@ -387,78 +387,3 @@ void ldt_epilogue_batch(
 }
 
 }  // extern "C"
-
-// ---------------------------------------------------------------------------
-// Wire flattening: dense PackedBatch arrays -> flat ragged device wire
-// (models/ngram.py to_wire contract; word layouts documented in
-// ops/score.py). One linear pass; the numpy equivalent costs ~300ms at
-// B=16K on this host, this runs in a few ms.
-// ---------------------------------------------------------------------------
-
-extern "C" {
-
-void ldt_flatten_wire(
-    const int8_t* kind,          // [B, Ls] dense (Ls = source row stride)
-    const int32_t* offset,       // [B, Ls]
-    const uint32_t* fp,          // [B, Ls]
-    const uint8_t* fp_hi,        // [B, Ls]
-    const int32_t* chunk_base,   // [B, Ls]
-    const int32_t* span_start,   // [B, Ls]
-    const int16_t* chunk_script, // [B, Cs]
-    const int8_t* chunk_cjk,     // [B, Cs]
-    const int8_t* chunk_side,    // [B, Cs]
-    const int32_t* chunk_span_end,  // [B, Cs]
-    const int32_t* n_slots,      // [B]
-    int32_t B, int32_t Ls, int32_t Cs,
-    int32_t C,                   // wire chunk width (<= Cs)
-    int32_t n_shards, int32_t N,  // wire row capacity per shard
-    uint32_t* w0,                // [n_shards, N] out (zeroed by caller)
-    uint32_t* w1,                // [n_shards, N] out
-    uint32_t* chunks,            // [B, C] out
-    uint8_t* span_cb,            // [B, C] out (zeroed by caller)
-    int32_t* doc_start) {        // [B] out (shard-local)
-  int Bd = B / n_shards;
-  for (int d = 0; d < n_shards; d++) {
-    int64_t cursor = 0;
-    uint32_t* dw0 = w0 + (int64_t)d * N;
-    uint32_t* dw1 = w1 + (int64_t)d * N;
-    for (int bb = 0; bb < Bd; bb++) {
-      int b = d * Bd + bb;
-      doc_start[b] = (int32_t)cursor;
-      int n = n_slots[b];
-      if (n > Ls) n = Ls;
-      const int8_t* kd = kind + (int64_t)b * Ls;
-      const int32_t* od = offset + (int64_t)b * Ls;
-      const uint32_t* fd = fp + (int64_t)b * Ls;
-      const uint8_t* hd = fp_hi + (int64_t)b * Ls;
-      const int32_t* cbd = chunk_base + (int64_t)b * Ls;
-      const int32_t* ssd = span_start + (int64_t)b * Ls;
-      int n_span = 0;
-      for (int l = 0; l < n; l++) {
-        uint32_t begin = (ssd[l] == l && kd[l] != 0) ? 1u : 0u;
-        if (begin) {
-          if (n_span < C) span_cb[(int64_t)b * C + n_span] =
-              (uint8_t)cbd[l];
-          n_span++;
-        }
-        dw0[cursor] = fd[l];
-        dw1[cursor] = (uint32_t)(od[l] & 0xFFFF) |
-                      ((uint32_t)hd[l] << 16) |
-                      ((uint32_t)(kd[l] & 7) << 24) | (begin << 27);
-        cursor++;
-      }
-    }
-    for (int bb = 0; bb < Bd; bb++) {
-      int b = d * Bd + bb;
-      for (int c = 0; c < C; c++) {
-        chunks[(int64_t)b * C + c] =
-            (uint32_t)(chunk_span_end[(int64_t)b * Cs + c] & 0xFFFF) |
-            ((uint32_t)(chunk_script[(int64_t)b * Cs + c] & 0x7F) << 16) |
-            ((uint32_t)(chunk_cjk[(int64_t)b * Cs + c] & 1) << 23) |
-            ((uint32_t)(chunk_side[(int64_t)b * Cs + c] & 1) << 24);
-      }
-    }
-  }
-}
-
-}  // extern "C"

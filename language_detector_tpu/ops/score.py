@@ -1,30 +1,26 @@
-"""Batched device scoring: packed candidates -> per-chunk summaries.
+"""Batched device scoring: resolved hits -> per-chunk summaries.
 
-The hot path of detection (compact_lang_det_impl.cc:1707-2106 ->
-cldutil.cc:315-533) runs here as one jitted program of fixed-shape tensor
-ops over a flat candidate wire:
+The numeric core of detection (ScoreOneChunk totes + top-2 + reliability,
+scoreonescriptspan.cc:208-302, cldutil.cc:553-605) as one jitted program
+of fixed-shape tensor ops over the resolved wire the native packer builds
+(packer.cc ldt_pack_resolve): langprob decode, chunk totes over 256
+per-script languages as one-hot matmuls on the MXU, masked double-argmax
+top-2, and the reliability formulas.
 
-  1. dense [B, L] reconstruction from the ragged wire   (gathers)
-  2. 4-way-associative probes of one concatenated table (2 gathers)
-  3. langprob resolution incl. double entries           (2 gathers)
-  4. quad repeat filter + distinct-boost rotation       (one lax.scan)
-  5. chunk assignment                                   (cumsums, closed form)
-  6. chunk totes over 256 per-script languages          (one-hot matmul, MXU)
-  7. top-2 + reliability per chunk                      (double argmax)
-
-Design rule for this device (TPU behind a high-latency tunnel): NO scatter,
-NO sort anywhere — scatters cost ~25ms each and sorts ~28ms at [4096, 256]
-shapes while gathers are ~1-6ms and one-hot matmuls ride the MXU (~7ms).
-Segment reductions are expressed as one-hot matmuls / masked broadcast
-reductions over the small chunk axis; top-k(2) as two masked argmaxes; the
-only sequential op is a single lax.scan carrying the 2-entry quad repeat
-cache (cldutil.cc:334-367) and the rotating 4-slot distinct-boost lists
-(scoreonescriptspan.cc:112-121).
+Design rules for this device (TPU behind a high-latency tunnel): NO
+scatter, NO sort, NO scan — segment reductions are one-hot matmuls over
+the small chunk axis, top-k(2) is two masked argmaxes, and everything
+sequential (probes, repeat cache, chunk assignment, boost rotation) lives
+in the C++ packer where the few-MB tables are cache-resident. History:
+ops/score.py@01ee7ba^ held the prior all-on-device program (probes +
+lax.scan); profiling (docs/PERF.md) showed the wire transfer and the
+fixed ~95ms dispatch latency dominating, so the split moved host-ward.
 
 The per-document epilogue (DocTote replay, close pairs, unreliable-language
 removal, summary language — all O(1) per doc) runs on the host in
-models/ngram.py, reusing the oracle-validated scalar code, so the batched
-path agrees with the scalar engine exactly (tests/test_batch_agreement.py).
+models/ngram.py + native/epilogue.cc, reusing the oracle-validated scalar
+semantics, so the batched path agrees with the scalar engine exactly
+(tests/test_batch_agreement.py).
 """
 from __future__ import annotations
 
@@ -33,41 +29,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from .device_tables import DeviceTables
-
-# Kind ids (keep in sync with preprocess/pack.py)
-PAD, SEED, QUAD, UNI, DELTA_OCTA, DISTINCT_OCTA, BI_DELTA, BI_DISTINCT = \
-    range(8)
-
-CHUNK_QUADS = 20
-CHUNK_UNIS = 50
-
-# Wire word layouts (keep in sync with models/ngram.py to_wire):
-#   w1 slot meta:  offset(16) | fp_hi(8) | kind(3) | span_begin(1)
-#   chunk meta:    span_end(16) | script(7) | cjk(1) | side(1)
-W1_OFFSET_BITS = 16
-W1_FPHI_SHIFT = 16
-W1_KIND_SHIFT = 24
-W1_SPANBEGIN_SHIFT = 27
-CM_SPANEND_BITS = 16
-CM_SCRIPT_SHIFT = 16
-CM_CJK_SHIFT = 23
-CM_SIDE_SHIFT = 24
-
-
-def _chunk_of_rank(r, n_quota, chunksize):
-    """Closed-form ChunkAll boundary rule (scoreonescriptspan.cc:994-1003):
-    chunks of `chunksize` until <2 chunks remain, then runt merging."""
-    c = chunksize
-    n = n_quota
-    k_full = jnp.where(n < 2 * c, 0, (n - 2 * c) // c + 1)
-    tail = n - k_full * c
-    in_full = r < k_full * c
-    tr = r - k_full * c
-    tail_single = tail < c + (c >> 1)
-    half = (tail + 1) >> 1
-    tail_chunk = jnp.where(tail_single, 0, (tr >= half).astype(jnp.int32))
-    return jnp.where(in_full, r // c, k_full + tail_chunk)
-
 
 def _decode3(lp):
     """langprob -> pslangs [.., 3] and group row index for qprob decode."""
@@ -104,379 +65,6 @@ def _lscript4(script):
                      jnp.where(script == 3, 1, jnp.where(script == 6, 2, 3)))
 
 
-def _filter_boost_scan(fp, quad_active, span_begin, distinct, side, lp_a):
-    """One pass over the slot axis carrying the two sequential pieces of
-    per-span scoring state:
-
-    - the exact 2-entry quad repeat cache, reset at span starts
-      (cldutil.cc:334-367); emits keep[B, L]
-    - the rotating 4-slot distinct-word boost list per (doc, side)
-      (AddDistinctBoost2, scoreonescriptspan.cc:112-121; persists across
-      spans like ScoringContext does); emits the post-slot state
-      [B, L, 2, 4] so chunk scoring can read the list as of its last slot.
-    """
-    B, L = fp.shape
-    init = (jnp.zeros(B, jnp.uint32), jnp.zeros(B, jnp.uint32),
-            jnp.zeros(B, jnp.int32),
-            jnp.zeros((B, 2, 4), jnp.uint32), jnp.zeros((B, 2), jnp.int32))
-
-    iota4 = jnp.arange(4)
-
-    def step(state, x):
-        c0, c1, nxt, bufs, ptrs = state
-        f, active, begin, dist, sd, lp = x
-        c0 = jnp.where(begin, jnp.uint32(0), c0)
-        c1 = jnp.where(begin, jnp.uint32(0), c1)
-        nxt = jnp.where(begin, 0, nxt)
-        repeat = (f == c0) | (f == c1)
-        keep = active & ~repeat
-        c0 = jnp.where(keep & (nxt == 0), f, c0)
-        c1 = jnp.where(keep & (nxt == 1), f, c1)
-        nxt = jnp.where(keep, 1 - nxt, nxt)
-        # rotating distinct boost list on the slot's script side
-        side_oh = jnp.arange(2)[None, :] == sd[:, None]        # [B, 2]
-        upd = (dist[:, None] & side_oh)[:, :, None] & \
-            (ptrs[:, :, None] == iota4[None, None, :])         # [B, 2, 4]
-        bufs = jnp.where(upd, lp[:, None, None], bufs)
-        ptrs = jnp.where(dist[:, None] & side_oh, (ptrs + 1) & 3, ptrs)
-        return (c0, c1, nxt, bufs, ptrs), (keep, bufs)
-
-    xs = tuple(jnp.swapaxes(a, 0, 1) for a in
-               (fp, quad_active, span_begin, distinct, side, lp_a))
-    _, (keep, bstate) = jax.lax.scan(step, init, xs)
-    return jnp.swapaxes(keep, 0, 1), jnp.moveaxis(bstate, 0, 1)
-
-
-def _chk(*xs):
-    """Tiny checksum that keeps a stage's outputs live under jit (the
-    staged profiling hook returns this so XLA dead-code-eliminates
-    everything after the stage being measured)."""
-    return sum(jnp.sum(x.astype(jnp.int32)) for x in xs)
-
-
-def score_batch_impl(dt: DeviceTables, p: dict, stage: int = 0):
-    """Score one packed batch into stacked chunk summaries [B, C, 5].
-
-    p is the flat wire format built by models/ngram.py to_wire (8 bytes per
-    used slot over the host->device link):
-      w0        [S, N]  u32  fingerprint low 32 (quad/bi/octa) or direct
-                             payload (seed langprob, uni compat class)
-      w1        [S, N]  u32  offset | fp_hi | kind | span_begin (see header)
-      chunks    [B, C]  u32  span_end | script | cjk | side
-      span_cb   [B, C]  u8   chunk_base of span s (span -> first chunk id)
-      doc_start [B]     i32  doc's first slot in the flat wire (shard-local)
-      n_slots   [B]     i32  slots used by the doc
-      l_iota    [L]     u8   dummy: carries the dense slot-axis length
-
-    S is the leading shard axis (1 per device; present so every leaf of the
-    wire shards on axis 0 under shard_map). Documents are independent and
-    every reduction is doc-local, so the program is safe under jit and
-    shard_map over the doc axis with zero collectives."""
-    w0f = p["w0"].reshape(-1)
-    w1f = p["w1"].reshape(-1)
-    N = w0f.shape[0]
-    doc_start = p["doc_start"].astype(jnp.int32)
-    n_slots = p["n_slots"].astype(jnp.int32)
-    B = doc_start.shape[0]
-    L = p["l_iota"].shape[0]
-    C = p["chunks"].shape[1]
-    chunk_meta = p["chunks"].astype(jnp.uint32)
-    span_cb = p["span_cb"].astype(jnp.int32)
-
-    # ---- 1. dense [B, L] reconstruction ----------------------------------
-    li = jnp.arange(L, dtype=jnp.int32)
-    valid_slot = li[None, :] < n_slots[:, None]
-    gidx = jnp.clip(doc_start[:, None] + li[None, :], 0, N - 1)
-    w0 = jnp.where(valid_slot, w0f[gidx], 0)
-    w1 = jnp.where(valid_slot, w1f[gidx], 0)
-
-    offset = (w1 & jnp.uint32(0xFFFF)).astype(jnp.int32)
-    fp_hi = (w1 >> W1_FPHI_SHIFT) & jnp.uint32(0xFF)
-    kind = ((w1 >> W1_KIND_SHIFT) & jnp.uint32(7)).astype(jnp.int32)
-    span_begin = ((w1 >> W1_SPANBEGIN_SHIFT) & jnp.uint32(1)).astype(bool)
-    fp = w0
-    pad = kind == PAD
-
-    # chunk metadata decode
-    chunk_span_end = (chunk_meta & jnp.uint32(0xFFFF)).astype(jnp.int32)
-    chunk_script = ((chunk_meta >> CM_SCRIPT_SHIFT) &
-                    jnp.uint32(0x7F)).astype(jnp.int32)
-    chunk_cjk = ((chunk_meta >> CM_CJK_SHIFT) & jnp.uint32(1)) \
-        .astype(jnp.int32)
-    chunk_side = ((chunk_meta >> CM_SIDE_SHIFT) & jnp.uint32(1)) \
-        .astype(jnp.int32)
-
-    # span structure: span index from begin marks; chunk_base per slot
-    span_idx = jnp.clip(jnp.cumsum(span_begin.astype(jnp.int32), axis=1) - 1,
-                        0, C - 1)
-    chunk_base = jnp.take_along_axis(span_cb, span_idx, axis=1)
-    span_start = jax.lax.cummax(
-        jnp.where(span_begin, li[None, :], 0), axis=1)
-    side = jnp.take_along_axis(chunk_side, chunk_base, axis=1)
-    cjk = jnp.take_along_axis(chunk_cjk, chunk_base, axis=1)
-    span_end_off = jnp.take_along_axis(chunk_span_end, chunk_base, axis=1)
-
-    # ---- 2. table probes (concatenated tables, 2 gathers) ----------------
-    kt = dt.kind_tbl  # per-kind geometry constants, [8]-vectors
-    size_k = kt.size[kind]
-    keymask_k = kt.keymask[kind]
-    probe_k = kt.probes[kind]
-
-    # quad-style sub/key (cldutil_shared.h:380-386)
-    sub_q = ((fp + (fp >> jnp.uint32(12))) &
-             (size_k - 1).astype(jnp.uint32)).astype(jnp.int32)
-    key_q = fp & keymask_k
-    # octa-style sub/key from the 40-bit fingerprint carried as (low 32,
-    # bits 32-39), exactly matching hashing.octa_subscript_key
-    # (cldutil_shared.h:389-397) in pure uint32 arithmetic
-    sum_lo = fp + ((fp >> jnp.uint32(12)) | (fp_hi << jnp.uint32(20)))
-    sub_o = (sum_lo & (size_k - 1).astype(jnp.uint32)).astype(jnp.int32)
-    key_o = ((fp >> jnp.uint32(4)) | (fp_hi << jnp.uint32(28))) & keymask_k
-
-    is_octa = (kind == DELTA_OCTA) | (kind == DISTINCT_OCTA)
-    sub = jnp.where(is_octa, sub_o, sub_q)
-    key = jnp.where(is_octa, key_o, key_q)
-    sub = jnp.where(probe_k, sub, 0)
-
-    def probe(rows, key, keymask):
-        match = ((rows ^ key[..., None]) & keymask[..., None]) == 0
-        hit = match.any(-1)
-        slot = jnp.argmax(match, axis=-1)
-        kv = jnp.take_along_axis(rows, slot[..., None], axis=-1)[..., 0]
-        return jnp.where(hit, kv, jnp.uint32(0))
-
-    rows1 = dt.cat_buckets[kt.bucket_off[kind] + sub]        # [B, L, 4]
-    kv = jnp.where(probe_k, probe(rows1, key, keymask_k), 0)
-
-    # dual quadgram table (second probe only meaningful for QUAD slots)
-    q2 = dt.kind_tbl2
-    if dt.quad2_enabled:
-        sub2 = ((fp + (fp >> jnp.uint32(12))) &
-                jnp.uint32(q2.size - 1)).astype(jnp.int32)
-        sub2 = jnp.where(kind == QUAD, sub2, 0)
-        rows2 = dt.cat_buckets[q2.bucket_off + sub2]
-        kv2 = jnp.where(kind == QUAD,
-                        probe(rows2, fp & jnp.uint32(q2.keymask),
-                              jnp.full_like(fp, q2.keymask)), 0)
-    else:
-        kv2 = jnp.zeros_like(kv)
-    if stage == 1:  # probes only
-        return _chk(kv, kv2)
-
-    # ---- 3. langprob resolution (2 gathers + double-entry logic) ---------
-    # All tables share the indirect convention (LinearizeAll,
-    # scoreonescriptspan.cc:936-964): subscript < size_one -> one langprob
-    # at ind[s]; else two at ind[2s - size_one]. The snapshot's octa/bi
-    # tables are all-single (size_one == len(ind)) and cjkcompat is
-    # all-double (size_one == 0), so one code path covers every kind.
-    ind_raw = jnp.where(kind == UNI, w0, kv & ~keymask_k) \
-        .astype(jnp.int32)
-    so_k = kt.size_one[kind]
-    io_k = kt.ind_off[kind]
-    single1 = ind_raw < so_k
-    ia1 = io_k + jnp.where(single1, ind_raw, 2 * ind_raw - so_k)
-    # QUAD slots falling back to the dual table
-    use2 = (kind == QUAD) & (kv == 0)
-    ind2 = (kv2 & jnp.uint32(~np.uint32(q2.keymask))).astype(jnp.int32)
-    single2 = ind2 < q2.size_one
-    ia2 = q2.ind_off + jnp.where(single2, ind2, 2 * ind2 - q2.size_one)
-    ia = jnp.where(use2, ia2, ia1)
-    single = jnp.where(use2, single2, single1)
-    hit = jnp.where(use2, kv2 != 0, (kv != 0) | (kind == UNI))
-
-    n_ind = len(dt.cat_ind)
-    lp_gather_a = dt.cat_ind[jnp.clip(ia, 0, n_ind - 1)]
-    lp_gather_b = dt.cat_ind[jnp.clip(ia + 1, 0, n_ind - 1)]
-
-    lp_a = jnp.where(kind == SEED, w0,
-                     jnp.where(hit & (kind > SEED), lp_gather_a, 0))
-    lp_b = jnp.where(hit & ((kind == QUAD) | (kind == UNI)) & ~single,
-                     lp_gather_b, 0)
-    if stage == 2:
-        return _chk(lp_a, lp_b)
-
-    # ---- 4. quad repeat filter + distinct boost rotation (one scan) ------
-    quad_active = (kind == QUAD) & (lp_a != 0)
-    is_distinct = ((kind == DISTINCT_OCTA) | (kind == BI_DISTINCT)) & \
-        (lp_a != 0)
-    keep_quad, bstate = _filter_boost_scan(
-        fp, quad_active, span_begin, is_distinct, side, lp_a)
-    quad_mask = (kind != QUAD) | keep_quad
-    lp_a = jnp.where(quad_mask, lp_a, 0)
-    lp_b = jnp.where(quad_mask, lp_b, 0)
-    valid_a = lp_a != 0
-    valid_b = lp_b != 0
-    if stage == 3:
-        return _chk(keep_quad, bstate, lp_a)
-
-    is_base_kind = (kind == SEED) | (kind == QUAD) | (kind == UNI)
-    # linear-entry contribution toward chunk quotas and gram counts
-    entry_contrib = jnp.where(is_base_kind,
-                              valid_a.astype(jnp.int32) +
-                              valid_b.astype(jnp.int32), 0)
-    # base hit RECORDS (chunk quota input; seed is not a record)
-    base_record = (((kind == QUAD) & keep_quad) |
-                   ((kind == UNI) & valid_a)).astype(jnp.int32)
-
-    # ---- 5. chunk assignment (cumsums + closed-form boundaries) ----------
-    # records per span: masked reduce over the small span axis (<= C spans)
-    span_oh = (span_idx[:, None, :] == jnp.arange(C)[None, :, None]) & \
-        ~pad[:, None, :]                                      # [B, C, L]
-    recs_per_span = jnp.sum(jnp.where(span_oh, base_record[:, None, :], 0),
-                            axis=2)                           # [B, C]
-    n_span_records = jnp.take_along_axis(recs_per_span, span_idx, axis=1)
-
-    cum_entries = jnp.cumsum(entry_contrib, axis=1)
-    cum_at_start = jnp.take_along_axis(cum_entries, span_start, axis=1)
-    contrib_at_start = jnp.take_along_axis(entry_contrib, span_start, axis=1)
-    cb_incl = cum_entries - cum_at_start + contrib_at_start
-    cb_excl = cb_incl - entry_contrib  # consumed strictly before this slot
-
-    chunksize = jnp.where(cjk > 0, CHUNK_UNIS, CHUNK_QUADS)
-    quota = jnp.maximum(n_span_records, 0)
-    # clip rank so overflow lands in the final chunk (forced end boundary)
-    r = jnp.clip(cb_excl, 0, jnp.maximum(quota - 1, 0))
-    local_chunk = jnp.where(quota == 0, 0,
-                            _chunk_of_rank(r, quota, chunksize))
-    chunk_id = jnp.clip(chunk_base + local_chunk, 0, C - 1)
-    slot_valid = valid_a & ~pad
-    if stage == 4:
-        return _chk(chunk_id, slot_valid)
-
-    # ---- 6. chunk totes: one-hot matmul on the MXU -----------------------
-    ps_a, row_a = _decode3(lp_a)
-    ps_b, row_b = _decode3(lp_b)
-    q_a = dt.lg_prob3[row_a].astype(jnp.int32)     # [B, L, 3]
-    q_b = dt.lg_prob3[row_b].astype(jnp.int32)
-
-    iota256 = jnp.arange(256, dtype=jnp.int32)
-    # per-slot language contribution vector [B, L, 256] (XLA fuses the six
-    # iota-compare adds into the einsum operand)
-    lang_val = jnp.zeros((B, L, 256), jnp.bfloat16)
-    for ps3, q3, ok in ((ps_a, q_a, valid_a), (ps_b, q_b, valid_b)):
-        for j in range(3):
-            contrib = jnp.where(ok & (ps3[..., j] > 0), q3[..., j], 0)
-            lang_val = lang_val + jnp.where(
-                ps3[..., j:j + 1] == iota256, contrib[..., None], 0
-            ).astype(jnp.bfloat16)
-
-    chunk_oh = ((chunk_id[:, None, :] == jnp.arange(C)[None, :, None]) &
-                slot_valid[:, None, :])                       # [B, C, L]
-    scores = jnp.einsum("bcl,blk->bck", chunk_oh.astype(jnp.bfloat16),
-                        lang_val,
-                        preferred_element_type=jnp.float32).astype(jnp.int32)
-    if stage == 5:
-        return _chk(scores)
-
-    # ---- 7. distinct-word boosts from the scan state ---------------------
-    # boost list as of the chunk's last valid slot, on the chunk's side
-    last_slot = jnp.max(jnp.where(chunk_oh, li[None, None, :], 0), axis=2)
-    chunk_has = jnp.any(chunk_oh, axis=2)                     # [B, C]
-    bstate_c = jnp.take_along_axis(
-        bstate.reshape(B, L, 8),
-        last_slot[..., None], axis=1).reshape(B, C, 2, 4)
-    boost_lps = jnp.take_along_axis(
-        bstate_c, chunk_side[..., None, None], axis=2)[:, :, 0, :]
-    boost_lps = jnp.where(chunk_has[..., None], boost_lps, 0)  # [B, C, 4]
-    bps, brow = _decode3(boost_lps)                            # [B, C, 4, 3]
-    bq = dt.lg_prob3[brow].astype(jnp.int32)
-    bval = jnp.where((boost_lps[..., None] != 0) & (bps > 0), bq, 0)
-    boost_scores = jnp.sum(
-        jnp.where(bps[..., None] == iota256, bval[..., None], 0),
-        axis=(2, 3))                                           # [B, C, 256]
-    scores = scores + boost_scores
-    if stage == 6:
-        return _chk(scores)
-
-    # ---- 8. chunk summaries (no sort, no scatter) ------------------------
-    # group-in-use semantics: every langprob add carries qprob >= 1
-    # (validated at DeviceTables.from_host), so a Tote group is in use iff
-    # any of its 4 language slots scored > 0
-    groups = jnp.any((scores > 0).reshape(B, C, 64, 4), axis=3)
-    slot_in_use = jnp.repeat(groups, 4, axis=2)                # [B, C, 256]
-
-    grams = jnp.sum(jnp.where(
-        chunk_oh, jnp.where(kind <= UNI, entry_contrib, 0)[:, None, :], 0),
-        axis=2)
-    lo_off = jnp.min(jnp.where(chunk_oh, offset[:, None, :], 1 << 30),
-                     axis=2)
-    real = chunk_has
-
-    # span of each chunk from the span->chunk_base map: chunk c belongs to
-    # span s iff span_cb[s] <= c < span_cb[s+1] (within allocated spans)
-    n_spans = jnp.max(jnp.where(span_begin, span_idx + 1, 0), axis=1)
-    ci = jnp.arange(C, dtype=jnp.int32)
-    span_alloc = jnp.arange(C)[None, :] < n_spans[:, None]     # [B, S]
-    span_of_chunk = jnp.sum(
-        ((ci[None, :, None] >= span_cb[:, None, :]) & span_alloc[:, None, :])
-        .astype(jnp.int32), axis=2) - 1                        # [B, C]
-
-    next_lo = jnp.concatenate([lo_off[:, 1:], jnp.full((B, 1), 1 << 30)],
-                              axis=1)
-    next_span = jnp.concatenate([span_of_chunk[:, 1:],
-                                 jnp.full((B, 1), -2)], axis=1)
-    next_real = jnp.concatenate([real[:, 1:], jnp.zeros((B, 1), bool)],
-                                axis=1)
-    hi_off = jnp.where(next_real & (next_span == span_of_chunk), next_lo,
-                       chunk_span_end)
-    cbytes = jnp.maximum(hi_off - lo_off, 0)
-
-    # top-2 by (score, lowest key wins ties): two masked argmaxes
-    sortkey = jnp.where(slot_in_use,
-                        scores * 256 + (255 - iota256), -1)
-    k1 = jnp.argmax(sortkey, axis=-1)
-    top1 = jnp.take_along_axis(sortkey, k1[..., None], axis=-1)[..., 0]
-    sortkey2 = jnp.where(iota256 == k1[..., None], -1, sortkey)
-    k2 = jnp.argmax(sortkey2, axis=-1)
-    top2 = jnp.take_along_axis(sortkey2, k2[..., None], axis=-1)[..., 0]
-    s1 = jnp.where(top1 >= 0, top1 >> 8, 0)
-    s2 = jnp.where(top2 >= 0, top2 >> 8, 0)
-    k1 = jnp.where(top1 >= 0, k1, 0)
-    k2 = jnp.where(top2 >= 0, k2, 0)
-
-    script = chunk_script
-    rtype = dt.lang_rtype_default[script, 0]
-    deflang = dt.lang_rtype_default[script, 1]
-    side_idx = jnp.where(script == 1, 0, 1)
-
-    def to_lang(ps):
-        mapped = dt.plang_to_lang[side_idx, ps]
-        return jnp.where(rtype <= 1, deflang, mapped)
-
-    lang1 = to_lang(k1)
-    lang2 = to_lang(k2)
-
-    actual_kb = jnp.where(cbytes > 0, (s1 << 10) // jnp.maximum(cbytes, 1), 0)
-    expected_kb = dt.expected_score[lang1, _lscript4(script)]
-    rd = _reliability_delta(s1, s2, grams)
-    same_set = (dt.close_set[lang1] != 0) & \
-        (dt.close_set[lang1] == dt.close_set[lang2])
-    rd = jnp.where(same_set, 100, rd)
-    rs = _reliability_expected(actual_kb, expected_kb)
-    crel = jnp.minimum(rd, rs)
-
-    # ---- 9. chunk summary outputs ----------------------------------------
-    # One stacked [B, C, 5] array (a single device->host transfer). The
-    # document epilogue (DocTote replay, close pairs, unreliable-language
-    # removal, summary language) runs on the host over it, reusing the
-    # oracle-validated scalar code (models/ngram.py). Chunk ids are
-    # allocated in span order by the packer, so replaying chunks by id
-    # reproduces the scalar engine's DocTote insertion order exactly.
-    return jnp.stack(
-        [lang1, cbytes, s1, crel, real.astype(jnp.int32)], axis=-1)
-
-
-# Lane order of the stacked score_batch output
-OUT_LANG1, OUT_BYTES, OUT_SCORE1, OUT_REL, OUT_REAL = range(5)
-
-
-score_batch = jax.jit(score_batch_impl)
-
-# Profiling variant: `stage` is static, so each stage compiles a pruned
-# program (everything after the requested stage is dead-code-eliminated) —
-# tools/profile_score.py times these to attribute device cost per stage.
-score_batch_staged = jax.jit(score_batch_impl, static_argnames=("stage",))
-
 
 # ---------------------------------------------------------------------------
 # Resolved-wire scorer: the production path.
@@ -504,7 +92,7 @@ OUTW_REAL_SHIFT = 31
 
 
 def score_resolved_impl(dt: DeviceTables, p: dict):
-    """Score one resolved wire into packed chunk outputs [B, C, 2] u32.
+    """Score one resolved wire into packed chunk outputs [B, C] u32.
 
     p (built by models/ngram.py from ldt_pack_resolve):
       idx       [S, N]  u16  cat_ind2 index per resolved hit
