@@ -113,9 +113,11 @@ class NgramBatchEngine:
         self.dt = DeviceTables.from_host(self.tables, self.reg)
         self.mesh = mesh
         if mesh is not None:
-            from ..parallel.mesh import sharded_score_fn
+            from ..parallel.mesh import BATCH_AXIS, sharded_score_fn
             self._score_fn = sharded_score_fn(mesh)
-            self._mesh_size = mesh.devices.size
+            # wire shards over the batch axis only; any extra mesh axes
+            # (e.g. a vestigial "model" axis) replicate
+            self._mesh_size = mesh.shape[BATCH_AXIS]
         else:
             self._score_fn = score_resolved
             self._mesh_size = 1

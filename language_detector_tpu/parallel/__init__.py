@@ -1,3 +1,4 @@
+from . import distributed
 from .mesh import batch_mesh, sharded_score_fn
 
-__all__ = ["batch_mesh", "sharded_score_fn"]
+__all__ = ["batch_mesh", "sharded_score_fn", "distributed"]

@@ -1,0 +1,79 @@
+"""Multi-host initialization for the data-parallel detector.
+
+The reference scales horizontally with independent processes behind a load
+balancer (SURVEY.md §2.7); the TPU-native equivalent keeps one logical
+program and extends the same 1-D "batch" mesh axis across hosts:
+
+  - within a host, the axis spans chips over ICI;
+  - across hosts, the same axis spans processes over DCN.
+
+Because documents are independent and the scoring program is
+communication-free (parallel/mesh.py), the only cross-host traffic is
+jax.distributed control-plane setup — no collectives ride DCN in steady
+state. Each host packs and feeds its own batch slice (the service layer
+runs per-host, like the reference's per-container servers); eval-harness
+accuracy reductions are the one place XLA inserts psums, and those ride
+ICI first by construction of the mesh axis order.
+
+Typical multi-host launch (one process per host; TPU pod slices discover
+topology from the runtime):
+
+    from language_detector_tpu.parallel import distributed, mesh
+    distributed.initialize()               # no-op on single process
+    m = mesh.batch_mesh()                  # all global devices
+    eng = NgramBatchEngine(mesh=m)
+"""
+from __future__ import annotations
+
+import os
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Initialize jax.distributed for multi-host execution.
+
+    Arguments default from the standard environment variables
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, as
+    set by TPU pod launchers) and fall back to jax's own TPU-metadata
+    autodetection when none are present. Returns True when distributed
+    mode was initialized, False for the single-process case (nothing to
+    do). Safe to call twice (second call is a no-op)."""
+    import jax
+
+    coordinator_address = coordinator_address or \
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if jax.distributed.is_initialized():
+        return True
+    # Multi-host iff explicitly configured, or the TPU runtime lists more
+    # than one worker. (Decided from env vars only — probing
+    # jax.process_count() would initialize the XLA backend and break a
+    # later initialize(); single-worker setups may still export
+    # TPU_WORKER_HOSTNAMES=localhost.)
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if coordinator_address is None and num_processes is None and \
+            "," not in workers:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def local_batch_slice(global_batch: int) -> tuple[int, int]:
+    """(start, size) of this process's document slice of a global batch:
+    contiguous shares in process order, matching the contiguous shard
+    layout to_wire builds (models/ngram.py). The last process takes the
+    remainder when the batch does not divide evenly."""
+    import jax
+    n = jax.process_count()
+    i = jax.process_index()
+    per = global_batch // n
+    start = i * per
+    size = global_batch - start if i == n - 1 else per
+    return start, size

@@ -61,3 +61,20 @@ print("sharded==unsharded ok")
                        timeout=900)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "sharded==unsharded ok" in r.stdout
+
+
+def test_distributed_initialize_single_process():
+    """initialize() is a safe no-op in single-process mode and the local
+    slice helper covers the whole batch."""
+    code = """
+from language_detector_tpu.parallel import distributed
+assert distributed.initialize() is False   # nothing to set up
+start, size = distributed.local_batch_slice(64)
+assert (start, size) == (0, 64)
+print("distributed single-process ok")
+"""
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       env=_cpu_mesh_env(1), capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "distributed single-process ok" in r.stdout
