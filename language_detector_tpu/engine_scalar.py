@@ -416,6 +416,7 @@ def _score_round(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
 
     tote = Tote()
     lg = t.lg_prob
+    summaries: list[ChunkSummary] = []
     for ci in range(len(takes)):
         lo_i, hi_i = chunk_starts[ci], chunk_starts[ci + 1]
         tote.reinit()
@@ -444,12 +445,107 @@ def _score_round(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
         lo_off = int(offs[lo_i])
         hi_off = int(offs[hi_i]) if hi_i < nlin else end_off
         cs = _make_chunk_summary(ctx, tote, lo_off, hi_off - lo_off)
+        summaries.append(cs)
+
+    if ctx.chunk_records is not None:
+        # vector path only, exactly like the reference (sharpening runs
+        # before the DocTote adds, so chunk byte counts shift too;
+        # scoreonescriptspan.cc:1099-1111)
+        _sharpen_boundaries(ctx, offs, lps, chunk_starts, summaries)
+    for cs in summaries:
         doc_tote.add(cs.lang1, cs.bytes, cs.score1,
                      min(cs.reliability_delta, cs.reliability_score))
         if ctx.chunk_records is not None:
             ctx.chunk_records.append(
-                (span, ctx.round_id, lo_off, cs.bytes, cs.lang1, cs.lang2,
-                 cs.reliability_delta, cs.reliability_score, False))
+                (span, ctx.round_id, cs.offset, cs.bytes, cs.lang1,
+                 cs.lang2, cs.reliability_delta, cs.reliability_score,
+                 False))
+
+
+def get_lang_score(lp: int, pslang: int, lg_prob: np.ndarray) -> int:
+    """qprob of one pslang within a packed langprob (GetLangScore,
+    cldutil.cc:141-152)."""
+    entry = lg_prob[lp & 0xFF]
+    for j, shift in enumerate((8, 16, 24)):
+        if (lp >> shift) & 0xFF == pslang:
+            return int(entry[5 + j])
+    return 0
+
+
+def _better_boundary(lps, lg, pslang0: int, pslang1: int,
+                     linear0: int, linear1: int, linear2: int) -> int:
+    """Sharpest lang0/lang1 split within [linear0, linear2): max of the
+    8-wide (+ + + + - - - -) running difference of per-hit score deltas
+    (BetterBoundary, scoreonescriptspan.cc:671-734)."""
+    if linear2 - linear0 <= 8:
+        return linear1
+    running = 0
+    diff = [0] * 8
+    for i in range(linear0, linear0 + 8):
+        j = i & 7
+        lp = int(lps[i])
+        diff[j] = get_lang_score(lp, pslang0, lg) - \
+            get_lang_score(lp, pslang1, lg)
+        if i < linear0 + 4:
+            running += diff[j]
+        else:
+            running -= diff[j]
+    best_value = 0
+    best = linear1
+    for i in range(linear0, linear2 - 8):
+        j = i & 7
+        if best_value < running:
+            has_plus = any(d > 0 for d in diff)
+            has_minus = any(d < 0 for d in diff)
+            if has_plus and has_minus:
+                best_value = running
+                best = i + 4
+        lp = int(lps[i + 8])
+        newdiff = get_lang_score(lp, pslang0, lg) - \
+            get_lang_score(lp, pslang1, lg)
+        middiff = diff[(i + 4) & 7]
+        olddiff = diff[j]
+        diff[j] = newdiff
+        running += -olddiff + 2 * middiff - newdiff
+    return best
+
+
+def _sharpen_boundaries(ctx: ScoringContext, offs, lps,
+                        chunk_starts: list, summaries: list) -> None:
+    """Move chunk boundaries between different-language neighbors to the
+    sharpest per-hit score split, shifting the byte counts accordingly
+    (SharpenBoundaries, scoreonescriptspan.cc:780-845). Runs only on the
+    result-vector path, exactly like the reference."""
+    if len(summaries) < 2:
+        return
+    reg = ctx.registry
+    lg = ctx.tables.lg_prob
+    prior_linear = chunk_starts[0]
+    prior_lang = summaries[0].lang1
+    for i in range(1, len(summaries)):
+        cs = summaries[i]
+        this_lang = cs.lang1
+        if this_lang == prior_lang:
+            prior_linear = chunk_starts[i]
+            continue
+        this_linear = chunk_starts[i]
+        next_linear = chunk_starts[i + 1]
+        if _same_close_set(reg, prior_lang, this_lang):
+            prior_linear = this_linear
+            prior_lang = this_lang
+            continue
+        pslang0 = reg.per_script_number(ctx.ulscript, prior_lang)
+        pslang1 = reg.per_script_number(ctx.ulscript, this_lang)
+        better = _better_boundary(lps, lg, pslang0, pslang1,
+                                  prior_linear, this_linear, next_linear)
+        old_offset = int(offs[this_linear])
+        new_offset = int(offs[better])
+        chunk_starts[i] = better
+        cs.offset = new_offset
+        cs.bytes -= new_offset - old_offset
+        summaries[i - 1].bytes += new_offset - old_offset
+        prior_linear = better
+        prior_lang = this_lang
 
 
 def _make_chunk_summary(ctx: ScoringContext, tote: Tote, offset: int,

@@ -39,22 +39,34 @@ def test_golden_accuracy_floor():
     Context: the reference snapshot is missing its quadgram data files, so
     the compiled reference itself scores only 56/402 here; the trained
     tables (tools/train_quad_tables.py: octa-word + CLDR vocabulary,
-    sweep-selected hyperparameters) recover detection to ~75.6%
+    sweep-selected hyperparameters) recover detection to ~76.1%
     (docs/eval_goldens_r03.txt). The gate sits just under that. About 5%
     of the suite is unreachable from clean vocabulary (Zawgyi-encoded
     Burmese, the X_BORK_BORK_BORK joke languages, Arabic-script Tajik,
     languages with no vocabulary source); the rest of the gap to the
     >=99% north star needs running-text n-gram statistics that no corpus
-    in this environment provides."""
+    in this environment provides. Round-3 exploration (all flat or
+    negative on this suite): quantizer base/slope/alpha/hi_cap sweeps,
+    close-set quadgram pooling, training-mass priors, English stop-word
+    and gettext-catalog sources, win-rate bias calibration, and
+    expected-score regeneration from synthetic dev docs (-42%: synthetic
+    scores mis-scale vs real text). Root cause of the residual errors:
+    the delta-octa word source systematically lacks the base language's
+    function/content words (e.g. the quad '_the' carries no English mass
+    at all), which no reweighting can recover."""
+    from language_detector_tpu.detector import LanguageDetector
     from language_detector_tpu.tables import ScoringTables
-    prod = ScoringTables.load()
+    det = LanguageDetector(tables=ScoringTables.load())
     hits = 0
     total = 0
     for name, lang, raw in PAIRS:
-        r = detect_scalar(raw.decode("utf-8", errors="replace"), prod)
+        # detect_bytes applies the interchange-validity gate, like the
+        # reference harness (ExtDetectLanguageSummaryCheckUTF8,
+        # cld2_unittest.cc:194)
+        r = det.detect_bytes(raw)
         total += 1
-        got = registry.code(r.summary_lang)
+        got = r.language
         if got == lang or (got, lang) == ("hmn", "blu"):  # same language
             hits += 1
     assert total > 100
-    assert hits / total > 0.72, f"accuracy {hits}/{total}"
+    assert hits / total > 0.74, f"accuracy {hits}/{total}"

@@ -58,6 +58,16 @@ TEXTS = [
     (True, "ελληνικά γλώσσα αβγქართული ენა ძალიან ლამაზია და საინტერესო"),
     (True, "ελληνικά γλώσσα @ქართული ენა ძალიან ლამაზია და საინტერესო"),
     (True, "abcქართული ენა ძალიან ლამაზია და საინტერესო ისტორია აქვს"),
+    # same-script language switches mid-chunk: SharpenBoundaries must move
+    # the chunk boundary to the sharpest per-hit split
+    # (scoreonescriptspan.cc:780-845)
+    (True, "中华人民共和国是世界上人口最多的国家拥有悠久历史和丰富文化传统经济发展迅速科学技术不断进步"[:37]
+           + "ひらがなのぶんしょうですきょうはとてもいいてんきですねさんぽにいきましょうたのしいです"),
+    (True, "中华人民共和国是世界上人口最多的国家拥有悠久历史和丰富文化传统经济发展迅速科学技术不断进步"
+           + "ひらがなのぶんしょうですきょうはとてもいいてんきですね"
+           + "中华人民共和国是世界上人口最多的国家拥有悠久历史和丰富文化传统经济发展迅速科学技术不断进步"),
+    (True, ("中华人民共和国是世界上人口最多的国家拥有悠久历史和丰富文化传统经济发展迅速科学技术不断进步"
+            + "ひらがなのぶんしょうですきょうはとてもいいてんきですねさんぽにいきましょうたのしいです") * 2),
     # squeeze-trigger texts -> Overwrite variants must keep offsets exact
     (True, "国民の大多数が内閣を支持し、集団的自衛権の行使を認める判断を歓迎した。" * 20),
     (True, "ελληνικά γλώσσα είναι " * 50 + " ภาษาไทยเป็นภาษาที่สวยงาม " * 30),

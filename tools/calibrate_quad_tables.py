@@ -94,14 +94,15 @@ def detect_all(prod, texts):
 
 def golden_accuracy(prod) -> tuple:
     from golden_data import golden_pairs
-    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.detector import LanguageDetector
     pairs = golden_pairs()
     if not pairs:
         return 0, 0
+    det = LanguageDetector(tables=prod)
     hits = 0
     for name, lang, raw in pairs:
-        r = detect_scalar(raw.decode("utf-8", errors="replace"), prod)
-        got = registry.code(r.summary_lang)
+        # UTF-8 validity gate, like the reference harness (CheckUTF8)
+        got = det.detect_bytes(raw).language
         if got == lang or (got, lang) == ("hmn", "blu"):
             hits += 1
     return hits, len(pairs)

@@ -40,17 +40,18 @@ def _init():
 
 def evaluate(cfg: dict) -> tuple:
     from train_quad_tables import train
-    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.detector import LanguageDetector
     tables = load_tables()
     out = train(tables, registry, _corpus, verbose=False, **cfg)
     quad = NgramTable.from_npz(out, "quadgram")
     prod = dataclasses.replace(
         tables, quadgram=quad,
         avg_delta_octa_score=out["expected_score_override"])
+    det = LanguageDetector(tables=prod)
     hits = 0
     for name, lang, raw in _pairs:
-        r = detect_scalar(raw.decode("utf-8", errors="replace"), prod)
-        got = registry.code(r.summary_lang)
+        # UTF-8 validity gate, like the reference harness (CheckUTF8)
+        got = det.detect_bytes(raw).language
         if got == lang or (got, lang) == ("hmn", "blu"):
             hits += 1
     return cfg, hits, len(_pairs)
