@@ -22,7 +22,8 @@ from .registry import (  # noqa: F401
     ENGLISH,
 )
 from .tables import ScoringTables, load_tables  # noqa: F401
-from .detector import LanguageDetector, DetectionResult, detect, detect_batch  # noqa: F401
+from .detector import (LanguageDetector, DetectionResult, detect,  # noqa: F401
+                       detect_batch, detect_language_version)
 from .hints import CLDHints  # noqa: F401
 
 __version__ = "0.3.0"

@@ -129,6 +129,18 @@ class LanguageDetector:
         return self._batch_engine or None
 
 
+def detect_language_version(tables: ScoringTables | None = None) -> str:
+    """Version string "code_version - data_build_date"
+    (DetectLanguageVersion, compact_lang_det_impl.cc:2112-2119). Empty
+    when no quadgram tables are loaded, like the reference's dynamic mode
+    before data load."""
+    t = tables or load_tables()
+    if t.quadgram.empty:
+        return ""
+    from . import __version__
+    return f"V{__version__} - {t.quadgram.build_date}"
+
+
 _default_detector: LanguageDetector | None = None
 
 

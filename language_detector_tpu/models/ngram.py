@@ -157,15 +157,50 @@ class NgramBatchEngine:
         packed, fut = self._dispatch(texts)
         return self._finish(texts, packed, fut)
 
+    # documents longer than this route to a wide-slot engine (few, large
+    # batches) so they stay on the device instead of overflowing the
+    # standard slot budget into the scalar fallback
+    LONG_DOC_BYTES = 1536
+    _LONG_SLOTS = 16384
+    _LONG_CHUNKS = 256
+    _LONG_BATCH = 64
+
     def detect_many(self, texts: list[str],
                     batch_size: int = 8192) -> list[ScalarResult]:
         """Multi-batch detection with host/device pipelining: the main
         thread packs + dispatches batch N+1 while pool workers force
         batch N's device execution and run its epilogue (both the C++
-        pack and epilogue release the GIL). Sustained-throughput entry
-        point for the service layer and bench."""
+        pack and epilogue release the GIL). Long documents split off to
+        a wide-slot sibling engine in small batches. Sustained-throughput
+        entry point for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
+        long_idx = [i for i, t in enumerate(texts)
+                    if len(t) > self.LONG_DOC_BYTES // 4 and
+                    len(t.encode("utf-8", "surrogatepass")) >
+                    self.LONG_DOC_BYTES]
+        if not long_idx:
+            return self._detect_many_uniform(texts, batch_size)
+        long_set = set(long_idx)
+        short = [t for i, t in enumerate(texts) if i not in long_set]
+        results: list = [None] * len(texts)
+        short_res = self._detect_many_uniform(short, batch_size) if short \
+            else []
+        long_res = self._long_engine().detect_batch_chunked(
+            [texts[i] for i in long_idx], self._LONG_BATCH)
+        for j, i in enumerate(long_idx):
+            results[i] = long_res[j]
+        si = 0
+        for i in range(len(texts)):
+            if i not in long_set:
+                results[i] = short_res[si]
+                si += 1
+        return results
+
+    def _detect_many_uniform(self, texts: list[str],
+                             batch_size: int) -> list[ScalarResult]:
+        if not texts:
+            return []
         from concurrent.futures import ThreadPoolExecutor
         results: list[ScalarResult] = []
         pending: list = []
@@ -182,6 +217,24 @@ class NgramBatchEngine:
             for f in pending:
                 results.extend(f.result())
         return results
+
+    def detect_batch_chunked(self, texts: list[str],
+                             batch_size: int) -> list[ScalarResult]:
+        out: list[ScalarResult] = []
+        for i in range(0, len(texts), batch_size):
+            out.extend(self.detect_batch(texts[i:i + batch_size]))
+        return out
+
+    def _long_engine(self) -> "NgramBatchEngine":
+        if getattr(self, "_long_eng", None) is None:
+            self._long_eng = NgramBatchEngine(
+                self.tables, self.reg, self.flags,
+                max_slots=self._LONG_SLOTS, max_chunks=self._LONG_CHUNKS,
+                mesh=self.mesh)
+            # surface the sibling's counters through this engine's stats
+            self._long_eng.stats = self.stats
+            self._long_eng._stats_lock = self._stats_lock
+        return self._long_eng
 
     def _dispatch(self, texts: list[str]):
         """Pack + launch the device program asynchronously; returns

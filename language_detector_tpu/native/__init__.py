@@ -218,15 +218,21 @@ class ResolvedBatch:
 
 def pack_resolve_native(texts: list[str], tables: ScoringTables,
                         reg: Registry, max_slots: int = 2048,
-                        max_chunks: int = 64, max_direct: int = 4,
+                        max_chunks: int = 64, max_direct: int | None = None,
                         flags: int = 0, n_threads: int = 0) -> ResolvedBatch:
     """texts -> resolved wire inputs (table probes, repeat filter, chunk
-    assignment, and distinct boosts all done in C++; see packer.cc)."""
+    assignment, and distinct boosts all done in C++; see packer.cc).
+
+    max_direct defaults to max_chunks: every RTypeNone/One span consumes
+    one chunk and one direct-add row, so a tighter cap would just send
+    long multi-script documents to the scalar fallback."""
     lib = _load()
     if not lib:
         raise RuntimeError("native packer unavailable")
     _ensure_init(tables, reg)
 
+    if max_direct is None:
+        max_direct = max_chunks
     B, L, C, D = len(texts), max_slots, max_chunks, max_direct
     enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
     bounds = np.zeros(B + 1, np.int64)

@@ -643,6 +643,189 @@ inline Resolved resolve_rec(const Rec& r) {
   return {rt.cat_ind[ia] != 0, b, ia};
 }
 
+// ---- per-round scanners (hitbuffer fills of <= 1000 base hits,
+// scoreonescriptspan.cc:1163-1277; Python spec preprocess/grams.py) ------
+
+// One quadgram round from `start`: pushes RESOLVED quad hits (Rec.pad_=1,
+// fp=indirect address, fp_hi=word-B flag) and returns next_offset (the
+// next candidate position when the fill hits kMaxScoringHits, else the
+// scan end). Repeat cache is round-local (GetQuadHits, cldutil.cc:334).
+int64_t scan_quad_round(const Span& sp, int64_t start,
+                        std::vector<Rec>* recs) {
+  const uint8_t* b = sp.buf.data();
+  const int limit = sp.text_bytes;
+  int64_t src = start;
+  if (b[src] == 0x20) src++;
+  uint32_t cache[2] = {0, 0};
+  int nxt = 0, hits = 0;
+  while (src < limit) {
+    int64_t e = src;
+    e += adv.but_space[b[e]];
+    e += adv.but_space[b[e]];
+    int64_t mid = e;
+    e += adv.but_space[b[e]];
+    e += adv.but_space[b[e]];
+    uint32_t fp = quad_hash(b, src, e - src);
+    int64_t rec_pos = src;
+    src = b[e] == 0x20 ? e : mid;
+    if (src < limit) src += adv.space_vowel[b[src]];
+    else src = limit;
+    if (fp != cache[0] && fp != cache[1]) {
+      Rec raw{(int32_t)rec_pos, QUAD, 0, 0, 0, fp};
+      Resolved rs = resolve_rec(raw);
+      if (rs.a) {
+        cache[nxt] = fp;
+        nxt = 1 - nxt;
+        recs->push_back({(int32_t)rec_pos, QUAD, 0,
+                         (uint8_t)(rs.b ? 1 : 0), 1, (uint32_t)rs.ia});
+        if (++hits >= kMaxScoringHits) return src;
+      }
+    }
+  }
+  return src;
+}
+
+// Word (octa) hits over [start, end): RESOLVED delta + distinct + pair
+// records (Rec.pad_=1), caches and HIT caps round-local (GetOctaHits,
+// cldutil.cc:416-533; Python spec grams.py get_octa_hits).
+void scan_word_range(const Span& sp, int64_t start, int64_t end,
+                     std::vector<Rec>* recs) {
+  const uint8_t* b = sp.buf.data();
+  const int64_t buflen = (int64_t)sp.buf.size();
+  int64_t src = start;
+  if (b[src] == 0x20) src++;
+  uint64_t cache[2] = {0, 0};
+  int nxt = 0;
+  int n_delta = 0, n_distinct = 0;
+  int64_t srclimit = end + 1;  // include trailing space off the end
+  int charcount = 0;
+  int64_t prior_word_start = src, word_start = src, word_end = word_start;
+  while (src < srclimit) {
+    if (b[src] == 0x20) {
+      if (word_end > word_start) {
+        uint64_t fpw = octa_hash40(b, word_start, word_end - word_start,
+                                   buflen);
+        if (fpw != cache[0] && fpw != cache[1]) {
+          cache[nxt] = fpw;
+          nxt = 1 - nxt;
+          uint64_t prior = cache[nxt];
+          if (prior != 0 && prior != fpw) {
+            uint64_t pfp = pair_hash(prior, fpw);
+            Rec raw{(int32_t)prior_word_start, DISTINCT_OCTA, 0,
+                    (uint8_t)(pfp >> 32), 0, (uint32_t)pfp};
+            Resolved rs = resolve_rec(raw);
+            if (rs.a) {
+              recs->push_back({(int32_t)prior_word_start, DISTINCT_OCTA, 0,
+                               0, 1, (uint32_t)rs.ia});
+              n_distinct++;
+            }
+          }
+          Rec rawx{(int32_t)word_start, DISTINCT_OCTA, 0,
+                   (uint8_t)(fpw >> 32), 0, (uint32_t)fpw};
+          Resolved rx = resolve_rec(rawx);
+          if (rx.a) {
+            recs->push_back({(int32_t)word_start, DISTINCT_OCTA, 0, 0, 1,
+                             (uint32_t)rx.ia});
+            n_distinct++;
+          }
+          Rec rawd{(int32_t)word_start, DELTA_OCTA, 0,
+                   (uint8_t)(fpw >> 32), 0, (uint32_t)fpw};
+          Resolved rd = resolve_rec(rawd);
+          if (rd.a) {
+            recs->push_back({(int32_t)word_start, DELTA_OCTA, 0, 0, 1,
+                             (uint32_t)rd.ia});
+            n_delta++;
+          }
+          if (n_delta >= kMaxScoringHits ||
+              n_distinct >= kMaxScoringHits - 1)
+            break;
+        }
+      }
+      charcount = 0;
+      prior_word_start = word_start;
+      word_start = src + 1;
+      word_end = word_start;
+    } else {
+      charcount++;
+    }
+    src += adv.one[b[src]];
+    if (charcount <= 8) word_end = src;
+  }
+}
+
+// Per-span CJK codepoint geometry, computed once and reused across
+// rounds (with a resume index so multi-round spans stay O(n) total).
+struct CjkGeom {
+  std::vector<int64_t> starts, ends;
+  int resume = 0;  // first codepoint index not yet consumed by a round
+
+  void init(const Span& sp) {
+    const int n = (int)sp.cps.size();
+    starts.resize(n);
+    ends.resize(n);
+    int64_t acc = 0;
+    for (int i = 0; i < n; i++) {
+      starts[i] = acc;
+      acc += u8len_of(sp.cps[i]);
+      ends[i] = acc;
+    }
+    resume = 0;
+  }
+};
+
+// One CJK round from `start`: unigram candidates (cap 1000 ->
+// next_offset just past the capping char, cldutil.cc:233) + bigram
+// candidates over the round range.
+int64_t scan_cjk_round(const Span& sp, int64_t start, CjkGeom* gm,
+                       std::vector<Rec>* recs) {
+  const int n = (int)sp.cps.size();
+  const std::vector<int64_t>& starts = gm->starts;
+  const std::vector<int64_t>& ends = gm->ends;
+  int64_t next_offset = sp.text_bytes;
+  int hits = 0;
+  int round_first = gm->resume;
+  for (int i = round_first; i < n; i++) {
+    uint32_t cp = sp.cps[i] > 0x10FFFF ? 0x10FFFF : sp.cps[i];
+    uint8_t prop = g.cjk_prop[cp];
+    if (prop > 0 && starts[i] >= start && starts[i] < sp.text_bytes) {
+      recs->push_back({(int32_t)ends[i], UNI, 0, 0, 0, prop});
+      if (++hits >= kMaxScoringHits) {
+        next_offset = ends[i];
+        gm->resume = i + 1;
+        break;
+      }
+    }
+  }
+  if (hits < kMaxScoringHits) gm->resume = n;
+  int nd = 0, nx = 0;
+  for (int i = round_first; i + 1 < n; i++) {
+    int64_t len2 = ends[i + 1] - starts[i];
+    if (starts[i] >= next_offset) break;
+    if (len2 >= 6 && starts[i] >= start) {
+      uint32_t fp = bi_hash(sp.buf.data(), starts[i], len2);
+      if (nd < kMaxScoringHits) {
+        Resolved rs = resolve_rec(
+            {(int32_t)starts[i], BI_DELTA, 0, 0, 0, fp});
+        if (rs.a) {
+          recs->push_back({(int32_t)starts[i], BI_DELTA, 0, 0, 1,
+                           (uint32_t)rs.ia});
+          nd++;
+        }
+      }
+      if (!g.distinctbi_empty && nx < kMaxScoringHits - 1) {
+        Resolved rs = resolve_rec(
+            {(int32_t)starts[i], BI_DISTINCT, 0, 0, 0, fp});
+        if (rs.a) {
+          recs->push_back({(int32_t)starts[i], BI_DISTINCT, 0, 0, 1,
+                           (uint32_t)rs.ia});
+          nx++;
+        }
+      }
+    }
+  }
+  return next_offset;
+}
+
 // Closed-form ChunkAll boundary rule (ops/score.py _chunk_of_rank;
 // scoreonescriptspan.cc:994-1003)
 inline int chunk_of_rank(int r, int n_quota, int c) {
@@ -696,7 +879,9 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   int32_t boosts[2][4] = {{0, 0, 0, 0}, {0, 0, 0, 0}};
   int bptr[2] = {0, 0};
 
-  int slot = 0, chunk_base = 0, n_direct = 0, span_no = 0;
+  // round_no uniquely ids each (span, hitbuffer-round): chunk byte
+  // ranges chain only within one round (scalar _score_round's end_off)
+  int slot = 0, chunk_base = 0, n_direct = 0, round_no = 0;
   int64_t total = 0;
   bool ok = true;
   std::vector<Rec> recs;
@@ -734,130 +919,131 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
     }
     if (sp.text_bytes <= 1) continue;
     const bool cjk = rtv == 3;
-    recs.clear();
-    bool fits = cjk ? pack_cjk_span(sp, &recs) : pack_quad_span(sp, &recs);
-    if (!fits) { ok = false; break; }
-    recs.push_back({1, SEED, 0, 0, 0,
-                    sp.ulscript < g.n_scripts ? g.seed_lp[sp.ulscript] : 0});
-    for (size_t i = 0; i < recs.size(); i++)
-      recs[i].prio = prio_of(recs[i].kind);
-    std::stable_sort(recs.begin(), recs.end(),
-                     [](const Rec& a, const Rec& c) {
-                       if (a.offset != c.offset) return a.offset < c.offset;
-                       return a.prio < c.prio;
-                     });
+    const int chunksize = cjk ? 50 : 20;
+    const int side = sp.ulscript == kUlScriptLatin ? 0 : 1;
+    const uint32_t seed_lp =
+        sp.ulscript < g.n_scripts ? g.seed_lp[sp.ulscript] : 0;
 
-    // ---- pass 1: resolve + quad repeat filter; count quota/entries ----
-    // (device semantics, ops/score.py stages 2-4: cache tracks HIT quads
-    // with nonzero word A; quota counts kept quads + word-A-valid unis;
-    // entry ranks accumulate every valid base-kind langprob word)
-    struct RRec { int32_t offset; int32_t ia; int8_t a, b, kind, rec; };
-    static thread_local std::vector<RRec> rres;
-    rres.clear();
-    uint32_t qcache[2] = {0, 0};
-    int qnext = 0;
-    int quota = 0;
-    for (const Rec& r : recs) {
-      RRec rr{r.offset, 0, 0, 0, r.kind, 0};
-      if (r.kind == SEED) {
-        if (r.fp) {
-          rr.ia = rt.seed_ind_base + sp.ulscript;
+    // hitbuffer rounds of <= 1000 base hits, each with its own seed,
+    // repeat caches, and chunk grid (score_span_hits / the reference's
+    // fill loops, scoreonescriptspan.cc:1163-1277)
+    static thread_local CjkGeom geom;
+    if (cjk) geom.init(sp);
+    int64_t lo_pos = 1;
+    while (lo_pos < sp.text_bytes && ok) {
+      recs.clear();
+      int64_t round_end = cjk ? scan_cjk_round(sp, lo_pos, &geom, &recs)
+                              : scan_quad_round(sp, lo_pos, &recs);
+      if (!cjk) scan_word_range(sp, lo_pos, round_end, &recs);
+      recs.push_back({(int32_t)lo_pos, SEED, 0, 0, 0, seed_lp});
+      for (size_t i = 0; i < recs.size(); i++)
+        recs[i].prio = prio_of(recs[i].kind);
+      std::stable_sort(recs.begin(), recs.end(),
+                       [](const Rec& a, const Rec& c) {
+                         if (a.offset != c.offset) return a.offset < c.offset;
+                         return a.prio < c.prio;
+                       });
+
+      // ---- pass 1: finish resolution; count quota/entries ----
+      // (most kinds arrive pre-resolved from the scanners: pad_ == 1,
+      // fp = indirect address, fp_hi = word-B flag for quads)
+      struct RRec { int32_t offset; int32_t ia; int8_t a, b, kind, rec; };
+      static thread_local std::vector<RRec> rres;
+      rres.clear();
+      int quota = 0;
+      for (const Rec& r : recs) {
+        RRec rr{r.offset, 0, 0, 0, r.kind, 0};
+        if (r.pad_) {  // pre-resolved hit
+          rr.ia = (int32_t)r.fp;
           rr.a = 1;
-        }
-      } else if (r.kind == QUAD) {
-        bool repeat = r.fp == qcache[0] || r.fp == qcache[1];
-        if (!repeat) {
-          Resolved rs = resolve_rec(r);
-          if (rs.a) {  // active: word A nonzero (keep_quad)
-            qcache[qnext] = r.fp;
-            qnext = 1 - qnext;
-            rr.ia = rs.ia;
+          rr.b = r.kind == QUAD ? (int8_t)(r.fp_hi & 1) : 0;
+          if (r.kind == QUAD) { rr.rec = 1; quota++; }
+        } else if (r.kind == SEED) {
+          if (r.fp) {
+            rr.ia = rt.seed_ind_base + sp.ulscript;
             rr.a = 1;
-            rr.b = rs.b;
-            rr.rec = 1;
-            quota++;
           }
+        } else if (r.kind == UNI) {
+          Resolved rs = resolve_rec(r);
+          rr.ia = rs.ia;
+          rr.a = rs.a;
+          rr.b = rs.b;
+          if (rs.a) { rr.rec = 1; quota++; }
         }
-      } else {
-        Resolved rs = resolve_rec(r);
-        rr.ia = rs.ia;
-        rr.a = rs.a;
-        rr.b = rs.b && r.kind == UNI;
-        if (r.kind == UNI && rs.a) { rr.rec = 1; quota++; }
-        // non-UNI kinds are inactive without word A
-        if (r.kind != UNI && !rs.a) { rr.a = 0; rr.b = 0; }
+        rres.push_back(rr);
       }
-      rres.push_back(rr);
-    }
 
-    // span chunk count from quota (device: n_span_records -> chunk grid)
-    int chunksize = cjk ? 50 : 20;
-    int span_chunks = quota <= 0 ? 1
-        : chunk_of_rank(quota - 1, quota, chunksize) + 1;
-    int emit = 0;
-    for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
-    if (slot + emit + 4 * span_chunks > L || chunk_base + span_chunks > C ||
-        chunk_base + span_chunks > 256) {
-      ok = false;
-      break;
-    }
+      // round chunk count from quota (chunk_boundaries grid)
+      int round_chunks = quota <= 0 ? 1
+          : chunk_of_rank(quota - 1, quota, chunksize) + 1;
+      int emit = 0;
+      for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
+      if (slot + emit + 4 * round_chunks > L ||
+          chunk_base + round_chunks > C ||
+          chunk_base + round_chunks > 256) {
+        ok = false;
+        break;
+      }
 
-    // ---- pass 2: chunk assignment + emission + boosts ----
-    // Device-exact accounting (ops/score.py stages 4-8): entry RANKS
-    // consume a+b for base kinds regardless of word-A validity; scores,
-    // grams, lo_off, and chunk realness require word A (slot_valid).
-    int side = sp.ulscript == kUlScriptLatin ? 0 : 1;
-    int cum_entries = 0;  // consumed base entries, exclusive
-    for (const RRec& rr : rres) {
-      bool base_kind = rr.kind == SEED || rr.kind == QUAD ||
-                       rr.kind == UNI;
-      int contrib = base_kind ? rr.a + rr.b : 0;
-      if (!rr.a) {
-        cum_entries += contrib;  // UNI word-B rank quirk
-        continue;
-      }
-      int r_excl = cum_entries;
-      int rank = quota > 0 ? std::min(r_excl, quota - 1) : 0;
-      int local = quota > 0 ? chunk_of_rank(rank, quota, chunksize) : 0;
-      int c = chunk_base + local;
-      if (c != open_chunk) {
-        flush_boosts(open_chunk);
-        open_chunk = c;
-      }
-      idx[slot] = (uint16_t)rr.ia;
-      chk[slot] = (uint8_t)c;
-      slot++;
-      if (rr.b) {
-        idx[slot] = (uint16_t)(rr.ia + 1);
+      // ---- pass 2: chunk assignment + emission + boosts ----
+      // Device-exact accounting (ops/score.py stages 4-8): entry RANKS
+      // consume a+b for base kinds regardless of word-A validity; scores,
+      // grams, lo_off, and chunk realness require word A (slot_valid).
+      int cum_entries = 0;  // consumed base entries, exclusive
+      for (const RRec& rr : rres) {
+        bool base_kind = rr.kind == SEED || rr.kind == QUAD ||
+                         rr.kind == UNI;
+        int contrib = base_kind ? rr.a + rr.b : 0;
+        if (!rr.a) {
+          cum_entries += contrib;  // UNI word-B rank quirk
+          continue;
+        }
+        int r_excl = cum_entries;
+        int rank = quota > 0 ? std::min(r_excl, quota - 1) : 0;
+        int local = quota > 0 ? chunk_of_rank(rank, quota, chunksize) : 0;
+        int c = chunk_base + local;
+        if (c != open_chunk) {
+          flush_boosts(open_chunk);
+          open_chunk = c;
+        }
+        idx[slot] = (uint16_t)rr.ia;
         chk[slot] = (uint8_t)c;
         slot++;
-      }
-      cum_entries += contrib;
-      if (base_kind) c_grams[c] += rr.a + rr.b;
-      if (rr.offset < c_lo[c]) c_lo[c] = rr.offset;
-      c_real[c] = 1;
-      c_side[c] = (int8_t)side;
-      c_span[c] = (int16_t)span_no;
-      c_span_end[c] = sp.text_bytes;
-      cscript[c] = (uint8_t)sp.ulscript;
-      // rotating distinct boost (device scan: update AFTER scoring the
-      // slot, state read by the chunk containing the slot)
-      if (rr.kind == DISTINCT_OCTA || rr.kind == BI_DISTINCT) {
-        boosts[side][bptr[side]] = rr.ia;
-        bptr[side] = (bptr[side] + 1) & 3;
-      }
-    }
-    // mark allocated-but-empty chunks of this span (runt grids)
-    for (int c = chunk_base; c < chunk_base + span_chunks; c++) {
-      if (c_span[c] < 0) {
-        c_span[c] = (int16_t)span_no;
-        c_span_end[c] = sp.text_bytes;
+        if (rr.b) {
+          idx[slot] = (uint16_t)(rr.ia + 1);
+          chk[slot] = (uint8_t)c;
+          slot++;
+        }
+        cum_entries += contrib;
+        if (base_kind) c_grams[c] += rr.a + rr.b;
+        if (rr.offset < c_lo[c]) c_lo[c] = rr.offset;
+        c_real[c] = 1;
         c_side[c] = (int8_t)side;
+        c_span[c] = (int16_t)round_no;
+        c_span_end[c] = (int32_t)round_end;
         cscript[c] = (uint8_t)sp.ulscript;
+        // rotating distinct boost (device scan: update AFTER scoring the
+        // slot, state read by the chunk containing the slot)
+        if (rr.kind == DISTINCT_OCTA || rr.kind == BI_DISTINCT) {
+          boosts[side][bptr[side]] = rr.ia;
+          bptr[side] = (bptr[side] + 1) & 3;
+        }
       }
+      // mark allocated-but-empty chunks of this round (runt grids)
+      for (int c = chunk_base; c < chunk_base + round_chunks; c++) {
+        if (c_span[c] < 0) {
+          c_span[c] = (int16_t)round_no;
+          c_span_end[c] = (int32_t)round_end;
+          c_side[c] = (int8_t)side;
+          cscript[c] = (uint8_t)sp.ulscript;
+        }
+      }
+      chunk_base += round_chunks;
+      round_no++;
+      if (round_end <= lo_pos) break;  // no forward progress possible
+      lo_pos = round_end;
     }
-    chunk_base += span_chunks;
-    span_no++;
+    if (!ok) break;  // fallback doc: skip remaining spans
   }
   flush_boosts(open_chunk);
 

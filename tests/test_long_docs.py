@@ -1,0 +1,60 @@
+"""Long documents on the device path: multi-round hitbuffer fills.
+
+Spans with more than 1000 base hits score in rounds (the reference's
+hitbuffer refill loop, scoreonescriptspan.cc:1249-1274); the native packer
+mirrors it (packer.cc scan_quad_round/scan_cjk_round), so long documents
+no longer fall back to the scalar engine. detect_many routes them to a
+wide-slot sibling engine automatically.
+"""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from golden_data import golden_pairs  # noqa: E402
+
+from language_detector_tpu.engine_scalar import detect_scalar  # noqa: E402
+from language_detector_tpu.models.ngram import NgramBatchEngine  # noqa: E402
+
+PAIRS = golden_pairs()
+pytestmark = pytest.mark.skipif(not PAIRS,
+                                reason="reference snapshot unavailable")
+
+
+def _texts():
+    return [raw.decode("utf-8", errors="replace") for _, _, raw in PAIRS]
+
+
+def _long_docs():
+    texts = _texts()
+    # distinct-paragraph concatenations (varied text, so the squeeze
+    # predictor does not trigger), 5-35KB
+    return [" ".join(texts[(k + i * 7) % len(texts)] for i in range(n))
+            for k, n in ((3, 12), (17, 25), (41, 40), (89, 60), (11, 100))]
+
+
+def test_multi_round_spans_stay_on_device():
+    eng = NgramBatchEngine(max_slots=16384, max_chunks=256)
+    docs = _long_docs()
+    rs = eng.detect_batch(docs)
+    assert eng.stats["fallback_docs"] == 0, \
+        "long documents must score on the device path"
+    for d, r in zip(docs, rs):
+        s = detect_scalar(d, eng.tables, eng.reg)
+        assert (r.summary_lang, r.language3, r.percent3) == \
+            (s.summary_lang, s.language3, s.percent3), d[:60]
+
+
+def test_detect_many_routes_long_docs():
+    texts = _texts()
+    docs = [texts[i % len(texts)][:200] for i in range(120)]
+    for pos, d in zip((7, 40, 77), _long_docs()):
+        docs.insert(pos, d)
+    eng = NgramBatchEngine()
+    rs = eng.detect_many(docs, batch_size=64)
+    assert eng.stats["fallback_docs"] == 0
+    for d, r in zip(docs, rs):
+        s = detect_scalar(d, eng.tables, eng.reg)
+        assert (r.summary_lang, r.percent3) == \
+            (s.summary_lang, s.percent3), d[:60]
