@@ -113,4 +113,13 @@ def _native_ok() -> bool:
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench()))
+    # --profile DIR: wrap the run in a jax.profiler trace (open DIR with
+    # tensorboard / xprof to see the device timeline per op)
+    if len(sys.argv) > 1 and sys.argv[1] == "--profile":
+        if len(sys.argv) < 3:
+            sys.exit("usage: bench.py [--profile TRACE_DIR]")
+        import jax
+        with jax.profiler.trace(sys.argv[2]):
+            print(json.dumps(bench()))
+    else:
+        print(json.dumps(bench()))

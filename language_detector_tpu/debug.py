@@ -1,0 +1,154 @@
+"""Score-trace debugging: the TPU framework's equivalent of the
+reference's HTML debug dumps (debug.cc CLD2_Debug chunk rendering +
+DumpHitBuffer/DumpSummaryBuffer, scoreonescriptspan.cc:561-661, flag-gated
+by kCLDFlagHtml/kCLDFlagVerbose, compact_lang_det.h:343-348).
+
+`trace_detect` runs full scalar detection while recording every scoring
+decision — spans, per-chunk summaries, the document tote before and after
+close-pair refinement and unreliable-language removal, recursion events,
+and the final summary-language calculation — and `format_trace` renders it
+as readable text. Unlike the reference's stderr HTML (not thread safe,
+compact_lang_det_impl.cc:478-485), the trace is a plain data object.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
+                            FLAG_SQUEEZE, ScalarResult, detect_scalar)
+from .registry import Registry, registry as default_registry
+from .tables import ScoringTables, load_tables
+
+
+@dataclasses.dataclass
+class DetectionTrace:
+    """Ordered trace events: (kind, payload) tuples.
+
+    Kinds: "pass" (flags for a detection pass; recursion produces
+    several), "span" (script/bytes), "chunk" (per-chunk summary),
+    "doc_tote" (stage name + [(lang, bytes, score, reliability)]),
+    "summary" (final decision)."""
+    events: list = dataclasses.field(default_factory=list)
+    result: ScalarResult | None = None
+
+    def add(self, kind: str, **payload):
+        self.events.append((kind, payload))
+
+    def add_tote(self, stage: str, doc_tote, reg):
+        """Record a doc-tote snapshot (called by the engine so it does not
+        depend on this module's helpers)."""
+        self.add("doc_tote", stage=stage, rows=_tote_rows(doc_tote, reg))
+
+
+def _tote_rows(doc_tote, reg):
+    rows = []
+    for i in range(doc_tote.MAX):
+        if doc_tote.key[i] != doc_tote.UNUSED and doc_tote.value[i] > 0:
+            rows.append((reg.code(int(doc_tote.key[i])),
+                         int(doc_tote.value[i]), int(doc_tote.score[i]),
+                         int(doc_tote.rel[i]) //
+                         max(int(doc_tote.value[i]), 1)))
+    return sorted(rows, key=lambda r: -r[2])
+
+
+def trace_detect(text: str, tables: ScoringTables | None = None,
+                 reg: Registry | None = None, flags: int = 0,
+                 is_plain_text: bool = True, hints=None,
+                 want_chunks: bool = False) -> DetectionTrace:
+    """Full-document detection with a recorded score trace.
+
+    want_chunks traces the result-VECTOR path instead (offset-preserving
+    squeeze rewrites + boundary sharpening) — exactly like the reference,
+    that path can produce different byte totals and therefore different
+    percentages on squeeze/repeat-triggering documents, so it is off by
+    default: a plain trace matches a plain detect_scalar call."""
+    tables = tables or load_tables()
+    reg = reg or default_registry
+    trace = DetectionTrace()
+    trace.result = detect_scalar(text, tables, reg, flags,
+                                 is_plain_text=is_plain_text, hints=hints,
+                                 want_chunks=want_chunks, _trace=trace)
+    return trace
+
+
+def format_trace(trace: DetectionTrace, reg: Registry | None = None) -> str:
+    """Render a DetectionTrace as indented text (the debug.cc HTML dump
+    equivalent)."""
+    reg = reg or default_registry
+    out = []
+    for kind, p in trace.events:
+        if kind == "pass":
+            fl = []
+            if p["flags"] & FLAG_FINISH:
+                fl.append("FINISH")
+            if p["flags"] & FLAG_SQUEEZE:
+                fl.append("SQUEEZE")
+            if p["flags"] & FLAG_REPEATS:
+                fl.append("REPEATS")
+            if p["flags"] & FLAG_BEST_EFFORT:
+                fl.append("BEST_EFFORT")
+            out.append(f"pass flags={p['flags']:#x} "
+                       f"[{' '.join(fl) or 'default'}]")
+        elif kind == "span":
+            out.append(f"  span script={p['script']} "
+                       f"({reg.ulscript_code[p['script']]}) "
+                       f"bytes={p['bytes']} rtype={p['rtype']}")
+        elif kind == "chunk":
+            out.append(
+                f"    chunk @{p['offset']}+{p['bytes']}B "
+                f"{reg.code(p['lang1'])}.{p['score1']} "
+                f"{reg.code(p['lang2'])}.{p['score2']} "
+                f"grams={p['grams']} relD={p['rel_delta']} "
+                f"relS={p['rel_score']}")
+        elif kind == "doc_tote":
+            rows = " ".join(f"{c}:{b}B/{s}/{r}%" for c, b, s, r in p["rows"])
+            out.append(f"  doc_tote[{p['stage']}] {rows or '(empty)'}")
+        elif kind == "summary":
+            out.append(
+                f"summary {reg.code(p['lang'])} reliable={p['reliable']} "
+                f"top3={[(reg.code(l), pc) for l, pc in p['top3']]} "
+                f"bytes={p['text_bytes']}")
+    return "\n".join(out)
+
+
+def _main(argv=None):
+    """CLI harness (the reference's compact_lang_det_test.cc interactive
+    tool): text from args/stdin -> summary + optional score trace and
+    per-range vector.
+
+      python -m language_detector_tpu.debug [--vector] [--plain|--html]
+                                            [text ...]   (stdin if none)
+    """
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(prog="language_detector_tpu.debug")
+    ap.add_argument("text", nargs="*", help="text (stdin when omitted)")
+    ap.add_argument("--html", action="store_true",
+                    help="treat input as HTML (strip tags, expand "
+                         "entities, scan lang= attributes)")
+    ap.add_argument("--vector", action="store_true",
+                    help="also print per-range results")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary line only, no trace")
+    args = ap.parse_args(argv)
+    text = " ".join(args.text) if args.text else sys.stdin.read()
+
+    tr = trace_detect(text, is_plain_text=not args.html,
+                      want_chunks=args.vector)
+    if not args.quiet:
+        print(format_trace(tr))
+    r = tr.result
+    reg = default_registry
+    print(f"=> {reg.code(r.summary_lang)} "
+          f"reliable={r.is_reliable} "
+          f"top3={[(reg.code(l), p) for l, p in zip(r.language3, r.percent3)]}")
+    if args.vector and r.chunks:
+        for c in r.chunks:
+            print(f"   [{c.offset:6d}..{c.offset + c.bytes:6d}) "
+                  f"{reg.code(c.lang1)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -247,6 +247,7 @@ class ScoringContext:
     # (span, round_id, lo_off, nbytes, lang1, lang2, rel_delta, rel_score)
     chunk_records: list | None = None
     round_id: int = 0
+    trace: object = None  # debug.DetectionTrace sink, or None
 
     def distinct_boost(self) -> LangBoosts:
         if self.ulscript == ULSCRIPT_LATIN:
@@ -460,6 +461,12 @@ def _score_round(ctx: ScoringContext, span: ScriptSpan, score_cjk: bool,
                 (span, ctx.round_id, cs.offset, cs.bytes, cs.lang1,
                  cs.lang2, cs.reliability_delta, cs.reliability_score,
                  False))
+        if ctx.trace is not None:
+            ctx.trace.add("chunk", offset=cs.offset, bytes=cs.bytes,
+                          lang1=cs.lang1, score1=cs.score1,
+                          lang2=cs.lang2, score2=cs.score2,
+                          grams=cs.grams, rel_delta=cs.reliability_delta,
+                          rel_score=cs.reliability_score)
 
 
 def get_lang_score(lp: int, pslang: int, lg_prob: np.ndarray) -> int:
@@ -609,6 +616,12 @@ def score_one_span(ctx: ScoringContext, span: ScriptSpan, doc_tote: DocTote):
                 (span, ctx.round_id, 1, span.text_bytes - 1, lang,
                  UNKNOWN_LANGUAGE, 100, 100, True))
             ctx.round_id += 1
+        if ctx.trace is not None:
+            # vector-record view: [1, text_bytes) like JustOneItemToVector
+            ctx.trace.add("chunk", offset=1, bytes=span.text_bytes - 1,
+                          lang1=lang, score1=span.text_bytes,
+                          lang2=UNKNOWN_LANGUAGE, score2=0, grams=0,
+                          rel_delta=100, rel_score=100)
     else:
         score_span_hits(ctx, span, rtype == RTYPE_CJK, doc_tote)
 
@@ -932,7 +945,8 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
                   reg: Registry | None = None,
                   flags: int = 0, is_plain_text: bool = True,
                   hints=None, want_chunks: bool = False,
-                  _hint_boosts=None, _vec_src=None) -> ScalarResult:
+                  _hint_boosts=None, _vec_src=None,
+                  _trace=None) -> ScalarResult:
     """Full-document detection (DetectLanguageSummaryV2,
     compact_lang_det_impl.cc:1707-2106), including the squeeze/repeat
     anti-spam recursion. is_plain_text=False strips HTML tags / expands
@@ -960,9 +974,12 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
     # the original input (impl.cc:1856-1862, :1908-1916) — detection then
     # scores the dotted text, exactly as the reference's vector path does.
     collect = want_chunks
+    if _trace is not None:
+        _trace.add("pass", flags=flags)
     ctx = ScoringContext(tables=tables, registry=reg, flags=flags,
                          hint_boosts=_hint_boosts,
-                         chunk_records=[] if collect else None)
+                         chunk_records=[] if collect else None,
+                         trace=_trace)
     doc_tote = DocTote()
     total_text_bytes = 0
     if flags & FLAG_REPEATS:
@@ -988,7 +1005,7 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
                                      flags | FLAG_SQUEEZE,
                                      want_chunks=want_chunks,
                                      _hint_boosts=_hint_boosts,
-                                     _vec_src=_vec_src)
+                                     _vec_src=_vec_src, _trace=_trace)
         if flags & FLAG_REPEATS:
             # Remove repeated words (impl.cc:1905-1918)
             if collect:
@@ -1001,11 +1018,19 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
                                            span.text_bytes,
                                            rep_hash, predict_tbl)
                 span = _respan(stripped, span.ulscript)
+        if _trace is not None:
+            _trace.add("span", script=span.ulscript,
+                       bytes=span.text_bytes,
+                       rtype=reg.rtype(span.ulscript))
         score_one_span(ctx, span, doc_tote)
         total_text_bytes += span.text_bytes
 
+    if _trace is not None:
+        _trace.add_tote("scored", doc_tote, reg)
     refine_close_pairs(reg, doc_tote)
     doc_tote.sort()
+    if _trace is not None:
+        _trace.add_tote("close_pairs_refined", doc_tote, reg)
     lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
         doc_tote, total_text_bytes)
 
@@ -1022,15 +1047,21 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
             extra |= FLAG_SHORT | FLAG_USE_WORDS
         return detect_scalar(text, tables, reg, flags | extra,
                              want_chunks=want_chunks,
-                             _hint_boosts=_hint_boosts, _vec_src=_vec_src)
+                             _hint_boosts=_hint_boosts, _vec_src=_vec_src,
+                             _trace=_trace)
 
     if not (flags & FLAG_BEST_EFFORT):
         remove_unreliable(reg, doc_tote)
+        if _trace is not None:
+            _trace.add_tote("unreliable_removed", doc_tote, reg)
     doc_tote.sort()
     lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
         doc_tote, total_text_bytes)
     summary, reliable = calc_summary_lang(reg, lang3, percent3, total,
                                           is_reliable, flags)
+    if _trace is not None:
+        _trace.add("summary", lang=summary, reliable=reliable,
+                   top3=list(zip(lang3, percent3)), text_bytes=total)
     chunks = build_result_chunks(orig_text, ctx.chunk_records, reg,
                                  html_offsets) if collect else None
     return ScalarResult(summary_lang=summary, language3=lang3,

@@ -1,0 +1,34 @@
+"""Score-trace debugging (debug.py): the debug.cc dump equivalent."""
+from language_detector_tpu.debug import format_trace, trace_detect
+
+
+def test_trace_records_pipeline(base_tables):
+    tr = trace_detect("This is English mixed with 日本語のテキストです。",
+                      tables=base_tables)
+    kinds = [k for k, _ in tr.events]
+    assert "pass" in kinds and "span" in kinds and "chunk" in kinds
+    assert kinds.count("doc_tote") >= 2  # scored + refined stages
+    assert kinds[-1] == "summary"
+    text = format_trace(tr)
+    assert "span script=" in text and "doc_tote[scored]" in text
+    # tracing must not change the result
+    from language_detector_tpu.engine_scalar import detect_scalar
+    plain = detect_scalar("This is English mixed with 日本語のテキストです。",
+                          base_tables)
+    assert tr.result.summary_lang == plain.summary_lang
+    assert tr.result.percent3 == plain.percent3
+
+
+def test_trace_recursion_passes(base_tables):
+    # squeeze-trigger text: the trace shows both detection passes
+    tr = trace_detect("ελληνικά γλώσσα είναι " * 60, tables=base_tables)
+    passes = [p["flags"] for k, p in tr.events if k == "pass"]
+    assert len(passes) >= 2 and any(f & 2 for f in passes)  # FLAG_SQUEEZE
+
+
+def test_cli_harness(capsys):
+    from language_detector_tpu.debug import _main
+    assert _main(["--quiet", "--vector",
+                  "国民の大多数が内閣を支持し ελληνικά γλώσσα"]) == 0
+    out = capsys.readouterr().out
+    assert "=>" in out and "ja" in out
