@@ -127,7 +127,12 @@ class NgramBatchEngine:
                 "batched engine requires the native packer "
                 "(language_detector_tpu/native/build.sh); "
                 "use detect_scalar without it")
-        self._pack = native.pack_resolve_native
+        # engine-owned buffer pool: rotation is safe because only this
+        # engine's pipeline (<= 4 in-flight batches) uses it
+        self._buf_pool = native.BufferPool()
+        import functools
+        self._pack = functools.partial(native.pack_resolve_native,
+                                       pool=self._buf_pool)
         # Running totals for observability (service /metrics): batches
         # scored, packer-fallback docs, and docs that failed the
         # good-answer gate into the scalar recursion

@@ -216,16 +216,75 @@ class ResolvedBatch:
     n_docs: int = 0
 
 
+class BufferPool:
+    """Rotating output-buffer pool for pack_resolve_native.
+
+    The dense per-doc scratch is tens of MB per batch, and
+    freshly-allocated pages cost ~60ms of first-touch faults during the
+    C++ writes at B=8192; rotating warm buffer sets removes that.
+
+    Safety contract: the packer clears the cmeta/cscript/direct_adds row
+    tails it does not write; idx/chk rows are valid only up to
+    n_slots[b] (the wire flattener and every other consumer respect
+    that bound). A pool must be owned by ONE engine/pipeline: rotation
+    assumes at most RING batches of a shape are alive at once (the
+    detect_many pipeline holds <= 4). Shapes evict LRU beyond MAX_KEYS
+    so variable batch sizes cannot pin unbounded memory."""
+
+    RING = 4
+    MAX_KEYS = 4
+
+    def __init__(self):
+        self._rings: dict = {}
+        self._lock = __import__("threading").Lock()
+
+    def get(self, B: int, L: int, C: int, D: int) -> "ResolvedBatch":
+        key = (B, L, C, D)
+        with self._lock:
+            ring = self._rings.pop(key, None)
+            if ring is None:
+                ring = []
+                if len(self._rings) >= self.MAX_KEYS:
+                    # evict the least-recently-used shape entirely
+                    self._rings.pop(next(iter(self._rings)))
+            self._rings[key] = ring  # re-insert: dict order = LRU order
+            if len(ring) < self.RING:
+                rb = ResolvedBatch(
+                    idx=np.zeros((B, L), np.uint16),
+                    chk=np.zeros((B, L), np.uint8),
+                    cmeta=np.zeros((B, C), np.uint32),
+                    cscript=np.zeros((B, C), np.uint8),
+                    direct_adds=np.full((B, D, 3), -1, np.int32),
+                    text_bytes=np.zeros(B, np.int32),
+                    fallback=np.zeros(B, bool),
+                    n_slots=np.zeros(B, np.int32),
+                    n_chunks=np.zeros(B, np.int32),
+                    n_docs=B,
+                )
+                ring.append(rb)
+                return rb
+            rb = ring.pop(0)
+            ring.append(rb)
+            rb.n_docs = B
+            return rb
+
+
 def pack_resolve_native(texts: list[str], tables: ScoringTables,
                         reg: Registry, max_slots: int = 2048,
                         max_chunks: int = 64, max_direct: int | None = None,
-                        flags: int = 0, n_threads: int = 0) -> ResolvedBatch:
+                        flags: int = 0, n_threads: int = 0,
+                        pool: BufferPool | None = None) -> ResolvedBatch:
     """texts -> resolved wire inputs (table probes, repeat filter, chunk
     assignment, and distinct boosts all done in C++; see packer.cc).
 
     max_direct defaults to max_chunks: every RTypeNone/One span consumes
     one chunk and one direct-add row, so a tighter cap would just send
-    long multi-script documents to the scalar fallback."""
+    long multi-script documents to the scalar fallback.
+
+    pool: optional caller-owned BufferPool reusing warm output buffers
+    (the returned ResolvedBatch is then only valid until the pool cycles
+    back around — see BufferPool's contract). Without a pool, fresh
+    arrays are allocated per call."""
     lib = _load()
     if not lib:
         raise RuntimeError("native packer unavailable")
@@ -241,18 +300,21 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
         else np.zeros(1, np.uint8)
     blob = np.ascontiguousarray(blob)
 
-    out = ResolvedBatch(
-        idx=np.zeros((B, L), np.uint16),
-        chk=np.zeros((B, L), np.uint8),
-        cmeta=np.zeros((B, C), np.uint32),
-        cscript=np.zeros((B, C), np.uint8),
-        direct_adds=np.full((B, D, 3), -1, np.int32),
-        text_bytes=np.zeros(B, np.int32),
-        fallback=np.zeros(B, bool),
-        n_slots=np.zeros(B, np.int32),
-        n_chunks=np.zeros(B, np.int32),
-        n_docs=B,
-    )
+    if pool is not None:
+        out = pool.get(B, L, C, D)
+    else:
+        out = ResolvedBatch(
+            idx=np.zeros((B, L), np.uint16),
+            chk=np.zeros((B, L), np.uint8),
+            cmeta=np.zeros((B, C), np.uint32),
+            cscript=np.zeros((B, C), np.uint8),
+            direct_adds=np.full((B, D, 3), -1, np.int32),
+            text_bytes=np.zeros(B, np.int32),
+            fallback=np.zeros(B, bool),
+            n_slots=np.zeros(B, np.int32),
+            n_chunks=np.zeros(B, np.int32),
+            n_docs=B,
+        )
     if n_threads <= 0:
         import os
         # oversubscribe modestly: the per-doc work mixes pointer-chasing

@@ -1063,6 +1063,16 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
     cmeta[c] = (uint32_t)cbytes | ((uint32_t)grams << 16) |
                ((uint32_t)(c_side[c] & 1) << 28) | (1u << 29);
   }
+  // Clear the cmeta/cscript/direct_adds tails explicitly: the caller may
+  // reuse output buffers across batches (pack_resolve_native's
+  // BufferPool), so stale rows must never read as live chunks / direct
+  // adds. idx/chk rows are NOT cleared — they are valid only up to
+  // n_slots[b], a bound every consumer (the wire flattener) respects.
+  for (int c = chunk_base; c < C; c++) {
+    cmeta[c] = 0;
+    cscript[c] = 0;
+  }
+  for (int d = n_direct; d < o.D; d++) dadds[d * 3 + 0] = -1;
   o.text_bytes[b] = (int32_t)total;
   o.fallback[b] = !ok;
   o.n_slots[b] = slot;
