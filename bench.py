@@ -48,6 +48,22 @@ def make_corpus(n: int) -> list:
     return out
 
 
+def make_mixed_corpus(n: int) -> list:
+    """Realistic traffic mix: service-sized docs plus a spam tail (1%
+    squeeze-trigger documents -> scalar fallback), 2% long documents
+    (3-8KB, routed to the wide-slot engine), and 1% degenerate inputs.
+    Measures what the clean corpus cannot: fallback and long-doc cost."""
+    docs = make_corpus(n)
+    for i in range(0, n, 100):            # 1% spam -> squeeze fallback
+        docs[i] = ("buy cheap now " * 300).strip()
+    for i in range(37, n, 50):            # 2% long docs
+        parts = [docs[(i + j * 13 + 1) % n] for j in range(20 + i % 21)]
+        docs[i] = " ".join(parts)
+    for i in range(73, n, 100):           # 1% degenerate
+        docs[i] = ["", "   ", "123 !!!", "a"][i // 100 % 4]
+    return docs
+
+
 def bench(batch_size: int = 8192, n_batches: int = 8) -> dict:
     from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
 
@@ -85,6 +101,20 @@ def bench(batch_size: int = 8192, n_batches: int = 8) -> dict:
             eng._doc_epilogue(packed, out, b)
     t_epi = time.time() - t0
 
+    # Mixed-traffic run (spam/long/degenerate tail): reported in detail so
+    # the headline stays comparable across rounds while the realistic mix
+    # is measured rather than assumed
+    mixed = make_mixed_corpus(batch_size)
+    eng.detect_many(mixed, batch_size=batch_size)  # warm retry/long shapes
+    eng.stats["fallback_docs"] = 0
+    eng.stats["scalar_recursion_docs"] = 0
+    t0 = time.time()
+    eng.detect_many(mixed * 2, batch_size=batch_size)
+    t_mixed = (time.time() - t0) / 2
+    mixed_docs_sec = batch_size / t_mixed
+    mixed_fallback = eng.stats["fallback_docs"] // 2
+    mixed_retried = eng.stats["scalar_recursion_docs"] // 2
+
     docs_sec = len(stream) / (t_e2e * n_batches)
     return dict(
         metric="batch_detect_throughput",
@@ -102,6 +132,9 @@ def bench(batch_size: int = 8192, n_batches: int = 8) -> dict:
             epilogue_ms=round(t_epi * 1e3, 1),
             e2e_ms_per_batch=round(t_e2e * 1e3, 1),
             fallback_docs=int(packed.fallback.sum()),
+            mixed_docs_sec=round(mixed_docs_sec, 1),
+            mixed_fallback_docs=int(mixed_fallback),
+            mixed_retried_docs=int(mixed_retried),
             summary_sample=results[0].summary_lang,
         ),
     )

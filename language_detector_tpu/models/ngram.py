@@ -20,7 +20,9 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH,
+from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
+                             FLAG_SHORT, FLAG_SQUEEZE, FLAG_TOP40,
+                             FLAG_USE_WORDS,
                              GOOD_LANG1_PERCENT, GOOD_LANG1AND2_PERCENT,
                              SHORT_TEXT_THRESH, DocTote, ScalarResult,
                              calc_summary_lang, detect_scalar,
@@ -31,11 +33,14 @@ from ..ops.score import score_resolved, unpack_resolved_out
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
-# Flags the device path supports. FLAG_FINISH and FLAG_BEST_EFFORT only
-# alter the host epilogue / packer gate; every other flag changes span
-# preprocessing or scoring dispatch (squeeze, repeat-strip, score-as-quads)
-# and routes the whole batch to the scalar engine.
-_DEVICE_OK_FLAGS = FLAG_FINISH | FLAG_BEST_EFFORT
+# Flags the device path supports. FINISH/BEST_EFFORT alter only the
+# epilogue gate; SQUEEZE/REPEATS run natively in the packer (squeeze_span /
+# cheap_rep_words_inplace); TOP40/SHORT/USE_WORDS are vestigial in this
+# CLD2 version (set by the recursion, read nowhere). Anything else
+# (score-as-quads) routes the batch to the scalar engine.
+_DEVICE_OK_FLAGS = (FLAG_FINISH | FLAG_BEST_EFFORT | FLAG_SQUEEZE |
+                    FLAG_REPEATS | FLAG_TOP40 | FLAG_SHORT |
+                    FLAG_USE_WORDS)
 
 def _next_pow2(n: int) -> int:
     p = 1
@@ -283,20 +288,71 @@ class NgramBatchEngine:
 
     def _epilogue_native(self, texts: list[str], packed,
                          out: np.ndarray) -> list[ScalarResult]:
-        """Batched C++ epilogue (native/epilogue.cc); docs flagged
-        need_scalar (packer fallback or failed good-answer gate) take the
-        scalar recursion path individually."""
+        """Batched C++ epilogue (native/epilogue.cc). Docs that fail the
+        good-answer gate re-score as a BATCH with the recursion flags
+        (TOP40|REPEATS|FINISH, plus SQUEEZE for docs whose first pass
+        squeezed) -- the reference's recursive DetectLanguageSummaryV2
+        call (impl.cc:2061-2105) run on the device instead of per-doc in
+        the scalar engine. Packer-fallback docs stay scalar."""
         from .. import native
         ep = native.epilogue_batch_native(
             out, packed.direct_adds, packed.text_bytes, packed.fallback,
             self.flags, self.reg)
+        results: list = [None] * len(texts)
+        retry = {False: [], True: []}  # squeezed? -> [(index, text)]
+        for b, text in enumerate(texts):
+            row = ep[b]
+            if row[12]:  # need_scalar: fallback or gate failure
+                if packed.fallback[b]:
+                    results[b] = detect_scalar(text, self.tables, self.reg,
+                                               self.flags)
+                else:
+                    retry[bool(packed.squeezed[b])].append((b, text))
+                continue
+            results[b] = ScalarResult(
+                summary_lang=int(row[0]),
+                language3=[int(row[1]), int(row[2]), int(row[3])],
+                percent3=[int(row[4]), int(row[5]), int(row[6])],
+                normalized_score3=[float(row[7]), float(row[8]),
+                                   float(row[9])],
+                text_bytes=int(row[10]),
+                is_reliable=bool(row[11]))
+        n_retry = len(retry[False]) + len(retry[True])
+        if n_retry:
+            with self._stats_lock:
+                self.stats["scalar_recursion_docs"] += n_retry
+            extra = FLAG_TOP40 | FLAG_REPEATS | FLAG_FINISH
+            for squeezed, group in retry.items():
+                if not group:
+                    continue
+                flags = self.flags | extra | \
+                    (FLAG_SQUEEZE if squeezed else 0)
+                rs = self._score_with_flags([t for _, t in group], flags)
+                for (b, _), r in zip(group, rs):
+                    results[b] = r
+        return results
+
+    def _score_with_flags(self, texts: list[str],
+                          flags: int) -> list[ScalarResult]:
+        """One device pass with explicit flags (the gate-failure retry;
+        FINISH forces the gate so no further recursion happens). Docs the
+        packer cannot place fall back to the scalar engine with the
+        engine's own flags, exactly like a first-pass fallback."""
+        from .. import native
+        bsz = _next_pow2(len(texts))
+        bsz += -bsz % self._mesh_size
+        padded = list(texts) + [""] * (bsz - len(texts))
+        packed = self._pack(padded, self.tables, self.reg,
+                            max_slots=self.max_slots,
+                            max_chunks=self.max_chunks, flags=flags)
+        out = self.score_packed(packed)
+        ep = native.epilogue_batch_native(
+            out, packed.direct_adds, packed.text_bytes, packed.fallback,
+            flags, self.reg)
         results = []
         for b, text in enumerate(texts):
             row = ep[b]
-            if row[12]:  # need_scalar
-                if not packed.fallback[b]:
-                    with self._stats_lock:
-                        self.stats["scalar_recursion_docs"] += 1
+            if packed.fallback[b] or row[12]:
                 results.append(detect_scalar(text, self.tables, self.reg,
                                              self.flags))
                 continue

@@ -211,6 +211,7 @@ class ResolvedBatch:
     direct_adds: np.ndarray  # [B, D, 3] i32
     text_bytes: np.ndarray   # [B] i32
     fallback: np.ndarray     # [B] bool
+    squeezed: np.ndarray     # [B] bool: doc took the squeeze re-scan
     n_slots: np.ndarray      # [B] i32
     n_chunks: np.ndarray     # [B] i32
     n_docs: int = 0
@@ -257,6 +258,7 @@ class BufferPool:
                     direct_adds=np.full((B, D, 3), -1, np.int32),
                     text_bytes=np.zeros(B, np.int32),
                     fallback=np.zeros(B, bool),
+                    squeezed=np.zeros(B, bool),
                     n_slots=np.zeros(B, np.int32),
                     n_chunks=np.zeros(B, np.int32),
                     n_docs=B,
@@ -311,6 +313,7 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
             direct_adds=np.full((B, D, 3), -1, np.int32),
             text_bytes=np.zeros(B, np.int32),
             fallback=np.zeros(B, bool),
+            squeezed=np.zeros(B, bool),
             n_slots=np.zeros(B, np.int32),
             n_chunks=np.zeros(B, np.int32),
             n_docs=B,
@@ -330,6 +333,7 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
         out.direct_adds.ctypes.data_as(ctypes.c_void_p),
         _ptr(out.text_bytes, np.int32),
         out.fallback.ctypes.data_as(ctypes.c_void_p),
+        out.squeezed.ctypes.data_as(ctypes.c_void_p),
         _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
     return out
 

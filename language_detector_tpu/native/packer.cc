@@ -158,14 +158,13 @@ int count_spaces4(const uint8_t* buf, int len) {
   return c;
 }
 
-bool cheap_squeeze_trigger(const uint8_t* buf, int src_len) {
-  const int testsize = kSqueezeTestLen;
-  if (src_len < testsize) return false;
-  if (count_spaces4(buf, testsize) >= testsize * 25 / 100) return true;
-  // CountPredictedBytes with a fresh 12-bit-hash table
-  std::vector<int64_t> tbl(kPredictionTableSize, 0);
-  int predicted = 0, h = 0, i = 0;
-  while (i < testsize) {
+// CountPredictedBytes (compact_lang_det_impl.cc:541; squeeze.py): bytes
+// whose UTF-8 char the rolling 12-bit-hash table predicted.
+int count_predicted(const uint8_t* buf, int start, int len, int* hash,
+                    int64_t* tbl) {
+  int predicted = 0, h = *hash, i = start;
+  const int limit = start + len;
+  while (i < limit) {
     uint8_t c0 = buf[i];
     int64_t c;
     int incr;
@@ -183,8 +182,83 @@ bool cheap_squeeze_trigger(const uint8_t* buf, int src_len) {
     tbl[h] = c;
     h = ((h << 4) ^ (int)c) & 0xFFF;
   }
-  return predicted >= testsize * 67 / 100;
+  *hash = h;
+  return predicted;
 }
+
+bool cheap_squeeze_trigger(const uint8_t* buf, int src_len) {
+  const int testsize = kSqueezeTestLen;
+  if (src_len < testsize) return false;
+  if (count_spaces4(buf, testsize) >= testsize * 25 / 100) return true;
+  std::vector<int64_t> tbl(kPredictionTableSize, 0);
+  int h = 0;
+  return count_predicted(buf, 0, testsize, &h, tbl.data()) >=
+         testsize * 67 / 100;
+}
+
+// BackscanToSpace / ForwardscanToSpace (compact_lang_det_impl.cc:491-521)
+int backscan_to_space(const uint8_t* b, int dst) {
+  int limit = dst < 32 ? dst : 32;
+  for (int n = 0; n < limit; n++)
+    if (b[dst - n - 1] == 0x20) return n;
+  for (int n = 0; n < limit; n++)
+    if ((b[dst - n] & 0xC0) != 0x80) return n;
+  return 0;
+}
+
+int forwardscan_to_space(const uint8_t* b, int src, int limit) {
+  if (limit > 32) limit = 32;
+  for (int n = 0; n < limit; n++)
+    if (b[src + n] == 0x20) return n + 1;
+  for (int n = 0; n < limit; n++)
+    if ((b[src + n] & 0xC0) != 0x80) return n;
+  return 0;
+}
+
+// CheapSqueezeInplace (compact_lang_det_impl.cc:785-865; squeeze.py
+// cheap_squeeze): drop space-heavy / well-predicted 48-byte chunks,
+// compacting in place. b must extend >= 4 bytes past src_len; returns the
+// new length.
+int cheap_squeeze_inplace(uint8_t* b, int src_len) {
+  const int chunksize = 48;
+  const int space_thresh = chunksize * 25 / 100;
+  const int predict_thresh = chunksize * 40 / 100;
+  std::vector<int64_t> tbl(kPredictionTableSize, 0);
+  int h = 0;
+  bool skipping = false;
+  int src = 0, dst = 0;
+  while (src < src_len) {
+    int len = src_len - src < chunksize ? src_len - src : chunksize;
+    while ((b[src + len] & 0xC0) == 0x80) len++;  // UTF-8 boundary
+    int space_n = count_spaces4(b + src, len);
+    int predb_n = count_predicted(b, src, len, &h, tbl.data());
+    if (space_n >= space_thresh || predb_n >= predict_thresh) {
+      if (!skipping) {
+        dst -= backscan_to_space(b, dst);
+        if (dst == 0) {
+          b[0] = 0x20;
+          dst = 1;
+        }
+        skipping = true;
+      }
+    } else {
+      int take_from = src, take_len = len;
+      if (skipping) {
+        int n = forwardscan_to_space(b, src, len);
+        take_from += n;
+        take_len -= n;
+        skipping = false;
+      }
+      if (take_len > 0) {
+        std::memmove(b + dst, b + take_from, take_len);
+        dst += take_len;
+      }
+    }
+    src += len;
+  }
+  return dst;
+}
+
 
 // ---- segmentation (preprocess/segment.py segment_text) ----
 struct Span {
@@ -250,6 +324,68 @@ void build_span(const std::vector<uint32_t>& cur, int ulscript,
   sp.buf.resize(sp.text_bytes + kTailPad, 0);
   sp.cps.push_back(0x20);
   out->push_back(std::move(sp));
+}
+
+// CheapRepWordsInplace (compact_lang_det_impl.cc:610-692; squeeze.py
+// cheap_rep_words): drop words with more than half their bytes predicted.
+// hash/tbl persist across the spans of one document.
+int cheap_rep_words_inplace(uint8_t* b, int src_len, int* hash,
+                            int64_t* tbl) {
+  int h = *hash;
+  int dst = 0, word_dst = 0, good_predict = 0, word_len = 0, src = 0;
+  while (src < src_len) {
+    uint8_t c0 = b[src];
+    b[dst++] = c0;
+    if (c0 == 0x20) {
+      if (good_predict * 2 > word_len) dst = word_dst;
+      word_dst = dst;
+      good_predict = 0;
+      word_len = 0;
+    }
+    int64_t c;
+    int incr;
+    if (c0 < 0xC0) { c = c0; incr = 1; }
+    else if ((c0 & 0xE0) == 0xC0) {
+      b[dst++] = b[src + 1];
+      c = (c0 << 8) | b[src + 1];
+      incr = 2;
+    } else if ((c0 & 0xF0) == 0xE0) {
+      b[dst++] = b[src + 1];
+      b[dst++] = b[src + 2];
+      c = ((int64_t)c0 << 16) | (b[src + 1] << 8) | b[src + 2];
+      incr = 3;
+    } else {
+      b[dst++] = b[src + 1];
+      b[dst++] = b[src + 2];
+      b[dst++] = b[src + 3];
+      c = ((int64_t)c0 << 24) | ((int64_t)b[src + 1] << 16) |
+          (b[src + 2] << 8) | b[src + 3];
+      incr = 4;
+    }
+    src += incr;
+    word_len += incr;
+    if (tbl[h] == c) good_predict += incr;
+    tbl[h] = c;
+    h = ((h << 4) ^ (int)c) & 0xFFF;
+  }
+  *hash = h;
+  return dst;
+}
+
+// Rebuild a span around rewritten (shorter) text
+void respan(Span* sp, int n) {
+  sp->text_bytes = n;
+  sp->buf.resize(n + kTailPad);
+  sp->buf[n] = sp->buf[n + 1] = sp->buf[n + 2] = 0x20;
+  std::memset(sp->buf.data() + n + 3, 0, kTailPad - 3);
+  sp->cps.clear();
+  u8decode(sp->buf.data(), n, &sp->cps);
+  sp->cps.push_back(0x20);
+}
+
+// Rebuild a span around its squeezed text (engine_scalar _respan)
+void squeeze_span(Span* sp) {
+  respan(sp, cheap_squeeze_inplace(sp->buf.data(), sp->text_bytes));
 }
 
 void segment_text(const uint8_t* text, int text_len,
@@ -847,6 +983,7 @@ struct ROut {
   int32_t* direct_adds;
   int32_t* text_bytes;
   uint8_t* fallback;
+  uint8_t* squeezed;  // [B] doc took the squeeze re-scan
   int32_t* n_slots;
   int32_t* n_chunks;
   int L, C, D, flags;
@@ -869,22 +1006,38 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   int32_t c_lo[256], c_span_end[256];
   int16_t c_span[256];
   int8_t c_side[256], c_real[256];
+  int32_t boosts[2][4];
+  int bptr[2];
+  int slot, chunk_base, n_direct, round_no, open_chunk;
+  int64_t total;
+  bool ok;
+  std::vector<Rec> recs;
+  // Repetitive documents restart the whole doc with span squeezing, like
+  // the reference's recursive kCLDFlagSqueeze call (impl.cc:1867-1901) —
+  // previously such docs fell back to the (much slower) scalar engine.
+  // FLAG_SQUEEZE (2) forces it batch-wide; FLAG_REPEATS (4) strips
+  // well-predicted words (the gate-failure recursion pass).
+  bool squeeze = (o.flags & 2) != 0;
+  static thread_local std::vector<int64_t> rep_tbl;
+  int rep_hash;
+
+restart:
+  rep_hash = 0;
+  if (o.flags & 4) rep_tbl.assign(kPredictionTableSize, 0);
   std::memset(c_grams, 0, sizeof(c_grams));
   for (int c = 0; c < C && c < 256; c++) {
     c_lo[c] = 1 << 30; c_span_end[c] = 0;
     c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
   }
-
   // per-doc rotating distinct-boost lists (idx into cat_ind; 0 = empty)
-  int32_t boosts[2][4] = {{0, 0, 0, 0}, {0, 0, 0, 0}};
-  int bptr[2] = {0, 0};
-
+  std::memset(boosts, 0, sizeof(boosts));
+  bptr[0] = bptr[1] = 0;
   // round_no uniquely ids each (span, hitbuffer-round): chunk byte
   // ranges chain only within one round (scalar _score_round's end_off)
-  int slot = 0, chunk_base = 0, n_direct = 0, round_no = 0;
-  int64_t total = 0;
-  bool ok = true;
-  std::vector<Rec> recs;
+  slot = 0; chunk_base = 0; n_direct = 0; round_no = 0;
+  open_chunk = -1;  // chunk awaiting its boost flush
+  total = 0;
+  ok = true;
 
   // emit the pending chunk's boost adds (list state at its last slot)
   auto flush_boosts = [&](int c) {
@@ -899,15 +1052,26 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
     }
   };
 
-  int open_chunk = -1;  // chunk awaiting its boost flush
-  for (const Span& sp : spans) {
+  for (Span& sp : spans) {
+    if (squeeze) {
+      // Remove repetitive or mostly-space chunks (impl.cc:1852-1864)
+      squeeze_span(&sp);
+    } else if (!(o.flags & 1) &&
+               sp.text_bytes > (kSqueezeTestThresh >> 1) &&
+               cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
+      // re-scan the whole document with squeezing on
+      squeeze = true;
+      spans.clear();
+      segment_text(text, text_len, &spans);
+      goto restart;
+    }
+    if (o.flags & 4) {
+      // Remove repeated words (impl.cc:1905-1918)
+      respan(&sp, cheap_rep_words_inplace(sp.buf.data(), sp.text_bytes,
+                                          &rep_hash, rep_tbl.data()));
+    }
     total += sp.text_bytes;
     int rtv = sp.ulscript < g.n_scripts ? g.rtype[sp.ulscript] : 0;
-    if (!(o.flags & 1) && sp.text_bytes > (kSqueezeTestThresh >> 1) &&
-        cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
-      ok = false;  // squeeze-trigger doc -> scalar path
-      break;
-    }
     if (rtv == 0 || rtv == 1) {  // RTypeNone/One: direct doc-tote add
       if (n_direct >= o.D || chunk_base >= C) { ok = false; break; }
       dadds[n_direct * 3 + 0] = chunk_base;
@@ -1075,6 +1239,7 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   for (int d = n_direct; d < o.D; d++) dadds[d * 3 + 0] = -1;
   o.text_bytes[b] = (int32_t)total;
   o.fallback[b] = !ok;
+  o.squeezed[b] = squeeze ? 1 : 0;
   o.n_slots[b] = slot;
   o.n_chunks[b] = chunk_base;
 }
@@ -1122,12 +1287,14 @@ void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
                       uint16_t* idx, uint8_t* chk, uint32_t* cmeta,
                       uint8_t* cscript, int32_t* direct_adds,
                       int32_t* text_bytes, uint8_t* fallback,
-                      int32_t* n_slots, int32_t* n_chunks) {
+                      uint8_t* squeezed, int32_t* n_slots,
+                      int32_t* n_chunks) {
   if (!rt_ready) {
     // ldt_init_tables was never called: flag every doc as fallback
     // instead of dereferencing null table pointers
     for (int b = 0; b < n_docs; b++) {
       fallback[b] = 1;
+      squeezed[b] = 0;
       n_slots[b] = 0;
       n_chunks[b] = 0;
       text_bytes[b] = 0;
@@ -1135,7 +1302,7 @@ void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
     return;
   }
   ROut o{idx, chk, cmeta, cscript, direct_adds, text_bytes, fallback,
-         n_slots, n_chunks, L, C, D, flags};
+         squeezed, n_slots, n_chunks, L, C, D, flags};
   auto work = [&](int lo, int hi) {
     for (int b = lo; b < hi; b++)
       pack_resolve_one_doc(texts + bounds[b],
