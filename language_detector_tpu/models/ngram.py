@@ -196,7 +196,7 @@ class NgramBatchEngine:
         results: list = [None] * len(texts)
         short_res = self._detect_many_uniform(short, batch_size) if short \
             else []
-        long_res = self._long_engine().detect_batch_chunked(
+        long_res = self._long_engine()._detect_many_uniform(
             [texts[i] for i in long_idx], self._LONG_BATCH)
         for j, i in enumerate(long_idx):
             results[i] = long_res[j]
@@ -227,13 +227,6 @@ class NgramBatchEngine:
             for f in pending:
                 results.extend(f.result())
         return results
-
-    def detect_batch_chunked(self, texts: list[str],
-                             batch_size: int) -> list[ScalarResult]:
-        out: list[ScalarResult] = []
-        for i in range(0, len(texts), batch_size):
-            out.extend(self.detect_batch(texts[i:i + batch_size]))
-        return out
 
     def _long_engine(self) -> "NgramBatchEngine":
         if getattr(self, "_long_eng", None) is None:
