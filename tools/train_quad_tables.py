@@ -276,11 +276,10 @@ def build_table(fp_entries: dict, bucketcount: int, keymask: int,
                 lg_prob: np.ndarray, alpha: float = None,
                 base: float = None, slope: float = None,
                 hi_cap: int = None):
-    """Pack (fp -> (ranked [(lang, weight)], total_weight, priority)) into
-    CLD2 bucket + indirect arrays."""
-    # Deduplicate langprob payloads
-    langprob_index: dict = {}
-    singles: list = []
+    """Quantize (fp -> ranked lang weights) and pack into CLD2 bucket +
+    indirect arrays. Bucket overflow spills to the caller (the
+    reference's answer to collisions is the DUAL quad table probed on
+    primary miss, cldutil.cc:356-363 -- the spill list feeds it)."""
     entries = []  # (fp, priority, langprob)
     for fp, (ranked, total_w, priority) in fp_entries.items():
         pslangs, row = quantize_top3(ranked, total_w, lg_prob, alpha,
@@ -288,36 +287,44 @@ def build_table(fp_entries: dict, bucketcount: int, keymask: int,
         lp = ((pslangs[2] & 0xFF) << 24) | ((pslangs[1] & 0xFF) << 16) | \
              ((pslangs[0] & 0xFF) << 8) | (row & 0xFF)
         entries.append((fp, priority, lp))
+    return pack_entries(entries, bucketcount, keymask)
 
-    # Indirect array: all single-langprob entries (no doubles needed; the
-    # top-3 languages fit one packed word)
+
+def pack_entries(entries: list, bucketcount: int, keymask: int):
+    """Pack pre-quantized (fp, priority, langprob) entries into a bucket +
+    indirect table: dedup langprobs, highest-priority entries claim the 4
+    bucket slots first, overflow returns as a spill list."""
+    langprob_index: dict = {}
+    singles: list = []
     for _, _, lp in entries:
         if lp not in langprob_index:
             langprob_index[lp] = len(singles)
             singles.append(lp)
-    size_one = len(singles)
-
+    size_one = max(len(singles), 2)
     ind_bits = (~keymask) & 0xFFFFFFFF
-    if size_one > ind_bits:
-        raise SystemExit(f"indirect overflow: {size_one} > {ind_bits}")
-
+    if len(singles) > ind_bits:
+        raise SystemExit(
+            f"indirect overflow: {len(singles)} langprobs > the "
+            f"{ind_bits:#x} index bits below keymask {keymask:#x}")
     buckets = np.zeros((bucketcount, 4), dtype=np.uint32)
-    # Highest-weight entries claim slots first (reference drops overflow)
-    entries.sort(key=lambda e: -e[1])
-    filled = dropped = 0
+    if not entries:
+        return buckets, np.array([0, 0], dtype=np.uint32), 2, 0, []
+    entries = sorted(entries, key=lambda e: -e[1])
     fps = np.array([e[0] for e in entries], dtype=np.uint32)
     subs, keys = quad_subscript_key(fps, keymask, bucketcount)
     slot_used = np.zeros(bucketcount, dtype=np.int32)
+    filled = 0
+    spilled = []
     for (fp, w, lp), sub, key in zip(entries, subs.tolist(), keys.tolist()):
         s = slot_used[sub]
         if s >= 4:
-            dropped += 1
+            spilled.append((fp, w, lp))
             continue
         buckets[sub, s] = np.uint32(key) | np.uint32(langprob_index[lp])
         slot_used[sub] = s + 1
         filled += 1
-    return buckets, np.array(singles, dtype=np.uint32), size_one, filled, \
-        dropped
+    return buckets, np.array(singles, dtype=np.uint32), len(singles), \
+        filled, spilled
 
 
 def collect_cldr_phrases(tables, reg):
@@ -366,7 +373,8 @@ def train(tables, reg, corpus, buckets: int = 65536,
           slope: float = SLOPE, hi_cap: int = HI_CAP,
           mo_weight: float = 0.0, ensw_weight: float = 0.0,
           prior_pow: float = 0.0, lang_bias: dict | None = None,
-          close_pool: float = 0.0, verbose: bool = True) -> dict:
+          close_pool: float = 0.0, buckets2: int = 8192,
+          verbose: bool = True) -> dict:
     """Accumulate the collected corpus into a packed quadgram table set.
 
     lang_bias: optional per-language multiplicative calibration on
@@ -468,12 +476,18 @@ def train(tables, reg, corpus, buckets: int = 65536,
 
     # >=32K buckets use a 2-byte key (cldutil.cc:103-105 comment)
     keymask = 0xFFFF0000 if buckets >= 32768 else 0xFFFFF000
-    bucket_arr, ind, size_one, filled, dropped = build_table(
+    bucket_arr, ind, size_one, filled, spilled = build_table(
         fp_entries, buckets, keymask, tables.lg_prob, alpha, base, slope,
         hi_cap)
+    # Bucket-overflow spill -> dual quadgram table probed on primary miss
+    # (kQuad_obj2 convention, cldutil.cc:356-373)
+    keymask2 = 0xFFFF0000 if buckets2 >= 32768 else 0xFFFFF000
+    b2, ind2, so2, f2, d2 = pack_entries(spilled, buckets2, keymask2) \
+        if buckets2 else (None, None, 0, 0, spilled)
     if verbose:
-        print(f"buckets {buckets} filled {filled} dropped {dropped} "
-              f"indirect {size_one}")
+        print(f"buckets {buckets} filled {filled} spilled {len(spilled)} "
+              f"indirect {size_one}; dual {buckets2} filled {f2} "
+              f"dropped {len(d2)}")
 
     # Expected-score calibration for the trained tables: keep the reference
     # values only for the CJK unigram/bigram-scored languages (that scoring
@@ -484,7 +498,7 @@ def train(tables, reg, corpus, buckets: int = 65536,
         lang = reg.code_to_lang[code]
         expected[lang] = tables.avg_delta_octa_score[lang]
 
-    return {
+    out = {
         "quadgram_buckets": bucket_arr,
         "quadgram_ind": ind,
         "quadgram_meta": np.array([size_one, buckets, keymask, 20260730],
@@ -492,6 +506,15 @@ def train(tables, reg, corpus, buckets: int = 65536,
         "quadgram_langscripts": np.array("trained-from-octa-and-cldr-data"),
         "expected_score_override": expected,
     }
+    if buckets2 and f2:
+        out.update({
+            "quadgram2_buckets": b2,
+            "quadgram2_ind": ind2,
+            "quadgram2_meta": np.array([so2, buckets2, keymask2, 20260730],
+                                       dtype=np.uint32),
+            "quadgram2_langscripts": np.array("spill-of-primary-table"),
+        })
+    return out
 
 
 def main():
