@@ -175,3 +175,47 @@ def test_detect_many_matches_detect_batch(engine):
     assert len(got) == len(texts)
     assert [_result_tuple(r) for r in got] == \
         [_result_tuple(r) for r in want]
+
+
+def test_fuzz_mixed_traffic_agreement(engine):
+    """Randomized traffic soup: slices and concatenations of golden text
+    across scripts, plus spam runs, entities, punctuation storms, and
+    random Unicode — every construction the packer's special paths
+    (squeeze, rounds, direct adds, boosts) can hit, asserted
+    doc-for-doc against the scalar engine."""
+    rng = random.Random(20260730)
+    texts = _golden_texts()
+    docs = []
+    for i in range(160):
+        kind = i % 8
+        if kind == 0:    # cross-script concatenation
+            docs.append(" ".join(
+                texts[rng.randrange(len(texts))][:rng.randint(20, 300)]
+                for _ in range(rng.randint(1, 5))))
+        elif kind == 1:  # repetitive spam of a random snippet
+            snip = texts[rng.randrange(len(texts))][:rng.randint(5, 30)]
+            docs.append((snip + " ") * rng.randint(50, 300))
+        elif kind == 2:  # mid-codepoint slices (invalid boundaries ok)
+            t = texts[rng.randrange(len(texts))]
+            lo = rng.randrange(max(1, len(t) - 100))
+            docs.append(t[lo:lo + rng.randint(1, 80)])
+        elif kind == 3:  # punctuation / digit storms
+            docs.append(" ".join(
+                rng.choice(["!!!", "123", "...", "@x", "#tag", "???"])
+                for _ in range(rng.randint(1, 40))))
+        elif kind == 4:  # random BMP codepoints
+            docs.append("".join(
+                chr(rng.choice([rng.randrange(0x20, 0x2000),
+                                rng.randrange(0x3040, 0x9FFF)]))
+                for _ in range(rng.randint(1, 120))))
+        elif kind == 5:  # words glued without spaces
+            t = texts[rng.randrange(len(texts))]
+            docs.append(t.replace(" ", "")[:rng.randint(10, 400)])
+        elif kind == 6:  # long multi-paragraph
+            docs.append(" ".join(
+                texts[(i * 13 + j * 7) % len(texts)][:400]
+                for j in range(rng.randint(4, 12))))
+        else:            # whitespace-heavy
+            t = texts[rng.randrange(len(texts))][:200]
+            docs.append(t.replace(" ", "   \n\t "))
+    _assert_batch_agrees(engine, docs)
