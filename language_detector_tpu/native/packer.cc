@@ -311,20 +311,44 @@ void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
 }
 
 void build_span(const std::vector<uint32_t>& cur, int ulscript,
-                std::vector<Span>* out) {
-  Span sp;
-  sp.ulscript = ulscript;
-  sp.cps.reserve(cur.size() + 2);
-  sp.cps.push_back(0x20);
-  for (uint32_t cp : cur) sp.cps.push_back(cp);
-  sp.buf.reserve(cur.size() * 2 + kTailPad + 4);
-  for (uint32_t cp : sp.cps) u8encode(cp, &sp.buf);
-  sp.text_bytes = (int)sp.buf.size();
-  sp.buf.push_back(0x20); sp.buf.push_back(0x20); sp.buf.push_back(0x20);
-  sp.buf.resize(sp.text_bytes + kTailPad, 0);
-  sp.cps.push_back(0x20);
-  out->push_back(std::move(sp));
+                Span* sp) {
+  sp->ulscript = ulscript;
+  sp->cps.clear();
+  sp->buf.clear();
+  sp->cps.reserve(cur.size() + 2);
+  sp->cps.push_back(0x20);
+  for (uint32_t cp : cur) sp->cps.push_back(cp);
+  sp->buf.reserve(cur.size() * 2 + kTailPad + 4);
+  for (uint32_t cp : sp->cps) u8encode(cp, &sp->buf);
+  sp->text_bytes = (int)sp->buf.size();
+  sp->buf.push_back(0x20); sp->buf.push_back(0x20); sp->buf.push_back(0x20);
+  sp->buf.resize(sp->text_bytes + kTailPad, 0);
+  sp->cps.push_back(0x20);
 }
+
+// Reusable per-thread segmentation scratch: all vectors keep their
+// capacity across documents, making steady-state packing allocation-free
+// (the malloc + first-touch cost was ~25% of single-thread pack time).
+struct SegScratch {
+  std::vector<uint32_t> cps, lower, cur;
+  std::vector<uint8_t> script;
+  std::vector<int8_t> u8l;
+  std::vector<int64_t> byte_before;
+  std::vector<Span> spans;  // pool; only [0, n_spans) are live
+  int n_spans = 0;
+
+  Span* alloc_span() {
+    if (n_spans == (int)spans.size()) spans.emplace_back();
+    return &spans[n_spans++];
+  }
+
+  // Bound long-lived retention: one pathological multi-MB document must
+  // not pin worst-case capacity on a persistent thread forever.
+  void maybe_shrink() {
+    if (cps.capacity() > (1 << 20) || spans.size() > 512)
+      *this = SegScratch();
+  }
+};
 
 // CheapRepWordsInplace (compact_lang_det_impl.cc:610-692; squeeze.py
 // cheap_rep_words): drop words with more than half their bytes predicted.
@@ -388,18 +412,23 @@ void squeeze_span(Span* sp) {
   respan(sp, cheap_squeeze_inplace(sp->buf.data(), sp->text_bytes));
 }
 
-void segment_text(const uint8_t* text, int text_len,
-                  std::vector<Span>* spans) {
-  std::vector<uint32_t> cps;
+void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
+  ss->n_spans = 0;
+  std::vector<uint32_t>& cps = ss->cps;
+  cps.clear();
   cps.reserve(text_len);
   u8decode(text, text_len, &cps);
   const int n = (int)cps.size();
   if (n == 0) return;
 
-  std::vector<uint8_t> script(n);
-  std::vector<uint32_t> lower(n);
-  std::vector<int8_t> u8l(n);
-  std::vector<int64_t> byte_before(n + 1);
+  std::vector<uint8_t>& script = ss->script;
+  std::vector<uint32_t>& lower = ss->lower;
+  std::vector<int8_t>& u8l = ss->u8l;
+  std::vector<int64_t>& byte_before = ss->byte_before;
+  script.resize(n);
+  lower.resize(n);
+  u8l.resize(n);
+  byte_before.resize(n + 1);
   int64_t acc = 0;
   for (int i = 0; i < n; i++) {
     uint32_t cp = cps[i] > 0x10FFFF ? 0x10FFFF : cps[i];
@@ -421,7 +450,8 @@ void segment_text(const uint8_t* text, int text_len,
     while (i < n && script[i] == 0) i++;
     if (i >= n) break;
     const int spanscript = script[i];
-    std::vector<uint32_t> cur;
+    std::vector<uint32_t>& cur = ss->cur;
+    cur.clear();
     int put = 1;
 
     while (i < n) {
@@ -448,7 +478,7 @@ void segment_text(const uint8_t* text, int text_len,
       if (script[i] != spanscript && script[i] != kUlScriptInherited) break;
       if (put >= soft_limit) break;
     }
-    if (cur.size() > 1) build_span(cur, spanscript, spans);
+    if (cur.size() > 1) build_span(cur, spanscript, ss->alloc_span());
   }
 }
 
@@ -601,8 +631,9 @@ struct Out {
 };
 
 void pack_one_doc(const uint8_t* text, int text_len, int b, const Out& o) {
-  std::vector<Span> spans;
-  segment_text(text, text_len, &spans);
+  static thread_local SegScratch seg;
+  seg.maybe_shrink();
+  segment_text(text, text_len, &seg);
 
   const int L = o.L, C = o.C;
   int8_t* kind = o.kind + (int64_t)b * L;
@@ -624,8 +655,9 @@ void pack_one_doc(const uint8_t* text, int text_len, int b, const Out& o) {
   int slot = 0, chunk_base = 0, n_direct = 0;
   int64_t total = 0;
   bool ok = true;
-  std::vector<Rec> recs;
-  for (const Span& sp : spans) {
+  static thread_local std::vector<Rec> recs;
+  for (int _si = 0; _si < seg.n_spans; _si++) {
+    const Span& sp = seg.spans[_si];
     total += sp.text_bytes;
     int rt = sp.ulscript < g.n_scripts ? g.rtype[sp.ulscript] : 0;
     if (!(o.flags & 1) && sp.text_bytes > (kSqueezeTestThresh >> 1) &&
@@ -991,8 +1023,13 @@ struct ROut {
 
 void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
                           const ROut& o) {
-  std::vector<Span> spans;
-  segment_text(text, text_len, &spans);
+  // NOTE: worker threads are spawned per batch, so thread_local scratch
+  // amortizes over one batch's ~n_docs/n_threads documents (hundreds at
+  // service batch sizes), and persists fully on the single-threaded
+  // calling-thread path.
+  static thread_local SegScratch seg;
+  seg.maybe_shrink();
+  segment_text(text, text_len, &seg);
 
   const int L = o.L, C = o.C;
   uint16_t* idx = o.idx + (int64_t)b * L;
@@ -1011,7 +1048,7 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   int slot, chunk_base, n_direct, round_no, open_chunk;
   int64_t total;
   bool ok;
-  std::vector<Rec> recs;
+  static thread_local std::vector<Rec> recs;
   // Repetitive documents restart the whole doc with span squeezing, like
   // the reference's recursive kCLDFlagSqueeze call (impl.cc:1867-1901) —
   // previously such docs fell back to the (much slower) scalar engine.
@@ -1052,7 +1089,8 @@ restart:
     }
   };
 
-  for (Span& sp : spans) {
+  for (int _si = 0; _si < seg.n_spans; _si++) {
+    Span& sp = seg.spans[_si];
     if (squeeze) {
       // Remove repetitive or mostly-space chunks (impl.cc:1852-1864)
       squeeze_span(&sp);
@@ -1061,8 +1099,7 @@ restart:
                cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
       // re-scan the whole document with squeezing on
       squeeze = true;
-      spans.clear();
-      segment_text(text, text_len, &spans);
+      segment_text(text, text_len, &seg);
       goto restart;
     }
     if (o.flags & 4) {
