@@ -62,6 +62,14 @@ def initialize(coordinator_address: str | None = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    # Some jaxlib builds (e.g. tunneled single-chip platforms) accept the
+    # call but never form the cluster; fail loudly rather than silently
+    # running 1/N of the workload as if it were the whole job.
+    if num_processes is not None and jax.process_count() != num_processes:
+        raise RuntimeError(
+            f"jax.distributed did not form the requested cluster: "
+            f"process_count()={jax.process_count()} != {num_processes}; "
+            "this jaxlib build may not support multi-process execution")
     return True
 
 
