@@ -173,3 +173,41 @@ def test_codes_match_reference_data():
     # every service-relevant reference code except legacy renames exists
     missing = set(ref) - set(mine) - {"mo", "sit", "sr-me", "zhT"}
     assert not missing
+
+
+def test_mixed_traffic_batch(server):
+    """Spam, long, degenerate, and normal docs in one request: every item
+    gets a well-formed response in order (per-item resilience,
+    handlers.go:133-160 contract)."""
+    texts = [
+        "le monde est grand et la vie est belle pour tous les hommes",
+        "buy cheap now " * 300,                       # squeeze spam
+        " ".join("Le gouvernement a annoncé de nouvelles mesures."
+                 for _ in range(120)),                # long doc
+        "",                                           # empty
+        "国民の大多数が内閣を支持し、集団的自衛権の行使を認める判断を",
+    ]
+    status, body = _post(server["url"] + "/",
+                         {"request": [{"text": t} for t in texts]})
+    assert status in (200, 203)
+    resp = body["response"]
+    assert len(resp) == len(texts)
+    for item in resp:
+        assert set(item) == {"iso6391code", "name"}
+    assert resp[0]["iso6391code"] == "fr"
+    assert resp[4]["iso6391code"] == "ja"
+
+
+def test_buffer_pool_rotation_and_eviction():
+    """BufferPool: same-shape requests rotate through RING warm sets;
+    shapes evict LRU beyond MAX_KEYS (native/__init__.py contract)."""
+    from language_detector_tpu import native
+    pool = native.BufferPool()
+    first = pool.get(8, 64, 8, 8)
+    ring = [pool.get(8, 64, 8, 8) for _ in range(pool.RING)]
+    assert ring[pool.RING - 1] is first  # wrapped around
+    # distinct shapes beyond MAX_KEYS evict the least-recently-used
+    for k in range(pool.MAX_KEYS):
+        pool.get(8 + k + 1, 64, 8, 8)
+    assert (8, 64, 8, 8) not in pool._rings
+    assert len(pool._rings) == pool.MAX_KEYS
