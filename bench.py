@@ -64,7 +64,7 @@ def make_mixed_corpus(n: int) -> list:
     return docs
 
 
-def bench(batch_size: int = 8192, n_batches: int = 8) -> dict:
+def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
     from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
 
     eng = NgramBatchEngine()

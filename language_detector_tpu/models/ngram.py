@@ -176,7 +176,7 @@ class NgramBatchEngine:
     _LONG_BATCH = 64
 
     def detect_many(self, texts: list[str],
-                    batch_size: int = 8192) -> list[ScalarResult]:
+                    batch_size: int = 16384) -> list[ScalarResult]:
         """Multi-batch detection with host/device pipelining: the main
         thread packs + dispatches batch N+1 while pool workers force
         batch N's device execution and run its epilogue (both the C++
