@@ -211,3 +211,31 @@ def test_buffer_pool_rotation_and_eviction():
         pool.get(8 + k + 1, 64, 8, 8)
     assert (8, 64, 8, 8) not in pool._rings
     assert len(pool._rings) == pool.MAX_KEYS
+
+
+def test_device_engine_service_path():
+    """The service's production configuration (use_device=True): requests
+    flow through the batcher into the batched device engine and back.
+    The scalar-path suite above covers HTTP semantics; this covers the
+    service -> NgramBatchEngine seam."""
+    svc = DetectorService(use_device=True, max_delay_ms=1.0)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        status, body = _post(url + "/", {"request": [
+            {"text": "le monde est grand et la vie est belle pour tous"},
+            {"text": "国民の大多数が内閣を支持し、集団的自衛権の行使を"},
+            {"text": "buy cheap now " * 300},
+        ]})
+        assert status in (200, 203)
+        codes = [r["iso6391code"] for r in body["response"]]
+        assert codes[0] == "fr" and codes[1] == "ja"
+        assert len(codes) == 3
+    finally:
+        httpd.shutdown()
+        metricsd.shutdown()
+        svc.batcher.close()
