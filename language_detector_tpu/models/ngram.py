@@ -60,7 +60,8 @@ def _bucket(n: int, lo: int, hi: int) -> int:
 
 def to_wire(rb, max_slots: int, max_chunks: int, n_shards: int = 1) -> dict:
     """ResolvedBatch -> flat ragged device wire (see score_resolved_impl):
-    3 bytes per RESOLVED hit (u16 cat_ind2 index + u8 doc-local chunk id)
+    3-4 bytes per RESOLVED hit (u16 cat_ind2 index + doc-local chunk id,
+    u8 when the chunk budget fits, u16 for long single-script documents)
     + 5 bytes per chunk + 8 per doc. Misses, offsets, and fingerprints
     never cross the host->device link — the native packer already probed
     the tables, ran the quad repeat cache, assigned chunks, and rotated
@@ -72,7 +73,7 @@ def to_wire(rb, max_slots: int, max_chunks: int, n_shards: int = 1) -> dict:
     axis 0)."""
     B, Lfull = rb.idx.shape
     assert B % n_shards == 0, (B, n_shards)
-    assert max_chunks <= 256, "chunk ids must fit the u8 wire lane"
+    assert max_chunks <= 0xFFFF, "chunk ids must fit the u16 wire lane"
     used_slots = max(int(rb.n_slots.max(initial=1)), 1)
     used_chunks = max(int(rb.n_chunks.max(initial=1)), 1)
     L = _bucket(used_slots, 64, max_slots)
@@ -90,6 +91,10 @@ def to_wire(rb, max_slots: int, max_chunks: int, n_shards: int = 1) -> dict:
 
     from .. import native
     wire = native.flatten_resolved_native(rb, D, N)
+    if C <= 256:
+        # common case: chunk ids fit u8 — halve that wire lane (the u16
+        # lane exists for long single-script documents, C up to 2048)
+        wire["chk"] = wire["chk"].astype(np.uint8)
     wire["cmeta"] = np.ascontiguousarray(rb.cmeta[:, :C])
     wire["cscript"] = np.ascontiguousarray(rb.cscript[:, :C])
     wire["l_iota"] = np.zeros(L, np.uint8)
@@ -171,9 +176,11 @@ class NgramBatchEngine:
     # batches) so they stay on the device instead of overflowing the
     # standard slot budget into the scalar fallback
     LONG_DOC_BYTES = 1536
-    _LONG_SLOTS = 16384
-    _LONG_CHUNKS = 256
-    _LONG_BATCH = 64
+    _LONG_SLOTS = 32768
+    _LONG_CHUNKS = 2048
+    # small batches: the [B, C, L] one-hot chunk matrix at the wide
+    # buckets (C=2048, L=32768) costs B * 128MB in bf16 on device
+    _LONG_BATCH = 16
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list[ScalarResult]:

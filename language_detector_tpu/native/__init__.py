@@ -38,13 +38,18 @@ def _build() -> bool:
 
 _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_epilogue_batch",
             "ldt_init_tables", "ldt_pack_resolve", "ldt_flatten_resolved")
+_ABI_VERSION = 4  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
-    """CDLL + symbol check; None when any entry point is missing (stale
-    .so built from an older source set)."""
+    """CDLL + symbol & ABI-version check; None for a missing or stale .so
+    (older source set OR older ABI — signature/wire-layout changes bump
+    _ABI_VERSION so a cached binary can never silently corrupt results)."""
     try:
         lib = ctypes.CDLL(str(_SO))
+        lib.ldt_abi_version.restype = ctypes.c_int32
+        if lib.ldt_abi_version() != _ABI_VERSION:
+            return None
         for sym in _SYMBOLS:
             getattr(lib, sym).restype = None
         return lib
@@ -205,7 +210,7 @@ class ResolvedBatch:
     """Host output of the resolve packer: dense per-doc resolved slots +
     chunk metadata + everything the document epilogue needs."""
     idx: np.ndarray          # [B, L] u16 cat_ind2 indices
-    chk: np.ndarray          # [B, L] u8 doc-local chunk ids
+    chk: np.ndarray          # [B, L] u16 doc-local chunk ids
     cmeta: np.ndarray        # [B, C] u32 cbytes|grams|side|real
     cscript: np.ndarray      # [B, C] u8
     direct_adds: np.ndarray  # [B, D, 3] i32
@@ -252,7 +257,7 @@ class BufferPool:
             if len(ring) < self.RING:
                 rb = ResolvedBatch(
                     idx=np.zeros((B, L), np.uint16),
-                    chk=np.zeros((B, L), np.uint8),
+                    chk=np.zeros((B, L), np.uint16),
                     cmeta=np.zeros((B, C), np.uint32),
                     cscript=np.zeros((B, C), np.uint8),
                     direct_adds=np.full((B, D, 3), -1, np.int32),
@@ -307,7 +312,7 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
     else:
         out = ResolvedBatch(
             idx=np.zeros((B, L), np.uint16),
-            chk=np.zeros((B, L), np.uint8),
+            chk=np.zeros((B, L), np.uint16),
             cmeta=np.zeros((B, C), np.uint32),
             cscript=np.zeros((B, C), np.uint8),
             direct_adds=np.full((B, D, 3), -1, np.int32),
@@ -328,7 +333,7 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
         ctypes.c_int32(B), ctypes.c_int32(L), ctypes.c_int32(C),
         ctypes.c_int32(D), ctypes.c_int32(flags),
         ctypes.c_int32(n_threads),
-        _ptr(out.idx, np.uint16), _ptr(out.chk, np.uint8),
+        _ptr(out.idx, np.uint16), _ptr(out.chk, np.uint16),
         _ptr(out.cmeta, np.uint32), _ptr(out.cscript, np.uint8),
         out.direct_adds.ctypes.data_as(ctypes.c_void_p),
         _ptr(out.text_bytes, np.int32),
@@ -347,14 +352,14 @@ def flatten_resolved_native(rb: ResolvedBatch, n_shards: int,
         raise RuntimeError("native library unavailable")
     B, L = rb.idx.shape
     idx_flat = np.zeros((n_shards, N), np.uint16)
-    chk_flat = np.zeros((n_shards, N), np.uint8)
+    chk_flat = np.zeros((n_shards, N), np.uint16)
     doc_start = np.zeros(B, np.int32)
     n_slots = np.ascontiguousarray(rb.n_slots, dtype=np.int32)
     lib.ldt_flatten_resolved(
-        _ptr(rb.idx, np.uint16), _ptr(rb.chk, np.uint8),
+        _ptr(rb.idx, np.uint16), _ptr(rb.chk, np.uint16),
         _ptr(n_slots, np.int32), ctypes.c_int32(B), ctypes.c_int32(L),
         ctypes.c_int32(n_shards), ctypes.c_int32(N),
-        _ptr(idx_flat, np.uint16), _ptr(chk_flat, np.uint8),
+        _ptr(idx_flat, np.uint16), _ptr(chk_flat, np.uint16),
         _ptr(doc_start, np.int32))
     return dict(idx=idx_flat, chk=chk_flat, doc_start=doc_start,
                 n_slots=n_slots)

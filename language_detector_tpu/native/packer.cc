@@ -1009,7 +1009,7 @@ inline int chunk_of_rank(int r, int n_quota, int c) {
 // Resolved-wire per-doc output views
 struct ROut {
   uint16_t* idx;      // [B, L] cat_ind2 indices
-  uint8_t* chk;       // [B, L] doc-local chunk ids
+  uint16_t* chk;      // [B, L] doc-local chunk ids
   uint32_t* cmeta;    // [B, C] cbytes(16) | grams(12) | side<<28 | real<<29
   uint8_t* cscript;   // [B, C]
   int32_t* direct_adds;
@@ -1033,16 +1033,18 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
 
   const int L = o.L, C = o.C;
   uint16_t* idx = o.idx + (int64_t)b * L;
-  uint8_t* chk = o.chk + (int64_t)b * L;
+  uint16_t* chk = o.chk + (int64_t)b * L;
   uint32_t* cmeta = o.cmeta + (int64_t)b * C;
   uint8_t* cscript = o.cscript + (int64_t)b * C;
   int32_t* dadds = o.direct_adds + (int64_t)b * o.D * 3;
 
-  // per-chunk accumulators
-  int32_t c_grams[256];
-  int32_t c_lo[256], c_span_end[256];
-  int16_t c_span[256];
-  int8_t c_side[256], c_real[256];
+  // per-chunk accumulators (sized to the chunk budget; the wire chunk
+  // lane is u16 so C can exceed 256 for long single-script documents)
+  static thread_local std::vector<int32_t> c_grams, c_lo, c_span_end;
+  static thread_local std::vector<int16_t> c_span;
+  static thread_local std::vector<int8_t> c_side, c_real;
+  c_grams.resize(C); c_lo.resize(C); c_span_end.resize(C);
+  c_span.resize(C); c_side.resize(C); c_real.resize(C);
   int32_t boosts[2][4];
   int bptr[2];
   int slot, chunk_base, n_direct, round_no, open_chunk;
@@ -1061,8 +1063,8 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
 restart:
   rep_hash = 0;
   if (o.flags & 4) rep_tbl.assign(kPredictionTableSize, 0);
-  std::memset(c_grams, 0, sizeof(c_grams));
-  for (int c = 0; c < C && c < 256; c++) {
+  for (int c = 0; c < C; c++) {
+    c_grams[c] = 0;
     c_lo[c] = 1 << 30; c_span_end[c] = 0;
     c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
   }
@@ -1083,7 +1085,7 @@ restart:
     for (int s = 0; s < 4; s++) {
       if (boosts[side][s] && slot < L) {
         idx[slot] = (uint16_t)boosts[side][s];
-        chk[slot] = (uint8_t)c;
+        chk[slot] = (uint16_t)c;
         slot++;
       }
     }
@@ -1180,8 +1182,7 @@ restart:
       int emit = 0;
       for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
       if (slot + emit + 4 * round_chunks > L ||
-          chunk_base + round_chunks > C ||
-          chunk_base + round_chunks > 256) {
+          chunk_base + round_chunks > C) {
         ok = false;
         break;
       }
@@ -1208,11 +1209,11 @@ restart:
           open_chunk = c;
         }
         idx[slot] = (uint16_t)rr.ia;
-        chk[slot] = (uint8_t)c;
+        chk[slot] = (uint16_t)c;
         slot++;
         if (rr.b) {
           idx[slot] = (uint16_t)(rr.ia + 1);
-          chk[slot] = (uint8_t)c;
+          chk[slot] = (uint16_t)c;
           slot++;
         }
         cum_entries += contrib;
@@ -1285,6 +1286,11 @@ restart:
 
 extern "C" {
 
+// Bumped on ANY change to the exported function signatures or wire
+// layouts; the Python loader refuses (and rebuilds) on mismatch so a
+// stale .so can never silently corrupt results across an ABI change.
+int32_t ldt_abi_version() { return 4; }
+
 // Table geometry + data for host-side resolution. Pointers are owned by
 // Python (DeviceTables host copies) and must outlive packing calls.
 void ldt_init_tables(const uint32_t* cat_buckets, const uint32_t* cat_ind,
@@ -1321,7 +1327,7 @@ void ldt_init_tables(const uint32_t* cat_buckets, const uint32_t* cat_ind,
 void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
                       int32_t n_docs, int32_t L, int32_t C, int32_t D,
                       int32_t flags, int32_t n_threads,
-                      uint16_t* idx, uint8_t* chk, uint32_t* cmeta,
+                      uint16_t* idx, uint16_t* chk, uint32_t* cmeta,
                       uint8_t* cscript, int32_t* direct_adds,
                       int32_t* text_bytes, uint8_t* fallback,
                       uint8_t* squeezed, int32_t* n_slots,
@@ -1360,10 +1366,10 @@ void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
 }
 
 // Dense [B, L] resolved slots -> flat ragged [n_shards, N] wire.
-void ldt_flatten_resolved(const uint16_t* idx, const uint8_t* chk,
+void ldt_flatten_resolved(const uint16_t* idx, const uint16_t* chk,
                           const int32_t* n_slots, int32_t B, int32_t L,
                           int32_t n_shards, int32_t N,
-                          uint16_t* idx_flat, uint8_t* chk_flat,
+                          uint16_t* idx_flat, uint16_t* chk_flat,
                           int32_t* doc_start) {
   int Bd = B / n_shards;
   for (int d = 0; d < n_shards; d++) {
@@ -1375,7 +1381,7 @@ void ldt_flatten_resolved(const uint16_t* idx, const uint8_t* chk,
       std::memcpy(idx_flat + (int64_t)d * N + pos, idx + (int64_t)b * L,
                   (size_t)n * sizeof(uint16_t));
       std::memcpy(chk_flat + (int64_t)d * N + pos, chk + (int64_t)b * L,
-                  (size_t)n);
+                  (size_t)n * sizeof(uint16_t));
       pos += n;
     }
   }

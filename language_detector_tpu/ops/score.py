@@ -72,8 +72,8 @@ def _lscript4(script):
 # The native packer (packer.cc ldt_pack_resolve) performs the table probes,
 # quad repeat cache, chunk assignment, and distinct-boost rotation on the
 # HOST (the tables are a few MB and cache-resident there), so the wire
-# carries only resolved hits — 3 bytes per slot (u16 index into the
-# concatenated indirect array + u8 doc-local chunk id) instead of 8, and
+# carries only resolved hits — 3-4 bytes per slot (u16 index into the
+# concatenated indirect array + u8/u16 doc-local chunk id) instead of 8, and
 # misses never cross the host->device link. The device keeps the dense
 # numeric core that actually benefits from the MXU: langprob decode,
 # per-chunk totes as one-hot matmuls, masked top-2, and the reliability
@@ -96,7 +96,7 @@ def score_resolved_impl(dt: DeviceTables, p: dict):
 
     p (built by models/ngram.py from ldt_pack_resolve):
       idx       [S, N]  u16  cat_ind2 index per resolved hit
-      chk       [S, N]  u8   doc-local chunk id
+      chk       [S, N]  u8/u16  doc-local chunk id
       doc_start [B]     i32  doc's first slot (shard-local)
       n_slots   [B]     i32
       cmeta     [B, C]  u32  chunk meta (see CM2_* layout)

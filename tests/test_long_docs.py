@@ -58,3 +58,22 @@ def test_detect_many_routes_long_docs():
         s = detect_scalar(d, eng.tables, eng.reg)
         assert (r.summary_lang, r.percent3) == \
             (s.summary_lang, s.percent3), d[:60]
+
+
+def test_single_script_60kb_on_device():
+    """A long single-SCRIPT document (one span chain, hundreds of chunks)
+    exceeds the old u8 chunk lane; the u16 lane keeps it on the device."""
+    texts = _texts()
+    latin = [t for t in texts if max(t.encode("utf-8", "replace")) < 0xD0
+             or all(ord(c) < 0x500 for c in t)]
+    doc = " ".join((latin or texts) * 3)[:60000]
+    eng = NgramBatchEngine(max_slots=32768, max_chunks=2048)
+    rb = eng._pack([doc], eng.tables, eng.reg, max_slots=eng.max_slots,
+                   max_chunks=eng.max_chunks, flags=eng.flags)
+    assert int(rb.n_chunks.max()) > 256, \
+        "document must overflow the u8 chunk lane to pin the regression"
+    rs = eng.detect_batch([doc])
+    assert eng.stats["fallback_docs"] == 0
+    s = detect_scalar(doc, eng.tables, eng.reg)
+    assert (rs[0].summary_lang, rs[0].language3, rs[0].percent3) == \
+        (s.summary_lang, s.language3, s.percent3)
