@@ -178,9 +178,12 @@ class NgramBatchEngine:
     LONG_DOC_BYTES = 1536
     _LONG_SLOTS = 32768
     _LONG_CHUNKS = 2048
-    # small batches: the [B, C, L] one-hot chunk matrix at the wide
-    # buckets (C=2048, L=32768) costs B * 128MB in bf16 on device
-    _LONG_BATCH = 16
+    # mid-length docs (to ~8KB) bucket to modest L/C: decent batches are
+    # safe; past that the [B, C, L] one-hot chunk matrix at the wide
+    # buckets (C=2048, L=32768) costs B * 128MB bf16, so batches shrink
+    _HUGE_DOC_BYTES = 8192
+    _LONG_BATCH = 64
+    _HUGE_BATCH = 16
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list[ScalarResult]:
@@ -203,10 +206,25 @@ class NgramBatchEngine:
         results: list = [None] * len(texts)
         short_res = self._detect_many_uniform(short, batch_size) if short \
             else []
-        long_res = self._long_engine()._detect_many_uniform(
-            [texts[i] for i in long_idx], self._LONG_BATCH)
+        longs = [texts[i] for i in long_idx]
+        eng = self._long_engine()
+        mid = [t for t in longs
+               if len(t.encode("utf-8", "surrogatepass")) <=
+               self._HUGE_DOC_BYTES]
+        huge = [t for t in longs
+                if len(t.encode("utf-8", "surrogatepass")) >
+                self._HUGE_DOC_BYTES]
+        rs = eng._detect_many_uniform(mid, self._LONG_BATCH) + \
+            eng._detect_many_uniform(huge, self._HUGE_BATCH)
+        mid_it = iter(rs[:len(mid)])
+        huge_it = iter(rs[len(mid):])
         for j, i in enumerate(long_idx):
-            results[i] = long_res[j]
+            t = texts[i]
+            if len(t.encode("utf-8", "surrogatepass")) <= \
+                    self._HUGE_DOC_BYTES:
+                results[i] = next(mid_it)
+            else:
+                results[i] = next(huge_it)
         si = 0
         for i in range(len(texts)):
             if i not in long_set:
