@@ -76,18 +76,24 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
     eng.detect_batch(docs[:batch_size])
 
     # Sustained pipelined throughput (pack N+1 overlaps device-score N).
-    # Best of 3 runs: the shared host fluctuates +-25%, and the best run
-    # is the least-interfered measurement of the pipeline itself.
-    t_e2e = float("inf")
+    # Headline = best of 3 runs: the shared host fluctuates +-25%, and the
+    # best run is the least-interfered measurement of the pipeline itself
+    # (NOT sustained throughput); the median is reported alongside so
+    # cross-round comparisons stay honest.
+    runs = []
     for _ in range(3):
         t0 = time.time()
         results = eng.detect_many(stream, batch_size=batch_size)
-        t_e2e = min(t_e2e, (time.time() - t0) / n_batches)
+        runs.append((time.time() - t0) / n_batches)
+    t_e2e = min(runs)
+    t_e2e_med = sorted(runs)[len(runs) // 2]
 
     # Stage split (one batch, serial, informational)
     t0 = time.time()
     packed = eng._pack(docs, eng.tables, eng.reg, flags=eng.flags)
     t_pack = time.time() - t0
+    # snapshot before later pooled packs can recycle this batch's buffers
+    n_fallback = int(packed.fallback.sum())
     t0 = time.time()
     p = to_wire(packed, eng.max_slots, eng.max_chunks)
     t_wire = time.time() - t0
@@ -112,14 +118,16 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
     eng.detect_many(mixed, batch_size=batch_size)  # warm retry/long shapes
     eng.stats["fallback_docs"] = 0
     eng.stats["scalar_recursion_docs"] = 0
-    t_mixed = float("inf")
-    for _ in range(2):
+    mruns = []
+    for _ in range(3):
         t0 = time.time()
         eng.detect_many(mixed, batch_size=batch_size)
-        t_mixed = min(t_mixed, time.time() - t0)
+        mruns.append(time.time() - t0)
+    t_mixed = min(mruns)
     mixed_docs_sec = batch_size / t_mixed
-    mixed_fallback = eng.stats["fallback_docs"] // 2
-    mixed_retried = eng.stats["scalar_recursion_docs"] // 2  # per pass
+    mixed_docs_sec_med = batch_size / sorted(mruns)[len(mruns) // 2]
+    mixed_fallback = eng.stats["fallback_docs"] // 3
+    mixed_retried = eng.stats["scalar_recursion_docs"] // 3  # per pass
 
     docs_sec = len(stream) / (t_e2e * n_batches)
     return dict(
@@ -137,8 +145,10 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
             score_ms=round(t_score * 1e3, 1),
             epilogue_ms=round(t_epi * 1e3, 1),
             e2e_ms_per_batch=round(t_e2e * 1e3, 1),
-            fallback_docs=int(packed.fallback.sum()),
+            docs_sec_median=round(len(docs) / t_e2e_med, 1),
+            fallback_docs=n_fallback,
             mixed_docs_sec=round(mixed_docs_sec, 1),
+            mixed_docs_sec_median=round(mixed_docs_sec_med, 1),
             mixed_fallback_docs=int(mixed_fallback),
             mixed_retried_docs=int(mixed_retried),
             summary_sample=results[0].summary_lang,

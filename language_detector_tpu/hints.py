@@ -462,7 +462,7 @@ def apply_hints(text: str, is_plain_text: bool, hints: CLDHints | None,
             continue
         cs = reg.close_set(lang)
         if cs > 0 and close_count.get(cs) == 1:
-            for lang2 in range(len(reg.lang_to_plang)):
+            for lang2 in range(reg.num_languages):
                 if lang2 != lang and reg.close_set(lang2) == cs:
                     add_whack(lang, lang2)
     return hb

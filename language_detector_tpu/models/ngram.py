@@ -355,14 +355,19 @@ class NgramBatchEngine:
         """One device pass with explicit flags (the gate-failure retry;
         FINISH forces the gate so no further recursion happens). Docs the
         packer cannot place fall back to the scalar engine with the
-        engine's own flags, exactly like a first-pass fallback."""
+        engine's own flags, exactly like a first-pass fallback.
+
+        Packs WITHOUT the engine buffer pool: retries run on detect_many's
+        worker threads while the pipeline holds up to RING same-shape
+        batches alive, so a pooled retry pack could recycle a still
+        in-flight batch's buffers mid-transfer."""
         from .. import native
         bsz = _next_pow2(len(texts))
         bsz += -bsz % self._mesh_size
         padded = list(texts) + [""] * (bsz - len(texts))
-        packed = self._pack(padded, self.tables, self.reg,
-                            max_slots=self.max_slots,
-                            max_chunks=self.max_chunks, flags=flags)
+        packed = native.pack_resolve_native(
+            padded, self.tables, self.reg, max_slots=self.max_slots,
+            max_chunks=self.max_chunks, flags=flags, pool=None)
         out = self.score_packed(packed)
         ep = native.epilogue_batch_native(
             out, packed.direct_adds, packed.text_bytes, packed.fallback,

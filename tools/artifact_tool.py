@@ -37,7 +37,7 @@ FORMAT_VERSION = 1
 NGRAM_PREFIXES = {
     "cld2_tables.npz": ("deltaocta", "distinctocta", "cjkdeltabi",
                         "distinctbi", "cjkcompat"),
-    "quad_tables.npz": ("quadgram",),
+    "quad_tables.npz": ("quadgram", "quadgram2"),
 }
 
 
@@ -68,6 +68,10 @@ def check_structure(path: Path) -> list[str]:
         missing = [k for k in ("meta", "buckets", "ind")
                    if f"{prefix}_{k}" not in z.files]
         if missing:
+            # the dual quad table (quadgram2, primary-bucket spill) is
+            # optional: absent entirely is fine, partially present is not
+            if prefix == "quadgram2" and len(missing) == 3:
+                continue
             err(f"missing {', '.join(f'{prefix}_{k}' for k in missing)}")
             continue
         meta = z[f"{prefix}_meta"]
