@@ -50,9 +50,9 @@ def make_corpus(n: int) -> list:
 
 def make_mixed_corpus(n: int) -> list:
     """Realistic traffic mix: service-sized docs plus a spam tail (1%
-    squeeze-trigger documents -> scalar fallback), 2% long documents
-    (3-8KB, routed to the wide-slot engine), and 1% degenerate inputs.
-    Measures what the clean corpus cannot: fallback and long-doc cost."""
+    squeeze-trigger documents), 2% long documents (3-8KB), and 1%
+    degenerate inputs. Measures what the clean corpus cannot: squeeze,
+    retry, and long-doc cost."""
     docs = make_corpus(n)
     for i in range(0, n, 100):            # 1% spam -> squeeze fallback
         docs[i] = ("buy cheap now " * 300).strip()
@@ -65,7 +65,7 @@ def make_mixed_corpus(n: int) -> list:
 
 
 def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
-    from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
+    from language_detector_tpu.models.ngram import NgramBatchEngine
 
     eng = NgramBatchEngine()
     docs = make_corpus(batch_size)
@@ -88,27 +88,22 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
     t_e2e = min(runs)
     t_e2e_med = sorted(runs)[len(runs) // 2]
 
-    # Stage split (one batch, serial, informational)
+    # Stage split (one batch, serial, informational). pack_ms includes
+    # the wire layout (the flat pack's begin+finish phases).
+    from language_detector_tpu import native
     t0 = time.time()
-    packed = eng._pack(docs, eng.tables, eng.reg, flags=eng.flags)
+    cb = native.pack_chunks_native(docs, eng.tables, eng.reg,
+                                   flags=eng.flags)
     t_pack = time.time() - t0
-    # snapshot before later pooled packs can recycle this batch's buffers
-    n_fallback = int(packed.fallback.sum())
-    t0 = time.time()
-    p = to_wire(packed, eng.max_slots, eng.max_chunks)
-    t_wire = time.time() - t0
+    n_fallback = int(cb.fallback.sum())
     t0 = time.time()
     import numpy as np
-    from language_detector_tpu.ops.score import unpack_resolved_out
-    out = unpack_resolved_out(np.asarray(eng._score_fn(eng.dt, p)),
-                              p["cmeta"])
+    from language_detector_tpu.ops.score import unpack_chunks_out
+    rows = unpack_chunks_out(np.asarray(eng._score_fn(eng.dt, cb.wire)),
+                             cb.wire["cmeta"])
     t_score = time.time() - t0
     t0 = time.time()
-    if _native_ok():
-        eng._epilogue_native(docs, packed, out)
-    else:  # time the path detect_many actually takes without the library
-        for b in range(len(docs)):
-            eng._doc_epilogue(packed, out, b)
+    native.epilogue_flat_native(rows, cb, eng.flags, eng.reg)
     t_epi = time.time() - t0
 
     # Mixed-traffic run (spam/long/degenerate tail): reported in detail so
@@ -141,7 +136,6 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
             doc_bytes_avg=round(total_bytes / len(stream), 1),
             mb_sec=round(total_bytes / (t_e2e * n_batches) / 1e6, 2),
             pack_ms=round(t_pack * 1e3, 1),
-            wire_ms=round(t_wire * 1e3, 1),
             score_ms=round(t_score * 1e3, 1),
             epilogue_ms=round(t_epi * 1e3, 1),
             e2e_ms_per_batch=round(t_e2e * 1e3, 1),
@@ -156,9 +150,6 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
     )
 
 
-def _native_ok() -> bool:
-    from language_detector_tpu import native
-    return native.available()
 
 
 if __name__ == "__main__":

@@ -3,33 +3,35 @@
 Pipeline per batch (the TPU redesign of DetectLanguageSummaryV2,
 compact_lang_det_impl.cc:1707-2106):
 
-  host   pack_resolve    texts -> resolved hit wire (C++: segmentation,
-                         hashing, table probes, repeat cache, chunking)
-  device score_resolved  langprob decode + chunk totes + top-2 + reliability
-  host   _doc_epilogue   DocTote replay + close pairs + unreliable removal +
-                         summary language (O(1) per doc, scalar-exact)
+  host   pack_chunks     texts -> chunk-major flat wire (C++: segmentation,
+                         hashing, table probes, repeat cache, chunk
+                         assignment, boost rotation — packer.cc)
+  device score_chunks    langprob decode + chunk totes + top-2 + reliability
+                         over a [G, K] chunk grid with NO document axis
+  host   epilogue_flat   DocTote replay + close pairs + unreliable removal +
+                         summary language (C++: epilogue.cc, O(1) per doc)
 
-Documents the packer flags (squeeze triggers, slot overflow) and documents
-failing the recursion gate (impl.cc:1978-1991) fall back to the scalar
-engine, which performs the reference's re-score recursion. Everything else
-is batched: the result agrees with `detect_scalar` on every document
+The wire is chunk-major: every document's chunks are rows of one flat
+grid, so a single dispatch freely mixes 100-byte tweets with 100KB
+documents — device cost is linear in total text, never quadratic in
+document length (the round-3 wide-slot engine's [B, C, L] cliff is gone,
+and with it the size-class routing).
+
+Documents the packer flags (per-doc budget overflow, adversarially fat
+chunks) fall back to the scalar engine; documents failing the good-answer
+gate (impl.cc:1978-1991) re-score as a batch with the recursion flags.
+Everything agrees with `detect_scalar` on every document
 (tests/test_batch_agreement.py).
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
-                             FLAG_SHORT, FLAG_SQUEEZE, FLAG_TOP40,
-                             FLAG_USE_WORDS,
-                             GOOD_LANG1_PERCENT, GOOD_LANG1AND2_PERCENT,
-                             SHORT_TEXT_THRESH, DocTote, ScalarResult,
-                             calc_summary_lang, detect_scalar,
-                             extract_lang_etc, refine_close_pairs,
-                             remove_unreliable)
+                             FLAG_SQUEEZE, FLAG_TOP40,
+                             ScalarResult, detect_scalar)
 from ..ops.device_tables import DeviceTables
-from ..ops.score import score_resolved, unpack_resolved_out
+from ..ops.score import score_chunks, unpack_chunks_out
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
@@ -38,83 +40,30 @@ from ..tables import ScoringTables, load_tables
 # cheap_rep_words_inplace); TOP40/SHORT/USE_WORDS are vestigial in this
 # CLD2 version (set by the recursion, read nowhere). Anything else
 # (score-as-quads) routes the batch to the scalar engine.
+from ..engine_scalar import FLAG_SHORT, FLAG_USE_WORDS
+
 _DEVICE_OK_FLAGS = (FLAG_FINISH | FLAG_BEST_EFFORT | FLAG_SQUEEZE |
                     FLAG_REPEATS | FLAG_TOP40 | FLAG_SHORT |
                     FLAG_USE_WORDS)
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
-
-
-def _bucket(n: int, lo: int, hi: int) -> int:
-    """Smallest power-of-two >= n within [lo, hi] (shape bucketing: a small
-    set of compiled programs covers every batch)."""
-    b = lo
-    while b < n and b < hi:
-        b <<= 1
-    return b
-
-
-def to_wire(rb, max_slots: int, max_chunks: int, n_shards: int = 1) -> dict:
-    """ResolvedBatch -> flat ragged device wire (see score_resolved_impl):
-    3-4 bytes per RESOLVED hit (u16 cat_ind2 index + doc-local chunk id,
-    u8 when the chunk budget fits, u16 for long single-script documents)
-    + 5 bytes per chunk + 8 per doc. Misses, offsets, and fingerprints
-    never cross the host->device link — the native packer already probed
-    the tables, ran the quad repeat cache, assigned chunks, and rotated
-    the distinct-boost lists (packer.cc ldt_pack_resolve).
-
-    n_shards: leading shard axis size for shard_map data parallelism; docs
-    split into contiguous equal groups, each flattened separately with
-    shard-local doc_start offsets (parallel/mesh.py shards every leaf on
-    axis 0)."""
-    B, Lfull = rb.idx.shape
-    assert B % n_shards == 0, (B, n_shards)
-    assert max_chunks <= 0xFFFF, "chunk ids must fit the u16 wire lane"
-    used_slots = max(int(rb.n_slots.max(initial=1)), 1)
-    used_chunks = max(int(rb.n_chunks.max(initial=1)), 1)
-    L = _bucket(used_slots, 64, max_slots)
-    C = _bucket(used_chunks, 8, max_chunks)
-
-    D = n_shards
-    Bd = B // D
-    n_slots = rb.n_slots.astype(np.int32)
-    per_shard_total = n_slots.reshape(D, Bd).sum(axis=1)
-    # 32K-slot granularity: resolved slots are ~36/doc, so power-of-two
-    # bucketing would ship up to 2x padding over the slow host->device
-    # link; 32K steps cap waste at ~96KB while keeping the compiled
-    # program set small
-    N = max(4096, -int(per_shard_total.max()) // 32768 * -32768)
-
-    from .. import native
-    wire = native.flatten_resolved_native(rb, D, N)
-    if C <= 256:
-        # common case: chunk ids fit u8 — halve that wire lane (the u16
-        # lane exists for long single-script documents, C up to 2048)
-        wire["chk"] = wire["chk"].astype(np.uint8)
-    wire["cmeta"] = np.ascontiguousarray(rb.cmeta[:, :C])
-    wire["cscript"] = np.ascontiguousarray(rb.cscript[:, :C])
-    wire["l_iota"] = np.zeros(L, np.uint8)
-    return wire
 
 
 class NgramBatchEngine:
     """Batched detector over a table artifact.
 
-    Batches are padded to power-of-two document counts so jit compiles a
-    small, reusable set of programs (static [B, L] shapes).
+    The compiled device program's shape depends only on content volume
+    (slot/chunk/fattest-chunk buckets), never on batch size or document
+    length — one small program set serves every traffic mix.
     """
 
     def __init__(self, tables: ScoringTables | None = None,
                  reg: Registry | None = None, flags: int = 0,
-                 max_slots: int = 2048, max_chunks: int = 64,
+                 max_slots: int = 1 << 17, max_chunks: int = 1 << 14,
                  mesh=None):
-        """mesh: optional jax.sharding.Mesh with a "batch" axis; when given,
-        batches shard over it data-parallel (parallel/mesh.py) and the
-        batch size rounds up to a multiple of the mesh size."""
+        """max_slots / max_chunks: PER-DOCUMENT budgets (packer scratch);
+        a document exceeding either falls back to the scalar engine. The
+        defaults admit ~100KB documents. mesh: optional jax.sharding.Mesh
+        with a "batch" axis; when given, the chunk grid shards over it
+        data-parallel and batches pad to a multiple of the mesh size."""
         self.tables = tables or load_tables()
         self.reg = reg or default_registry
         self.flags = flags
@@ -123,13 +72,13 @@ class NgramBatchEngine:
         self.dt = DeviceTables.from_host(self.tables, self.reg)
         self.mesh = mesh
         if mesh is not None:
-            from ..parallel.mesh import BATCH_AXIS, sharded_score_fn
-            self._score_fn = sharded_score_fn(mesh)
+            from ..parallel.mesh import BATCH_AXIS, sharded_score_chunks_fn
+            self._score_fn = sharded_score_chunks_fn(mesh)
             # wire shards over the batch axis only; any extra mesh axes
             # (e.g. a vestigial "model" axis) replicate
             self._mesh_size = mesh.shape[BATCH_AXIS]
         else:
-            self._score_fn = score_resolved
+            self._score_fn = score_chunks
             self._mesh_size = 1
         from .. import native
         if not native.available():
@@ -137,15 +86,9 @@ class NgramBatchEngine:
                 "batched engine requires the native packer "
                 "(language_detector_tpu/native/build.sh); "
                 "use detect_scalar without it")
-        # engine-owned buffer pool: rotation is safe because only this
-        # engine's pipeline (<= 4 in-flight batches) uses it
-        self._buf_pool = native.BufferPool()
-        import functools
-        self._pack = functools.partial(native.pack_resolve_native,
-                                       pool=self._buf_pool)
         # Running totals for observability (service /metrics): batches
         # scored, packer-fallback docs, and docs that failed the
-        # good-answer gate into the scalar recursion
+        # good-answer gate into the batched recursion
         self.stats = {"batches": 0, "fallback_docs": 0,
                       "scalar_recursion_docs": 0}
         import threading
@@ -153,15 +96,21 @@ class NgramBatchEngine:
 
     # -- device dispatch ----------------------------------------------------
 
-    def score_packed(self, rb) -> np.ndarray:
-        """Run the jitted device program over a ResolvedBatch; returns the
-        [B, C, 5] stacked chunk-summary array on host."""
-        p = to_wire(rb, self.max_slots, self.max_chunks,
-                    n_shards=self._mesh_size)
-        out = np.asarray(self._score_fn(self.dt, p))
-        return unpack_resolved_out(out, p["cmeta"])
+    def score_chunk_batch(self, cb) -> np.ndarray:
+        """Run the jitted device program over a ChunkBatch; returns the
+        flat [G, 5] chunk-summary rows on host (test/debug seam)."""
+        out = np.asarray(self._score_fn(self.dt, cb.wire))
+        return unpack_chunks_out(out, cb.wire["cmeta"])
 
     # -- public API ---------------------------------------------------------
+
+    # Per-dispatch content budget (chars; bytes <= 4x): device memory is
+    # linear in total chunk rows (~1KB/row for the [G, 256] tote
+    # accumulator plus decode intermediates), so slices bound TEXT VOLUME
+    # as well as document count — a batch of 100KB documents splits into
+    # several dispatches instead of one HBM-exhausting grid. 6M chars ~
+    # 100-160K chunk rows ~ 100-200MB peak per dispatch.
+    DISPATCH_CHAR_BUDGET = 6 << 20
 
     def detect_batch(self, texts: list[str]) -> list[ScalarResult]:
         if not texts:
@@ -169,172 +118,94 @@ class NgramBatchEngine:
         if self.flags & ~_DEVICE_OK_FLAGS:
             return [detect_scalar(t, self.tables, self.reg, self.flags)
                     for t in texts]
-        packed, fut = self._dispatch(texts)
-        return self._finish(texts, packed, fut)
-
-    # documents longer than this route to a wide-slot engine (few, large
-    # batches) so they stay on the device instead of overflowing the
-    # standard slot budget into the scalar fallback
-    LONG_DOC_BYTES = 1536
-    _LONG_SLOTS = 32768
-    _LONG_CHUNKS = 2048
-    # mid-length docs (to ~8KB) bucket to modest L/C: decent batches are
-    # safe; past that the [B, C, L] one-hot chunk matrix at the wide
-    # buckets (C=2048, L=32768) costs B * 128MB bf16, so batches shrink
-    _HUGE_DOC_BYTES = 8192
-    _LONG_BATCH = 64
-    _HUGE_BATCH = 16
+        if sum(len(t) for t in texts) > self.DISPATCH_CHAR_BUDGET:
+            return self.detect_many(texts, batch_size=len(texts))
+        cb, fut = self._dispatch(texts)
+        return self._finish(texts, cb, fut)
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list[ScalarResult]:
         """Multi-batch detection with host/device pipelining: the main
         thread packs + dispatches batch N+1 while pool workers force
         batch N's device execution and run its epilogue (both the C++
-        pack and epilogue release the GIL). Long documents split off to
-        a wide-slot sibling engine in small batches. Sustained-throughput
-        entry point for the service layer and bench."""
+        pack and epilogue release the GIL). Sustained-throughput entry
+        point for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
-        long_idx = [i for i, t in enumerate(texts)
-                    if len(t) > self.LONG_DOC_BYTES // 4 and
-                    len(t.encode("utf-8", "surrogatepass")) >
-                    self.LONG_DOC_BYTES]
-        if not long_idx:
-            return self._detect_many_uniform(texts, batch_size)
-        long_set = set(long_idx)
-        short = [t for i, t in enumerate(texts) if i not in long_set]
-        results: list = [None] * len(texts)
-        short_res = self._detect_many_uniform(short, batch_size) if short \
-            else []
-        longs = [texts[i] for i in long_idx]
-        eng = self._long_engine()
-        mid = [t for t in longs
-               if len(t.encode("utf-8", "surrogatepass")) <=
-               self._HUGE_DOC_BYTES]
-        huge = [t for t in longs
-                if len(t.encode("utf-8", "surrogatepass")) >
-                self._HUGE_DOC_BYTES]
-        rs = eng._detect_many_uniform(mid, self._LONG_BATCH) + \
-            eng._detect_many_uniform(huge, self._HUGE_BATCH)
-        mid_it = iter(rs[:len(mid)])
-        huge_it = iter(rs[len(mid):])
-        for j, i in enumerate(long_idx):
-            t = texts[i]
-            if len(t.encode("utf-8", "surrogatepass")) <= \
-                    self._HUGE_DOC_BYTES:
-                results[i] = next(mid_it)
-            else:
-                results[i] = next(huge_it)
-        si = 0
-        for i in range(len(texts)):
-            if i not in long_set:
-                results[i] = short_res[si]
-                si += 1
-        return results
-
-    def _detect_many_uniform(self, texts: list[str],
-                             batch_size: int) -> list[ScalarResult]:
-        if not texts:
-            return []
         from concurrent.futures import ThreadPoolExecutor
         results: list[ScalarResult] = []
         pending: list = []
-        # two workers: batch N's device fetch + epilogue overlap batch
-        # N+1's C++ packing on the main thread (both release the GIL)
-        with ThreadPoolExecutor(2) as pool:
-            for i in range(0, len(texts), batch_size):
-                chunk = texts[i:i + batch_size]
-                packed, fut = self._dispatch(chunk)
-                pending.append(pool.submit(self._finish, chunk, packed,
-                                           fut))
-                while len(pending) > 2:
+        # workers force device fetches + run epilogues + batched retries
+        # concurrently with the main thread's C++ packing (all release
+        # the GIL); depth 3 keeps the device queue full across the
+        # ~95ms dispatch latency of this host's TPU tunnel
+        with ThreadPoolExecutor(3) as pool:
+            for chunk in self._slices(texts, batch_size):
+                cb, fut = self._dispatch(chunk)
+                pending.append(pool.submit(self._finish, chunk, cb, fut))
+                while len(pending) > 3:
                     results.extend(pending.pop(0).result())
             for f in pending:
                 results.extend(f.result())
         return results
 
-    def _long_engine(self) -> "NgramBatchEngine":
-        if getattr(self, "_long_eng", None) is None:
-            self._long_eng = NgramBatchEngine(
-                self.tables, self.reg, self.flags,
-                max_slots=self._LONG_SLOTS, max_chunks=self._LONG_CHUNKS,
-                mesh=self.mesh)
-            # surface the sibling's counters through this engine's stats
-            self._long_eng.stats = self.stats
-            self._long_eng._stats_lock = self._stats_lock
-        return self._long_eng
+    def _slices(self, texts: list[str], batch_size: int):
+        """Greedy batch slicing by document count AND content volume
+        (DISPATCH_CHAR_BUDGET), preserving order; every slice holds at
+        least one document."""
+        out: list[str] = []
+        vol = 0
+        for t in texts:
+            if out and (len(out) >= batch_size or
+                        vol + len(t) > self.DISPATCH_CHAR_BUDGET):
+                yield out
+                out, vol = [], 0
+            out.append(t)
+            vol += len(t)
+        if out:
+            yield out
 
-    def _dispatch(self, texts: list[str]):
+    def _dispatch(self, texts: list[str], flags: int | None = None):
         """Pack + launch the device program asynchronously; returns
-        (packed, (cmeta, device future))."""
-        bsz = _next_pow2(len(texts))
-        bsz += -bsz % self._mesh_size  # divisible over the mesh axis
-        padded = list(texts) + [""] * (bsz - len(texts))
-        packed = self._pack(padded, self.tables, self.reg,
-                            max_slots=self.max_slots,
-                            max_chunks=self.max_chunks, flags=self.flags)
-        p = to_wire(packed, self.max_slots, self.max_chunks,
-                    n_shards=self._mesh_size)
-        return packed, (p["cmeta"], self._score_fn(self.dt, p))
+        (ChunkBatch, device future)."""
+        from .. import native
+        fl = self.flags if flags is None else flags
+        pad = -len(texts) % self._mesh_size
+        padded = list(texts) + [""] * pad if pad else texts
+        cb = native.pack_chunks_native(
+            padded, self.tables, self.reg, flags=fl,
+            n_shards=self._mesh_size, l_doc=self.max_slots,
+            c_doc=self.max_chunks)
+        return cb, self._score_fn(self.dt, cb.wire)
 
-    def _finish(self, texts: list[str], packed,
-                fut) -> list[ScalarResult]:
-        """Fetch the device result ((cmeta, device array)) and run the
-        document epilogue. Runs on detect_many's worker pool, so stats
+    def _finish(self, texts: list[str], cb, fut) -> list[ScalarResult]:
+        """Fetch the device result and run the document epilogue. Docs
+        that fail the good-answer gate re-score as a BATCH with the
+        recursion flags (TOP40|REPEATS|FINISH, plus SQUEEZE for docs
+        whose first pass squeezed) — the reference's recursive
+        DetectLanguageSummaryV2 call (impl.cc:2061-2105) run on the
+        device instead of per-doc in the scalar engine. Packer-fallback
+        docs stay scalar. Runs on detect_many's worker pool, so stats
         updates take the lock."""
-        cmeta, dev = fut
-        out = unpack_resolved_out(np.asarray(dev), cmeta)
+        from .. import native
+        rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
         with self._stats_lock:
             self.stats["batches"] += 1
-            self.stats["fallback_docs"] += int(packed.fallback.sum())
-        from .. import native
-        if native.available():
-            return self._epilogue_native(texts, packed, out)
-        results = []
-        for b, text in enumerate(texts):
-            if packed.fallback[b]:
-                results.append(detect_scalar(text, self.tables, self.reg,
-                                             self.flags))
-                continue
-            r = self._doc_epilogue(packed, out, b)
-            if r is None:  # failed the good-answer gate: scalar recursion
-                with self._stats_lock:
-                    self.stats["scalar_recursion_docs"] += 1
-                r = detect_scalar(text, self.tables, self.reg, self.flags)
-            results.append(r)
-        return results
-
-    def _epilogue_native(self, texts: list[str], packed,
-                         out: np.ndarray) -> list[ScalarResult]:
-        """Batched C++ epilogue (native/epilogue.cc). Docs that fail the
-        good-answer gate re-score as a BATCH with the recursion flags
-        (TOP40|REPEATS|FINISH, plus SQUEEZE for docs whose first pass
-        squeezed) -- the reference's recursive DetectLanguageSummaryV2
-        call (impl.cc:2061-2105) run on the device instead of per-doc in
-        the scalar engine. Packer-fallback docs stay scalar."""
-        from .. import native
-        ep = native.epilogue_batch_native(
-            out, packed.direct_adds, packed.text_bytes, packed.fallback,
-            self.flags, self.reg)
+            self.stats["fallback_docs"] += int(cb.fallback[:len(texts)]
+                                               .sum())
+        ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
         results: list = [None] * len(texts)
         retry = {False: [], True: []}  # squeezed? -> [(index, text)]
         for b, text in enumerate(texts):
             row = ep[b]
             if row[12]:  # need_scalar: fallback or gate failure
-                if packed.fallback[b]:
+                if cb.fallback[b]:
                     results[b] = detect_scalar(text, self.tables, self.reg,
                                                self.flags)
                 else:
-                    retry[bool(packed.squeezed[b])].append((b, text))
+                    retry[bool(cb.squeezed[b])].append((b, text))
                 continue
-            results[b] = ScalarResult(
-                summary_lang=int(row[0]),
-                language3=[int(row[1]), int(row[2]), int(row[3])],
-                percent3=[int(row[4]), int(row[5]), int(row[6])],
-                normalized_score3=[float(row[7]), float(row[8]),
-                                   float(row[9])],
-                text_bytes=int(row[10]),
-                is_reliable=bool(row[11]))
+            results[b] = _result_from_row(row)
         n_retry = len(retry[False]) + len(retry[True])
         if n_retry:
             with self._stats_lock:
@@ -355,81 +226,28 @@ class NgramBatchEngine:
         """One device pass with explicit flags (the gate-failure retry;
         FINISH forces the gate so no further recursion happens). Docs the
         packer cannot place fall back to the scalar engine with the
-        engine's own flags, exactly like a first-pass fallback.
-
-        Packs WITHOUT the engine buffer pool: retries run on detect_many's
-        worker threads while the pipeline holds up to RING same-shape
-        batches alive, so a pooled retry pack could recycle a still
-        in-flight batch's buffers mid-transfer."""
+        engine's own flags, exactly like a first-pass fallback."""
         from .. import native
-        bsz = _next_pow2(len(texts))
-        bsz += -bsz % self._mesh_size
-        padded = list(texts) + [""] * (bsz - len(texts))
-        packed = native.pack_resolve_native(
-            padded, self.tables, self.reg, max_slots=self.max_slots,
-            max_chunks=self.max_chunks, flags=flags, pool=None)
-        out = self.score_packed(packed)
-        ep = native.epilogue_batch_native(
-            out, packed.direct_adds, packed.text_bytes, packed.fallback,
-            flags, self.reg)
+        cb, fut = self._dispatch(texts, flags=flags)
+        rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+        ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
         results = []
         for b, text in enumerate(texts):
             row = ep[b]
-            if packed.fallback[b] or row[12]:
+            if cb.fallback[b] or row[12]:
                 results.append(detect_scalar(text, self.tables, self.reg,
                                              self.flags))
                 continue
-            results.append(ScalarResult(
-                summary_lang=int(row[0]),
-                language3=[int(row[1]), int(row[2]), int(row[3])],
-                percent3=[int(row[4]), int(row[5]), int(row[6])],
-                normalized_score3=[float(row[7]), float(row[8]),
-                                   float(row[9])],
-                text_bytes=int(row[10]),
-                is_reliable=bool(row[11])))
+            results.append(_result_from_row(row))
         return results
 
-    # -- exact host epilogue ------------------------------------------------
 
-    def _doc_epilogue(self, packed, out: np.ndarray,
-                      b: int) -> ScalarResult | None:
-        """DocTote replay in chunk-id (= span) order, then the document
-        post-processing pipeline, byte-identical to detect_scalar
-        (impl.cc:1956-2106). Returns None when the good-answer gate fails
-        and the reference would recurse."""
-        doc_tote = DocTote()
-        direct = {int(cid): (int(lang), int(nb))
-                  for cid, lang, nb in packed.direct_adds[b] if cid >= 0}
-        rows = out[b]  # [C, 5] lang1, bytes, score1, rel, real
-        for c in range(rows.shape[0]):
-            if c in direct:
-                lang, nb = direct[c]
-                doc_tote.add(lang, nb, nb, 100)
-            elif rows[c, 4]:
-                doc_tote.add(int(rows[c, 0]), int(rows[c, 1]),
-                             int(rows[c, 2]), int(rows[c, 3]))
-        total_text_bytes = int(packed.text_bytes[b])
-        flags = self.flags
-
-        refine_close_pairs(self.reg, doc_tote)
-        doc_tote.sort()
-        lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
-            doc_tote, total_text_bytes)
-
-        good = (flags & FLAG_FINISH) or total <= SHORT_TEXT_THRESH or \
-            (is_reliable and percent3[0] >= GOOD_LANG1_PERCENT) or \
-            (is_reliable and
-             percent3[0] + percent3[1] >= GOOD_LANG1AND2_PERCENT)
-        if not good:
-            return None
-
-        if not (flags & FLAG_BEST_EFFORT):
-            remove_unreliable(self.reg, doc_tote)
-        doc_tote.sort()
-        lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
-            doc_tote, total_text_bytes)
-        summary, reliable = calc_summary_lang(self.reg, lang3, percent3,
-                                              total, is_reliable, flags)
-        return ScalarResult(summary_lang=summary, language3=lang3,
-                            percent3=percent3, normalized_score3=ns3,
-                            text_bytes=total, is_reliable=reliable)
+def _result_from_row(row) -> ScalarResult:
+    """ldt_epilogue_flat [14]-lane row -> ScalarResult."""
+    return ScalarResult(
+        summary_lang=int(row[0]),
+        language3=[int(row[1]), int(row[2]), int(row[3])],
+        percent3=[int(row[4]), int(row[5]), int(row[6])],
+        normalized_score3=[float(row[7]), float(row[8]), float(row[9])],
+        text_bytes=int(row[10]),
+        is_reliable=bool(row[11]))

@@ -36,9 +36,10 @@ def _build() -> bool:
         return False
 
 
-_SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_epilogue_batch",
-            "ldt_init_tables", "ldt_pack_resolve", "ldt_flatten_resolved")
-_ABI_VERSION = 4  # must match packer.cc ldt_abi_version()
+_SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
+            "ldt_pack_flat_begin", "ldt_pack_flat_finish",
+            "ldt_pack_flat_free", "ldt_epilogue_flat")
+_ABI_VERSION = 5  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -52,6 +53,7 @@ def _try_load_all():
             return None
         for sym in _SYMBOLS:
             getattr(lib, sym).restype = None
+        lib.ldt_pack_flat_begin.restype = ctypes.c_int64
         return lib
     except (OSError, AttributeError):
         return None
@@ -202,104 +204,64 @@ def pack_batch_native(texts: list[str], tables: ScoringTables,
     return out
 
 
-# -- resolved-wire packing (packer.cc ldt_pack_resolve) ---------------------
+# -- chunk-major flat wire (packer.cc ldt_pack_flat_begin/finish) -----------
 
 
 @dataclasses.dataclass
-class ResolvedBatch:
-    """Host output of the resolve packer: dense per-doc resolved slots +
-    chunk metadata + everything the document epilogue needs."""
-    idx: np.ndarray          # [B, L] u16 cat_ind2 indices
-    chk: np.ndarray          # [B, L] u16 doc-local chunk ids
-    cmeta: np.ndarray        # [B, C] u32 cbytes|grams|side|real
-    cscript: np.ndarray      # [B, C] u8
-    direct_adds: np.ndarray  # [B, D, 3] i32
+class ChunkBatch:
+    """Chunk-major flat wire + the per-doc host arrays the epilogue needs.
+
+    The wire has NO document axis: all docs' resolved slots concatenate
+    into idx, chunks are rows addressed by (cstart, cnsl), and the device
+    program shape depends only on content volume (N slots, Gs chunks per
+    shard, K = fattest chunk) — never on batch size or document length.
+    """
+    wire: dict               # idx [D,N] u16; cstart [D,Gs] i32;
+                             # cnsl [D,Gs] u16; cmeta [D,Gs] u32;
+                             # cscript [D,Gs] u8; k_iota [K] u8
+    doc_chunk_start: np.ndarray  # [B] i64 first chunk row in flat [D*Gs]
+    direct_adds: np.ndarray  # [B, Dcap, 3] i32
     text_bytes: np.ndarray   # [B] i32
     fallback: np.ndarray     # [B] bool
-    squeezed: np.ndarray     # [B] bool: doc took the squeeze re-scan
-    n_slots: np.ndarray      # [B] i32
+    squeezed: np.ndarray     # [B] bool
+    n_slots: np.ndarray      # [B] i32 (0 for fallback docs)
     n_chunks: np.ndarray     # [B] i32
     n_docs: int = 0
 
 
-class BufferPool:
-    """Rotating output-buffer pool for pack_resolve_native.
-
-    The dense per-doc scratch is tens of MB per batch, and
-    freshly-allocated pages cost ~60ms of first-touch faults during the
-    C++ writes at B=8192; rotating warm buffer sets removes that.
-
-    Safety contract: the packer clears the cmeta/cscript/direct_adds row
-    tails it does not write; idx/chk rows are valid only up to
-    n_slots[b] (the wire flattener and every other consumer respect
-    that bound). A pool must be owned by ONE engine/pipeline: rotation
-    assumes at most RING batches of a shape are alive at once (the
-    detect_many pipeline holds <= 4). Shapes evict LRU beyond MAX_KEYS
-    so variable batch sizes cannot pin unbounded memory."""
-
-    RING = 4
-    MAX_KEYS = 4
-
-    def __init__(self):
-        self._rings: dict = {}
-        self._lock = __import__("threading").Lock()
-
-    def get(self, B: int, L: int, C: int, D: int) -> "ResolvedBatch":
-        key = (B, L, C, D)
-        with self._lock:
-            ring = self._rings.pop(key, None)
-            if ring is None:
-                ring = []
-                if len(self._rings) >= self.MAX_KEYS:
-                    # evict the least-recently-used shape entirely
-                    self._rings.pop(next(iter(self._rings)))
-            self._rings[key] = ring  # re-insert: dict order = LRU order
-            if len(ring) < self.RING:
-                rb = ResolvedBatch(
-                    idx=np.zeros((B, L), np.uint16),
-                    chk=np.zeros((B, L), np.uint16),
-                    cmeta=np.zeros((B, C), np.uint32),
-                    cscript=np.zeros((B, C), np.uint8),
-                    direct_adds=np.full((B, D, 3), -1, np.int32),
-                    text_bytes=np.zeros(B, np.int32),
-                    fallback=np.zeros(B, bool),
-                    squeezed=np.zeros(B, bool),
-                    n_slots=np.zeros(B, np.int32),
-                    n_chunks=np.zeros(B, np.int32),
-                    n_docs=B,
-                )
-                ring.append(rb)
-                return rb
-            rb = ring.pop(0)
-            ring.append(rb)
-            rb.n_docs = B
-            return rb
+def _bucket_step(n: int, step: int, lo: int) -> int:
+    """Shape bucket: powers of two from lo up to step, then multiples of
+    step — small batches get small programs, large batches bound padding
+    waste to one step, and the compiled program set stays small."""
+    n = max(n, 1)
+    if n >= step:
+        return -(-n // step) * step
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
 
 
-def pack_resolve_native(texts: list[str], tables: ScoringTables,
-                        reg: Registry, max_slots: int = 2048,
-                        max_chunks: int = 64, max_direct: int | None = None,
-                        flags: int = 0, n_threads: int = 0,
-                        pool: BufferPool | None = None) -> ResolvedBatch:
-    """texts -> resolved wire inputs (table probes, repeat filter, chunk
-    assignment, and distinct boosts all done in C++; see packer.cc).
+# K buckets: the slot axis of one chunk row. Slot counts concentrate at
+# 10-40; the ladder keeps padding compute <= 2x while capping the
+# program count at 4 per (N, Gs) shape.
+_K_BUCKETS = (32, 64, 128, 256)
 
-    max_direct defaults to max_chunks: every RTypeNone/One span consumes
-    one chunk and one direct-add row, so a tighter cap would just send
-    long multi-script documents to the scalar fallback.
 
-    pool: optional caller-owned BufferPool reusing warm output buffers
-    (the returned ResolvedBatch is then only valid until the pool cycles
-    back around — see BufferPool's contract). Without a pool, fresh
-    arrays are allocated per call."""
+def pack_chunks_native(texts: list[str], tables: ScoringTables,
+                       reg: Registry, flags: int = 0, n_shards: int = 1,
+                       l_doc: int = 1 << 17, c_doc: int = 1 << 14,
+                       max_direct: int = 64,
+                       n_threads: int = 0) -> ChunkBatch:
+    """texts -> chunk-major flat wire (one dispatch regardless of the
+    batch's document-length mix). len(texts) must divide n_shards."""
     lib = _load()
     if not lib:
         raise RuntimeError("native packer unavailable")
     _ensure_init(tables, reg)
 
-    if max_direct is None:
-        max_direct = max_chunks
-    B, L, C, D = len(texts), max_slots, max_chunks, max_direct
+    B, Dc = len(texts), max_direct
+    assert B % n_shards == 0, (B, n_shards)
     enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
     bounds = np.zeros(B + 1, np.int64)
     np.cumsum([len(e) for e in enc], out=bounds[1:])
@@ -307,62 +269,89 @@ def pack_resolve_native(texts: list[str], tables: ScoringTables,
         else np.zeros(1, np.uint8)
     blob = np.ascontiguousarray(blob)
 
-    if pool is not None:
-        out = pool.get(B, L, C, D)
-    else:
-        out = ResolvedBatch(
-            idx=np.zeros((B, L), np.uint16),
-            chk=np.zeros((B, L), np.uint16),
-            cmeta=np.zeros((B, C), np.uint32),
-            cscript=np.zeros((B, C), np.uint8),
-            direct_adds=np.full((B, D, 3), -1, np.int32),
-            text_bytes=np.zeros(B, np.int32),
-            fallback=np.zeros(B, bool),
-            squeezed=np.zeros(B, bool),
-            n_slots=np.zeros(B, np.int32),
-            n_chunks=np.zeros(B, np.int32),
-            n_docs=B,
-        )
+    direct_adds = np.full((B, Dc, 3), -1, np.int32)
+    text_bytes = np.zeros(B, np.int32)
+    fallback = np.zeros(B, bool)
+    squeezed = np.zeros(B, bool)
+    n_slots = np.zeros(B, np.int32)
+    n_chunks = np.zeros(B, np.int32)
+    max_nsl = ctypes.c_int32(0)
     if n_threads <= 0:
         import os
-        # oversubscribe modestly: the per-doc work mixes pointer-chasing
-        # probes with byte scans, and cgroup-limited cpu counts underreport
         n_threads = min(16, 2 * (os.cpu_count() or 1) + 6)
-    lib.ldt_pack_resolve(
+    handle = lib.ldt_pack_flat_begin(
         _ptr(blob, np.uint8), _ptr(bounds, np.int64),
-        ctypes.c_int32(B), ctypes.c_int32(L), ctypes.c_int32(C),
-        ctypes.c_int32(D), ctypes.c_int32(flags),
+        ctypes.c_int32(B), ctypes.c_int32(l_doc), ctypes.c_int32(c_doc),
+        ctypes.c_int32(Dc), ctypes.c_int32(flags),
         ctypes.c_int32(n_threads),
-        _ptr(out.idx, np.uint16), _ptr(out.chk, np.uint16),
-        _ptr(out.cmeta, np.uint32), _ptr(out.cscript, np.uint8),
-        out.direct_adds.ctypes.data_as(ctypes.c_void_p),
-        _ptr(out.text_bytes, np.int32),
-        out.fallback.ctypes.data_as(ctypes.c_void_p),
-        out.squeezed.ctypes.data_as(ctypes.c_void_p),
-        _ptr(out.n_slots, np.int32), _ptr(out.n_chunks, np.int32))
-    return out
+        _ptr(direct_adds, np.int32), _ptr(text_bytes, np.int32),
+        fallback.ctypes.data_as(ctypes.c_void_p),
+        squeezed.ctypes.data_as(ctypes.c_void_p),
+        _ptr(n_slots, np.int32), _ptr(n_chunks, np.int32),
+        ctypes.byref(max_nsl))
+
+    try:
+        D = n_shards
+        shard_slots = n_slots.reshape(D, B // D).sum(axis=1)
+        shard_chunks = n_chunks.reshape(D, B // D).sum(axis=1)
+        # 32K-slot / 8K-chunk step granularity: padding waste stays
+        # bounded while the compiled program set stays small (shapes
+        # repeat across batches)
+        N = _bucket_step(int(shard_slots.max()), 32768, 4096)
+        Gs = _bucket_step(int(shard_chunks.max()), 8192, 512)
+        K = next(k for k in _K_BUCKETS if k >= max(int(max_nsl.value), 1))
+
+        idx = np.zeros((D, N), np.uint16)
+        cstart = np.zeros((D, Gs), np.int32)
+        cnsl = np.zeros((D, Gs), np.uint16)
+        cmeta = np.zeros((D, Gs), np.uint32)
+        cscript = np.zeros((D, Gs), np.uint8)
+        doc_chunk_start = np.zeros(B, np.int64)
+    except BaseException:
+        # finish() is the only free-er; without this the C++-owned
+        # compacted batch would leak on allocation failure / interrupt
+        lib.ldt_pack_flat_free(ctypes.c_int64(handle))
+        raise
+    lib.ldt_pack_flat_finish(
+        ctypes.c_int64(handle), ctypes.c_int32(B), ctypes.c_int32(D),
+        ctypes.c_int32(N), ctypes.c_int32(Gs),
+        _ptr(n_slots, np.int32), _ptr(n_chunks, np.int32),
+        _ptr(idx, np.uint16), _ptr(cstart, np.int32),
+        _ptr(cnsl, np.uint16), _ptr(cmeta, np.uint32),
+        _ptr(cscript, np.uint8), _ptr(doc_chunk_start, np.int64))
+    wire = dict(idx=idx, cstart=cstart, cnsl=cnsl, cmeta=cmeta,
+                cscript=cscript, k_iota=np.zeros(K, np.uint8))
+    return ChunkBatch(wire=wire, doc_chunk_start=doc_chunk_start,
+                      direct_adds=direct_adds, text_bytes=text_bytes,
+                      fallback=fallback, squeezed=squeezed,
+                      n_slots=n_slots, n_chunks=n_chunks, n_docs=B)
 
 
-def flatten_resolved_native(rb: ResolvedBatch, n_shards: int,
-                            N: int) -> dict:
-    """Dense ResolvedBatch slots -> flat ragged [n_shards, N] wire leaves
-    (idx, chk, doc_start)."""
+def epilogue_flat_native(rows: np.ndarray, cb: ChunkBatch, flags: int,
+                         reg: Registry,
+                         skip: np.ndarray | None = None) -> np.ndarray:
+    """Chunk-major document epilogue (epilogue.cc ldt_epilogue_flat).
+
+    rows: [G, 5] int32 chunk summaries in flat wire order.
+    Returns the ldt_epilogue_batch [B, 14] contract."""
     lib = _load()
     if not lib:
-        raise RuntimeError("native library unavailable")
-    B, L = rb.idx.shape
-    idx_flat = np.zeros((n_shards, N), np.uint16)
-    chk_flat = np.zeros((n_shards, N), np.uint16)
-    doc_start = np.zeros(B, np.int32)
-    n_slots = np.ascontiguousarray(rb.n_slots, dtype=np.int32)
-    lib.ldt_flatten_resolved(
-        _ptr(rb.idx, np.uint16), _ptr(rb.chk, np.uint16),
-        _ptr(n_slots, np.int32), ctypes.c_int32(B), ctypes.c_int32(L),
-        ctypes.c_int32(n_shards), ctypes.c_int32(N),
-        _ptr(idx_flat, np.uint16), _ptr(chk_flat, np.uint16),
-        _ptr(doc_start, np.int32))
-    return dict(idx=idx_flat, chk=chk_flat, doc_start=doc_start,
-                n_slots=n_slots)
+        raise RuntimeError("native epilogue unavailable")
+    B = cb.n_docs
+    Dc = cb.direct_adds.shape[1]
+    close, alt, figs = _epilogue_reg_arrays(reg)
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    sk = np.ascontiguousarray(
+        cb.fallback if skip is None else skip, dtype=np.uint8)
+    out = np.zeros((B, 14), np.int64)
+    lib.ldt_epilogue_flat(
+        _ptr(rows, np.int32), _ptr(cb.doc_chunk_start, np.int64),
+        _ptr(cb.n_chunks, np.int32), _ptr(cb.direct_adds, np.int32),
+        _ptr(cb.text_bytes, np.int32), _ptr(sk, np.uint8),
+        ctypes.c_int32(B), ctypes.c_int32(Dc), ctypes.c_int32(flags),
+        _ptr(close, np.int32), _ptr(alt, np.int32), _ptr(figs, np.uint8),
+        ctypes.c_int32(len(close)), _ptr(out, np.int64))
+    return out
 
 
 # -- batched document epilogue (epilogue.cc) --------------------------------
@@ -389,35 +378,3 @@ def _epilogue_reg_arrays(reg: Registry):
     arrays = (close, alt, figs)
     _epi_reg_cache = (reg, arrays)
     return arrays
-
-
-def epilogue_batch_native(rows: np.ndarray, direct_adds: np.ndarray,
-                          text_bytes: np.ndarray, skip: np.ndarray,
-                          flags: int, reg: Registry) -> np.ndarray:
-    """Batched DocTote replay + document post-processing (epilogue.cc),
-    the C++ twin of models/ngram.py _doc_epilogue.
-
-    rows: [B, C, 5] int32 chunk summaries from the device scorer.
-    direct_adds: [B, D, 3] int32 (chunk_id, lang, bytes; -1 = pad).
-    skip: [B] bool - packer-fallback docs the caller resolves via the
-    scalar engine regardless.
-    Returns [B, 14] int64: summary, lang3[3], percent3[3], ns3[3],
-    text_bytes, is_reliable, need_scalar, pad."""
-    lib = _load()
-    if not lib:
-        raise RuntimeError("native epilogue unavailable")
-    B, C, _ = rows.shape
-    D = direct_adds.shape[1]
-    close, alt, figs = _epilogue_reg_arrays(reg)
-    rows = np.ascontiguousarray(rows, dtype=np.int32)
-    direct = np.ascontiguousarray(direct_adds, dtype=np.int32)
-    tb = np.ascontiguousarray(text_bytes, dtype=np.int32)
-    sk = np.ascontiguousarray(skip, dtype=np.uint8)
-    out = np.zeros((B, 14), np.int64)
-    lib.ldt_epilogue_batch(
-        _ptr(rows, np.int32), _ptr(direct, np.int32), _ptr(tb, np.int32),
-        _ptr(sk, np.uint8), ctypes.c_int32(B), ctypes.c_int32(C),
-        ctypes.c_int32(D), ctypes.c_int32(flags),
-        _ptr(close, np.int32), _ptr(alt, np.int32), _ptr(figs, np.uint8),
-        ctypes.c_int32(len(close)), _ptr(out, np.int64))
-    return out

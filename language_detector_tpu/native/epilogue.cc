@@ -315,19 +315,26 @@ void calc_summary_lang(const Reg& reg, const Extract& e,
 
 extern "C" {
 
-// Output layout per doc (int64, 14 lanes):
+// Batched document epilogue. Output layout per doc (int64, 14 lanes):
 //   0 summary | 1-3 lang3 | 4-6 percent3 | 7-9 ns3 | 10 text_bytes
 //   11 is_reliable | 12 need_scalar (good-answer gate failed ->
-//   caller runs the scalar recursion) | 13 unused
-void ldt_epilogue_batch(
-    const int32_t* rows,        // [B, C, 5] lang1, bytes, score1, rel, real
-    const int32_t* direct,      // [B, D, 3] chunk_id, lang, bytes (-1 pad)
-    const int32_t* text_bytes,  // [B]
-    const uint8_t* skip,        // [B] nonzero = packer fallback, skip doc
-    int32_t B, int32_t C, int32_t D, int32_t flags,
+//   caller runs the batched recursion) | 13 unused
+// Chunk summaries arrive as one
+// flat [G, 5] array (all docs' chunks concatenated, the device layout of
+// the flat wire) and each doc owns rows [doc_chunk_start[b],
+// doc_chunk_start[b] + n_chunks[b]). direct_adds chunk ids stay
+// doc-local. Same output contract.
+void ldt_epilogue_flat(
+    const int32_t* rows,             // [G, 5] lang1, bytes, score1, rel, real
+    const int64_t* doc_chunk_start,  // [B] doc's first chunk row
+    const int32_t* n_chunks,         // [B]
+    const int32_t* direct,           // [B, D, 3] chunk_id, lang, bytes
+    const int32_t* text_bytes,       // [B]
+    const uint8_t* skip,             // [B] nonzero = packer fallback
+    int32_t B, int32_t D, int32_t flags,
     const int32_t* close_set, const int32_t* closest_alt,
     const uint8_t* is_figs, int32_t n_lang,
-    int64_t* out) {             // [B, 14]
+    int64_t* out) {                  // [B, 14]
   Reg reg{close_set, closest_alt, is_figs, n_lang};
   for (int b = 0; b < B; b++) {
     int64_t* o = out + (int64_t)b * 14;
@@ -339,10 +346,12 @@ void ldt_epilogue_batch(
     DocTote t;
     t.init();
     const int32_t* dd = direct + (int64_t)b * D * 3;
-    const int32_t* rr = rows + (int64_t)b * C * 5;
-    for (int c = 0; c < C; c++) {
+    const int32_t* rr = rows + doc_chunk_start[b] * 5;
+    int nd = 0;
+    while (nd < D && dd[nd * 3] >= 0) nd++;
+    for (int c = 0; c < n_chunks[b]; c++) {
       bool is_direct = false;
-      for (int d = 0; d < D; d++) {
+      for (int d = 0; d < nd; d++) {
         if (dd[d * 3] == c) {
           t.add(dd[d * 3 + 1], dd[d * 3 + 2], dd[d * 3 + 2], 100);
           is_direct = true;

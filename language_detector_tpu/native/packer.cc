@@ -1038,8 +1038,9 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   uint8_t* cscript = o.cscript + (int64_t)b * C;
   int32_t* dadds = o.direct_adds + (int64_t)b * o.D * 3;
 
-  // per-chunk accumulators (sized to the chunk budget; the wire chunk
-  // lane is u16 so C can exceed 256 for long single-script documents)
+  // per-chunk accumulators (sized to the per-doc chunk budget; resize
+  // to an already-seen size is O(1), and entries zero lazily at
+  // allocation — see zero_chunks)
   static thread_local std::vector<int32_t> c_grams, c_lo, c_span_end;
   static thread_local std::vector<int16_t> c_span;
   static thread_local std::vector<int8_t> c_side, c_real;
@@ -1060,14 +1061,20 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   static thread_local std::vector<int64_t> rep_tbl;
   int rep_hash;
 
+  // Chunk accumulators zero lazily at allocation (zero_chunks below):
+  // upfront O(C) init would dominate packing when the per-doc budget is
+  // generous (the flat path's C_doc is 16K+ while real docs use ~4).
+  auto zero_chunks = [&](int lo, int hi) {
+    for (int c = lo; c < hi; c++) {
+      c_grams[c] = 0;
+      c_lo[c] = 1 << 30; c_span_end[c] = 0;
+      c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
+    }
+  };
+
 restart:
   rep_hash = 0;
   if (o.flags & 4) rep_tbl.assign(kPredictionTableSize, 0);
-  for (int c = 0; c < C; c++) {
-    c_grams[c] = 0;
-    c_lo[c] = 1 << 30; c_span_end[c] = 0;
-    c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
-  }
   // per-doc rotating distinct-boost lists (idx into cat_ind; 0 = empty)
   std::memset(boosts, 0, sizeof(boosts));
   bptr[0] = bptr[1] = 0;
@@ -1117,6 +1124,7 @@ restart:
       dadds[n_direct * 3 + 1] = g.deflang[sp.ulscript];
       dadds[n_direct * 3 + 2] = sp.text_bytes;
       n_direct++;
+      zero_chunks(chunk_base, chunk_base + 1);
       chunk_base++;
       continue;
     }
@@ -1186,6 +1194,7 @@ restart:
         ok = false;
         break;
       }
+      zero_chunks(chunk_base, chunk_base + round_chunks);
 
       // ---- pass 2: chunk assignment + emission + boosts ----
       // Device-exact accounting (ops/score.py stages 4-8): entry RANKS
@@ -1265,15 +1274,9 @@ restart:
     cmeta[c] = (uint32_t)cbytes | ((uint32_t)grams << 16) |
                ((uint32_t)(c_side[c] & 1) << 28) | (1u << 29);
   }
-  // Clear the cmeta/cscript/direct_adds tails explicitly: the caller may
-  // reuse output buffers across batches (pack_resolve_native's
-  // BufferPool), so stale rows must never read as live chunks / direct
-  // adds. idx/chk rows are NOT cleared — they are valid only up to
-  // n_slots[b], a bound every consumer (the wire flattener) respects.
-  for (int c = chunk_base; c < C; c++) {
-    cmeta[c] = 0;
-    cscript[c] = 0;
-  }
+  // Tails are NOT cleared: every consumer respects the n_slots/n_chunks
+  // bounds (the flat compaction copies exactly [0, n_chunks) rows).
+  // direct_adds pads with -1 sentinels (the epilogue's stop condition).
   for (int d = n_direct; d < o.D; d++) dadds[d * 3 + 0] = -1;
   o.text_bytes[b] = (int32_t)total;
   o.fallback[b] = !ok;
@@ -1282,6 +1285,49 @@ restart:
   o.n_chunks[b] = chunk_base;
 }
 
+// ---- chunk-major ragged pack (the flat wire) ------------------------------
+//
+// The doc-major dense wire ([B, L] slots + [B, C] chunks) couples device
+// program shape to the LONGEST document in a batch: one 60KB doc forces
+// L=32768/C=2048 buckets whose [B, C, L] one-hot chunk matmul is quadratic
+// in doc length, capping batches at 16 docs. Chunks, however, are
+// independent once the packer assigns them (the reference's chunk totes
+// are order-free sums, scoreonescriptspan.cc:978-1031; doc aggregation
+// :305-315) and the packer emits slots with monotone chunk ids — so the
+// flat wire drops the doc axis entirely: all docs' slots concatenate into
+// one [N] lane, chunks become rows of a [G, K] grid (K = fattest chunk in
+// the batch, <= kMaxChunkSlots), and a long document simply contributes
+// more chunk rows. Device cost is linear in total text; batches freely
+// mix 100-byte tweets with 100KB documents in ONE dispatch.
+//
+// Two-phase because the wire is sized by content (total slots/chunks and
+// the K bucket are known only after packing): begin() packs every doc via
+// pack_resolve_one_doc into thread-local dense scratch and compacts into
+// per-thread growing buffers; the caller then sizes/allocates the wire
+// and finish() lays it out shard-major and frees the state.
+
+// A chunk holds <= ~20 quads / ~50 CJK unigrams (a+b pairs), trailing
+// runt merges (x1.5), interleaved word hits, and a 4-slot boost flush;
+// 256 covers every real text with margin. Fatter chunks (adversarial
+// constructions) route the doc to the scalar fallback.
+constexpr int kMaxChunkSlots = 256;
+
+struct FlatThreadBuf {
+  std::vector<uint16_t> idx;     // resolved slots, concat over this
+                                 // thread's docs
+  std::vector<uint16_t> cnsl;    // per-chunk slot count
+  std::vector<uint32_t> cmeta;   // per-chunk meta (ROut layout)
+  std::vector<uint8_t> cscript;  // per-chunk ULScript
+};
+
+struct FlatPackState {
+  int B = 0;
+  std::vector<FlatThreadBuf> bufs;
+  std::vector<int32_t> doc_buf;        // thread-buffer index per doc
+  std::vector<int64_t> doc_slot_off;   // doc's slot offset in its buffer
+  std::vector<int64_t> doc_chunk_off;  // doc's chunk offset in its buffer
+};
+
 }  // namespace
 
 extern "C" {
@@ -1289,7 +1335,167 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 4; }
+int32_t ldt_abi_version() { return 5; }
+
+// Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
+// text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
+// arrays; slots and chunk meta stay in C++-owned buffers until finish().
+// Fallback docs report 0 slots/chunks (they resolve via the scalar
+// engine, so nothing of theirs belongs on the wire). Returns an opaque
+// handle; *max_chunk_nsl gets the fattest chunk's slot count (the
+// caller's K bucket). L_doc/C_doc are per-doc scratch budgets —
+// generosity costs thread-local scratch only, not wire.
+int64_t ldt_pack_flat_begin(
+    const uint8_t* texts, const int64_t* bounds, int32_t n_docs,
+    int32_t L_doc, int32_t C_doc, int32_t D_cap, int32_t flags,
+    int32_t n_threads,
+    int32_t* direct_adds, int32_t* text_bytes, uint8_t* fallback,
+    uint8_t* squeezed, int32_t* n_slots, int32_t* n_chunks,
+    int32_t* max_chunk_nsl) {
+  FlatPackState* st = new FlatPackState;
+  st->B = n_docs;
+  st->doc_buf.assign(n_docs, 0);
+  st->doc_slot_off.assign(n_docs, 0);
+  st->doc_chunk_off.assign(n_docs, 0);
+  if (!rt_ready) {
+    for (int b = 0; b < n_docs; b++) {
+      fallback[b] = 1;
+      squeezed[b] = 0;
+      n_slots[b] = 0;
+      n_chunks[b] = 0;
+      text_bytes[b] = 0;
+      for (int d = 0; d < D_cap; d++)
+        direct_adds[((int64_t)b * D_cap + d) * 3] = -1;
+    }
+    st->bufs.resize(1);
+    *max_chunk_nsl = 0;
+    return (int64_t)(intptr_t)st;
+  }
+  int nt = n_threads;
+  if (nt <= 1 || n_docs < 2 * nt) nt = 1;
+  st->bufs.resize(nt);
+  std::vector<int32_t> tmax(nt, 0);
+
+  auto work = [&](int t, int lo, int hi) {
+    FlatThreadBuf& tb = st->bufs[t];
+    static thread_local std::vector<uint16_t> sidx, schk;
+    static thread_local std::vector<uint32_t> scmeta;
+    static thread_local std::vector<uint8_t> scscript;
+    static thread_local std::vector<int32_t> counts;
+    sidx.resize(L_doc);
+    schk.resize(L_doc);
+    scmeta.resize(C_doc);
+    scscript.resize(C_doc);
+    for (int b = lo; b < hi; b++) {
+      // per-doc views: scratch for slot/chunk lanes (b=0 addressing),
+      // caller rows for everything per-doc
+      ROut o{sidx.data(), schk.data(), scmeta.data(), scscript.data(),
+             direct_adds + (int64_t)b * D_cap * 3, text_bytes + b,
+             fallback + b, squeezed + b, n_slots + b, n_chunks + b,
+             L_doc, C_doc, D_cap, flags};
+      pack_resolve_one_doc(texts + bounds[b],
+                           (int)(bounds[b + 1] - bounds[b]), 0, o);
+      st->doc_buf[b] = t;
+      st->doc_slot_off[b] = (int64_t)tb.idx.size();
+      st->doc_chunk_off[b] = (int64_t)tb.cnsl.size();
+      int ns = n_slots[b], nc = n_chunks[b];
+      if (!fallback[b] && nc > 0) {
+        counts.assign(nc, 0);
+        for (int i = 0; i < ns; i++) counts[schk[i]]++;
+        int mx = 0;
+        for (int c = 0; c < nc; c++) mx = std::max(mx, counts[c]);
+        if (mx > kMaxChunkSlots) fallback[b] = 1;  // adversarial chunk
+        else {
+          if (mx > tmax[t]) tmax[t] = mx;
+          tb.idx.insert(tb.idx.end(), sidx.begin(), sidx.begin() + ns);
+          for (int c = 0; c < nc; c++)
+            tb.cnsl.push_back((uint16_t)counts[c]);
+          tb.cmeta.insert(tb.cmeta.end(), scmeta.begin(),
+                          scmeta.begin() + nc);
+          tb.cscript.insert(tb.cscript.end(), scscript.begin(),
+                            scscript.begin() + nc);
+        }
+      }
+      if (fallback[b]) {
+        n_slots[b] = 0;
+        n_chunks[b] = 0;
+      }
+    }
+  };
+  if (nt == 1) {
+    work(0, 0, n_docs);
+  } else {
+    std::vector<std::thread> ts;
+    int per = (n_docs + nt - 1) / nt;
+    for (int t = 0; t < nt; t++) {
+      int lo = t * per, hi = std::min(n_docs, lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back(work, t, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  int mx = 0;
+  for (int t = 0; t < nt; t++) mx = std::max(mx, tmax[t]);
+  *max_chunk_nsl = mx;
+  return (int64_t)(intptr_t)st;
+}
+
+// Free a begin() handle without laying out the wire (error-path cleanup:
+// the caller could not allocate the wire arrays, or was interrupted).
+void ldt_pack_flat_free(int64_t handle) {
+  delete (FlatPackState*)(intptr_t)handle;
+}
+
+// Phase 2: lay the packed content out shard-major and free the state.
+// Shard d takes docs [d*B/D, (d+1)*B/D); within a shard, slots and
+// chunks concatenate in doc order (chunk_start is shard-local so the
+// device program is identical on every shard). doc_chunk_start[b] is
+// the doc's first chunk row in the flattened [D*Gs] grid (the epilogue's
+// map back from chunk rows to documents). Tails beyond each shard's
+// content are zeroed: cnsl=0 rows are dead on device (masked) and in
+// the epilogue (real bit 0).
+void ldt_pack_flat_finish(
+    int64_t handle, int32_t B, int32_t D, int32_t N, int32_t Gs,
+    const int32_t* n_slots, const int32_t* n_chunks,
+    uint16_t* idx_flat, int32_t* cstart, uint16_t* cnsl_flat,
+    uint32_t* cmeta_flat, uint8_t* cscript_flat,
+    int64_t* doc_chunk_start) {
+  FlatPackState* st = (FlatPackState*)(intptr_t)handle;
+  int Bd = B / D;
+  for (int d = 0; d < D; d++) {
+    int64_t spos = 0, gpos = 0;
+    for (int i = 0; i < Bd; i++) {
+      int b = d * Bd + i;
+      const FlatThreadBuf& tb = st->bufs[st->doc_buf[b]];
+      int ns = n_slots[b], nc = n_chunks[b];
+      std::memcpy(idx_flat + (int64_t)d * N + spos,
+                  tb.idx.data() + st->doc_slot_off[b],
+                  (size_t)ns * sizeof(uint16_t));
+      doc_chunk_start[b] = (int64_t)d * Gs + gpos;
+      int64_t cpos = spos;
+      int64_t src = st->doc_chunk_off[b];
+      int64_t dst = (int64_t)d * Gs + gpos;
+      for (int c = 0; c < nc; c++) {
+        cstart[dst + c] = (int32_t)cpos;
+        uint16_t n = tb.cnsl[src + c];
+        cnsl_flat[dst + c] = n;
+        cmeta_flat[dst + c] = tb.cmeta[src + c];
+        cscript_flat[dst + c] = tb.cscript[src + c];
+        cpos += n;
+      }
+      spos += ns;
+      gpos += nc;
+    }
+    for (int64_t g = gpos; g < Gs; g++) {
+      int64_t dst = (int64_t)d * Gs + g;
+      cstart[dst] = 0;
+      cnsl_flat[dst] = 0;
+      cmeta_flat[dst] = 0;
+      cscript_flat[dst] = 0;
+    }
+  }
+  delete st;
+}
 
 // Table geometry + data for host-side resolution. Pointers are owned by
 // Python (DeviceTables host copies) and must outlive packing calls.
@@ -1320,71 +1526,6 @@ void ldt_init_tables(const uint32_t* cat_buckets, const uint32_t* cat_ind,
   rt.q2_enabled = q2_enabled;
   rt.seed_ind_base = seed_ind_base;
   rt_ready = true;
-}
-
-// texts -> resolved wire (dense per doc; caller flattens via
-// ldt_flatten_resolved). Requires ldt_init + ldt_init_tables.
-void ldt_pack_resolve(const uint8_t* texts, const int64_t* bounds,
-                      int32_t n_docs, int32_t L, int32_t C, int32_t D,
-                      int32_t flags, int32_t n_threads,
-                      uint16_t* idx, uint16_t* chk, uint32_t* cmeta,
-                      uint8_t* cscript, int32_t* direct_adds,
-                      int32_t* text_bytes, uint8_t* fallback,
-                      uint8_t* squeezed, int32_t* n_slots,
-                      int32_t* n_chunks) {
-  if (!rt_ready) {
-    // ldt_init_tables was never called: flag every doc as fallback
-    // instead of dereferencing null table pointers
-    for (int b = 0; b < n_docs; b++) {
-      fallback[b] = 1;
-      squeezed[b] = 0;
-      n_slots[b] = 0;
-      n_chunks[b] = 0;
-      text_bytes[b] = 0;
-    }
-    return;
-  }
-  ROut o{idx, chk, cmeta, cscript, direct_adds, text_bytes, fallback,
-         squeezed, n_slots, n_chunks, L, C, D, flags};
-  auto work = [&](int lo, int hi) {
-    for (int b = lo; b < hi; b++)
-      pack_resolve_one_doc(texts + bounds[b],
-                           (int)(bounds[b + 1] - bounds[b]), b, o);
-  };
-  if (n_threads <= 1 || n_docs < 2 * n_threads) {
-    work(0, n_docs);
-    return;
-  }
-  std::vector<std::thread> ts;
-  int per = (n_docs + n_threads - 1) / n_threads;
-  for (int t = 0; t < n_threads; t++) {
-    int lo = t * per, hi = std::min(n_docs, lo + per);
-    if (lo >= hi) break;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
-}
-
-// Dense [B, L] resolved slots -> flat ragged [n_shards, N] wire.
-void ldt_flatten_resolved(const uint16_t* idx, const uint16_t* chk,
-                          const int32_t* n_slots, int32_t B, int32_t L,
-                          int32_t n_shards, int32_t N,
-                          uint16_t* idx_flat, uint16_t* chk_flat,
-                          int32_t* doc_start) {
-  int Bd = B / n_shards;
-  for (int d = 0; d < n_shards; d++) {
-    int64_t pos = 0;
-    for (int i = 0; i < Bd; i++) {
-      int b = d * Bd + i;
-      doc_start[b] = (int32_t)pos;
-      int n = n_slots[b];
-      std::memcpy(idx_flat + (int64_t)d * N + pos, idx + (int64_t)b * L,
-                  (size_t)n * sizeof(uint16_t));
-      std::memcpy(chk_flat + (int64_t)d * N + pos, chk + (int64_t)b * L,
-                  (size_t)n * sizeof(uint16_t));
-      pos += n;
-    }
-  }
 }
 
 void ldt_init(const uint8_t* script_of_cp, const uint32_t* lower_map,

@@ -1,2 +1,2 @@
 from .device_tables import DeviceTables  # noqa: F401
-from .score import score_resolved  # noqa: F401
+from .score import score_chunks  # noqa: F401

@@ -2,24 +2,27 @@
 
 The numeric core of detection (ScoreOneChunk totes + top-2 + reliability,
 scoreonescriptspan.cc:208-302, cldutil.cc:553-605) as one jitted program
-of fixed-shape tensor ops over the resolved wire the native packer builds
-(packer.cc ldt_pack_resolve): langprob decode, chunk totes over 256
-per-script languages as one-hot matmuls on the MXU, masked double-argmax
-top-2, and the reliability formulas.
+of fixed-shape tensor ops over the chunk-major flat wire the native
+packer builds (packer.cc ldt_pack_flat_begin/finish): langprob decode,
+chunk totes over 256 per-script languages as a fused one-hot reduction,
+masked double-argmax top-2, and the reliability formulas.
 
 Design rules for this device (TPU behind a high-latency tunnel): NO
-scatter, NO sort, NO scan — segment reductions are one-hot matmuls over
-the small chunk axis, top-k(2) is two masked argmaxes, and everything
-sequential (probes, repeat cache, chunk assignment, boost rotation) lives
-in the C++ packer where the few-MB tables are cache-resident. History:
-ops/score.py@01ee7ba^ held the prior all-on-device program (probes +
-lax.scan); profiling (docs/PERF.md) showed the wire transfer and the
-fixed ~95ms dispatch latency dominating, so the split moved host-ward.
+scatter, NO sort, NO scan — the chunk tote is a masked one-hot reduce
+over each chunk row's slot axis, top-k(2) is two masked argmaxes, and
+everything sequential (probes, repeat cache, chunk assignment, boost
+rotation) lives in the C++ packer where the few-MB tables are
+cache-resident. History: ops/score.py@01ee7ba^ held an all-on-device
+program (probes + lax.scan) — the wire transfer and the fixed ~95ms
+dispatch latency dominated, so the split moved host-ward; the doc-major
+successor (dense [B, L] slots + [B, C, L] one-hot chunk matmul,
+@01ef460) coupled program shape to the longest document and collapsed on
+mixed traffic, so the doc axis was dropped entirely.
 
 The per-document epilogue (DocTote replay, close pairs, unreliable-language
 removal, summary language — all O(1) per doc) runs on the host in
-models/ngram.py + native/epilogue.cc, reusing the oracle-validated scalar
-semantics, so the batched path agrees with the scalar engine exactly
+native/epilogue.cc, reusing the oracle-validated scalar semantics, so the
+batched path agrees with the scalar engine exactly
 (tests/test_batch_agreement.py).
 """
 from __future__ import annotations
@@ -65,21 +68,6 @@ def _lscript4(script):
                      jnp.where(script == 3, 1, jnp.where(script == 6, 2, 3)))
 
 
-
-# ---------------------------------------------------------------------------
-# Resolved-wire scorer: the production path.
-#
-# The native packer (packer.cc ldt_pack_resolve) performs the table probes,
-# quad repeat cache, chunk assignment, and distinct-boost rotation on the
-# HOST (the tables are a few MB and cache-resident there), so the wire
-# carries only resolved hits — 3-4 bytes per slot (u16 index into the
-# concatenated indirect array + u8/u16 doc-local chunk id) instead of 8, and
-# misses never cross the host->device link. The device keeps the dense
-# numeric core that actually benefits from the MXU: langprob decode,
-# per-chunk totes as one-hot matmuls, masked top-2, and the reliability
-# formulas (cldutil.cc:553-605).
-# ---------------------------------------------------------------------------
-
 # cmeta bit layout (keep in sync with packer.cc pack_resolve_one_doc):
 #   cbytes(16) | grams(12) << 16 | side << 28 | real << 29
 CM2_GRAMS_SHIFT = 16
@@ -91,67 +79,17 @@ OUTW_REL_SHIFT = 24
 OUTW_REAL_SHIFT = 31
 
 
-def score_resolved_impl(dt: DeviceTables, p: dict):
-    """Score one resolved wire into packed chunk outputs [B, C] u32.
-
-    p (built by models/ngram.py from ldt_pack_resolve):
-      idx       [S, N]  u16  cat_ind2 index per resolved hit
-      chk       [S, N]  u8/u16  doc-local chunk id
-      doc_start [B]     i32  doc's first slot (shard-local)
-      n_slots   [B]     i32
-      cmeta     [B, C]  u32  chunk meta (see CM2_* layout)
-      cscript   [B, C]  u8   chunk ULScript
-      l_iota    [L]     u8   dense slot-axis length carrier
-
-    Every reduction is doc-local: safe under jit and shard_map over the
-    doc axis with zero collectives."""
-    idxf = p["idx"].reshape(-1)
-    chkf = p["chk"].reshape(-1)
-    N = idxf.shape[0]
-    doc_start = p["doc_start"].astype(jnp.int32)
-    n_slots = p["n_slots"].astype(jnp.int32)
-    B = doc_start.shape[0]
-    L = p["l_iota"].shape[0]
-    cmeta = p["cmeta"].astype(jnp.uint32)
-    C = cmeta.shape[1]
-
-    # dense [B, L] reconstruction (one gather pair)
-    li = jnp.arange(L, dtype=jnp.int32)
-    valid = li[None, :] < n_slots[:, None]
-    gidx = jnp.clip(doc_start[:, None] + li[None, :], 0, N - 1)
-    lp = jnp.where(valid, dt.cat_ind2[idxf[gidx].astype(jnp.int32)], 0)
-    chunk_id = jnp.where(valid, chkf[gidx].astype(jnp.int32), 0)
-
-    # decode + per-slot language contribution [B, L, 256]
-    ps, row = _decode3(lp)
-    q = dt.lg_prob3[row].astype(jnp.int32)                     # [B, L, 3]
+def _chunk_out_word(dt, scores, cbytes, grams, side, real, script):
+    """[..., 256] chunk totes + chunk meta -> packed u32 chunk summary:
+    group-in-use top-2 (tote.cc:30-100), reliability (cldutil.cc:553-605),
+    output word OUTW_* layout. Leading dims are free (doc-major [B, C]
+    and chunk-major [G] reuse it)."""
     iota256 = jnp.arange(256, dtype=jnp.int32)
-    lang_val = jnp.zeros((B, L, 256), jnp.bfloat16)
-    for j in range(3):
-        contrib = jnp.where(valid & (ps[..., j] > 0), q[..., j], 0)
-        lang_val = lang_val + jnp.where(
-            ps[..., j:j + 1] == iota256, contrib[..., None], 0
-        ).astype(jnp.bfloat16)
-
-    # chunk totes on the MXU
-    chunk_oh = ((chunk_id[:, None, :] == jnp.arange(C)[None, :, None]) &
-                valid[:, None, :])                             # [B, C, L]
-    scores = jnp.einsum("bcl,blk->bck", chunk_oh.astype(jnp.bfloat16),
-                        lang_val,
-                        preferred_element_type=jnp.float32).astype(jnp.int32)
-
-    # chunk meta decode
-    cbytes = (cmeta & jnp.uint32(0xFFFF)).astype(jnp.int32)
-    grams = ((cmeta >> CM2_GRAMS_SHIFT) & jnp.uint32(0xFFF)) \
-        .astype(jnp.int32)
-    side = ((cmeta >> CM2_SIDE_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
-    real = ((cmeta >> CM2_REAL_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
-    script = p["cscript"].astype(jnp.int32)
-
-    # group-in-use top-2 (tote.cc:30-100 semantics; qprob >= 1 invariant
-    # validated at DeviceTables.from_host)
-    groups = jnp.any((scores > 0).reshape(B, C, 64, 4), axis=3)
-    slot_in_use = jnp.repeat(groups, 4, axis=2)
+    lead = scores.shape[:-1]
+    # group-in-use top-2 (qprob >= 1 invariant validated at
+    # DeviceTables.from_host)
+    groups = jnp.any((scores > 0).reshape(lead + (64, 4)), axis=-1)
+    slot_in_use = jnp.repeat(groups, 4, axis=-1)
     sortkey = jnp.where(slot_in_use, scores * 256 + (255 - iota256), -1)
     k1 = jnp.argmax(sortkey, axis=-1)
     top1 = jnp.take_along_axis(sortkey, k1[..., None], axis=-1)[..., 0]
@@ -178,7 +116,7 @@ def score_resolved_impl(dt: DeviceTables, p: dict):
     rs = _reliability_expected(actual_kb, expected_kb)
     crel = jnp.minimum(rd, rs)
 
-    # single packed word per chunk: 32 bytes/doc device->host readback.
+    # single packed word per chunk: 4 bytes device->host readback.
     # s1 clips at 16383 — chunk totes are bounded far below (<= ~110
     # entries x qprob 12 + 4x12 boosts); the batch-agreement suite pins
     # exactness against the scalar engine.
@@ -188,15 +126,82 @@ def score_resolved_impl(dt: DeviceTables, p: dict):
             (real.astype(jnp.uint32) << OUTW_REAL_SHIFT))
 
 
-score_resolved = jax.jit(score_resolved_impl)
+# ---------------------------------------------------------------------------
+# Chunk-major scorer: the flat wire (native.pack_chunks_native).
+#
+# The doc axis is gone — chunks from every document form one [G, K] grid
+# (G = chunk rows per shard, K = fattest chunk's slot count, <= 256), so
+# device cost is linear in total text and a 100KB document just
+# contributes more rows to the same dispatch as the tweets around it.
+# The doc-major wire's [B, C, L] one-hot chunk matmul (quadratic in doc
+# length, the round-3 mixed-traffic cliff) has no equivalent here: the
+# chunk reduction IS the K-axis sum.
+# ---------------------------------------------------------------------------
 
 
-def unpack_resolved_out(out: np.ndarray, cmeta: np.ndarray) -> np.ndarray:
-    """Device output [B, C] u32 + host chunk meta -> the [B, C, 5] int32
-    chunk-summary layout the document epilogue consumes (OUT_* lanes)."""
+def score_chunks_impl(dt: DeviceTables, p: dict):
+    """Score a chunk-major flat wire into packed chunk outputs [G] u32.
+
+    p (built by native.pack_chunks_native):
+      idx     [N]   u16  cat_ind2 index per resolved slot (flat)
+      cstart  [G]   i32  chunk's first slot (shard-local)
+      cnsl    [G]   u16  chunk's slot count
+      cmeta   [G]   u32  chunk meta (CM2_* layout)
+      cscript [G]   u8   chunk ULScript
+      k_iota  [K]   u8   dense chunk-row length carrier
+
+    Reductions are chunk-local: safe under jit and shard_map over the
+    chunk axis with zero collectives."""
+    idxf = p["idx"].reshape(-1)
+    N = idxf.shape[0]
+    cstart = p["cstart"].reshape(-1).astype(jnp.int32)
+    cnsl = p["cnsl"].reshape(-1).astype(jnp.int32)
+    cmeta = p["cmeta"].reshape(-1).astype(jnp.uint32)
+    G = cstart.shape[0]
+    K = p["k_iota"].shape[0]
+
+    # dense [G, K] chunk rows (one gather pair)
+    ki = jnp.arange(K, dtype=jnp.int32)
+    valid = ki[None, :] < cnsl[:, None]
+    gidx = jnp.clip(cstart[:, None] + ki[None, :], 0, N - 1)
+    lp = jnp.where(valid, dt.cat_ind2[idxf[gidx].astype(jnp.int32)], 0)
+
+    # decode + chunk totes: the K-axis sum is the whole chunk reduction
+    # (XLA fuses the one-hot compare into the reduce; nothing [G, K, 256]
+    # materializes)
+    ps, row = _decode3(lp)                                     # [G, K, 3]
+    q = dt.lg_prob3[row].astype(jnp.int32)
+    iota256 = jnp.arange(256, dtype=jnp.int32)
+    scores = jnp.zeros((G, 256), jnp.int32)
+    for j in range(3):
+        contrib = jnp.where(valid & (ps[..., j] > 0), q[..., j], 0)
+        scores = scores + jnp.sum(
+            jnp.where(ps[..., j, None] == iota256, contrib[..., None], 0),
+            axis=1)
+
+    cbytes = (cmeta & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    grams = ((cmeta >> CM2_GRAMS_SHIFT) & jnp.uint32(0xFFF)) \
+        .astype(jnp.int32)
+    side = ((cmeta >> CM2_SIDE_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
+    real = ((cmeta >> CM2_REAL_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
+    script = p["cscript"].reshape(-1).astype(jnp.int32)
+    return _chunk_out_word(dt, scores, cbytes, grams, side, real, script)
+
+
+score_chunks = jax.jit(score_chunks_impl)
+
+
+def unpack_chunks_out(out: np.ndarray, cmeta: np.ndarray) -> np.ndarray:
+    """Device output [G] u32 (or sharded [D, Gs]) + host chunk meta ->
+    the flat [G, 5] int32 chunk-summary layout the flat epilogue
+    consumes."""
+    out = np.asarray(out).reshape(-1)
+    cmeta = cmeta.reshape(-1)
     lang1 = (out & 0x3FF).astype(np.int32)
     s1 = ((out >> OUTW_S1_SHIFT) & 0x3FFF).astype(np.int32)
     rel = ((out >> OUTW_REL_SHIFT) & 0x7F).astype(np.int32)
     real = ((out >> OUTW_REAL_SHIFT) & 1).astype(np.int32)
     cbytes = (cmeta & 0xFFFF).astype(np.int32)
     return np.stack([lang1, cbytes, s1, rel, real], axis=-1)
+
+

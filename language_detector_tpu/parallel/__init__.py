@@ -1,4 +1,4 @@
 from . import distributed
-from .mesh import batch_mesh, sharded_score_fn
+from .mesh import batch_mesh, sharded_score_chunks_fn
 
-__all__ = ["batch_mesh", "sharded_score_fn", "distributed"]
+__all__ = ["batch_mesh", "sharded_score_chunks_fn", "distributed"]

@@ -76,8 +76,8 @@ def initialize(coordinator_address: str | None = None,
 def local_batch_slice(global_batch: int) -> tuple[int, int]:
     """(start, size) of this process's document slice of a global batch:
     contiguous shares in process order, matching the contiguous shard
-    layout to_wire builds (models/ngram.py). The last process takes the
-    remainder when the batch does not divide evenly."""
+    layout the flat pack builds (native.pack_chunks_native). The last
+    process takes the remainder when the batch does not divide evenly."""
     import jax
     n = jax.process_count()
     i = jax.process_index()

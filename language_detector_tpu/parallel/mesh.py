@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.score import score_resolved_impl
+from ..ops.score import score_chunks_impl
 
 BATCH_AXIS = "batch"
 
@@ -34,19 +34,17 @@ def batch_mesh(n_devices: int | None = None,
     return Mesh(devs, (BATCH_AXIS,))
 
 
-def sharded_score_fn(mesh: Mesh):
-    """Jitted score_resolved with the document axis sharded over the mesh.
+def sharded_score_chunks_fn(mesh: Mesh):
+    """Jitted score_chunks with the CHUNK axis sharded over the mesh.
 
-    Tables replicate (in_specs P()); every wire leaf shards on its leading
-    axis (to_wire builds the flat slot arrays with one shard row per
-    device and shard-local doc_start offsets) except the L-carrier dummy,
-    which replicates. The body is communication-free: all reductions are
-    document-local."""
-    wire_specs = dict(idx=P(BATCH_AXIS), chk=P(BATCH_AXIS),
-                      doc_start=P(BATCH_AXIS), n_slots=P(BATCH_AXIS),
-                      cmeta=P(BATCH_AXIS), cscript=P(BATCH_AXIS),
-                      l_iota=P())
-    fn = jax.shard_map(score_resolved_impl, mesh=mesh,
+    The flat wire has no document axis; each shard row carries the slots
+    and chunk rows of its contiguous document range (pack_chunks_native
+    lays shards out with shard-local cstart offsets), so the body is
+    communication-free exactly like the doc-major scorer."""
+    wire_specs = dict(idx=P(BATCH_AXIS), cstart=P(BATCH_AXIS),
+                      cnsl=P(BATCH_AXIS), cmeta=P(BATCH_AXIS),
+                      cscript=P(BATCH_AXIS), k_iota=P())
+    fn = jax.shard_map(score_chunks_impl, mesh=mesh,
                        in_specs=(P(), wire_specs),
                        out_specs=P(BATCH_AXIS))
     return jax.jit(fn)

@@ -6,8 +6,8 @@ models/ngram.py) must produce byte-identical results to the scalar engine
 reference golden paragraphs, randomized mixed-script composites, and the
 fallback/edge paths (spam squeezing, empty and tiny inputs).
 
-All batches use one fixed [64, 2048] shape so the scoring program compiles
-once per session (cached persistently in .jax_cache/).
+Batches reuse the small chunk-major bucket shapes so the scoring program
+compiles once per session (cached persistently in .jax_cache/).
 """
 import random
 import sys
@@ -80,10 +80,11 @@ def test_squeeze_spam_agreement(engine):
     native packer performs the squeeze re-scan itself (packer.cc
     squeeze_span, mirroring the reference's recursive kCLDFlagSqueeze
     pass) and still agrees with the scalar engine end-to-end."""
+    from language_detector_tpu import native
     spam = ("buy cheap now " * 400).strip()
     docs = [spam, "word " * 600, "The quick brown fox. " + "spam ham " * 300]
-    packed = engine._pack(docs, engine.tables, engine.reg)
-    assert not packed.fallback.any(), \
+    cb = native.pack_chunks_native(docs, engine.tables, engine.reg)
+    assert not cb.fallback.any(), \
         "squeeze docs must pack natively, not fall back"
     _assert_batch_agrees(engine, docs)
 
@@ -119,16 +120,17 @@ def test_chunk_level_parity(engine):
                                                      score_one_span)
     from language_detector_tpu.preprocess.segment import segment_text
 
+    from language_detector_tpu import native
+
     texts = _golden_texts()
     rng = random.Random(7)
     docs = [t for t in (texts[i] for i in range(0, len(texts), 9))][:48]
     docs += [texts[3][:120] + " " + texts[-5][:120] for _ in range(4)]
     docs += [""] * (-len(docs) % BATCH)
 
-    packed = engine._pack(docs, engine.tables, engine.reg,
-                          max_slots=engine.max_slots,
-                          max_chunks=engine.max_chunks, flags=engine.flags)
-    out = engine.score_packed(packed)
+    cb = native.pack_chunks_native(docs, engine.tables, engine.reg,
+                                   flags=engine.flags)
+    out = engine.score_chunk_batch(cb)
 
     class RecordingTote(DocTote):
         def __init__(self):
@@ -140,7 +142,7 @@ def test_chunk_level_parity(engine):
             super().add(lang, nbytes, score, reliability)
 
     for b, text in enumerate(docs):
-        if packed.fallback[b]:
+        if cb.fallback[b]:
             continue
         tote = RecordingTote()
         ctx = ScoringContext(tables=engine.tables, registry=engine.reg)
@@ -150,15 +152,15 @@ def test_chunk_level_parity(engine):
                 continue
             score_one_span(ctx, span, tote)
         direct = {int(cid): (int(lang), int(nb))
-                  for cid, lang, nb in packed.direct_adds[b] if cid >= 0}
+                  for cid, lang, nb in cb.direct_adds[b] if cid >= 0}
         got = []
-        rows = out[b]
-        for c in range(rows.shape[0]):
+        g0 = int(cb.doc_chunk_start[b])
+        for c in range(int(cb.n_chunks[b])):
             if c in direct:
                 lang, nb = direct[c]
                 got.append((lang, nb, nb, 100))
-            elif rows[c, 4]:
-                got.append(tuple(int(x) for x in rows[c, :4]))
+            elif out[g0 + c, 4]:
+                got.append(tuple(int(x) for x in out[g0 + c, :4]))
         assert got == tote.adds, \
             f"doc {b}: {got[:6]} != {tote.adds[:6]} ({text[:50]!r})"
 

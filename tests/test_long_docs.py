@@ -2,9 +2,9 @@
 
 Spans with more than 1000 base hits score in rounds (the reference's
 hitbuffer refill loop, scoreonescriptspan.cc:1249-1274); the native packer
-mirrors it (packer.cc scan_quad_round/scan_cjk_round), so long documents
-no longer fall back to the scalar engine. detect_many routes them to a
-wide-slot sibling engine automatically.
+mirrors it (packer.cc scan_quad_round/scan_cjk_round). On the chunk-major
+flat wire a long document simply contributes more chunk rows to the same
+grid as everything else — no routing, no fallback, no special engine.
 """
 import sys
 from pathlib import Path
@@ -60,19 +60,41 @@ def test_detect_many_routes_long_docs():
             (s.summary_lang, s.percent3), d[:60]
 
 
+def test_dispatch_volume_cap():
+    """Batches slice by content volume, not just document count: a pile
+    of large documents must split into several dispatches (device memory
+    is linear in chunk rows), and results stay scalar-exact across the
+    slice boundaries."""
+    texts = _texts()
+    big = " ".join(texts[:40])
+    docs = [big] * 8 + [texts[0][:200]]
+    eng = NgramBatchEngine()
+    eng.DISPATCH_CHAR_BUDGET = 3 * len(big)  # force multiple slices
+    slices = list(eng._slices(docs, batch_size=1024))
+    assert len(slices) >= 3
+    assert sum(len(s) for s in slices) == len(docs)
+    rs = eng.detect_batch(docs)
+    want = detect_scalar(big, eng.tables, eng.reg)
+    for r in rs[:8]:
+        assert (r.summary_lang, r.percent3) == \
+            (want.summary_lang, want.percent3)
+
+
 def test_single_script_60kb_on_device():
-    """A long single-SCRIPT document (one span chain, hundreds of chunks)
-    exceeds the old u8 chunk lane; the u16 lane keeps it on the device."""
+    """A long single-SCRIPT document (one span chain, hundreds of chunk
+    rows) stays on the device and in the SAME batch as short docs."""
+    from language_detector_tpu import native
     texts = _texts()
     latin = [t for t in texts if max(t.encode("utf-8", "replace")) < 0xD0
              or all(ord(c) < 0x500 for c in t)]
     doc = " ".join((latin or texts) * 3)[:60000]
-    eng = NgramBatchEngine(max_slots=32768, max_chunks=2048)
-    rb = eng._pack([doc], eng.tables, eng.reg, max_slots=eng.max_slots,
-                   max_chunks=eng.max_chunks, flags=eng.flags)
-    assert int(rb.n_chunks.max()) > 256, \
-        "document must overflow the u8 chunk lane to pin the regression"
-    rs = eng.detect_batch([doc])
+    eng = NgramBatchEngine()
+    cb = native.pack_chunks_native([doc, texts[0][:200]], eng.tables,
+                                   eng.reg)
+    assert int(cb.n_chunks.max()) > 256, \
+        "document must produce hundreds of chunk rows to pin this case"
+    assert not cb.fallback.any()
+    rs = eng.detect_batch([doc, texts[0][:200]])
     assert eng.stats["fallback_docs"] == 0
     s = detect_scalar(doc, eng.tables, eng.reg)
     assert (rs[0].summary_lang, rs[0].language3, rs[0].percent3) == \

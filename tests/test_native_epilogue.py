@@ -1,10 +1,11 @@
-"""C++ epilogue (native/epilogue.cc) vs the Python document epilogue.
+"""C++ epilogue (native/epilogue.cc) vs a Python document-epilogue replay.
 
-The native path must agree with models/ngram.py _doc_epilogue (itself
-pinned to the scalar engine by test_batch_agreement) on every document:
-real texts through the full pipeline, plus randomized chunk summaries that
-exercise DocTote eviction, close-pair merges, unreliable removal, and the
-summary-language edge cases far beyond what natural text reaches.
+The native path must agree with the engine_scalar.py document pipeline
+(_python_doc_epilogue below, pinned to the scalar engine by
+test_batch_agreement) on every document: real texts through the full
+pipeline, plus randomized chunk summaries that exercise DocTote eviction,
+close-pair merges, unreliable removal, and the summary-language edge
+cases far beyond what natural text reaches.
 """
 import numpy as np
 import pytest
@@ -37,30 +38,77 @@ def eng():
     return NgramBatchEngine(ScoringTables.load(), registry)
 
 
-def _python_results(eng, texts, packed, out):
-    results = []
-    for b, text in enumerate(texts):
-        if packed.fallback[b]:
-            results.append(detect_scalar(text, eng.tables, eng.reg,
-                                         eng.flags))
-            continue
-        r = eng._doc_epilogue(packed, out, b)
-        if r is None:
-            r = detect_scalar(text, eng.tables, eng.reg, eng.flags)
-        results.append(r)
-    return results
+def _python_doc_epilogue(eng, cb, rows, b):
+    """DocTote replay in chunk-row order + the document post-processing
+    pipeline, byte-identical to detect_scalar (impl.cc:1956-2106) — the
+    behavioral spec the C++ epilogue must match. Returns None when the
+    good-answer gate fails (the engine then runs the batched recursion)."""
+    from language_detector_tpu.engine_scalar import (
+        FLAG_BEST_EFFORT, FLAG_FINISH, GOOD_LANG1_PERCENT,
+        GOOD_LANG1AND2_PERCENT, SHORT_TEXT_THRESH, DocTote, ScalarResult,
+        calc_summary_lang, extract_lang_etc, refine_close_pairs,
+        remove_unreliable)
+
+    doc_tote = DocTote()
+    direct = {int(cid): (int(lang), int(nb))
+              for cid, lang, nb in cb.direct_adds[b] if cid >= 0}
+    g0 = int(cb.doc_chunk_start[b])
+    for c in range(int(cb.n_chunks[b])):
+        if c in direct:
+            lang, nb = direct[c]
+            doc_tote.add(lang, nb, nb, 100)
+        elif rows[g0 + c, 4]:
+            doc_tote.add(int(rows[g0 + c, 0]), int(rows[g0 + c, 1]),
+                         int(rows[g0 + c, 2]), int(rows[g0 + c, 3]))
+    total_text_bytes = int(cb.text_bytes[b])
+    flags = eng.flags
+
+    refine_close_pairs(eng.reg, doc_tote)
+    doc_tote.sort()
+    lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
+        doc_tote, total_text_bytes)
+
+    good = (flags & FLAG_FINISH) or total <= SHORT_TEXT_THRESH or \
+        (is_reliable and percent3[0] >= GOOD_LANG1_PERCENT) or \
+        (is_reliable and
+         percent3[0] + percent3[1] >= GOOD_LANG1AND2_PERCENT)
+    if not good:
+        return None
+
+    if not (flags & FLAG_BEST_EFFORT):
+        remove_unreliable(eng.reg, doc_tote)
+    doc_tote.sort()
+    lang3, percent3, rel3, ns3, total, is_reliable = extract_lang_etc(
+        doc_tote, total_text_bytes)
+    summary, reliable = calc_summary_lang(eng.reg, lang3, percent3,
+                                          total, is_reliable, flags)
+    return ScalarResult(summary_lang=summary, language3=lang3,
+                        percent3=percent3, normalized_score3=ns3,
+                        text_bytes=total, is_reliable=reliable)
 
 
 def test_native_epilogue_real_texts(eng):
+    """ldt_epilogue_flat == the Python replay on real texts through the
+    full pack+score pipeline (including gate-failure and fallback docs)."""
     texts = TEXTS * 3
-    packed = eng._pack(texts, eng.tables, eng.reg,
-                       max_slots=eng.max_slots, max_chunks=eng.max_chunks,
-                       flags=eng.flags)
-    out = eng.score_packed(packed)
-    want = _python_results(eng, texts, packed, out)
-    got = eng._epilogue_native(texts, packed, out)
-    assert [dataclass_tuple(r) for r in got] == \
-        [dataclass_tuple(r) for r in want]
+    cb = native.pack_chunks_native(texts, eng.tables, eng.reg,
+                                   flags=eng.flags)
+    rows = eng.score_chunk_batch(cb)
+    ep = native.epilogue_flat_native(rows, cb, eng.flags, eng.reg)
+    for b, text in enumerate(texts):
+        if cb.fallback[b]:
+            assert ep[b, 12] == 1, b
+            continue
+        want = _python_doc_epilogue(eng, cb, rows, b)
+        if want is None:
+            assert ep[b, 12] == 1, (b, text[:40])
+            continue
+        assert ep[b, 12] == 0, (b, text[:40])
+        got = (int(ep[b, 0]), [int(x) for x in ep[b, 1:4]],
+               [int(x) for x in ep[b, 4:7]],
+               [float(x) for x in ep[b, 7:10]], int(ep[b, 10]),
+               bool(ep[b, 11]))
+        assert got == dataclass_tuple(want), (b, text[:40])
 
 
 def dataclass_tuple(r):
@@ -71,6 +119,7 @@ def dataclass_tuple(r):
 def test_native_epilogue_randomized(eng):
     """Synthetic chunk summaries: random languages/bytes/scores/reliability
     hammer the DocTote eviction + merge paths."""
+    import dataclasses
     rng = np.random.default_rng(7)
     B, C, D = 256, 8, 4
     langs = rng.integers(0, 200, (B, C)).astype(np.int32)
@@ -78,7 +127,8 @@ def test_native_epilogue_randomized(eng):
     scores = rng.integers(0, 4000, (B, C)).astype(np.int32)
     rel = rng.integers(0, 101, (B, C)).astype(np.int32)
     real = (rng.random((B, C)) < 0.8).astype(np.int32)
-    rows = np.stack([langs, nbytes, scores, rel, real], axis=-1)
+    rows = np.stack([langs, nbytes, scores, rel, real],
+                    axis=-1).reshape(B * C, 5)
     direct = np.full((B, D, 3), -1, np.int32)
     # a third of docs get one direct add on a random chunk id
     for b in range(0, B, 3):
@@ -88,8 +138,13 @@ def test_native_epilogue_randomized(eng):
     text_bytes = rng.integers(0, 20000, B).astype(np.int32)
     skip = np.zeros(B, bool)
 
-    ep = native.epilogue_batch_native(rows, direct, text_bytes, skip,
-                                      0, registry)
+    cb = native.ChunkBatch(
+        wire={}, doc_chunk_start=(np.arange(B, dtype=np.int64) * C),
+        direct_adds=direct, text_bytes=text_bytes, fallback=skip,
+        squeezed=np.zeros(B, bool),
+        n_slots=np.zeros(B, np.int32),
+        n_chunks=np.full(B, C, np.int32), n_docs=B)
+    ep = native.epilogue_flat_native(rows, cb, 0, registry)
 
     from language_detector_tpu.engine_scalar import (
         FLAG_FINISH, GOOD_LANG1_PERCENT, GOOD_LANG1AND2_PERCENT,
@@ -102,9 +157,9 @@ def test_native_epilogue_randomized(eng):
             if c in dmap:
                 lang, nb = dmap[c]
                 doc.add(lang, nb, nb, 100)
-            elif rows[b, c, 4]:
-                doc.add(int(rows[b, c, 0]), int(rows[b, c, 1]),
-                        int(rows[b, c, 2]), int(rows[b, c, 3]))
+            elif rows[b * C + c, 4]:
+                doc.add(int(rows[b * C + c, 0]), int(rows[b * C + c, 1]),
+                        int(rows[b * C + c, 2]), int(rows[b * C + c, 3]))
         refine_close_pairs(registry, doc)
         doc.sort()
         lang3, percent3, rel3, ns3, total, is_rel = extract_lang_etc(

@@ -198,21 +198,6 @@ def test_mixed_traffic_batch(server):
     assert resp[4]["iso6391code"] == "ja"
 
 
-def test_buffer_pool_rotation_and_eviction():
-    """BufferPool: same-shape requests rotate through RING warm sets;
-    shapes evict LRU beyond MAX_KEYS (native/__init__.py contract)."""
-    from language_detector_tpu import native
-    pool = native.BufferPool()
-    first = pool.get(8, 64, 8, 8)
-    ring = [pool.get(8, 64, 8, 8) for _ in range(pool.RING)]
-    assert ring[pool.RING - 1] is first  # wrapped around
-    # distinct shapes beyond MAX_KEYS evict the least-recently-used
-    for k in range(pool.MAX_KEYS):
-        pool.get(8 + k + 1, 64, 8, 8)
-    assert (8, 64, 8, 8) not in pool._rings
-    assert len(pool._rings) == pool.MAX_KEYS
-
-
 def test_device_engine_service_path():
     """The service's production configuration (use_device=True): requests
     flow through the batcher into the batched device engine and back.

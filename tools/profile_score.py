@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
-"""Device timing for the resolved-wire scorer.
+"""Device timing for the chunk-major scorer.
 
-Times the production program (ops/score.py score_resolved) over the bench
+Times the production program (ops/score.py score_chunks) over the bench
 corpus three ways — device-resident inputs (compute + readback), full
 round trip (transfer + compute + readback), and a trivial jit call (the
 backend's fixed dispatch latency) — so wire-size and compute changes can
@@ -27,18 +27,20 @@ def main(batch_size: int = 8192, iters: int = 5):
     import jax
     import jax.numpy as jnp
     from bench import make_corpus
-    from language_detector_tpu.models.ngram import NgramBatchEngine, to_wire
-    from language_detector_tpu.ops.score import score_resolved
+    from language_detector_tpu import native
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    from language_detector_tpu.ops.score import score_chunks
 
     eng = NgramBatchEngine()
     docs = make_corpus(batch_size)
     t0 = time.time()
-    rb = eng._pack(docs, eng.tables, eng.reg, max_slots=eng.max_slots,
-                   max_chunks=eng.max_chunks, flags=eng.flags)
+    cb = native.pack_chunks_native(docs, eng.tables, eng.reg,
+                                   flags=eng.flags)
     t_pack = time.time() - t0
-    p = to_wire(rb, eng.max_slots, eng.max_chunks)
+    p = cb.wire
     print(f"wire: B={batch_size} N={p['idx'].shape[1]} "
-          f"avg_slots={rb.n_slots.mean():.1f} "
+          f"G={p['cmeta'].shape[1]} K={p['k_iota'].shape[0]} "
+          f"avg_slots={cb.n_slots.mean():.1f} "
           f"({sum(a.nbytes for a in p.values()) / 1e6:.2f} MB); "
           f"pack {t_pack * 1e3:.1f} ms", flush=True)
 
@@ -55,16 +57,16 @@ def main(batch_size: int = 8192, iters: int = 5):
           "ms", flush=True)
 
     pd = {k: jax.device_put(v) for k, v in p.items()}
-    np.asarray(score_resolved(eng.dt, pd))  # compile
+    np.asarray(score_chunks(eng.dt, pd))  # compile
     t0 = time.time()
     for _ in range(iters):
-        np.asarray(score_resolved(eng.dt, pd))
+        np.asarray(score_chunks(eng.dt, pd))
     print(f"compute + readback:          {(time.time()-t0)/iters*1e3:8.1f} "
           "ms", flush=True)
 
     t0 = time.time()
     for _ in range(iters):
-        np.asarray(score_resolved(eng.dt, p))
+        np.asarray(score_chunks(eng.dt, p))
     print(f"transfer+compute+readback:   {(time.time()-t0)/iters*1e3:8.1f} "
           "ms", flush=True)
 
