@@ -112,7 +112,10 @@ class NgramBatchEngine:
     # 100-160K chunk rows ~ 100-200MB peak per dispatch.
     DISPATCH_CHAR_BUDGET = 6 << 20
 
-    def detect_batch(self, texts: list[str]) -> list[ScalarResult]:
+    def detect_batch(self, texts: list[str]) -> list:
+        """ScalarResult-compatible results, one per text (EpilogueResult
+        views for device-scored docs, real ScalarResults for scalar-path
+        docs)."""
         if not texts:
             return []
         if self.flags & ~_DEVICE_OK_FLAGS:
@@ -124,30 +127,36 @@ class NgramBatchEngine:
         return self._finish(texts, cb, fut)
 
     def detect_many(self, texts: list[str],
-                    batch_size: int = 16384) -> list[ScalarResult]:
-        """Multi-batch detection with host/device pipelining: the main
-        thread packs + dispatches batch N+1 while pool workers force
-        batch N's device execution and run its epilogue (both the C++
-        pack and epilogue release the GIL). Sustained-throughput entry
-        point for the service layer and bench."""
+                    batch_size: int = 16384) -> list:
+        """Multi-batch detection with host/device pipelining; returns
+        ScalarResult-compatible rows (EpilogueResult views; scalar-path
+        docs get real ScalarResults). Sustained-throughput entry point
+        for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
+        out: list = []
+        for part in self._pipelined(texts, batch_size, self._finish):
+            out.extend(part)
+        return out
+
+    def _pipelined(self, texts: list[str], batch_size: int, finish):
+        """Shared pipeline: the main thread packs + dispatches slice N+1
+        while pool workers force slice N's device execution and run its
+        epilogue (the C++ pack, the epilogue, and the readback all
+        release the GIL). Yields finish()'s per-slice values in order.
+        Depth 3 keeps the device queue full across the ~95ms dispatch
+        latency of this host's TPU tunnel (>= 3 concurrent fetches reach
+        the backend's overlap ceiling)."""
         from concurrent.futures import ThreadPoolExecutor
-        results: list[ScalarResult] = []
         pending: list = []
-        # workers force device fetches + run epilogues + batched retries
-        # concurrently with the main thread's C++ packing (all release
-        # the GIL); depth 3 keeps the device queue full across the
-        # ~95ms dispatch latency of this host's TPU tunnel
         with ThreadPoolExecutor(3) as pool:
             for chunk in self._slices(texts, batch_size):
                 cb, fut = self._dispatch(chunk)
-                pending.append(pool.submit(self._finish, chunk, cb, fut))
+                pending.append(pool.submit(finish, chunk, cb, fut))
                 while len(pending) > 3:
-                    results.extend(pending.pop(0).result())
+                    yield pending.pop(0).result()
             for f in pending:
-                results.extend(f.result())
-        return results
+                yield f.result()
 
     def _slices(self, texts: list[str], batch_size: int):
         """Greedy batch slicing by document count AND content volume
@@ -178,34 +187,35 @@ class NgramBatchEngine:
             c_doc=self.max_chunks)
         return cb, self._score_fn(self.dt, cb.wire)
 
-    def _finish(self, texts: list[str], cb, fut) -> list[ScalarResult]:
-        """Fetch the device result and run the document epilogue. Docs
-        that fail the good-answer gate re-score as a BATCH with the
+    def _epilogue(self, texts: list[str], cb, fut):
+        """Fetch the device result, run the C++ document epilogue, and
+        resolve the exception docs: packer fallbacks go scalar; docs
+        failing the good-answer gate re-score as a BATCH with the
         recursion flags (TOP40|REPEATS|FINISH, plus SQUEEZE for docs
         whose first pass squeezed) — the reference's recursive
         DetectLanguageSummaryV2 call (impl.cc:2061-2105) run on the
-        device instead of per-doc in the scalar engine. Packer-fallback
-        docs stay scalar. Runs on detect_many's worker pool, so stats
-        updates take the lock."""
+        device instead of per-doc in the scalar engine. Returns
+        (ep [B, 14], {doc index: ScalarResult} patches). Runs on
+        detect_many's worker pool, so stats updates take the lock."""
         from .. import native
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+        B = len(texts)
         with self._stats_lock:
             self.stats["batches"] += 1
-            self.stats["fallback_docs"] += int(cb.fallback[:len(texts)]
-                                               .sum())
+            self.stats["fallback_docs"] += int(cb.fallback[:B].sum())
         ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
-        results: list = [None] * len(texts)
+        patches: dict[int, ScalarResult] = {}
+        need = np.flatnonzero(ep[:B, 12])
+        if not need.size:
+            return ep, patches
         retry = {False: [], True: []}  # squeezed? -> [(index, text)]
-        for b, text in enumerate(texts):
-            row = ep[b]
-            if row[12]:  # need_scalar: fallback or gate failure
-                if cb.fallback[b]:
-                    results[b] = detect_scalar(text, self.tables, self.reg,
-                                               self.flags)
-                else:
-                    retry[bool(cb.squeezed[b])].append((b, text))
-                continue
-            results[b] = _result_from_row(row)
+        for b in need:
+            b = int(b)
+            if cb.fallback[b]:
+                patches[b] = detect_scalar(texts[b], self.tables,
+                                           self.reg, self.flags)
+            else:
+                retry[bool(cb.squeezed[b])].append((b, texts[b]))
         n_retry = len(retry[False]) + len(retry[True])
         if n_retry:
             with self._stats_lock:
@@ -218,8 +228,42 @@ class NgramBatchEngine:
                     (FLAG_SQUEEZE if squeezed else 0)
                 rs = self._score_with_flags([t for _, t in group], flags)
                 for (b, _), r in zip(group, rs):
-                    results[b] = r
+                    patches[b] = r
+        return ep, patches
+
+    def _finish(self, texts: list[str], cb, fut) -> list:
+        ep, patches = self._epilogue(texts, cb, fut)
+        # lazy row views instead of eager dataclasses: constructing 16K
+        # ScalarResults costs ~70ms on the single-core host while most
+        # consumers read one or two fields; the view defers field
+        # extraction to attribute access (ScalarResult-compatible)
+        results = [EpilogueResult(r) for r in ep[:len(texts)].tolist()]
+        for b, r in patches.items():
+            results[b] = r
         return results
+
+    def _finish_codes(self, texts: list[str], cb, fut) -> np.ndarray:
+        """Summary-language ids only (no per-doc result objects)."""
+        ep, patches = self._epilogue(texts, cb, fut)
+        out = ep[:len(texts), 0].astype(np.int32)
+        for b, r in patches.items():
+            out[b] = r.summary_lang
+        return out
+
+    def detect_codes(self, texts: list[str],
+                     batch_size: int = 16384) -> list[str]:
+        """Summary ISO codes only — the reference's production semantic
+        (wrapper.cc:7-16 discards everything but the code string), so
+        the service (server.py) and eval harness consume this. Skips
+        per-document result materialization entirely, which matters on a
+        single-core host."""
+        if self.flags & ~_DEVICE_OK_FLAGS or not texts:
+            return [self.reg.code(r.summary_lang)
+                    for r in self.detect_batch(texts)]
+        parts = list(self._pipelined(texts, batch_size,
+                                     self._finish_codes))
+        ids = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+        return self.reg.lang_code[ids].tolist()
 
     def _score_with_flags(self, texts: list[str],
                           flags: int) -> list[ScalarResult]:
@@ -240,6 +284,48 @@ class NgramBatchEngine:
                 continue
             results.append(_result_from_row(row))
         return results
+
+
+class EpilogueResult:
+    """Lazy ScalarResult-compatible view over one ldt_epilogue_flat row
+    (a plain 14-int list). Field extraction happens on attribute access —
+    building 16K eager dataclasses per batch costs ~70ms of single-core
+    host time the common consumers (code-only service path, top-1 eval)
+    never use."""
+    __slots__ = ("_r",)
+    chunks = None  # ResultChunk vectors come from the scalar engine only
+
+    def __init__(self, row: list):
+        self._r = row
+
+    @property
+    def summary_lang(self) -> int:
+        return self._r[0]
+
+    @property
+    def language3(self) -> list:
+        return self._r[1:4]
+
+    @property
+    def percent3(self) -> list:
+        return self._r[4:7]
+
+    @property
+    def normalized_score3(self) -> list:
+        return [float(x) for x in self._r[7:10]]
+
+    @property
+    def text_bytes(self) -> int:
+        return self._r[10]
+
+    @property
+    def is_reliable(self) -> bool:
+        return self._r[11] != 0
+
+    def __repr__(self):
+        return (f"EpilogueResult(summary_lang={self.summary_lang}, "
+                f"language3={self.language3}, percent3={self.percent3}, "
+                f"is_reliable={self.is_reliable})")
 
 
 def _result_from_row(row) -> ScalarResult:

@@ -109,7 +109,7 @@ class Metrics:
 class DetectorService:
     """Engine + batcher + metrics shared by all handler threads."""
 
-    def __init__(self, max_batch: int = 4096, max_delay_ms: float = 5.0,
+    def __init__(self, max_batch: int = 16384, max_delay_ms: float = 5.0,
                  use_device: bool = True):
         self.metrics = Metrics()
         self.known = json.loads(_CODES_FILE.read_text())
@@ -130,8 +130,12 @@ class DetectorService:
                 metrics = self.metrics
 
                 def detect(texts):
+                    # codes-only engine path: the handler needs just the
+                    # ISO code per item (wrapper.cc:7-16 semantics), and
+                    # skipping result materialization matters at 16K-doc
+                    # flushes on a single-core host
                     before = dict(eng.stats)
-                    results = eng.detect_batch(texts)
+                    codes = eng.detect_codes(texts)
                     metrics.inc("ldt_batch_flushes_total",
                                 eng.stats["batches"] - before["batches"])
                     metrics.inc("ldt_fallback_documents_total",
@@ -139,7 +143,7 @@ class DetectorService:
                                  before["fallback_docs"]) +
                                 (eng.stats["scalar_recursion_docs"] -
                                  before["scalar_recursion_docs"]))
-                    return [registry.code(r.summary_lang) for r in results]
+                    return codes
                 return detect
             except (ImportError, RuntimeError):
                 pass
