@@ -146,11 +146,24 @@ class NgramBatchEngine:
         release the GIL). Yields finish()'s per-slice values in order.
         Depth 3 keeps the device queue full across the ~95ms dispatch
         latency of this host's TPU tunnel (>= 3 concurrent fetches reach
-        the backend's overlap ceiling)."""
+        the backend's overlap ceiling). A single-slice call (the service
+        batcher's common flush) skips the pool entirely — its flushes
+        already overlap on the batcher's worker pool, and per-call
+        thread spawning is real cost on the single-core host."""
+        slices = self._slices(texts, batch_size)
+        first = next(slices, None)
+        if first is None:
+            return
+        second = next(slices, None)
+        if second is None:
+            cb, fut = self._dispatch(first)
+            yield finish(first, cb, fut)
+            return
         from concurrent.futures import ThreadPoolExecutor
+        import itertools
         pending: list = []
         with ThreadPoolExecutor(3) as pool:
-            for chunk in self._slices(texts, batch_size):
+            for chunk in itertools.chain([first, second], slices):
                 cb, fut = self._dispatch(chunk)
                 pending.append(pool.submit(finish, chunk, cb, fut))
                 while len(pending) > 3:

@@ -1,0 +1,278 @@
+"""Event-driven HTTP front end: the single-core production server.
+
+Same JSON contract as service/server.py (the reference's handlers.go
+semantics — shared via pre_detect/post_detect), but served from one
+asyncio event loop instead of a thread per connection. On this host's
+single CPU core the threaded stack loses most of its cycles to GIL
+convoying and context switches once a few dozen sockets are active; the
+event loop serves hundreds of connections from one thread, and only the
+engine flushes leave it (a small executor, mirroring the sync Batcher's
+worker pool).
+
+Run: python -m language_detector_tpu.service.aioserver
+(LISTEN_PORT / PROMETHEUS_PORT env vars, like the sync server).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+from .batcher import _FLUSH_WORKERS
+from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
+                     parse_post_body, post_detect, pre_detect)
+
+_MAX_HEADER_BYTES = 16384
+
+
+class AioBatcher:
+    """Asyncio-native twin of batcher.Batcher: accumulate submissions
+    from the event loop, flush to the engine on a small executor, and
+    resolve asyncio futures back on the loop."""
+
+    def __init__(self, detect_fn, max_batch: int = 16384,
+                 max_delay_ms: float = 5.0):
+        self._detect = detect_fn
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1e3
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
+                                        thread_name_prefix="ldt-aioflush")
+        self._task: asyncio.Task | None = None
+
+    def start(self):
+        self._task = asyncio.get_running_loop().create_task(
+            self._collector())
+
+    async def submit(self, texts: list) -> list:
+        fut = asyncio.get_running_loop().create_future()
+        await self._q.put((texts, fut))
+        # same 60s bound the sync path enforces via fut.result(60): a
+        # wedged flush must fail the request, not pin the connection
+        return await asyncio.wait_for(fut, timeout=60)
+
+    async def close(self):
+        if self._task is not None:
+            self._task.cancel()
+        self._pool.shutdown(wait=False)
+
+    async def _collector(self):
+        loop = asyncio.get_running_loop()
+        # bound in-flight flushes (executor queue would otherwise grow
+        # unboundedly when the device falls behind)
+        slots = asyncio.Semaphore(_FLUSH_WORKERS + 1)
+        while True:
+            pending = [await self._q.get()]
+            n = len(pending[0][0])
+            deadline = loop.time() + self.max_delay
+            while n < self.max_batch:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._q.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                pending.append(nxt)
+                n += len(nxt[0])
+            await slots.acquire()
+            texts = [t for ts, _ in pending for t in ts]
+            task = loop.run_in_executor(self._pool, self._detect, texts)
+
+            def _done(ftr, pending=pending):
+                slots.release()
+                err = ftr.exception()
+                if err is not None:
+                    for _, fut in pending:
+                        if not fut.done():
+                            fut.set_exception(err)
+                    return
+                results = ftr.result()
+                i = 0
+                for ts, fut in pending:
+                    if not fut.done():
+                        fut.set_result(results[i:i + len(ts)])
+                    i += len(ts)
+            task.add_done_callback(_done)
+
+
+def _http_response(status: int, body: bytes,
+                   content_type: bytes = b"application/json; "
+                                         b"charset=utf-8") -> bytes:
+    reason = {200: b"OK", 203: b"Non-Authoritative Information",
+              400: b"Bad Request", 404: b"Not Found",
+              431: b"Request Header Fields Too Large"}.get(status, b"OK")
+    return (b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+            b"Content-Length: %d\r\n\r\n"
+            % (status, reason, content_type, len(body))) + body
+
+
+class AioService:
+    """Connection handling + routing over a shared DetectorService."""
+
+    def __init__(self, svc: DetectorService | None = None,
+                 max_batch: int = 16384, max_delay_ms: float = 5.0):
+        # reuse DetectorService for metrics/codes/engine, but route
+        # detection through the asyncio batcher (one batching layer
+        # only: an internally-built service skips the sync Batcher, a
+        # caller-provided one gets its batcher closed)
+        self.svc = svc or DetectorService(max_batch=max_batch,
+                                          max_delay_ms=max_delay_ms,
+                                          start_batcher=False)
+        if self.svc.batcher is not None:
+            self.svc.batcher.close()
+            self.svc.batcher = None
+        self.batcher = AioBatcher(self.svc._detect, max_batch,
+                                  max_delay_ms)
+        self._usage = json.dumps(USAGE).encode()
+
+    async def handle(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter):
+        try:
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                import socket as _s
+                sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                except asyncio.LimitOverrunError:
+                    writer.write(_http_response(
+                        431, b'{"error":"headers too large"}'))
+                    break
+                if len(head) > _MAX_HEADER_BYTES:
+                    writer.write(_http_response(
+                        431, b'{"error":"headers too large"}'))
+                    break
+                line, _, rest = head.partition(b"\r\n")
+                parts = line.split()
+                if len(parts) < 2:
+                    break
+                method, path = parts[0], parts[1].decode("latin-1")
+                headers = {}
+                for h in rest.split(b"\r\n"):
+                    k, _, v = h.partition(b":")
+                    if _:
+                        headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get(b"content-length", 0) or 0)
+                except ValueError:
+                    length = 0
+                body = b""
+                if length > 0:
+                    # truncate at the 1MB contract limit, draining the
+                    # rest so keep-alive stays in sync (handlers.go:43)
+                    want = min(length, BODY_LIMIT_BYTES)
+                    body = await reader.readexactly(want)
+                    left = length - want
+                    while left > 0:
+                        chunk = await reader.read(min(left, 65536))
+                        if not chunk:
+                            break
+                        left -= len(chunk)
+                resp = await self._route(method, path, headers, body)
+                writer.write(resp)
+                await writer.drain()
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - already torn down
+                pass
+
+    async def _route(self, method: bytes, path: str, headers: dict,
+                     body: bytes) -> bytes:
+        svc = self.svc
+        m = svc.metrics
+        import time
+        t0 = time.time()
+        try:
+            if method == b"GET":
+                if path in ("/", ""):
+                    return _http_response(200, self._usage)
+                m.inc("augmentation_invalid_requests_total")
+                return _http_response(404, b'{"error":"Not found"}')
+            if method != b"POST" or path not in ("/", ""):
+                m.inc("augmentation_invalid_requests_total")
+                return _http_response(404, b'{"error":"Not found"}')
+            ct = headers.get(b"content-type")
+            doc, err = parse_post_body(
+                m, ct.decode("latin-1") if ct is not None else None, body)
+            if err is not None:
+                return _http_response(*err)
+            pre = pre_detect(svc, doc)
+            if pre is None:
+                m.inc("augmentation_errors_logged_total")
+                return _http_response(400, json.dumps(
+                    {"error": "Unable to parse request - invalid JSON "
+                              "detected"}).encode())
+            texts, slots, responses, status = pre
+            codes = await self.batcher.submit(texts) if texts else []
+            status, payload = post_detect(svc, codes, slots, responses,
+                                          status)
+            return _http_response(status, payload)
+        finally:
+            m.inc("augmentation_requests_total")
+            m.inc("augmentation_request_duration_milliseconds",
+                  (time.time() - t0) * 1e3)
+
+    async def handle_metrics(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        asyncio.LimitOverrunError):
+                    break
+                body = self.svc.metrics.render().encode()
+                writer.write(_http_response(
+                    200, body, b"text/plain; version=0.0.4"))
+                await writer.drain()
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+async def serve(port: int = 3000, metrics_port: int = 30000,
+                svc: DetectorService | None = None,
+                ready: "asyncio.Future | None" = None):
+    aio = AioService(svc)
+    aio.batcher.start()
+    # the stream limit must exceed the body contract limit: readexactly
+    # waits for the full body in the buffer, and the transport pauses at
+    # 2x limit — a smaller limit would deadlock large (legal) bodies.
+    # Bind IPv4 explicitly: host "" dual-stack-binds v4 AND v6, and with
+    # port=0 each family gets a DIFFERENT ephemeral port (sockets[0]'s
+    # family is unordered — callers would connect to the wrong one).
+    server = await asyncio.start_server(aio.handle, "0.0.0.0", port,
+                                        limit=BODY_LIMIT_BYTES + 65536)
+    mserver = await asyncio.start_server(aio.handle_metrics, "0.0.0.0",
+                                         metrics_port)
+    ports = (server.sockets[0].getsockname()[1],
+             mserver.sockets[0].getsockname()[1])
+    print(json.dumps({"msg": f"language-detector (asyncio) listening on "
+                             f":{ports[0]}, metrics on :{ports[1]}"}),
+          flush=True)
+    if ready is not None and not ready.done():
+        ready.set_result(ports)
+    async with server, mserver:
+        await asyncio.gather(server.serve_forever(),
+                             mserver.serve_forever())
+
+
+def main():
+    port = int(os.environ.get("LISTEN_PORT", 3000))
+    metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
+    try:
+        asyncio.run(serve(port, metrics_port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

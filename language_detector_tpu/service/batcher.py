@@ -5,27 +5,39 @@ The reference calls the detector once per item inside the handler loop
 (handlers.go:133-186, one cgo call each); the TPU redesign accumulates
 items from all in-flight requests and dispatches them as one batch
 (SURVEY.md §3.1), trading a small queueing delay for device efficiency.
-A single worker thread drains the queue, flushing when `max_batch` items
-are pending or `max_delay_ms` has passed since the oldest undispatched
-item arrived.
+A collector thread drains the queue, flushing when `max_batch` items are
+pending or `max_delay_ms` has passed since the oldest undispatched item
+arrived; flushes run on a small worker pool so batch N+1 accumulates and
+dispatches while batch N is still in flight on the device — without
+this, every flush pays the backend's full ~95ms dispatch latency
+serially and HTTP throughput collapses to flush_size/latency.
 """
 from __future__ import annotations
 
 import queue
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
+
+# concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
+# ceiling (models/ngram.py _pipelined uses the same depth)
+_FLUSH_WORKERS = 3
 
 
 class Batcher:
     """Deadline/size-batched dispatcher over a detection engine."""
 
-    def __init__(self, detect_fn, max_batch: int = 4096,
+    def __init__(self, detect_fn, max_batch: int = 16384,
                  max_delay_ms: float = 5.0):
         self._detect = detect_fn          # list[str] -> list[results]
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
+                                        thread_name_prefix="ldt-flush")
+        # bound in-flight flushes so a backed-up device cannot pile
+        # unbounded batches in memory
+        self._slots = threading.Semaphore(_FLUSH_WORKERS + 1)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ldt-batcher")
         self._thread.start()
@@ -39,10 +51,11 @@ class Batcher:
 
     def close(self):
         self._stop.set()
-        self._q.put(None)  # wake the worker
+        self._q.put(None)  # wake the collector
         self._thread.join(timeout=5)
+        self._pool.shutdown(wait=True)
 
-    # -- worker --------------------------------------------------------------
+    # -- collector -----------------------------------------------------------
 
     def _run(self):
         while not self._stop.is_set():
@@ -66,6 +79,34 @@ class Batcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            # acquire a flush slot without racing close(): if the pool
+            # is being torn down, fail this batch's waiters instead of
+            # submitting to a shut-down executor (which would kill the
+            # collector and hang every waiter)
+            while not self._slots.acquire(timeout=0.5):
+                if self._stop.is_set():
+                    self._fail(pending,
+                               RuntimeError("batcher closed"))
+                    return
+            if self._stop.is_set():
+                self._slots.release()
+                self._fail(pending, RuntimeError("batcher closed"))
+                return
+            try:
+                self._pool.submit(self._flush, pending)
+            except RuntimeError as e:  # close() shut the pool first
+                self._slots.release()
+                self._fail(pending, e)
+                return
+
+    @staticmethod
+    def _fail(pending: list, err: Exception):
+        for _, fut in pending:
+            if not fut.cancelled():
+                fut.set_exception(err)
+
+    def _flush(self, pending: list):
+        try:
             texts = [t for ts, _ in pending for t in ts]
             try:
                 results = self._detect(texts)
@@ -73,9 +114,11 @@ class Batcher:
                 for _, fut in pending:
                     if not fut.cancelled():
                         fut.set_exception(e)
-                continue
+                return
             i = 0
             for ts, fut in pending:
                 if not fut.cancelled():
                     fut.set_result(results[i:i + len(ts)])
                 i += len(ts)
+        finally:
+            self._slots.release()

@@ -224,3 +224,68 @@ def test_device_engine_service_path():
         httpd.shutdown()
         metricsd.shutdown()
         svc.batcher.close()
+
+
+def test_aio_server_contract():
+    """The asyncio front end (service/aioserver.py) speaks the same
+    contract as the threaded server: usage, detection, per-item errors,
+    wrong content type, 404, metrics — served from one event loop."""
+    import asyncio
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    ports_q: _q.Queue = _q.Queue()
+    loop_holder = {}
+
+    def run_loop():
+        async def main():
+            loop_holder["loop"] = asyncio.get_running_loop()
+            ready = asyncio.get_running_loop().create_future()
+            svc = DetectorService(use_device=False, max_delay_ms=1.0)
+            task = asyncio.get_running_loop().create_task(
+                serve(0, 0, svc=svc, ready=ready))
+            ports_q.put(await ready)
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass  # loop.stop() teardown ends the run mid-await
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    port, mport = ports_q.get(timeout=30)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        status, body = _get(url + "/")
+        assert status == 200 and body and json.loads(body)["result"]
+
+        status, body = _post(url + "/", {"request": [
+            {"text": "ภาษาไทยเป็นภาษาที่สวยงามมาก"},
+            {"nokey": 1},
+        ]})
+        assert status == 400  # per-item error forces 400 overall
+        assert body["response"][0]["iso6391code"] == "th"
+        assert body["response"][1] == {"error": "Missing text key"}
+
+        status, body = _post(url + "/", {"x": 1}, raw=b"not json{{")
+        assert status == 400
+
+        status, body = _post(url + "/", {"request": []},
+                             content_type="text/plain")
+        assert status == 400
+        assert "Content-Type" in body["error"]
+
+        status, body = _get(url + "/bogus")
+        assert status == 404
+
+        status, body = _get(f"http://127.0.0.1:{mport}/metrics")
+        assert status == 200
+        assert b"augmentation_requests_total" in body
+    finally:
+        loop = loop_holder.get("loop")
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)

@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""HTTP-path throughput benchmark: docs/sec through POST / end-to-end.
+
+Starts the real service in-process (device engine + batcher + the
+reference's JSON contract, service/server.py), drives it with concurrent
+keep-alive HTTP clients, and reports end-to-end docs/sec — the number the
+reference actually shipped (its Go layer logged throughput per 1000
+objects, main.go:209-218, but never published one). Results feed
+docs/PERF.md.
+
+Usage: bench_service.py [total_docs] [clients] [docs_per_request]
+       bench_service.py --aio [total_docs] [clients] [docs_per_request]
+Prints one JSON line. --aio benches the asyncio server (the single-core
+production front) with a same-loop asyncio load generator; the default
+benches the threaded server with threaded clients.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def run(total_docs: int = 98304, clients: int = 8,
+        docs_per_request: int = 512) -> dict:
+    from bench import make_corpus
+    from language_detector_tpu.service.server import (DetectorService,
+                                                      make_server)
+
+    svc = DetectorService(use_device=True, max_delay_ms=4.0)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+
+    docs = make_corpus(total_docs)
+    n_requests = total_docs // docs_per_request
+    payloads = []
+    for r in range(n_requests):
+        chunk = docs[r * docs_per_request:(r + 1) * docs_per_request]
+        payloads.append(json.dumps(
+            {"request": [{"text": d} for d in chunk]}).encode())
+
+    # warm-up: compile the device programs on a small request
+    warm = json.dumps({"request": [{"text": d}
+                                   for d in docs[:256]]}).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("POST", "/", warm,
+                 {"Content-Type": "application/json"})
+    conn.getresponse().read()
+    conn.close()
+
+    results = {"docs": 0, "errors": 0}
+    lock = threading.Lock()
+    work = list(enumerate(payloads))
+    widx = [0]
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        got, errs = 0, 0
+        while True:
+            with lock:
+                if widx[0] >= len(work):
+                    break
+                _, payload = work[widx[0]]
+                widx[0] += 1
+            conn.request("POST", "/", payload,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status in (200, 203):
+                # byte count instead of a JSON parse: the client runs on
+                # the same single core as the server, so client-side
+                # parsing steals serve-side throughput
+                got += body.count(b'"iso6391code"')
+            else:
+                errs += 1
+        conn.close()
+        with lock:
+            results["docs"] += got
+            results["errors"] += errs
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.time()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    took = time.time() - t0
+
+    httpd.shutdown()
+    svc.batcher.close()
+    docs_sec = results["docs"] / took
+    return dict(
+        metric="service_http_throughput",
+        value=round(docs_sec, 1),
+        unit="docs/sec",
+        detail=dict(total_docs=results["docs"], errors=results["errors"],
+                    clients=clients, docs_per_request=docs_per_request,
+                    took_sec=round(took, 2)),
+    )
+
+
+def run_aio(total_docs: int = 98304, clients: int = 32,
+            docs_per_request: int = 512) -> dict:
+    """Bench the asyncio server: server + clients share one event loop
+    (and the one CPU core), no thread thrash."""
+    import asyncio
+
+    from bench import make_corpus
+    from language_detector_tpu.service.aioserver import serve
+    from language_detector_tpu.service.server import DetectorService
+
+    docs = make_corpus(total_docs)
+    n_requests = total_docs // docs_per_request
+    payloads = []
+    for r in range(n_requests):
+        chunk = docs[r * docs_per_request:(r + 1) * docs_per_request]
+        body = json.dumps(
+            {"request": [{"text": d} for d in chunk]}).encode()
+        payloads.append(
+            b"POST / HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body) + body)
+
+    async def client(port, work, results):
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", port, limit=1 << 22)
+        sock = writer.get_extra_info("socket")
+        import socket as _s
+        sock.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        while work:
+            payload = work.pop()
+            writer.write(payload)
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            length = int(head.lower().split(b"content-length:")[1]
+                         .split(b"\r\n")[0])
+            body = await reader.readexactly(length)
+            status = int(head.split(b" ")[1])
+            if status in (200, 203):
+                results["docs"] += body.count(b'"iso6391code"')
+            else:
+                results["errors"] += 1
+        writer.close()
+
+    async def main():
+        svc = DetectorService(use_device=True, max_delay_ms=4.0)
+        ready = asyncio.get_running_loop().create_future()
+        server_task = asyncio.create_task(
+            serve(0, 0, svc=svc, ready=ready))
+        port, _ = await ready
+        # warm-up
+        results = {"docs": 0, "errors": 0}
+        await client(port, [payloads[0]], results)
+        results = {"docs": 0, "errors": 0}
+        work = list(payloads)
+        t0 = time.time()
+        await asyncio.gather(*[client(port, work, results)
+                               for _ in range(clients)])
+        took = time.time() - t0
+        server_task.cancel()
+        return results, took
+
+    results, took = asyncio.run(main())
+    docs_sec = results["docs"] / took
+    return dict(
+        metric="service_http_throughput_aio",
+        value=round(docs_sec, 1),
+        unit="docs/sec",
+        detail=dict(total_docs=results["docs"], errors=results["errors"],
+                    clients=clients, docs_per_request=docs_per_request,
+                    took_sec=round(took, 2)),
+    )
+
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--aio":
+        print(json.dumps(run_aio(*[int(a) for a in argv[1:]])))
+    else:
+        print(json.dumps(run(*[int(a) for a in argv])))
