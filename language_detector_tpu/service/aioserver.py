@@ -114,9 +114,11 @@ class AioService:
     def __init__(self, svc: DetectorService | None = None,
                  max_batch: int = 16384, max_delay_ms: float = 5.0):
         # reuse DetectorService for metrics/codes/engine, but route
-        # detection through the asyncio batcher (one batching layer
-        # only: an internally-built service skips the sync Batcher, a
-        # caller-provided one gets its batcher closed)
+        # detection through the asyncio batcher — one batching layer
+        # only. Callers should construct their service with
+        # start_batcher=False; a service arriving with a live sync
+        # Batcher gets it closed (and svc.detect_codes disabled), since
+        # sharing one service between both fronts double-batches.
         self.svc = svc or DetectorService(max_batch=max_batch,
                                           max_delay_ms=max_delay_ms,
                                           start_batcher=False)
@@ -162,20 +164,28 @@ class AioService:
                 except ValueError:
                     length = 0
                 body = b""
-                if length > 0:
-                    # truncate at the 1MB contract limit, draining the
-                    # rest so keep-alive stays in sync (handlers.go:43)
-                    want = min(length, BODY_LIMIT_BYTES)
-                    body = await reader.readexactly(want)
-                    left = length - want
-                    while left > 0:
-                        chunk = await reader.read(min(left, 65536))
-                        if not chunk:
-                            break
-                        left -= len(chunk)
-                resp = await self._route(method, path, headers, body)
-                writer.write(resp)
-                await writer.drain()
+                try:
+                    if length > 0:
+                        # truncate at the 1MB contract limit, draining
+                        # the rest so keep-alive stays in sync
+                        # (handlers.go:43)
+                        want = min(length, BODY_LIMIT_BYTES)
+                        body = await reader.readexactly(want)
+                        left = length - want
+                        while left > 0:
+                            chunk = await reader.read(min(left, 65536))
+                            if not chunk:
+                                break
+                            left -= len(chunk)
+                    resp = await self._route(method, path, headers, body)
+                    writer.write(resp)
+                    await writer.drain()
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        TimeoutError):
+                    # abrupt disconnect mid-body or mid-response: drop
+                    # the connection quietly (health probes and impatient
+                    # clients would otherwise spam task tracebacks)
+                    break
         finally:
             try:
                 writer.close()

@@ -79,22 +79,15 @@ class Batcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
-            # acquire a flush slot without racing close(): if the pool
-            # is being torn down, fail this batch's waiters instead of
-            # submitting to a shut-down executor (which would kill the
-            # collector and hang every waiter)
-            while not self._slots.acquire(timeout=0.5):
-                if self._stop.is_set():
-                    self._fail(pending,
-                               RuntimeError("batcher closed"))
-                    return
-            if self._stop.is_set():
-                self._slots.release()
-                self._fail(pending, RuntimeError("batcher closed"))
-                return
+            # block for a flush slot, then submit. close() keeps the
+            # pool alive until in-flight flushes finish (shutdown
+            # wait=True after joining this thread), so a batch in hand
+            # at shutdown still gets served; only a pool that is truly
+            # gone fails the waiters instead of killing the collector.
+            self._slots.acquire()
             try:
                 self._pool.submit(self._flush, pending)
-            except RuntimeError as e:  # close() shut the pool first
+            except RuntimeError as e:  # pool shut down first
                 self._slots.release()
                 self._fail(pending, e)
                 return

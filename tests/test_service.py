@@ -242,7 +242,8 @@ def test_aio_server_contract():
         async def main():
             loop_holder["loop"] = asyncio.get_running_loop()
             ready = asyncio.get_running_loop().create_future()
-            svc = DetectorService(use_device=False, max_delay_ms=1.0)
+            svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                                  start_batcher=False)
             task = asyncio.get_running_loop().create_task(
                 serve(0, 0, svc=svc, ready=ready))
             ports_q.put(await ready)

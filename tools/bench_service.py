@@ -151,7 +151,8 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
         writer.close()
 
     async def main():
-        svc = DetectorService(use_device=True, max_delay_ms=4.0)
+        svc = DetectorService(use_device=True, max_delay_ms=4.0,
+                              start_batcher=False)
         ready = asyncio.get_running_loop().create_future()
         server_task = asyncio.create_task(
             serve(0, 0, svc=svc, ready=ready))

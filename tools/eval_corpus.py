@@ -8,12 +8,16 @@ correct/wrong-got/wrong-missed counts, and print per-language
 precision/recall/F plus the _Totals_Known aggregate row and the top
 confusions per language.
 
-Input: a TSV of "code<TAB>text" lines (--corpus), or the reference golden
-suite by default (tests/golden_data.py). Detection runs on the batched
-engine when an accelerator is available, else the scalar engine.
+Input: a TSV of "code<TAB>text" lines (--corpus, streamed — corpora of
+millions of lines never fully materialize), or the reference golden suite
+by default (tests/golden_data.py). Detection runs the batched engine's
+codes-only path in 16K-doc blocks when an accelerator is available, else
+the scalar engine; --mesh N shards blocks data-parallel over an N-device
+mesh (BASELINE configs #4-#5 are corpus streams over v5e meshes).
 
 Usage:
   python3 tools/eval_corpus.py [--corpus file.tsv] [--out docs/eval.txt]
+                               [--mesh N] [--limit N]
 """
 from __future__ import annotations
 
@@ -27,6 +31,17 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tests"))
 
+# Persist compiled programs across runs: a fresh process otherwise pays
+# 20-40s of jit compilation for the block shapes before the first result
+try:
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      str(REPO / ".jax_cache"))
+    # the block programs each compile in ~0.5-1.5s — persist them all
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+except Exception:  # noqa: BLE001 - no jax: scalar path still works
+    pass
+
 from language_detector_tpu.registry import registry  # noqa: E402
 from language_detector_tpu.tables import ScoringTables  # noqa: E402
 
@@ -35,53 +50,92 @@ from language_detector_tpu.tables import ScoringTables  # noqa: E402
 ALIASES = {("hmn", "blu"): True}
 
 
-def load_pairs(path: str | None):
+def iter_pairs(path: str | None, limit: int | None = None):
+    """Stream (label, text) pairs; TSV files are read line-by-line so a
+    multi-GB corpus never materializes."""
+    n = 0
     if path:
-        pairs = []
-        for line in Path(path).read_text().splitlines():
-            if "\t" in line:
-                code, text = line.split("\t", 1)
-                pairs.append((code.strip(), text))
-        return pairs
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                if "\t" not in line:
+                    continue
+                code, text = line.rstrip("\n").split("\t", 1)
+                yield code.strip(), text
+                n += 1
+                if limit and n >= limit:
+                    return
+        return
     from golden_data import golden_pairs
-    return [(lang, raw.decode("utf-8", errors="replace"))
-            for _, lang, raw in golden_pairs()]
+    for _, lang, raw in golden_pairs():
+        yield lang, raw.decode("utf-8", errors="replace")
+        n += 1
+        if limit and n >= limit:
+            return
 
 
-def detect_all(texts, tables):
+def make_detector(tables, mesh_size: int | None = None):
+    """codes-detector over 16K-doc blocks: batched engine (codes-only
+    fast path, optionally mesh-sharded) or the scalar engine."""
+    if mesh_size:
+        # an explicit mesh request must not silently degrade: a
+        # too-small device count or missing accelerator raises here
+        # instead of publishing scalar numbers as "mesh" results
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        from language_detector_tpu.parallel.mesh import batch_mesh
+        eng = NgramBatchEngine(tables, registry,
+                               mesh=batch_mesh(mesh_size))
+        return lambda texts: eng.detect_codes(texts, batch_size=16384)
     try:
         from language_detector_tpu.models.ngram import NgramBatchEngine
         eng = NgramBatchEngine(tables, registry)
-        return [registry.code(r.summary_lang)
-                for r in eng.detect_many(texts, batch_size=4096)]
+        return lambda texts: eng.detect_codes(texts, batch_size=16384)
     except (ImportError, RuntimeError):
         from language_detector_tpu.engine_scalar import detect_scalar
-        return [registry.code(detect_scalar(t, tables, registry)
-                              .summary_lang) for t in texts]
+        return lambda texts: [
+            registry.code(detect_scalar(t, tables, registry).summary_lang)
+            for t in texts]
 
 
-def evaluate(pairs, tables) -> str:
-    texts = [t for _, t in pairs]
-    t0 = time.time()
-    got = detect_all(texts, tables)
-    took = time.time() - t0
+BLOCK = 65536  # docs per streamed detection block
 
+
+def evaluate(pair_iter, tables, mesh_size: int | None = None) -> str:
+    detect = make_detector(tables, mesh_size)
     per_lang = collections.defaultdict(lambda: dict(correct=0, got=0,
                                                     actual=0))
     confusion = collections.defaultdict(collections.Counter)
-    for (want, _), g in zip(pairs, got):
-        hit = g == want or (g, want) in ALIASES
-        per_lang[want]["actual"] += 1
-        per_lang[g]["got"] += 1
-        if hit:
-            per_lang[want]["correct"] += 1
-        else:
-            confusion[want][g] += 1
+    n_docs = 0
+    took = 0.0
+    block: list = []
+
+    def flush():
+        nonlocal n_docs, took
+        if not block:
+            return
+        t0 = time.time()
+        got = detect([t for _, t in block])
+        took += time.time() - t0
+        n_docs += len(block)
+        for (want, _), g in zip(block, got):
+            hit = g == want or (g, want) in ALIASES
+            per_lang[want]["actual"] += 1
+            per_lang[g]["got"] += 1
+            if hit:
+                per_lang[want]["correct"] += 1
+            else:
+                confusion[want][g] += 1
+        block.clear()
+
+    for pair in pair_iter:
+        block.append(pair)
+        if len(block) >= BLOCK:
+            flush()
+    flush()
 
     lines = []
-    lines.append(f"Evaluation over {len(pairs)} labeled documents "
+    lines.append(f"Evaluation over {n_docs} labeled documents "
                  f"({len(per_lang)} languages), "
-                 f"{len(pairs)/max(took,1e-9):.0f} docs/sec")
+                 f"{n_docs/max(took,1e-9):.0f} docs/sec")
     lines.append("")
     lines.append(f"{'Language':12s} {'Precision':>9s} {'Recall':>8s} "
                  f"{'F':>7s} {'N':>6s}  Top confusions")
@@ -115,11 +169,15 @@ def main():
                     help="TSV code<TAB>text (default: golden suite)")
     ap.add_argument("--quad-tables", default=None)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--mesh", type=int, default=None,
+                    help="shard blocks over an N-device mesh")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="stop after N corpus lines")
     args = ap.parse_args()
 
     tables = ScoringTables.load(quad_path=args.quad_tables)
-    pairs = load_pairs(args.corpus)
-    report = evaluate(pairs, tables)
+    pairs = iter_pairs(args.corpus, args.limit)
+    report = evaluate(pairs, tables, args.mesh)
     print(report)
     if args.out:
         Path(args.out).write_text(report)
