@@ -38,8 +38,9 @@ def _build() -> bool:
 
 _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
             "ldt_pack_flat_begin", "ldt_pack_flat_finish",
-            "ldt_pack_flat_free", "ldt_epilogue_flat")
-_ABI_VERSION = 5  # must match packer.cc ldt_abi_version()
+            "ldt_pack_flat_free", "ldt_epilogue_flat", "ldt_init_detect",
+            "detect_language", "ldt_detect_batch_codes")
+_ABI_VERSION = 6  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -54,6 +55,8 @@ def _try_load_all():
         for sym in _SYMBOLS:
             getattr(lib, sym).restype = None
         lib.ldt_pack_flat_begin.restype = ctypes.c_int64
+        lib.detect_language.restype = ctypes.c_char_p
+        lib.detect_language.argtypes = [ctypes.c_char_p]
         return lib
     except (OSError, AttributeError):
         return None
@@ -139,7 +142,46 @@ def _ensure_init(tables: ScoringTables, reg: Registry):
             ctypes.c_int32(ht.q2.ind_off), ctypes.c_int32(ht.q2.size_one),
             ctypes.c_int32(1 if ht.q2_enabled else 0),
             ctypes.c_int32(ht.seed_ind_base))
+        # C ABI detection path (wrapper.h:8 seam): scoring + epilogue
+        # tables so detect_language()/ldt_detect_batch_codes() run with
+        # no Python in the loop
+        lg3 = np.zeros((256, 3), np.uint8)
+        lg3[:tables.lg_prob.shape[0]] = tables.lg_prob[:, 5:8]
+        plang = np.ascontiguousarray(np.stack([
+            reg.plang_to_lang_latn.astype(np.int32),
+            reg.plang_to_lang_othr.astype(np.int32)]))
+        n = reg.num_languages
+        expected = np.zeros((n, 4), np.int32)
+        es = tables.avg_delta_octa_score.astype(np.int32).reshape(-1, 4)
+        expected[:min(n, es.shape[0])] = es[:n]
+        close, alt, figs = _epilogue_reg_arrays(reg)
+        stride = 8
+        codes = np.zeros(n * stride, np.uint8)
+        for lang in range(n):
+            b = str(reg.lang_code[lang]).encode()[:stride - 1]
+            codes[lang * stride:lang * stride + len(b)] = \
+                np.frombuffer(b, np.uint8)
+        _init_keepalive.extend([lg3, plang, expected, close, alt, figs,
+                                codes])
+        lib.ldt_init_detect(
+            _ptr(lg3, np.uint8), _ptr(plang, np.int32),
+            _ptr(expected, np.int32), _ptr(close, np.int32),
+            _ptr(alt, np.int32), _ptr(figs, np.uint8),
+            ctypes.c_int32(n),
+            codes.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int32(stride))
         _initialized_for = key
+
+
+def ensure_init(tables: ScoringTables, reg: Registry):
+    """Public init seam for C-ABI hosts and tests: upload every table the
+    native library needs (packing + the C-only detection path), exactly
+    as the batched engine's first pack would."""
+    lib = _load()
+    if not lib:
+        raise RuntimeError("native library unavailable")
+    _ensure_init(tables, reg)
+    return lib
 
 
 def pack_batch_native(texts: list[str], tables: ScoringTables,

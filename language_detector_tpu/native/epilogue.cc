@@ -16,6 +16,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "ldt_internal.h"
+
 namespace {
 
 constexpr int kMax = 24;

@@ -17,6 +17,8 @@
 #include <thread>
 #include <vector>
 
+#include "ldt_internal.h"
+
 namespace {
 
 // ---- candidate kinds (preprocess/pack.py) ----
@@ -1328,6 +1330,132 @@ struct FlatPackState {
   std::vector<int64_t> doc_chunk_off;  // doc's chunk offset in its buffer
 };
 
+// ---- C ABI detection (wrapper.h:8 seam) -----------------------------------
+//
+// A cgo/Go host links this library and calls detect_language() /
+// ldt_detect_batch_codes() with no Python in the loop: the chunk scorer
+// below is a bit-exact C twin of the device program (ops/score.py
+// score_chunks_impl — same integer decode/tote/top-2/reliability math),
+// and the document epilogue is the existing ldt_epilogue_flat. Tables
+// arrive via ldt_init_tables + ldt_init_detect (today driven by the
+// Python runtime; the mmap artifact loader can drive them C-only).
+
+struct DetectCtx {
+  const uint8_t* lg_prob3 = nullptr;        // [256, 3] (padded from 240)
+  const int32_t* plang_to_lang = nullptr;   // [2, 256]
+  const int32_t* expected_score = nullptr;  // [n_lang, 4]
+  const int32_t* close_set = nullptr;       // [n_lang]
+  const int32_t* closest_alt = nullptr;     // [n_lang]
+  const uint8_t* is_figs = nullptr;         // [n_lang]
+  const char* codes = nullptr;              // [n_lang, code_stride]
+  int32_t n_lang = 0;
+  int32_t code_stride = 0;
+  bool ready = false;
+};
+DetectCtx dctx;
+
+inline int lscript4_of(int script) {
+  return script == 1 ? 0 : script == 3 ? 1 : script == 6 ? 2 : 3;
+}
+
+// cldutil.cc:553-570 (ops/score.py _reliability_delta)
+inline int c_rel_delta(int s1, int s2, int grams) {
+  int maxp = grams < 8 ? 12 * grams : 100;
+  int thresh = (grams * 5) >> 3;
+  thresh = thresh < 3 ? 3 : thresh > 16 ? 16 : thresh;
+  int delta = s1 - s2;
+  if (delta >= thresh) return maxp;
+  if (delta <= 0) return 0;
+  int pct = (100 * delta) / thresh;
+  return pct < maxp ? pct : maxp;
+}
+
+// cldutil.cc:587-605 (ops/score.py _reliability_expected; f32 math)
+inline int c_rel_expected(int actual, int expected) {
+  if (actual == 0) return expected == 0 ? 100 : 0;
+  float hi = (float)(actual > expected ? actual : expected);
+  float lo = (float)(actual < expected ? actual : expected);
+  float ratio = hi / (lo > 1.0f ? lo : 1.0f);
+  int pct = (int)(100.0f * (4.0f - ratio) / 2.5f);
+  if (ratio <= 1.5f) pct = 100;
+  else if (ratio > 4.0f) pct = 0;
+  if (expected == 0) pct = 100;
+  return pct;
+}
+
+// Score the chunk rows of one packed doc into [nc, 5] epilogue rows
+// (lang1, cbytes, score1, rel, real) — the C twin of the device scorer.
+void score_chunks_host(const uint16_t* idx, const uint16_t* chk, int ns,
+                       int nc, const uint32_t* cmeta,
+                       const uint8_t* cscript, int32_t* rows) {
+  static thread_local std::vector<int32_t> scores;
+  scores.assign((size_t)nc * 256, 0);
+  for (int i = 0; i < ns; i++) {
+    uint32_t lp = rt.cat_ind[idx[i]];
+    int row = lp & 0xFF;
+    int c = chk[i];
+    int32_t* sc = scores.data() + (size_t)c * 256;
+    for (int j = 0; j < 3; j++) {
+      int ps = (lp >> (8 * (j + 1))) & 0xFF;
+      if (ps > 0) sc[ps] += dctx.lg_prob3[row * 3 + j];
+    }
+  }
+  for (int c = 0; c < nc; c++) {
+    const int32_t* sc = scores.data() + (size_t)c * 256;
+    uint32_t cm = cmeta[c];
+    int cbytes = cm & 0xFFFF;
+    int grams = (cm >> 16) & 0xFFF;
+    int side = (cm >> 28) & 1;
+    int real = (cm >> 29) & 1;
+    // group-in-use top-2 (tote.cc:30-100 semantics)
+    int k1 = -1, k2 = -1;
+    int64_t top1 = -1, top2 = -1;
+    for (int gi = 0; gi < 64; gi++) {
+      bool in_use = sc[gi * 4] > 0 || sc[gi * 4 + 1] > 0 ||
+                    sc[gi * 4 + 2] > 0 || sc[gi * 4 + 3] > 0;
+      if (!in_use) continue;
+      for (int k = gi * 4; k < gi * 4 + 4; k++) {
+        int64_t key = (int64_t)sc[k] * 256 + (255 - k);
+        if (key > top1) {
+          top2 = top1; k2 = k1;
+          top1 = key; k1 = k;
+        } else if (key > top2) {
+          top2 = key; k2 = k;
+        }
+      }
+    }
+    int s1 = top1 >= 0 ? (int)(top1 >> 8) : 0;
+    int s2 = top2 >= 0 ? (int)(top2 >> 8) : 0;
+    if (k1 < 0) k1 = 0;
+    if (k2 < 0) k2 = 0;
+    int lang1 = dctx.plang_to_lang[side * 256 + k1];
+    int lang2 = dctx.plang_to_lang[side * 256 + k2];
+    int actual_kb = cbytes > 0 ? (s1 << 10) / cbytes : 0;
+    int expected_kb =
+        dctx.expected_score[lang1 * 4 + lscript4_of(cscript[c])];
+    int rd = c_rel_delta(s1, s2, grams);
+    int cs1 = dctx.close_set[lang1];
+    if (cs1 != 0 && cs1 == dctx.close_set[lang2]) rd = 100;
+    int rs = c_rel_expected(actual_kb, expected_kb);
+    int rel = rd < rs ? rd : rs;
+    // device wire clips (OUTW packing): keep bit-for-bit agreement
+    if (s1 > 0x3FFF) s1 = 0x3FFF;
+    if (rel < 0) rel = 0;
+    if (rel > 127) rel = 127;
+    rows[c * 5 + 0] = lang1;
+    rows[c * 5 + 1] = cbytes;
+    rows[c * 5 + 2] = s1;
+    rows[c * 5 + 3] = rel;
+    rows[c * 5 + 4] = real;
+  }
+}
+
+constexpr int kCabiFlagFinish = 1;
+constexpr int kCabiFlagSqueeze = 2;
+constexpr int kCabiFlagRepeats = 4;
+constexpr int kCabiFlagTop40 = 8;
+constexpr int kCabiUnknown = 26;  // UNKNOWN_LANGUAGE
+
 }  // namespace
 
 extern "C" {
@@ -1335,7 +1463,7 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 5; }
+int32_t ldt_abi_version() { return 6; }
 
 // Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
 // text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
@@ -1444,6 +1572,93 @@ int64_t ldt_pack_flat_begin(
 // the caller could not allocate the wire arrays, or was interrupted).
 void ldt_pack_flat_free(int64_t handle) {
   delete (FlatPackState*)(intptr_t)handle;
+}
+
+// Scoring/epilogue tables for the C-only detection path. Pointers must
+// outlive detection calls (the Python runtime pins them; a C host keeps
+// the artifact mapped).
+void ldt_init_detect(const uint8_t* lg_prob3, const int32_t* plang_to_lang,
+                     const int32_t* expected_score,
+                     const int32_t* close_set, const int32_t* closest_alt,
+                     const uint8_t* is_figs, int32_t n_lang,
+                     const char* codes, int32_t code_stride) {
+  dctx = DetectCtx{lg_prob3, plang_to_lang, expected_score, close_set,
+                   closest_alt, is_figs, codes, n_lang, code_stride, true};
+}
+
+// One full C-side detection: pack -> score -> epilogue, plus the
+// reference's gate-failure recursion (impl.cc:2061-2105) as a second
+// pass with the recursion flags. Returns a language id; budget-overflow
+// documents (pathological input) answer UNKNOWN.
+static int32_t detect_one_c(const uint8_t* text, int32_t len) {
+  if (!rt_ready || !dctx.ready) return kCabiUnknown;
+  static thread_local std::vector<uint16_t> sidx, schk;
+  static thread_local std::vector<uint32_t> scmeta;
+  static thread_local std::vector<uint8_t> scscript;
+  static thread_local std::vector<int32_t> rows, dadds;
+  const int L = 1 << 17, C = 1 << 14, D = 64;
+  sidx.resize(L); schk.resize(L);
+  scmeta.resize(C); scscript.resize(C);
+  dadds.resize(D * 3);
+  int32_t text_bytes = 0, n_slots = 0, n_chunks = 0;
+  uint8_t fallback = 0, squeezed = 0;
+  int flags = 0;
+  for (int pass = 0; pass < 2; pass++) {
+    ROut o{sidx.data(), schk.data(), scmeta.data(), scscript.data(),
+           dadds.data(), &text_bytes, &fallback, &squeezed, &n_slots,
+           &n_chunks, L, C, D, flags};
+    pack_resolve_one_doc(text, len, 0, o);
+    if (fallback) return kCabiUnknown;
+    rows.assign((size_t)n_chunks * 5, 0);
+    score_chunks_host(sidx.data(), schk.data(), n_slots, n_chunks,
+                      scmeta.data(), scscript.data(), rows.data());
+    int64_t dcs = 0;
+    uint8_t skip = 0;
+    int64_t out[14];
+    ldt_epilogue_flat(rows.data(), &dcs, &n_chunks, dadds.data(),
+                      &text_bytes, &skip, 1, D, flags, dctx.close_set,
+                      dctx.closest_alt, dctx.is_figs, dctx.n_lang, out);
+    if (!out[12]) return (int32_t)out[0];
+    // good-answer gate failed: one recursion pass (FINISH forces it)
+    flags = kCabiFlagTop40 | kCabiFlagRepeats | kCabiFlagFinish |
+            (squeezed ? kCabiFlagSqueeze : 0);
+  }
+  return kCabiUnknown;  // unreachable: FINISH always passes the gate
+}
+
+// The reference seam (wrapper.h:8 / wrapper.cc:7-16): NUL-terminated
+// UTF-8 in, static ISO-639 code string out, no allocation. The returned
+// pointer is thread-local and valid until this thread's next call.
+const char* detect_language(const char* src) {
+  if (src == nullptr || !dctx.ready) return "un";
+  int32_t lang = detect_one_c((const uint8_t*)src,
+                              (int32_t)strlen(src));
+  if (lang < 0 || lang >= dctx.n_lang) lang = kCabiUnknown;
+  return dctx.codes + (size_t)lang * dctx.code_stride;
+}
+
+// Batched variant: concatenated UTF-8 docs + bounds, language ids out.
+// Thread-parallel like the packer (each doc is independent).
+void ldt_detect_batch_codes(const uint8_t* texts, const int64_t* bounds,
+                            int32_t n_docs, int32_t n_threads,
+                            int32_t* lang_out) {
+  auto work = [&](int lo, int hi) {
+    for (int b = lo; b < hi; b++)
+      lang_out[b] = detect_one_c(texts + bounds[b],
+                                 (int32_t)(bounds[b + 1] - bounds[b]));
+  };
+  if (n_threads <= 1 || n_docs < 2 * n_threads) {
+    work(0, n_docs);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int per = (n_docs + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    int lo = t * per, hi = std::min(n_docs, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
 }
 
 // Phase 2: lay the packed content out shard-major and free the state.

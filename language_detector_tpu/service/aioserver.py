@@ -102,7 +102,8 @@ def _http_response(status: int, body: bytes,
                                          b"charset=utf-8") -> bytes:
     reason = {200: b"OK", 203: b"Non-Authoritative Information",
               400: b"Bad Request", 404: b"Not Found",
-              431: b"Request Header Fields Too Large"}.get(status, b"OK")
+              431: b"Request Header Fields Too Large",
+              500: b"Internal Server Error"}.get(status, b"OK")
     return (b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
             b"Content-Length: %d\r\n\r\n"
             % (status, reason, content_type, len(body))) + body
@@ -219,7 +220,15 @@ class AioService:
                     {"error": "Unable to parse request - invalid JSON "
                               "detected"}).encode())
             texts, slots, responses, status = pre
-            codes = await self.batcher.submit(texts) if texts else []
+            try:
+                codes = await self.batcher.submit(texts) if texts else []
+            except (asyncio.TimeoutError, TimeoutError):
+                # wedged flush: fail THIS request with a response (the
+                # disconnect handler upstream must not eat it — on 3.12
+                # asyncio.TimeoutError IS builtins.TimeoutError)
+                m.inc("augmentation_errors_logged_total")
+                return _http_response(
+                    500, b'{"error":"detection timed out"}')
             status, payload = post_detect(svc, codes, slots, responses,
                                           status)
             return _http_response(status, payload)

@@ -1,0 +1,100 @@
+"""C ABI seam: detect_language() / ldt_detect_batch_codes().
+
+The reference's cgo boundary is one C function (wrapper.h:8,
+wrapper.cc:7-16): `const char* detect_language(const char*)` returning a
+static ISO-code string. A Go host links the library and calls it with no
+Python in the loop. These tests call the exported symbols through a raw
+ctypes handle — exactly the cgo calling convention — and assert the
+C-side pipeline (pack -> C chunk scorer -> epilogue -> recursion) agrees
+with the engine's device path on every document.
+"""
+from __future__ import annotations
+
+import ctypes
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from golden_data import golden_pairs  # noqa: E402
+
+from language_detector_tpu import native  # noqa: E402
+from language_detector_tpu.registry import registry  # noqa: E402
+from language_detector_tpu.tables import load_tables  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+@pytest.fixture(scope="module")
+def clib():
+    """Raw CDLL handle, as a cgo host would hold it (tables initialized
+    through the public init seam first)."""
+    tables = load_tables()
+    native.ensure_init(tables, registry)
+    lib = ctypes.CDLL(str(Path(native.__file__).parent / "libldtpack.so"))
+    lib.detect_language.restype = ctypes.c_char_p
+    lib.detect_language.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def test_detect_language_known_scripts(clib):
+    cases = [
+        ("Le gouvernement a annoncé de nouvelles mesures pour aider "
+         "les familles", b"fr"),
+        ("こんにちは世界。今日はとても良い天気ですね。散歩に行きましょう。",
+         b"ja"),
+        ("ภาษาไทยเป็นภาษาที่สวยงามและมีประวัติศาสตร์", b"th"),
+        ("Η γρήγορη καφέ αλεπού πηδά πάνω από το τεμπέλικο σκυλί σήμερα "
+         "το πρωί στον κήπο", b"el"),
+        ("", b"un"),
+    ]
+    for text, want in cases:
+        assert clib.detect_language(text.encode()) == want, text[:40]
+
+
+def test_detect_language_matches_engine(clib):
+    """C-side detection == the engine's device path on the golden suite
+    plus squeeze/retry/edge constructions (the pipelines share the
+    packer and epilogue; this pins the C chunk scorer against the device
+    scorer)."""
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    pairs = golden_pairs()
+    if not pairs:
+        pytest.skip("reference snapshot unavailable")
+    texts = [raw.decode("utf-8", errors="replace")
+             for _, _, raw in pairs][::4]
+    texts += [
+        "buy cheap now " * 400,                  # squeeze pass
+        "word " * 600,                           # squeeze + repeats
+        texts[0][:150] + " " + texts[-1][:150],  # gate-failure retry
+        "", "a", "123 !!!", "🎉🎊",
+    ]
+    eng = NgramBatchEngine()
+    want = eng.detect_codes(texts)
+
+    # single-doc entry (NUL-terminated: embedded NULs truncate, so only
+    # compare docs without them)
+    for t, w in zip(texts, want):
+        if "\x00" in t:
+            continue
+        got = clib.detect_language(t.encode("utf-8", "surrogatepass"))
+        assert got.decode() == w, t[:50]
+
+    # batched entry
+    enc = [t.encode("utf-8", "surrogatepass") for t in texts]
+    bounds = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=bounds[1:])
+    blob = np.frombuffer(b"".join(enc), np.uint8) if bounds[-1] \
+        else np.zeros(1, np.uint8)
+    blob = np.ascontiguousarray(blob)
+    out = np.zeros(len(enc), np.int32)
+    clib.ldt_detect_batch_codes(
+        blob.ctypes.data_as(ctypes.c_void_p),
+        bounds.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(len(enc)), ctypes.c_int32(4),
+        out.ctypes.data_as(ctypes.c_void_p))
+    got_codes = [registry.code(int(i)) for i in out]
+    assert got_codes == want
