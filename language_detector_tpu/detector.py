@@ -106,11 +106,18 @@ class LanguageDetector:
         r.valid_prefix_bytes = valid
         return r
 
-    def detect_batch(self, texts: list[str]) -> list[DetectionResult]:
+    def detect_batch(self, texts: list[str], hints=None,
+                     is_plain_text: bool = True) -> list[DetectionResult]:
+        """Batched detection (device engine when available). hints /
+        is_plain_text ride the device path too: priors become wire-level
+        chunk boosts, HTML cleans host-side before packing."""
         eng = self._get_batch_engine()
         if eng is None:  # no usable accelerator backend: scalar per doc
-            return [self.detect(t) for t in texts]
-        rs = eng.detect_batch(texts)
+            return [self.detect(t, hints=hints,
+                                is_plain_text=is_plain_text)
+                    for t in texts]
+        rs = eng.detect_batch(texts, hints=hints,
+                              is_plain_text=is_plain_text)
         return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
     def _get_batch_engine(self):

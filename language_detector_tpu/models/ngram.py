@@ -112,19 +112,76 @@ class NgramBatchEngine:
     # 100-160K chunk rows ~ 100-200MB peak per dispatch.
     DISPATCH_CHAR_BUDGET = 6 << 20
 
-    def detect_batch(self, texts: list[str]) -> list:
+    def detect_batch(self, texts: list[str], hints=None,
+                     is_plain_text: bool = True) -> list:
         """ScalarResult-compatible results, one per text (EpilogueResult
         views for device-scored docs, real ScalarResults for scalar-path
-        docs)."""
+        docs). hints: optional hints.CLDHints applied to every document
+        of the call; is_plain_text=False strips HTML host-side and scans
+        lang= tags into per-document hint priors — both stay on the
+        device path."""
         if not texts:
             return []
         if self.flags & ~_DEVICE_OK_FLAGS:
-            return [detect_scalar(t, self.tables, self.reg, self.flags)
+            return [detect_scalar(t, self.tables, self.reg, self.flags,
+                                  hints=hints,
+                                  is_plain_text=is_plain_text)
                     for t in texts]
+        if hints is not None or not is_plain_text:
+            return self._detect_hinted(texts, hints, is_plain_text)
         if sum(len(t) for t in texts) > self.DISPATCH_CHAR_BUDGET:
             return self.detect_many(texts, batch_size=len(texts))
         cb, fut = self._dispatch(texts)
         return self._finish(texts, cb, fut)
+
+    def _detect_hinted(self, texts: list[str], hints,
+                       is_plain_text: bool) -> list:
+        """Hinted / HTML detection on the device path: hint priors ride
+        the wire as extra chunk slots (hint_lp window), whacks as
+        per-chunk mask rows, and HTML cleans host-side before packing
+        (the scalar engine does the same pre-pass, so segmentation sees
+        identical bytes). Slices respect the same content-volume budget
+        as the plain path. Gate-failing and fallback docs run the scalar
+        engine with the ORIGINAL text + hints — exactness over speed on
+        this low-volume path."""
+        from .. import native
+        from ..hints import apply_hints
+        from ..preprocess.html import clean_html
+        hbs: list = []
+        clean: list = []
+        for t in texts:
+            hbs.append(apply_hints(t, is_plain_text, hints, self.tables,
+                                   self.reg))
+            clean.append(clean_html(t, self.tables)[0]
+                         if not is_plain_text else t)
+        results: list = []
+        pos = 0
+        for chunk in self._slices(clean, 16384):
+            n = len(chunk)
+            cb, fut = self._dispatch(chunk,
+                                     hint_boosts=hbs[pos:pos + n])
+            rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+            ep = native.epilogue_flat_native(rows, cb, self.flags,
+                                             self.reg)
+            n_fb = n_retry = 0
+            for b in range(n):
+                if ep[b, 12]:  # fallback or gate-failure recursion
+                    if cb.fallback[b]:
+                        n_fb += 1
+                    else:
+                        n_retry += 1
+                    results.append(detect_scalar(
+                        texts[pos + b], self.tables, self.reg,
+                        self.flags, hints=hints,
+                        is_plain_text=is_plain_text))
+                else:
+                    results.append(EpilogueResult(ep[b].tolist()))
+            with self._stats_lock:
+                self.stats["batches"] += 1
+                self.stats["fallback_docs"] += n_fb
+                self.stats["scalar_recursion_docs"] += n_retry
+            pos += n
+        return results
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list:
@@ -187,17 +244,20 @@ class NgramBatchEngine:
         if out:
             yield out
 
-    def _dispatch(self, texts: list[str], flags: int | None = None):
+    def _dispatch(self, texts: list[str], flags: int | None = None,
+                  hint_boosts: list | None = None):
         """Pack + launch the device program asynchronously; returns
         (ChunkBatch, device future)."""
         from .. import native
         fl = self.flags if flags is None else flags
         pad = -len(texts) % self._mesh_size
         padded = list(texts) + [""] * pad if pad else texts
+        if pad and hint_boosts is not None:
+            hint_boosts = list(hint_boosts) + [None] * pad
         cb = native.pack_chunks_native(
             padded, self.tables, self.reg, flags=fl,
             n_shards=self._mesh_size, l_doc=self.max_slots,
-            c_doc=self.max_chunks)
+            c_doc=self.max_chunks, hint_boosts=hint_boosts)
         return cb, self._score_fn(self.dt, cb.wire)
 
     def _epilogue(self, texts: list[str], cb, fut):

@@ -40,7 +40,7 @@ _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
             "ldt_pack_flat_begin", "ldt_pack_flat_finish",
             "ldt_pack_flat_free", "ldt_epilogue_flat", "ldt_init_detect",
             "detect_language", "ldt_detect_batch_codes")
-_ABI_VERSION = 6  # must match packer.cc ldt_abi_version()
+_ABI_VERSION = 7  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -131,6 +131,13 @@ def _ensure_init(tables: ScoringTables, reg: Registry):
         from ..ops.device_tables import host_tables
         ht = host_tables(tables, reg)
         _init_keepalive.append(ht)
+        # scoring indices and the per-script seeds must stay below the
+        # hint-boost window, or wire idx values would alias into it
+        if len(ht.cat_ind) + reg.num_scripts > HINT_BASE:
+            raise RuntimeError(
+                f"scoring tables too large for the u16 wire: "
+                f"{len(ht.cat_ind)} + {reg.num_scripts} seed rows "
+                f"reach the hint window at {HINT_BASE}")
         lib.ldt_init_tables(
             _ptr(ht.cat_buckets, np.uint32), _ptr(ht.cat_ind2, np.uint32),
             ctypes.c_int64(len(ht.cat_ind)),
@@ -271,6 +278,13 @@ class ChunkBatch:
     n_docs: int = 0
 
 
+def _next_pow2_min(n: int, lo: int) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
 def _bucket_step(n: int, step: int, lo: int) -> int:
     """Shape bucket: powers of two from lo up to step, then multiples of
     step — small batches get small programs, large batches bound padding
@@ -290,19 +304,76 @@ def _bucket_step(n: int, step: int, lo: int) -> int:
 _K_BUCKETS = (32, 64, 128, 256)
 
 
+# Hint-boost window base: wire idx values >= this address the per-batch
+# hint_lp table instead of cat_ind2 (packer.cc kHintBase; scoring tables
+# end well below it — validated at init)
+HINT_BASE = 40960
+
+
+def _hint_arrays(hint_boosts, B: int):
+    """Per-doc HintBoosts -> (hint_lp table, hint_boost [B,2,4] window
+    indices, whack_tbl [W,2,256] masks, doc_whack [B] rows). None when
+    no doc carries hints (the common case packs hint-free)."""
+    if hint_boosts is None or all(
+            hb is None or hb.empty() for hb in hint_boosts):
+        return None, None, None, None
+    lp_index: dict = {}
+    whack_index: dict = {((), ()): 0}  # row 0 = no whacks
+    hint_boost = np.full((B, 2, 4), -1, np.int32)
+    doc_whack = np.zeros(B, np.int32)
+    whack_sets: list = [((), ())]
+    for b, hb in enumerate(hint_boosts):
+        if hb is None or hb.empty():
+            continue
+        for side, boosts in ((0, hb.boost_latn), (1, hb.boost_othr)):
+            for s, lp in enumerate(list(boosts)[:4]):
+                if lp <= 0:
+                    continue
+                w = lp_index.setdefault(int(lp), len(lp_index))
+                hint_boost[b, side, s] = w
+        wset = (tuple(sorted({(lp >> 8) & 0xFF
+                              for lp in hb.whack_latn if lp > 0})),
+                tuple(sorted({(lp >> 8) & 0xFF
+                              for lp in hb.whack_othr if lp > 0})))
+        if wset != ((), ()):
+            row = whack_index.get(wset)
+            if row is None:
+                row = len(whack_sets)
+                whack_index[wset] = row
+                whack_sets.append(wset)
+            doc_whack[b] = row
+    if len(lp_index) > 16384:
+        raise ValueError("too many distinct hint langprobs in one batch")
+    hint_lp = np.zeros(max(len(lp_index), 1), np.uint32)
+    for lp, w in lp_index.items():
+        hint_lp[w] = lp
+    whack_tbl = np.zeros((len(whack_sets), 2, 256), np.uint8)
+    for row, (wl, wo) in enumerate(whack_sets):
+        for ps in wl:
+            whack_tbl[row, 0, ps] = 1
+        for ps in wo:
+            whack_tbl[row, 1, ps] = 1
+    return hint_lp, hint_boost, whack_tbl, doc_whack
+
+
 def pack_chunks_native(texts: list[str], tables: ScoringTables,
                        reg: Registry, flags: int = 0, n_shards: int = 1,
                        l_doc: int = 1 << 17, c_doc: int = 1 << 14,
-                       max_direct: int = 64,
-                       n_threads: int = 0) -> ChunkBatch:
+                       max_direct: int = 64, n_threads: int = 0,
+                       hint_boosts: list | None = None) -> ChunkBatch:
     """texts -> chunk-major flat wire (one dispatch regardless of the
-    batch's document-length mix). len(texts) must divide n_shards."""
+    batch's document-length mix). len(texts) must divide n_shards.
+    hint_boosts: optional per-doc hints.HintBoosts (None entries fine) —
+    prior boosts ride the wire as extra chunk slots addressing the
+    hint_lp window; whacks become per-chunk mask rows."""
     lib = _load()
     if not lib:
         raise RuntimeError("native packer unavailable")
     _ensure_init(tables, reg)
 
     B, Dc = len(texts), max_direct
+    hint_lp, hint_boost, whack_tbl, doc_whack = _hint_arrays(
+        hint_boosts, B)
     assert B % n_shards == 0, (B, n_shards)
     enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
     bounds = np.zeros(B + 1, np.int64)
@@ -326,6 +397,8 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         ctypes.c_int32(B), ctypes.c_int32(l_doc), ctypes.c_int32(c_doc),
         ctypes.c_int32(Dc), ctypes.c_int32(flags),
         ctypes.c_int32(n_threads),
+        _ptr(hint_boost, np.int32) if hint_boost is not None
+        else ctypes.c_void_p(None),
         _ptr(direct_adds, np.int32), _ptr(text_bytes, np.int32),
         fallback.ctypes.data_as(ctypes.c_void_p),
         squeezed.ctypes.data_as(ctypes.c_void_p),
@@ -348,7 +421,20 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         cnsl = np.zeros((D, Gs), np.uint16)
         cmeta = np.zeros((D, Gs), np.uint32)
         cscript = np.zeros((D, Gs), np.uint8)
+        cwhack = np.zeros((D, Gs), np.uint16)
         doc_chunk_start = np.zeros(B, np.int64)
+        # hint leaves pad to power-of-two buckets so the hint-free and
+        # hinted paths share compiled programs per (N, Gs, K) shape
+        Hb = _next_pow2_min(len(hint_lp) if hint_lp is not None else 1,
+                            32)
+        hint_lp_w = np.zeros(Hb, np.uint32)
+        if hint_lp is not None:
+            hint_lp_w[:len(hint_lp)] = hint_lp
+        Wb = _next_pow2_min(
+            whack_tbl.shape[0] if whack_tbl is not None else 1, 1)
+        whack_w = np.zeros((Wb, 2, 256), np.uint8)
+        if whack_tbl is not None:
+            whack_w[:whack_tbl.shape[0]] = whack_tbl
     except BaseException:
         # finish() is the only free-er; without this the C++-owned
         # compacted batch would leak on allocation failure / interrupt
@@ -358,11 +444,15 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         ctypes.c_int64(handle), ctypes.c_int32(B), ctypes.c_int32(D),
         ctypes.c_int32(N), ctypes.c_int32(Gs),
         _ptr(n_slots, np.int32), _ptr(n_chunks, np.int32),
+        _ptr(doc_whack, np.int32) if doc_whack is not None
+        else ctypes.c_void_p(None),
         _ptr(idx, np.uint16), _ptr(cstart, np.int32),
         _ptr(cnsl, np.uint16), _ptr(cmeta, np.uint32),
-        _ptr(cscript, np.uint8), _ptr(doc_chunk_start, np.int64))
+        _ptr(cscript, np.uint8), _ptr(cwhack, np.uint16),
+        _ptr(doc_chunk_start, np.int64))
     wire = dict(idx=idx, cstart=cstart, cnsl=cnsl, cmeta=cmeta,
-                cscript=cscript, k_iota=np.zeros(K, np.uint8))
+                cscript=cscript, cwhack=cwhack, hint_lp=hint_lp_w,
+                whack_tbl=whack_w, k_iota=np.zeros(K, np.uint8))
     return ChunkBatch(wire=wire, doc_chunk_start=doc_chunk_start,
                       direct_adds=direct_adds, text_bytes=text_bytes,
                       fallback=fallback, squeezed=squeezed,

@@ -1008,6 +1008,11 @@ inline int chunk_of_rank(int r, int n_quota, int c) {
   return k_full + (tail_single ? 0 : (tr >= half ? 1 : 0));
 }
 
+// Hint-boost slots (hints engine priors riding the wire): idx values at
+// or above kHintBase address the per-batch hint_lp window instead of the
+// scoring tables (cat_ind2 ends ~38.8K; seeds sit just above it).
+constexpr int kHintBase = 40960;
+
 // Resolved-wire per-doc output views
 struct ROut {
   uint16_t* idx;      // [B, L] cat_ind2 indices
@@ -1021,6 +1026,9 @@ struct ROut {
   int32_t* n_slots;
   int32_t* n_chunks;
   int L, C, D, flags;
+  // per-doc hint boosts: window indices into the batch hint_lp table,
+  // [2 sides][4 slots], -1 = empty; nullptr = no hints (the common case)
+  const int32_t* hint_boost = nullptr;
 };
 
 void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
@@ -1087,10 +1095,23 @@ restart:
   total = 0;
   ok = true;
 
-  // emit the pending chunk's boost adds (list state at its last slot)
+  // emit the pending chunk's boost adds (list state at its last slot):
+  // hint priors first, then the rotating distinct boosts (ScoreBoosts
+  // order, scoreonescriptspan.cc:125-152 — tote adds commute, whacks
+  // apply as a separate device mask)
   auto flush_boosts = [&](int c) {
     if (c < 0 || !c_real[c]) return;
     int side = c_side[c];
+    if (o.hint_boost != nullptr) {
+      for (int s = 0; s < 4; s++) {
+        int w = o.hint_boost[side * 4 + s];
+        if (w >= 0 && slot < L) {
+          idx[slot] = (uint16_t)(kHintBase + w);
+          chk[slot] = (uint16_t)c;
+          slot++;
+        }
+      }
+    }
     for (int s = 0; s < 4; s++) {
       if (boosts[side][s] && slot < L) {
         idx[slot] = (uint16_t)boosts[side][s];
@@ -1191,7 +1212,10 @@ restart:
           : chunk_of_rank(quota - 1, quota, chunksize) + 1;
       int emit = 0;
       for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
-      if (slot + emit + 4 * round_chunks > L ||
+      // budget: emitted hits + per-chunk boost flush (4 rotating + up
+      // to 4 hint priors when the doc carries hints)
+      int per_chunk = o.hint_boost != nullptr ? 8 : 4;
+      if (slot + emit + per_chunk * round_chunks > L ||
           chunk_base + round_chunks > C) {
         ok = false;
         break;
@@ -1463,7 +1487,7 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 6; }
+int32_t ldt_abi_version() { return 7; }
 
 // Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
 // text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
@@ -1477,6 +1501,7 @@ int64_t ldt_pack_flat_begin(
     const uint8_t* texts, const int64_t* bounds, int32_t n_docs,
     int32_t L_doc, int32_t C_doc, int32_t D_cap, int32_t flags,
     int32_t n_threads,
+    const int32_t* hint_boost,  // [B, 2, 4] hint-window indices, or null
     int32_t* direct_adds, int32_t* text_bytes, uint8_t* fallback,
     uint8_t* squeezed, int32_t* n_slots, int32_t* n_chunks,
     int32_t* max_chunk_nsl) {
@@ -1520,7 +1545,8 @@ int64_t ldt_pack_flat_begin(
       ROut o{sidx.data(), schk.data(), scmeta.data(), scscript.data(),
              direct_adds + (int64_t)b * D_cap * 3, text_bytes + b,
              fallback + b, squeezed + b, n_slots + b, n_chunks + b,
-             L_doc, C_doc, D_cap, flags};
+             L_doc, C_doc, D_cap, flags,
+             hint_boost ? hint_boost + (int64_t)b * 8 : nullptr};
       pack_resolve_one_doc(texts + bounds[b],
                            (int)(bounds[b + 1] - bounds[b]), 0, o);
       st->doc_buf[b] = t;
@@ -1672,8 +1698,9 @@ void ldt_detect_batch_codes(const uint8_t* texts, const int64_t* bounds,
 void ldt_pack_flat_finish(
     int64_t handle, int32_t B, int32_t D, int32_t N, int32_t Gs,
     const int32_t* n_slots, const int32_t* n_chunks,
+    const int32_t* doc_whack_row,  // [B] whack-table rows, or null
     uint16_t* idx_flat, int32_t* cstart, uint16_t* cnsl_flat,
-    uint32_t* cmeta_flat, uint8_t* cscript_flat,
+    uint32_t* cmeta_flat, uint8_t* cscript_flat, uint16_t* cwhack_flat,
     int64_t* doc_chunk_start) {
   FlatPackState* st = (FlatPackState*)(intptr_t)handle;
   int Bd = B / D;
@@ -1690,12 +1717,14 @@ void ldt_pack_flat_finish(
       int64_t cpos = spos;
       int64_t src = st->doc_chunk_off[b];
       int64_t dst = (int64_t)d * Gs + gpos;
+      uint16_t wrow = doc_whack_row ? (uint16_t)doc_whack_row[b] : 0;
       for (int c = 0; c < nc; c++) {
         cstart[dst + c] = (int32_t)cpos;
         uint16_t n = tb.cnsl[src + c];
         cnsl_flat[dst + c] = n;
         cmeta_flat[dst + c] = tb.cmeta[src + c];
         cscript_flat[dst + c] = tb.cscript[src + c];
+        cwhack_flat[dst + c] = wrow;
         cpos += n;
       }
       spos += ns;
@@ -1707,6 +1736,7 @@ void ldt_pack_flat_finish(
       cnsl_flat[dst] = 0;
       cmeta_flat[dst] = 0;
       cscript_flat[dst] = 0;
+      cwhack_flat[dst] = 0;
     }
   }
   delete st;

@@ -33,6 +33,11 @@ import numpy as np
 
 from .device_tables import DeviceTables
 
+# keep in sync with packer.cc kHintBase / native HINT_BASE: wire idx
+# values at or above this address the per-batch hint_lp window
+HINT_BASE = 40960
+
+
 def _decode3(lp):
     """langprob -> pslangs [.., 3] and group row index for qprob decode."""
     lp = lp.astype(jnp.uint32)
@@ -79,16 +84,23 @@ OUTW_REL_SHIFT = 24
 OUTW_REAL_SHIFT = 31
 
 
-def _chunk_out_word(dt, scores, cbytes, grams, side, real, script):
+def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
+                    group_scores=None):
     """[..., 256] chunk totes + chunk meta -> packed u32 chunk summary:
     group-in-use top-2 (tote.cc:30-100), reliability (cldutil.cc:553-605),
-    output word OUTW_* layout. Leading dims are free (doc-major [B, C]
-    and chunk-major [G] reuse it)."""
+    output word OUTW_* layout. Leading dims are free.
+
+    group_scores: pre-whack scores for the group-in-use mask — the
+    scalar tote marks groups in use at ADD time, and a hint whack zeroes
+    the score without retiring the group (ZeroPSLang), so a fully
+    whacked chunk still reports its zeroed top language."""
     iota256 = jnp.arange(256, dtype=jnp.int32)
     lead = scores.shape[:-1]
+    if group_scores is None:
+        group_scores = scores
     # group-in-use top-2 (qprob >= 1 invariant validated at
     # DeviceTables.from_host)
-    groups = jnp.any((scores > 0).reshape(lead + (64, 4)), axis=-1)
+    groups = jnp.any((group_scores > 0).reshape(lead + (64, 4)), axis=-1)
     slot_in_use = jnp.repeat(groups, 4, axis=-1)
     sortkey = jnp.where(slot_in_use, scores * 256 + (255 - iota256), -1)
     k1 = jnp.argmax(sortkey, axis=-1)
@@ -143,12 +155,16 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
     """Score a chunk-major flat wire into packed chunk outputs [G] u32.
 
     p (built by native.pack_chunks_native):
-      idx     [N]   u16  cat_ind2 index per resolved slot (flat)
-      cstart  [G]   i32  chunk's first slot (shard-local)
-      cnsl    [G]   u16  chunk's slot count
-      cmeta   [G]   u32  chunk meta (CM2_* layout)
-      cscript [G]   u8   chunk ULScript
-      k_iota  [K]   u8   dense chunk-row length carrier
+      idx       [N]        u16  cat_ind2 index per resolved slot (flat);
+                                values >= HINT_BASE address hint_lp
+      cstart    [G]        i32  chunk's first slot (shard-local)
+      cnsl      [G]        u16  chunk's slot count
+      cmeta     [G]        u32  chunk meta (CM2_* layout)
+      cscript   [G]        u8   chunk ULScript
+      cwhack    [G]        u16  whack-table row (0 = no whacks)
+      hint_lp   [H]        u32  hint-prior langprob window (per batch)
+      whack_tbl [W,2,256]  u8   close-set whack masks per side
+      k_iota    [K]        u8   dense chunk-row length carrier
 
     Reductions are chunk-local: safe under jit and shard_map over the
     chunk axis with zero collectives."""
@@ -160,11 +176,19 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
     G = cstart.shape[0]
     K = p["k_iota"].shape[0]
 
-    # dense [G, K] chunk rows (one gather pair)
+    # dense [G, K] chunk rows (one gather pair); hint-prior slots read
+    # the per-batch window (hints.py apply_hints boosts — extra tote
+    # adds per chunk, scoreonescriptspan.cc:125-142)
     ki = jnp.arange(K, dtype=jnp.int32)
     valid = ki[None, :] < cnsl[:, None]
     gidx = jnp.clip(cstart[:, None] + ki[None, :], 0, N - 1)
-    lp = jnp.where(valid, dt.cat_ind2[idxf[gidx].astype(jnp.int32)], 0)
+    raw = idxf[gidx].astype(jnp.int32)
+    hint_lp = p["hint_lp"]
+    H = hint_lp.shape[0]
+    lp_tbl = dt.cat_ind2[jnp.clip(raw, 0, dt.cat_ind2.shape[0] - 1)]
+    lp_hint = hint_lp[jnp.clip(raw - HINT_BASE, 0, H - 1)]
+    lp = jnp.where(valid,
+                   jnp.where(raw >= HINT_BASE, lp_hint, lp_tbl), 0)
 
     # decode + chunk totes: the K-axis sum is the whole chunk reduction
     # (XLA fuses the one-hot compare into the reduce; nothing [G, K, 256]
@@ -185,7 +209,16 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
     side = ((cmeta >> CM2_SIDE_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
     real = ((cmeta >> CM2_REAL_SHIFT) & jnp.uint32(1)).astype(jnp.int32)
     script = p["cscript"].reshape(-1).astype(jnp.int32)
-    return _chunk_out_word(dt, scores, cbytes, grams, side, real, script)
+
+    # close-set whacks (ZeroPSLang, scoreonescriptspan.cc:144-151):
+    # zero hinted-out rival languages AFTER all tote adds, per chunk;
+    # the group-in-use mask keeps the pre-whack adds (tote semantics)
+    cwhack = p["cwhack"].reshape(-1).astype(jnp.int32)
+    wmask = p["whack_tbl"][jnp.clip(cwhack, 0,
+                                    p["whack_tbl"].shape[0] - 1), side]
+    whacked = jnp.where(wmask > 0, 0, scores)
+    return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
+                           script, group_scores=scores)
 
 
 score_chunks = jax.jit(score_chunks_impl)

@@ -43,7 +43,8 @@ def sharded_score_chunks_fn(mesh: Mesh):
     communication-free exactly like the doc-major scorer."""
     wire_specs = dict(idx=P(BATCH_AXIS), cstart=P(BATCH_AXIS),
                       cnsl=P(BATCH_AXIS), cmeta=P(BATCH_AXIS),
-                      cscript=P(BATCH_AXIS), k_iota=P())
+                      cscript=P(BATCH_AXIS), cwhack=P(BATCH_AXIS),
+                      hint_lp=P(), whack_tbl=P(), k_iota=P())
     fn = jax.shard_map(score_chunks_impl, mesh=mesh,
                        in_specs=(P(), wire_specs),
                        out_specs=P(BATCH_AXIS))

@@ -221,3 +221,54 @@ def test_fuzz_mixed_traffic_agreement(engine):
             t = texts[rng.randrange(len(texts))][:200]
             docs.append(t.replace(" ", "   \n\t "))
     _assert_batch_agrees(engine, docs)
+
+
+def test_hinted_detection_agreement(engine):
+    """Hints through the DEVICE path: prior boosts ride the wire as
+    hint-window slots, whacks as per-chunk masks — results must equal
+    the scalar engine with the same CLDHints on every document."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.hints import CLDHints
+
+    reg = engine.reg
+    texts = _golden_texts()
+    docs = [texts[i][:300] for i in range(0, 60, 3)]
+    docs += ["", "tiny", texts[2][:150] + " " + texts[-3][:150]]
+    for hints in (CLDHints(tld_hint="fr"),
+                  CLDHints(content_language_hint="de,en"),
+                  # unique close-set member -> close-set whacks
+                  CLDHints(language_hint=reg.code_to_lang["id"]),
+                  CLDHints(tld_hint="jp",
+                           language_hint=reg.code_to_lang["no"])):
+        got = engine.detect_batch(docs, hints=hints)
+        for t, r in zip(docs, got):
+            want = detect_scalar(t, engine.tables, engine.reg,
+                                 hints=hints)
+            assert _result_tuple(r) == _result_tuple(want), \
+                (hints, t[:40])
+
+
+def test_html_detection_agreement(engine):
+    """is_plain_text=False through the DEVICE path: the host HTML
+    pre-pass + lang= tag hints must reproduce the scalar engine's HTML
+    handling exactly."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+
+    texts = _golden_texts()
+    docs = [
+        "<html><body><p>" + texts[0][:200] + "</p><p>" +
+        texts[0][200:400] + "</p></body></html>",
+        "<div lang=\"fr\">" + texts[5][:250] + "</div>",
+        "<a href='http://x'>link</a> " + texts[9][:300],
+        "&eacute;t&eacute; " + texts[5][:200],
+        "<script>var x = 1;</script>" + texts[12][:250],
+        "<html lang='ja'><b>" + texts[3][:200] + "</b></html>",
+        "plain text no markup at all " + texts[7][:200],
+        "<p></p>",
+        "",
+    ]
+    got = engine.detect_batch(docs, is_plain_text=False)
+    for t, r in zip(docs, got):
+        want = detect_scalar(t, engine.tables, engine.reg,
+                             is_plain_text=False)
+        assert _result_tuple(r) == _result_tuple(want), t[:60]
