@@ -1,0 +1,111 @@
+"""Single-file mmap model artifact.
+
+The TPU rebuild of the reference's dynamic-data format
+(cld2_dynamic_data.h:23-110, loader cld2_dynamic_data_loader.cc:164):
+one little-endian file = fixed header + per-array descriptors + 64-byte
+aligned data blobs, reconstructed at load time as zero-copy views over a
+single mmap — no parsing, no decompression, no per-array allocation.
+The npz artifacts remain the interchange format (tools/artifact_tool.py
+converts with --pack); this is the serving format.
+
+Layout (all little-endian):
+  0   u32  magic "LDTA" (0x4154444C)
+  4   u32  format version
+  8   u32  n_arrays
+  12  u32  reserved (0)
+  16  u64  header_bytes (end of the descriptor table)
+  24  u64  total_bytes  (file size; load-time truncation check)
+  32  n_arrays x 108-byte packed descriptors:
+      48s  name (NUL-padded UTF-8)
+      8s   numpy dtype string, e.g. "<u4" (NUL-padded)
+      u32  ndim (<= 4)
+      4xu64 shape (unused dims 0)
+      u64  offset (file-relative), u64 nbytes
+  blobs: each 64-byte aligned.
+
+The fixed flat layout is deliberately C-parsable so a native host can
+mmap the same file (the cgo seam's table story).
+"""
+from __future__ import annotations
+
+import mmap
+import struct
+from pathlib import Path
+
+import numpy as np
+
+MAGIC = 0x4154444C  # "LDTA"
+VERSION = 1
+ALIGN = 64
+_HDR = struct.Struct("<IIII QQ")
+_DESC = struct.Struct("<48s8sI 4Q QQ")
+
+
+def write_artifact(arrays: dict, path: str | Path) -> None:
+    """Write name->ndarray as one aligned little-endian artifact file."""
+    items = []
+    for name, a in arrays.items():
+        a = np.asarray(a)
+        # note: ascontiguousarray would promote 0-d arrays to 1-d, so
+        # shape/ndim come from the original and only the BYTES go
+        # through a contiguous copy
+        buf = a if a.flags.c_contiguous else np.ascontiguousarray(a)
+        if len(name.encode()) > 47:
+            raise ValueError(f"array name too long: {name!r}")
+        if a.ndim > 4:
+            raise ValueError(f"{name}: ndim {a.ndim} > 4")
+        if a.dtype.hasobject:
+            raise ValueError(f"{name}: object arrays not supported")
+        items.append((name, a, buf))
+
+    header_bytes = _HDR.size + len(items) * _DESC.size
+    pos = -(-header_bytes // ALIGN) * ALIGN
+    descs = []
+    for name, a, _ in items:
+        shape = list(a.shape) + [0] * (4 - a.ndim)
+        descs.append((name.encode(), a.dtype.str.encode(), a.ndim,
+                      shape, pos, a.nbytes))
+        pos += -(-max(a.nbytes, 1) // ALIGN) * ALIGN
+    total = pos
+
+    with open(path, "wb") as f:
+        f.write(_HDR.pack(MAGIC, VERSION, len(items), 0, header_bytes,
+                          total))
+        for (name, dt, ndim, shape, off, nb) in descs:
+            f.write(_DESC.pack(name, dt, ndim, *shape, off, nb))
+        for (name, a, buf), (_, _, _, _, off, nb) in zip(items, descs):
+            f.seek(off)
+            f.write(buf.tobytes())
+        f.truncate(total)
+
+
+def load_artifact(path: str | Path) -> dict:
+    """mmap the artifact and return name -> zero-copy ndarray views.
+    The mapping stays alive as long as any view does (numpy holds the
+    buffer reference)."""
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    if len(mm) < _HDR.size:
+        raise ValueError(f"{path}: not an LDTA artifact (truncated)")
+    magic, version, n, _, header_bytes, total = _HDR.unpack_from(mm, 0)
+    if magic != MAGIC:
+        raise ValueError(f"{path}: bad magic {magic:#x}")
+    if version != VERSION:
+        raise ValueError(f"{path}: format version {version}, "
+                         f"expected {VERSION}")
+    if total != len(mm):
+        raise ValueError(f"{path}: size {len(mm)} != recorded {total} "
+                         "(truncated or corrupt)")
+    out: dict = {}
+    buf = memoryview(mm)
+    for i in range(n):
+        name_b, dt_b, ndim, s0, s1, s2, s3, off, nb = _DESC.unpack_from(
+            mm, _HDR.size + i * _DESC.size)
+        name = name_b.rstrip(b"\0").decode()
+        dtype = np.dtype(dt_b.rstrip(b"\0").decode())
+        shape = (s0, s1, s2, s3)[:ndim]
+        if off + nb > total:
+            raise ValueError(f"{path}: {name} blob out of bounds")
+        a = np.frombuffer(buf[off:off + nb], dtype=dtype)
+        out[name] = a.reshape(shape)
+    return out

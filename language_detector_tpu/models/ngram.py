@@ -147,40 +147,62 @@ class NgramBatchEngine:
         from .. import native
         from ..hints import apply_hints
         from ..preprocess.html import clean_html
-        hbs: list = []
-        clean: list = []
-        for t in texts:
-            hbs.append(apply_hints(t, is_plain_text, hints, self.tables,
-                                   self.reg))
-            clean.append(clean_html(t, self.tables)[0]
-                         if not is_plain_text else t)
-        results: list = []
-        pos = 0
-        for chunk in self._slices(clean, 16384):
-            n = len(chunk)
-            cb, fut = self._dispatch(chunk,
-                                     hint_boosts=hbs[pos:pos + n])
+        if is_plain_text:
+            # without HTML there is no per-document hint input (lang=
+            # scanning is the only one): one HintBoosts serves the batch
+            shared_hb = apply_hints("", True, hints, self.tables,
+                                    self.reg)
+            hbs = [shared_hb] * len(texts)
+            clean = texts
+        else:
+            hbs = [apply_hints(t, False, hints, self.tables, self.reg)
+                   for t in texts]
+            clean = [clean_html(t, self.tables)[0] for t in texts]
+
+        # budget-sliced jobs carrying (clean slice, original slice, hint
+        # slice); the shared pipeline overlaps pack/score across slices
+        def jobs():
+            pos = 0
+            for chunk in self._slices(clean, 16384):
+                n = len(chunk)
+                yield (chunk, texts[pos:pos + n], hbs[pos:pos + n])
+                pos += n
+
+        def dispatch(job):
+            chunk, _, hb_slice = job
+            return self._dispatch(chunk, hint_boosts=hb_slice)
+
+        def finish(job, cb, fut):
+            # hinted twin of _epilogue/_finish: BOTH exception classes
+            # (packer fallback, gate failure) resolve via the scalar
+            # engine with the ORIGINAL text + hints — the batched retry
+            # pass does not carry hint state
+            _, orig, _ = job
             rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
             ep = native.epilogue_flat_native(rows, cb, self.flags,
                                              self.reg)
+            out: list = []
             n_fb = n_retry = 0
-            for b in range(n):
-                if ep[b, 12]:  # fallback or gate-failure recursion
+            for b, text in enumerate(orig):
+                if ep[b, 12]:
                     if cb.fallback[b]:
                         n_fb += 1
                     else:
                         n_retry += 1
-                    results.append(detect_scalar(
-                        texts[pos + b], self.tables, self.reg,
-                        self.flags, hints=hints,
-                        is_plain_text=is_plain_text))
+                    out.append(detect_scalar(
+                        text, self.tables, self.reg, self.flags,
+                        hints=hints, is_plain_text=is_plain_text))
                 else:
-                    results.append(EpilogueResult(ep[b].tolist()))
+                    out.append(EpilogueResult(ep[b].tolist()))
             with self._stats_lock:
                 self.stats["batches"] += 1
                 self.stats["fallback_docs"] += n_fb
                 self.stats["scalar_recursion_docs"] += n_retry
-            pos += n
+            return out
+
+        results: list = []
+        for part in self._pipelined_jobs(jobs(), dispatch, finish):
+            results.extend(part)
         return results
 
     def detect_many(self, texts: list[str],
@@ -197,32 +219,40 @@ class NgramBatchEngine:
         return out
 
     def _pipelined(self, texts: list[str], batch_size: int, finish):
-        """Shared pipeline: the main thread packs + dispatches slice N+1
-        while pool workers force slice N's device execution and run its
-        epilogue (the C++ pack, the epilogue, and the readback all
-        release the GIL). Yields finish()'s per-slice values in order.
-        Depth 3 keeps the device queue full across the ~95ms dispatch
-        latency of this host's TPU tunnel (>= 3 concurrent fetches reach
-        the backend's overlap ceiling). A single-slice call (the service
-        batcher's common flush) skips the pool entirely — its flushes
-        already overlap on the batcher's worker pool, and per-call
-        thread spawning is real cost on the single-core host."""
-        slices = self._slices(texts, batch_size)
-        first = next(slices, None)
+        """Slice texts by count + content volume and pipeline them;
+        yields finish()'s per-slice values in order."""
+        yield from self._pipelined_jobs(
+            self._slices(texts, batch_size),
+            self._dispatch, finish)
+
+    def _pipelined_jobs(self, jobs, dispatch, finish):
+        """Shared pipeline core: the main thread packs + dispatches job
+        N+1 while pool workers force job N's device execution and run
+        its epilogue (the C++ pack, the epilogue, and the readback all
+        release the GIL). Yields finish(job, cb, fut) values in job
+        order. Depth 3 keeps the device queue full across the ~95ms
+        dispatch latency of this host's TPU tunnel (>= 3 concurrent
+        fetches reach the backend's overlap ceiling). A single-job call
+        (the service batcher's common flush) skips the pool entirely —
+        its flushes already overlap on the batcher's worker pool, and
+        per-call thread spawning is real cost on the single-core
+        host."""
+        jobs = iter(jobs)
+        first = next(jobs, None)
         if first is None:
             return
-        second = next(slices, None)
+        second = next(jobs, None)
         if second is None:
-            cb, fut = self._dispatch(first)
+            cb, fut = dispatch(first)
             yield finish(first, cb, fut)
             return
         from concurrent.futures import ThreadPoolExecutor
         import itertools
         pending: list = []
         with ThreadPoolExecutor(3) as pool:
-            for chunk in itertools.chain([first, second], slices):
-                cb, fut = self._dispatch(chunk)
-                pending.append(pool.submit(finish, chunk, cb, fut))
+            for job in itertools.chain([first, second], jobs):
+                cb, fut = dispatch(job)
+                pending.append(pool.submit(finish, job, cb, fut))
                 while len(pending) > 3:
                     yield pending.pop(0).result()
             for f in pending:

@@ -97,7 +97,6 @@ class ScoringTables:
             raise ValueError("quad_path must be a Path, None (auto-discover) "
                              "or False (disable)")
         z = np.load(path, allow_pickle=False)
-        expected_override = None
         discovery_miss = False
         if quad_path is None:
             qp = Path(__file__).parent / "data" / "quad_tables.npz"
@@ -105,10 +104,34 @@ class ScoringTables:
             discovery_miss = quad_path is False
         if quad_path is not False:
             qz = np.load(quad_path, allow_pickle=False)
+        else:
+            qz = None
+        return cls._build(z, qz, discovery_miss)
+
+    @classmethod
+    def load_mmap(cls, path: Path) -> "ScoringTables":
+        """Load from the single-file mmap artifact (artifact.py): every
+        array is a zero-copy view over one shared mapping — the serving
+        load path (the npz pair remains the interchange format). Arrays
+        are namespaced "c/<name>" (cld2 tables) and "q/<name>" (quad
+        tables; absent when the artifact was packed without them)."""
+        from .artifact import load_artifact
+        arrays = load_artifact(path)
+        z = {k[2:]: v for k, v in arrays.items() if k.startswith("c/")}
+        qz = {k[2:]: v for k, v in arrays.items() if k.startswith("q/")}
+        return cls._build(z, qz or None, not qz)
+
+    @classmethod
+    def _build(cls, z, qz, discovery_miss: bool) -> "ScoringTables":
+        """Shared constructor over mapping-like table sources (npz files
+        or mmap-artifact views)."""
+        expected_override = None
+        if qz is not None:
             quad = NgramTable.from_npz(qz, "quadgram")
+            qz_files = getattr(qz, "files", qz)
             quad2 = (NgramTable.from_npz(qz, "quadgram2")
-                     if "quadgram2_meta" in qz.files else _empty_table())
-            if "expected_score_override" in qz.files:
+                     if "quadgram2_meta" in qz_files else _empty_table())
+            if "expected_score_override" in qz_files:
                 # Trained tables carry their own expected-score calibration
                 # (the reference regenerates kAvgDeltaOctaScore per table
                 # build via cld2_do_score.cc; zero = "no data yet" => the
@@ -153,7 +176,15 @@ _tables_cache: dict = {}
 
 
 def load_tables(path: Path = _DATA) -> ScoringTables:
+    """Default table loading: the single-file mmap artifact
+    (data/model.ldta, zero-copy) when present next to the npz bundle,
+    else the npz pair. tools/artifact_tool.py --pack builds the
+    artifact; both sources are bit-identical (test_artifact_mmap)."""
     key = str(path)
     if key not in _tables_cache:
-        _tables_cache[key] = ScoringTables.load(path)
+        ldta = Path(path).parent / "model.ldta"
+        if str(path) == str(_DATA) and ldta.exists():
+            _tables_cache[key] = ScoringTables.load_mmap(ldta)
+        else:
+            _tables_cache[key] = ScoringTables.load(path)
     return _tables_cache[key]

@@ -1,0 +1,98 @@
+"""Single-file mmap artifact (artifact.py + tables.load_mmap).
+
+The serving-format twin of the reference's dynamic-data file
+(cld2_dynamic_data.h:23-110): one aligned little-endian blob, loaded as
+zero-copy views over a single mapping, bit-identical to the npz pair.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from language_detector_tpu.artifact import load_artifact, write_artifact
+from language_detector_tpu.tables import ScoringTables
+
+DATA = Path(__file__).resolve().parent.parent / \
+    "language_detector_tpu" / "data"
+
+
+def test_round_trip(tmp_path):
+    rng = np.random.default_rng(3)
+    arrays = {
+        "a/ints": rng.integers(0, 1 << 31, (7, 3)).astype(np.int64),
+        "b/bytes": rng.integers(0, 255, 1000).astype(np.uint8),
+        "c/f32": rng.random((2, 2, 2)).astype(np.float32),
+        "d/strs": np.array(["alpha", "βήτα", ""]),
+        "e/empty": np.zeros(0, np.uint32),
+        "f/zerodim": np.array("one long scalar string"),
+    }
+    p = tmp_path / "t.ldta"
+    write_artifact(arrays, p)
+    back = load_artifact(p)
+    assert set(back) == set(arrays)
+    for k, a in arrays.items():
+        assert np.array_equal(np.asarray(back[k]), a), k
+        assert back[k].dtype == a.dtype, k
+        assert back[k].shape == a.shape, k
+
+
+def test_zero_copy_views(tmp_path):
+    p = tmp_path / "t.ldta"
+    write_artifact({"x": np.arange(1024, dtype=np.uint32)}, p)
+    back = load_artifact(p)
+    # views, not copies: numpy must not own the data (it references the
+    # shared mmap buffer)
+    assert not back["x"].flags["OWNDATA"]
+
+
+def test_truncation_detected(tmp_path):
+    p = tmp_path / "t.ldta"
+    write_artifact({"x": np.arange(4096, dtype=np.uint32)}, p)
+    data = p.read_bytes()
+    p.write_bytes(data[:-64])
+    with pytest.raises(ValueError, match="truncated|size"):
+        load_artifact(p)
+
+
+def test_packed_artifact_matches_npz():
+    """data/model.ldta (committed, built by artifact_tool --pack) loads
+    into a ScoringTables bit-identical to the npz pair."""
+    ldta = DATA / "model.ldta"
+    if not ldta.exists():
+        pytest.skip("model.ldta not packed")
+    t_npz = ScoringTables.load()
+    t_map = ScoringTables.load_mmap(ldta)
+    for field in ("cjk_uni_prop", "avg_delta_octa_score", "lg_prob",
+                  "script_of_cp", "lower_pairs", "interchange_ok",
+                  "entity_values", "tld_hint_prior1"):
+        assert np.array_equal(getattr(t_map, field),
+                              getattr(t_npz, field)), field
+    for tbl in ("quadgram", "quadgram2", "deltaocta", "distinctocta",
+                "cjkdeltabi", "distinctbi", "cjkcompat"):
+        a, b = getattr(t_map, tbl), getattr(t_npz, tbl)
+        assert np.array_equal(a.buckets, b.buckets), tbl
+        assert np.array_equal(a.ind, b.ind), tbl
+        assert (a.size_one, a.size, a.keymask) == \
+            (b.size_one, b.size, b.keymask), tbl
+
+
+def test_detection_over_mmap_tables():
+    """End-to-end: detection over mmap-loaded tables equals detection
+    over npz-loaded tables (the scalar engine exercises every table)."""
+    ldta = DATA / "model.ldta"
+    if not ldta.exists():
+        pytest.skip("model.ldta not packed")
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.registry import registry
+    t_npz = ScoringTables.load()
+    t_map = ScoringTables.load_mmap(ldta)
+    for text in ("Le gouvernement a annoncé de nouvelles mesures",
+                 "こんにちは世界。今日はとても良い天気ですね。",
+                 "ภาษาไทยเป็นภาษาที่สวยงาม",
+                 "Der Hund läuft schnell durch den großen Wald heute"):
+        a = detect_scalar(text, t_map, registry)
+        b = detect_scalar(text, t_npz, registry)
+        assert (a.summary_lang, a.language3, a.percent3) == \
+            (b.summary_lang, b.language3, b.percent3), text

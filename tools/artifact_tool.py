@@ -178,11 +178,80 @@ def cmd_verify() -> int:
                         f"{name}: {k} drifted "
                         f"(manifest {want[k]['sha256'][:12]} != "
                         f"file {got[k]['sha256'][:12]})")
+    # the mmap artifact is derived from the npz pair: stale contents
+    # would silently serve old tables (load_tables prefers it)
+    ldta = DATA / "model.ldta"
+    if ldta.exists():
+        from language_detector_tpu.artifact import load_artifact
+        try:
+            packed = load_artifact(ldta)
+        except ValueError as e:
+            packed = None
+            errors.append(f"model.ldta: {e}")
+        if packed is not None:
+            expected_keys: set = set()
+            for name, prefix in (("cld2_tables.npz", "c/"),
+                                 ("quad_tables.npz", "q/")):
+                path = DATA / name
+                if not path.exists():
+                    continue
+                z = np.load(path, allow_pickle=False)
+                for k in z.files:
+                    pk = prefix + k
+                    expected_keys.add(pk)
+                    if pk not in packed:
+                        errors.append(f"model.ldta: {pk} missing "
+                                      "(stale pack — rerun --pack)")
+                    elif not np.array_equal(np.asarray(packed[pk]),
+                                            z[k]):
+                        errors.append(f"model.ldta: {pk} drifted from "
+                                      f"{name} (rerun --pack)")
+            # reverse direction: arrays the npz no longer carries (or a
+            # deleted quad_tables.npz) must not survive in the pack
+            for pk in sorted(set(packed) - expected_keys):
+                errors.append(f"model.ldta: {pk} no longer in the npz "
+                              "sources (stale pack — rerun --pack)")
     if errors:
         for e in errors:
             print(f"VERIFY FAIL: {e}")
         return 1
     print("artifact verify OK")
+    return 0
+
+
+def cmd_pack() -> int:
+    """npz pair -> single-file mmap artifact (data/model.ldta) with an
+    immediate round-trip verification: every array loaded back through
+    the mmap path must be bit-identical to its npz source."""
+    from language_detector_tpu.artifact import load_artifact, write_artifact
+
+    arrays: dict = {}
+    for name, prefix in (("cld2_tables.npz", "c/"),
+                         ("quad_tables.npz", "q/")):
+        path = DATA / name
+        if not path.exists():
+            if name == "quad_tables.npz":
+                continue  # optional trained add-on
+            print(f"PACK FAIL: {name} missing")
+            return 1
+        z = np.load(path, allow_pickle=False)
+        for k in z.files:
+            arrays[prefix + k] = z[k]
+    out = DATA / "model.ldta"
+    write_artifact(arrays, out)
+    back = load_artifact(out)
+    bad = [k for k in arrays
+           if not np.array_equal(np.asarray(back[k]), arrays[k])]
+    missing = set(arrays) - set(back)
+    if bad or missing:
+        for k in bad:
+            print(f"PACK FAIL: {k} round-trip mismatch")
+        for k in missing:
+            print(f"PACK FAIL: {k} missing after round trip")
+        out.unlink(missing_ok=True)
+        return 1
+    print(f"wrote {out} ({out.stat().st_size // 1024} KB, "
+          f"{len(arrays)} arrays, round-trip verified)")
     return 0
 
 
@@ -200,12 +269,16 @@ def main() -> int:
     g = ap.add_mutually_exclusive_group(required=True)
     g.add_argument("--dump", action="store_true")
     g.add_argument("--verify", action="store_true")
+    g.add_argument("--pack", action="store_true",
+                   help="npz pair -> data/model.ldta mmap artifact")
     g.add_argument("--write-manifest", action="store_true")
     args = ap.parse_args()
     if args.dump:
         return cmd_dump()
     if args.verify:
         return cmd_verify()
+    if args.pack:
+        return cmd_pack()
     return cmd_write_manifest()
 
 
