@@ -165,11 +165,14 @@ def bench(batch_size: int = 16384, n_batches: int = 6) -> dict:
 if __name__ == "__main__":
     # --profile DIR: wrap the run in a jax.profiler trace (open DIR with
     # tensorboard / xprof to see the device timeline per op)
+    # --smoke: small fast configuration (CI sanity, not a benchmark)
     if len(sys.argv) > 1 and sys.argv[1] == "--profile":
         if len(sys.argv) < 3:
-            sys.exit("usage: bench.py [--profile TRACE_DIR]")
+            sys.exit("usage: bench.py [--profile TRACE_DIR | --smoke]")
         import jax
         with jax.profiler.trace(sys.argv[2]):
             print(json.dumps(bench()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        print(json.dumps(bench(batch_size=2048, n_batches=2)))
     else:
         print(json.dumps(bench()))

@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-command CI: build natives -> verify artifacts -> tests -> entry
+# checks -> bench smoke. Exits non-zero on the first failure.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+language_detector_tpu/native/build.sh
+
+if [ -d /root/reference/cld2 ] && [ ! -f tools/oracle/libcld2_oracle.so ]; then
+    echo "== oracle build =="
+    tools/oracle/build.sh
+fi
+
+echo "== artifact verify =="
+python3 tools/artifact_tool.py --verify
+
+echo "== tests =="
+python3 -m pytest tests/ -q
+
+echo "== graft entry =="
+python3 __graft_entry__.py
+
+echo "== bench smoke =="
+python3 bench.py --smoke
+
+echo "CI OK"

@@ -75,7 +75,8 @@ def write_artifact(arrays: dict, path: str | Path) -> None:
             f.write(_DESC.pack(name, dt, ndim, *shape, off, nb))
         for (name, a, buf), (_, _, _, _, off, nb) in zip(items, descs):
             f.seek(off)
-            f.write(buf.tobytes())
+            # buf is C-contiguous: its buffer writes zero-copy
+            f.write(buf.data if buf.size else b"")
         f.truncate(total)
 
 
@@ -96,16 +97,31 @@ def load_artifact(path: str | Path) -> dict:
     if total != len(mm):
         raise ValueError(f"{path}: size {len(mm)} != recorded {total} "
                          "(truncated or corrupt)")
+    # a corrupted n_arrays/header_bytes must fail the ValueError
+    # contract, not crash struct.unpack past the mapping
+    if header_bytes != _HDR.size + n * _DESC.size or \
+            header_bytes > total:
+        raise ValueError(f"{path}: header_bytes {header_bytes} "
+                         f"inconsistent with {n} descriptors (corrupt)")
     out: dict = {}
     buf = memoryview(mm)
     for i in range(n):
         name_b, dt_b, ndim, s0, s1, s2, s3, off, nb = _DESC.unpack_from(
             mm, _HDR.size + i * _DESC.size)
         name = name_b.rstrip(b"\0").decode()
-        dtype = np.dtype(dt_b.rstrip(b"\0").decode())
+        try:
+            dtype = np.dtype(dt_b.rstrip(b"\0").decode())
+        except TypeError as e:
+            raise ValueError(f"{path}: {name} bad dtype ({e})") from None
         shape = (s0, s1, s2, s3)[:ndim]
-        if off + nb > total:
-            raise ValueError(f"{path}: {name} blob out of bounds")
+        if ndim > 4 or off + nb > total:
+            raise ValueError(f"{path}: {name} descriptor out of bounds")
+        count = 1
+        for s in shape:
+            count *= s
+        if nb != count * dtype.itemsize:
+            raise ValueError(f"{path}: {name} nbytes {nb} != shape "
+                             f"{shape} x itemsize {dtype.itemsize}")
         a = np.frombuffer(buf[off:off + nb], dtype=dtype)
         out[name] = a.reshape(shape)
     return out

@@ -71,9 +71,14 @@ def trace_detect(text: str, tables: ScoringTables | None = None,
     return trace
 
 
-def format_trace(trace: DetectionTrace, reg: Registry | None = None) -> str:
-    """Render a DetectionTrace as indented text (the debug.cc HTML dump
-    equivalent)."""
+def format_trace(trace: DetectionTrace, reg: Registry | None = None,
+                 html: bool = False) -> str:
+    """Render a DetectionTrace as indented text, or — with html=True —
+    as a self-contained HTML page with every chunk decision as a colored
+    cell (the eyeballable per-chunk dump the reference renders to stderr
+    under kCLDFlagHtml, debug.cc CLD2_Debug)."""
+    if html:
+        return _format_trace_html(trace, reg or default_registry)
     reg = reg or default_registry
     out = []
     for kind, p in trace.events:
@@ -111,6 +116,74 @@ def format_trace(trace: DetectionTrace, reg: Registry | None = None) -> str:
     return "\n".join(out)
 
 
+def _lang_color(code: str) -> str:
+    """Stable pastel per language code (debug.cc keys its colors off the
+    language too; exact palette is presentation, not contract)."""
+    h = 0
+    for ch in code:
+        h = (h * 131 + ord(ch)) % 360
+    return f"hsl({h},70%,85%)"
+
+
+def _format_trace_html(trace: DetectionTrace, reg: Registry) -> str:
+    from html import escape
+
+    rows: list = []
+    cur_pass = 0
+    for kind, p in trace.events:
+        if kind == "pass":
+            cur_pass += 1
+            rows.append(f"<h3>pass {cur_pass} "
+                        f"(flags={p['flags']:#x})</h3>")
+        elif kind == "span":
+            rows.append(
+                f"<div class=span>span "
+                f"{escape(str(reg.ulscript_code[p['script']]))} "
+                f"{p['bytes']}B rtype={p['rtype']}</div>")
+        elif kind == "chunk":
+            c1 = reg.code(p["lang1"])
+            c2 = reg.code(p["lang2"])
+            rows.append(
+                f"<span class=chunk style=\"background:"
+                f"{_lang_color(c1)}\" title=\""
+                f"offset={p['offset']} bytes={p['bytes']} "
+                f"grams={p['grams']} relD={p['rel_delta']} "
+                f"relS={p['rel_score']}\">"
+                f"{escape(c1)}.{p['score1']}&nbsp;/"
+                f"&nbsp;{escape(c2)}.{p['score2']}"
+                f"<small>&nbsp;{p['bytes']}B</small></span>")
+        elif kind == "doc_tote":
+            body = "".join(
+                f"<tr><td style=\"background:{_lang_color(c)}\">"
+                f"{escape(c)}</td><td>{b}</td><td>{s}</td>"
+                f"<td>{r}%</td></tr>"
+                for c, b, s, r in p["rows"])
+            rows.append(
+                f"<details><summary>doc_tote "
+                f"[{escape(p['stage'])}]</summary><table>"
+                f"<tr><th>lang</th><th>bytes</th><th>score</th>"
+                f"<th>rel</th></tr>{body}</table></details>")
+        elif kind == "summary":
+            top3 = " ".join(f"{escape(reg.code(l))}:{pc}%"
+                            for l, pc in p["top3"])
+            rows.append(
+                f"<div class=summary style=\"background:"
+                f"{_lang_color(reg.code(p['lang']))}\">summary "
+                f"<b>{escape(reg.code(p['lang']))}</b> "
+                f"reliable={p['reliable']} {top3} "
+                f"bytes={p['text_bytes']}</div>")
+    style = ("<style>body{font:13px monospace;margin:1em}"
+             ".chunk{padding:2px 6px;margin:1px;display:inline-block;"
+             "border:1px solid #bbb;border-radius:3px}"
+             ".span{color:#666;margin-top:4px}"
+             ".summary{padding:6px;margin-top:8px;border:1px solid #888}"
+             "table{border-collapse:collapse;margin:4px 0}"
+             "td,th{border:1px solid #ccc;padding:1px 6px}</style>")
+    return ("<!doctype html><html><head><meta charset=\"utf-8\">"
+            f"<title>score trace</title>{style}</head><body>"
+            + "\n".join(rows) + "</body></html>")
+
+
 def _main(argv=None):
     """CLI harness (the reference's compact_lang_det_test.cc interactive
     tool): text from args/stdin -> summary + optional score trace and
@@ -131,11 +204,18 @@ def _main(argv=None):
                     help="also print per-range results")
     ap.add_argument("--quiet", action="store_true",
                     help="summary line only, no trace")
+    ap.add_argument("--render-html", metavar="FILE",
+                    help="write the colored per-chunk HTML dump to FILE "
+                         "(the kCLDFlagHtml debug render)")
     args = ap.parse_args(argv)
     text = " ".join(args.text) if args.text else sys.stdin.read()
 
     tr = trace_detect(text, is_plain_text=not args.html,
                       want_chunks=args.vector)
+    if args.render_html:
+        from pathlib import Path
+        Path(args.render_html).write_text(format_trace(tr, html=True))
+        print(f"wrote {args.render_html}")
     if not args.quiet:
         print(format_trace(tr))
     r = tr.result

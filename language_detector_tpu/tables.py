@@ -106,7 +106,11 @@ class ScoringTables:
             qz = np.load(quad_path, allow_pickle=False)
         else:
             qz = None
-        return cls._build(z, qz, discovery_miss)
+        return cls._build(z, qz, quad_warning=(
+            "quad_tables.npz not found: quadgram scoring disabled, "
+            "so most Latin/Cyrillic/Greek-script languages will "
+            "detect as unknown. Build it with "
+            "tools/train_quad_tables.py.") if discovery_miss else None)
 
     @classmethod
     def load_mmap(cls, path: Path) -> "ScoringTables":
@@ -119,12 +123,18 @@ class ScoringTables:
         arrays = load_artifact(path)
         z = {k[2:]: v for k, v in arrays.items() if k.startswith("c/")}
         qz = {k[2:]: v for k, v in arrays.items() if k.startswith("q/")}
-        return cls._build(z, qz or None, not qz)
+        return cls._build(z, qz or None, quad_warning=None if qz else (
+            f"{path} was packed without quad tables: quadgram scoring "
+            "disabled, so most Latin/Cyrillic/Greek-script languages "
+            "will detect as unknown. Re-pack with tools/artifact_tool.py "
+            "--pack after training quad_tables.npz."))
 
     @classmethod
-    def _build(cls, z, qz, discovery_miss: bool) -> "ScoringTables":
+    def _build(cls, z, qz, quad_warning: str | None = None
+               ) -> "ScoringTables":
         """Shared constructor over mapping-like table sources (npz files
-        or mmap-artifact views)."""
+        or mmap-artifact views). quad_warning is emitted when qz is None
+        (source-specific remediation advice)."""
         expected_override = None
         if qz is not None:
             quad = NgramTable.from_npz(qz, "quadgram")
@@ -138,13 +148,9 @@ class ScoringTables:
                 # delta reliability model governs, cldutil.cc:588).
                 expected_override = qz["expected_score_override"]
         else:
-            if discovery_miss:
+            if quad_warning:
                 import warnings
-                warnings.warn(
-                    "quad_tables.npz not found: quadgram scoring disabled, "
-                    "so most Latin/Cyrillic/Greek-script languages will "
-                    "detect as unknown. Build it with "
-                    "tools/train_quad_tables.py.", stacklevel=2)
+                warnings.warn(quad_warning, stacklevel=2)
             quad, quad2 = _empty_table(), _empty_table()
         expected = z["avg_delta_octa_score"] if expected_override is None \
             else expected_override

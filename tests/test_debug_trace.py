@@ -32,3 +32,20 @@ def test_cli_harness(capsys):
                   "国民の大多数が内閣を支持し ελληνικά γλώσσα"]) == 0
     out = capsys.readouterr().out
     assert "=>" in out and "ja" in out
+
+
+def test_format_trace_html():
+    """html=True renders the per-chunk colored dump (the kCLDFlagHtml
+    debug render, debug.cc): every chunk decision appears as a cell and
+    the page is self-contained HTML."""
+    from language_detector_tpu.debug import format_trace, trace_detect
+    tr = trace_detect(
+        "Le gouvernement a annoncé de nouvelles mesures pour aider "
+        "les familles. こんにちは世界。今日はとても良い天気ですね。")
+    page = format_trace(tr, html=True)
+    assert page.startswith("<!doctype html>")
+    n_chunks = sum(1 for k, _ in tr.events if k == "chunk")
+    assert n_chunks > 0 and page.count("class=chunk") == n_chunks
+    assert "summary" in page and "doc_tote" in page
+    # language codes render in the cells
+    assert "fr" in page and "ja" in page

@@ -190,9 +190,7 @@ def cmd_verify() -> int:
             errors.append(f"model.ldta: {e}")
         if packed is not None:
             expected_keys: set = set()
-            for name, prefix in (("cld2_tables.npz", "c/"),
-                                 ("quad_tables.npz", "q/")):
-                path = DATA / name
+            for name, prefix, path in _npz_sources():
                 if not path.exists():
                     continue
                 z = np.load(path, allow_pickle=False)
@@ -219,6 +217,14 @@ def cmd_verify() -> int:
     return 0
 
 
+def _npz_sources():
+    """(name, prefix, path) for every npz source of the mmap artifact —
+    the single enumeration --pack and --verify share."""
+    for name, prefix in (("cld2_tables.npz", "c/"),
+                         ("quad_tables.npz", "q/")):
+        yield name, prefix, DATA / name
+
+
 def cmd_pack() -> int:
     """npz pair -> single-file mmap artifact (data/model.ldta) with an
     immediate round-trip verification: every array loaded back through
@@ -226,9 +232,7 @@ def cmd_pack() -> int:
     from language_detector_tpu.artifact import load_artifact, write_artifact
 
     arrays: dict = {}
-    for name, prefix in (("cld2_tables.npz", "c/"),
-                         ("quad_tables.npz", "q/")):
-        path = DATA / name
+    for name, prefix, path in _npz_sources():
         if not path.exists():
             if name == "quad_tables.npz":
                 continue  # optional trained add-on
