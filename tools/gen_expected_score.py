@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Regenerate the expected-score table from a labeled corpus.
+
+The TPU rebuild of the reference's cld2_do_score tool
+(cld2_do_score.cc:34-270): detect every labeled line, and for lines
+whose top-1 language matches the label, accumulate raw score and bytes
+per (language, script4); each table cell is then
+round(total_score * 1024 / total_bytes) — the kAvgDeltaOctaScore
+"expected score per KB" that drives ReliabilityExpected
+(cldutil.cc:587-605).
+
+Input: a TSV of "code<TAB>text" lines (the eval harness format). The
+label's script4 comes from the document's dominant RTypeMany span (the
+reference's corpus labels carried explicit ll-Ssss scripts; TSV labels
+are bare codes).
+
+Output: an npz holding `expected_score_override` [614, 4] int16 plus a
+coverage report. NOT applied to the live tables by default — a round-3
+experiment showed a synthetic-corpus regeneration REGRESSING accuracy
+(-42%), because expected scores trained on unrepresentative text
+mis-calibrate ReliabilityExpected. Apply deliberately by copying the
+array into quad_tables.npz (tools/train_quad_tables.py does this when
+retraining) and re-packing the mmap artifact.
+
+Usage:
+  python3 tools/gen_expected_score.py --corpus file.tsv --out exp.npz
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+sys.path.insert(0, str(REPO / "tools"))
+
+from eval_corpus import iter_pairs  # noqa: E402  (tools/ sibling)
+
+
+def _doc_script4(text: str, tables, reg) -> int:
+    """script4 of the document's dominant RTypeMany span (Latn=0,
+    Cyrl=1, Arab=2, other=3 — ops/score.py _lscript4)."""
+    from language_detector_tpu.preprocess.segment import segment_text
+    best = (0, 0)  # (bytes, script)
+    for span in segment_text(text, tables):
+        if reg.rtype(span.ulscript) >= 2 and span.text_bytes > best[0]:
+            best = (span.text_bytes, span.ulscript)
+    s = best[1]
+    return 0 if s == 1 else 1 if s == 3 else 2 if s == 6 else 3
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=None,
+                    help="TSV code<TAB>text (default: golden suite)")
+    ap.add_argument("--out", default="expected_score.npz")
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+
+    from language_detector_tpu.registry import registry
+    from language_detector_tpu.tables import load_tables
+    tables = load_tables()
+
+    pairs = list(iter_pairs(args.corpus, args.limit))
+    texts = [t for _, t in pairs]
+
+    try:
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        results = NgramBatchEngine(tables, registry).detect_many(texts)
+    except (ImportError, RuntimeError):
+        from language_detector_tpu.engine_scalar import detect_scalar
+        results = [detect_scalar(t, tables, registry) for t in texts]
+
+    n_lang = registry.num_languages
+    score = np.zeros((n_lang, 4), np.float64)
+    byts = np.zeros((n_lang, 4), np.float64)
+    n_match = 0
+    code_to_lang = registry.code_to_lang
+    for (label, text), r in zip(pairs, results):
+        lang = code_to_lang.get(label)
+        if lang is None or r.language3[0] != lang:
+            continue  # only lines the detector agrees on (cld2_do_score)
+        s4 = _doc_script4(text, tables, registry)
+        # normalized_score3[0] is score per 1024 bytes ((score<<10)/bytes)
+        score[lang, s4] += r.normalized_score3[0] * r.text_bytes / 1024.0
+        byts[lang, s4] += r.text_bytes
+        n_match += 1
+
+    table = np.round(score * 1024.0 / np.maximum(byts, 1.0)) \
+        .astype(np.int16)
+    covered = int((table > 0).sum())
+    cur = tables.avg_delta_octa_score.astype(np.int32)
+    both = (table > 0) & (cur[:n_lang] > 0)
+    drift = (np.abs(table[both] - cur[:n_lang][both]).mean()
+             if both.any() else 0.0)
+    np.savez_compressed(args.out, expected_score_override=table)
+    print(f"{len(pairs)} lines, {n_match} label-agreeing; "
+          f"{covered} (lang, script4) cells covered; "
+          f"mean |delta| vs current table on shared cells: {drift:.1f}")
+    print(f"wrote {args.out} (apply deliberately — see module docstring)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
