@@ -26,4 +26,4 @@ from .detector import (LanguageDetector, DetectionResult, detect,  # noqa: F401
                        detect_batch, detect_language_version)
 from .hints import CLDHints  # noqa: F401
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"

@@ -158,9 +158,13 @@ def _get_default() -> LanguageDetector:
     return _default_detector
 
 
-def detect(text: str) -> DetectionResult:
-    return _get_default().detect(text)
+def detect(text: str, is_plain_text: bool = True, hints=None,
+           return_chunks: bool = False) -> DetectionResult:
+    return _get_default().detect(text, is_plain_text=is_plain_text,
+                                 hints=hints, return_chunks=return_chunks)
 
 
-def detect_batch(texts: list[str]) -> list[DetectionResult]:
-    return _get_default().detect_batch(texts)
+def detect_batch(texts: list[str], hints=None,
+                 is_plain_text: bool = True) -> list[DetectionResult]:
+    return _get_default().detect_batch(texts, hints=hints,
+                                       is_plain_text=is_plain_text)

@@ -238,6 +238,7 @@ def test_hinted_detection_agreement(engine):
                   CLDHints(content_language_hint="de,en"),
                   # unique close-set member -> close-set whacks
                   CLDHints(language_hint=reg.code_to_lang["id"]),
+                  CLDHints(encoding_hint="ISO_8859_8"),  # Hebrew prior
                   CLDHints(tld_hint="jp",
                            language_hint=reg.code_to_lang["no"])):
         got = engine.detect_batch(docs, hints=hints)
