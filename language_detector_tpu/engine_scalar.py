@@ -855,8 +855,9 @@ def build_result_chunks(orig_text: str, records: list, reg: Registry,
     (clean char -> original char), and the original text's char->byte
     cumsum — the index-array equivalent of the reference's composed
     OffsetMaps (offsetmap.cc:428-496)."""
-    raw = orig_text.encode("utf-8")
-    cps = np.frombuffer(orig_text.encode("utf-32-le"), np.uint32)
+    raw = orig_text.encode("utf-8", "surrogatepass")
+    cps = np.frombuffer(orig_text.encode("utf-32-le", "surrogatepass"),
+                        np.uint32)
     from .preprocess.segment import utf8_len_of_cps
     byte_of_char = np.zeros(len(cps) + 1, np.int64)
     if len(cps):

@@ -297,7 +297,10 @@ def get_lang_tags_from_html(body: str,
     <meta http-equiv=content-language content=...> attributes
     (the reference budget is bytes, not characters)."""
     if len(body) > max_scan:  # chars >= bytes, so only then can it exceed
-        body = body.encode("utf-8")[:max_scan].decode("utf-8", "ignore")
+        # surrogatepass: lone surrogates must not crash the scanner
+        # (the ignore-decode then drops any split/invalid tail bytes)
+        body = body.encode("utf-8", "surrogatepass")[:max_scan] \
+            .decode("utf-8", "ignore")
     n = len(body)
     out = ""
     k = 0

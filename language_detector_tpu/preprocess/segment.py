@@ -70,7 +70,11 @@ def _lower_table() -> np.ndarray:
 
 
 def _decode_utf32(text: str) -> np.ndarray:
-    return np.frombuffer(text.encode("utf-32-le"), dtype=np.uint32)
+    # surrogatepass: lone surrogates (e.g. from surrogatepass-decoded
+    # byte input) must detect as non-letters, not crash — the native
+    # packer round-trips them through UTF-8 the same way
+    return np.frombuffer(text.encode("utf-32-le", "surrogatepass"),
+                         dtype=np.uint32)
 
 
 def segment_text(text: str,
@@ -172,7 +176,8 @@ def segment_text(text: str,
 def _build_span(span_cps: list[int], ulscript: int,
                 src: list[int] | None = None) -> ScriptSpan:
     cps = np.array([0x20] + span_cps, dtype=np.uint32)
-    text = cps.tobytes().decode("utf-32-le").encode("utf-8")
+    text = cps.tobytes().decode("utf-32-le", "surrogatepass") \
+        .encode("utf-8", "surrogatepass")
     buf = np.zeros(len(text) + _TAIL_PAD, dtype=np.uint8)
     buf[:len(text)] = np.frombuffer(text, dtype=np.uint8)
     buf[len(text):len(text) + 3] = 0x20  # trailing "   " then NULs

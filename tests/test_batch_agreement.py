@@ -273,3 +273,24 @@ def test_html_detection_agreement(engine):
         want = detect_scalar(t, engine.tables, engine.reg,
                              is_plain_text=False)
         assert _result_tuple(r) == _result_tuple(want), t[:60]
+
+
+def test_lone_surrogate_inputs(engine):
+    """Python strings can carry lone surrogates (e.g. surrogatepass-
+    decoded byte input); both engines must detect them as non-letters —
+    not crash on strict UTF-32/UTF-8 encodes — and agree."""
+    docs = [
+        "hello \udcd9 world this is english text with a stray surrogate",
+        "𐀀 le gouvernement a annoncé de nouvelles mesures",
+        "\udfff" * 20,
+        "こんにちは\ud912世界、今日はとても良い天気ですね",
+    ]
+    _assert_batch_agrees(engine, docs)
+    # HTML path, >8KB so the lang-tag scanner's byte-budget slice runs
+    from language_detector_tpu.engine_scalar import detect_scalar
+    big_html = ("<html lang='fr'><p>" +
+                ("le monde est grand \udcd9 " * 600) + "</p></html>")
+    got = engine.detect_batch([big_html], is_plain_text=False)
+    want = detect_scalar(big_html, engine.tables, engine.reg,
+                         is_plain_text=False)
+    assert _result_tuple(got[0]) == _result_tuple(want)
