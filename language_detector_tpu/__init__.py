@@ -27,3 +27,20 @@ from .detector import (LanguageDetector, DetectionResult, detect,  # noqa: F401
 from .hints import CLDHints  # noqa: F401
 
 __version__ = "0.4.0"
+
+
+def enable_jit_cache(cache_dir=None, min_compile_secs: float = 0.3):
+    """Persist compiled XLA programs across processes (tools, tests, and
+    benches share one setting; a fresh process otherwise pays 20-40s of
+    jit compilation for the engine's block shapes). Safe no-op without
+    jax. Call before the first jit dispatch."""
+    try:
+        import jax
+        from pathlib import Path
+        d = cache_dir or Path(__file__).resolve().parent.parent / \
+            ".jax_cache"
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:  # noqa: BLE001 - cacheless operation is fine
+        pass

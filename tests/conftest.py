@@ -16,14 +16,13 @@ os.environ.setdefault(
     "XLA_FLAGS",
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
 )
-# Persist XLA executables across test runs: the [B, L] scoring program takes
-# ~1 min to compile on CPU the first time, milliseconds after. Set via
-# jax.config (env vars are too late: jax is pre-imported at startup here).
-import jax  # noqa: E402
+# Persist XLA executables across test runs: the scoring programs take
+# up to ~1 min to compile on CPU the first time, milliseconds after.
+# (Set via jax.config — env vars are too late: jax is pre-imported at
+# startup here.)
+from language_detector_tpu import enable_jit_cache  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir",
-                  str(Path(__file__).resolve().parent.parent / ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+enable_jit_cache()
 
 import ctypes  # noqa: E402
 

@@ -31,16 +31,9 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tests"))
 
-# Persist compiled programs across runs: a fresh process otherwise pays
-# 20-40s of jit compilation for the block shapes before the first result
-try:
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      str(REPO / ".jax_cache"))
-    # the block programs each compile in ~0.5-1.5s — persist them all
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-except Exception:  # noqa: BLE001 - no jax: scalar path still works
-    pass
+from language_detector_tpu import enable_jit_cache  # noqa: E402
+
+enable_jit_cache()
 
 from language_detector_tpu.registry import registry  # noqa: E402
 from language_detector_tpu.tables import ScoringTables  # noqa: E402
