@@ -62,12 +62,39 @@ def _try_load_all():
         return None
 
 
+def _host_isa() -> str:
+    """Fingerprint of this host's instruction set (build.sh writes the
+    builder's into the .host sidecar). A mismatch means the cached .so
+    was -march=native-compiled on different hardware — loading it risks
+    SIGILL, so the loader rebuilds instead."""
+    import hashlib
+    import platform
+    flags = b""
+    try:
+        for line in Path("/proc/cpuinfo").read_bytes().splitlines():
+            if line.startswith(b"flags"):
+                flags = line + b"\n"  # grep emits the trailing newline
+                break
+    except OSError:
+        pass
+    digest = hashlib.md5(flags).hexdigest()
+    return f"{platform.machine()}\n{digest}  -\n"
+
+
+def _isa_matches() -> bool:
+    sidecar = _SO.with_suffix(".so.host")
+    try:
+        return sidecar.read_text() == _host_isa()
+    except OSError:
+        return False  # no sidecar: pre-sidecar build, rebuild once
+
+
 def _load():
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        lib = _try_load_all() if _SO.exists() else None
+        lib = _try_load_all() if _SO.exists() and _isa_matches() else None
         if lib is None:
             # missing or stale: rebuild once, then retry
             try:

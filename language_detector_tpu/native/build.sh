@@ -2,5 +2,13 @@
 # Build the native packer shared library.
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -shared -fPIC -std=c++17 -o libldtpack.so packer.cc epilogue.cc -lpthread
+# -march=native: the library is always built on the host that runs it
+# (build-on-demand via native/__init__.py; the wheel ships sources).
+# The .host sidecar records the build host's ISA so the loader rebuilds
+# instead of SIGILL-ing when a copied working tree lands on a host with
+# a different instruction set (native/__init__.py _host_isa()).
+g++ -O3 -march=native -funroll-loops -shared -fPIC -std=c++17 \
+    -o libldtpack.so packer.cc epilogue.cc -lpthread
+{ uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
+    > libldtpack.so.host 2>/dev/null || true
 echo "built $(pwd)/libldtpack.so"

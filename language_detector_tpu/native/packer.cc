@@ -19,6 +19,31 @@
 
 #include "ldt_internal.h"
 
+// Stage cycle counters, compiled in only for profiling builds.
+// build.sh never defines LDT_PROF and no LDT_PROF_SCOPE marker exists
+// in this file: tools/profile_pack.py generates packer_prof.cc with
+// scopes inserted at the stage boundaries and builds the instrumented
+// .so side by side. Slots: 0 segment, 1 quad scan, 2 word scan,
+// 4 emission pass, 5 build_span, 7 whole pack_resolve_one_doc.
+#ifdef LDT_PROF
+#include <x86intrin.h>
+extern "C" uint64_t ldt_prof_cycles[8];
+uint64_t ldt_prof_cycles[8] = {};
+namespace {
+struct ProfScope {
+  int i;
+  uint64_t t0;
+  explicit ProfScope(int i) : i(i), t0(__rdtsc()) {}
+  ~ProfScope() { ldt_prof_cycles[i] += __rdtsc() - t0; }
+};
+}  // namespace
+#define LDT_PROF_CAT2(a, b) a##b
+#define LDT_PROF_CAT(a, b) LDT_PROF_CAT2(a, b)
+#define LDT_PROF_SCOPE(i) ProfScope LDT_PROF_CAT(_prof_scope_, __LINE__)(i)
+#else
+#define LDT_PROF_SCOPE(i)
+#endif
+
 namespace {
 
 // ---- candidate kinds (preprocess/pack.py) ----
@@ -291,12 +316,17 @@ inline void u8encode(uint32_t cp, std::vector<uint8_t>* out) {
   }
 }
 
-// Decode valid UTF-8 (input comes from a Python str).
+// Decode valid UTF-8 (internal span buffers; truncated tails consume the
+// lead byte alone rather than reading past `len`).
 void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
   int i = 0;
   while (i < len) {
     uint8_t c = s[i];
-    if (c < 0x80) { out->push_back(c); i += 1; }
+    if (c >= 0xC0 && i + (c < 0xE0 ? 2 : c < 0xF0 ? 3 : 4) > len) {
+      out->push_back(c);
+      i += 1;
+    }
+    else if (c < 0x80) { out->push_back(c); i += 1; }
     else if (c < 0xE0) {
       out->push_back(((c & 0x1F) << 6) | (s[i + 1] & 0x3F));
       i += 2;
@@ -315,24 +345,47 @@ void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
 void build_span(const std::vector<uint32_t>& cur, int ulscript,
                 Span* sp) {
   sp->ulscript = ulscript;
-  sp->cps.clear();
-  sp->buf.clear();
-  sp->cps.reserve(cur.size() + 2);
-  sp->cps.push_back(0x20);
-  for (uint32_t cp : cur) sp->cps.push_back(cp);
-  sp->buf.reserve(cur.size() * 2 + kTailPad + 4);
-  for (uint32_t cp : sp->cps) u8encode(cp, &sp->buf);
-  sp->text_bytes = (int)sp->buf.size();
-  sp->buf.push_back(0x20); sp->buf.push_back(0x20); sp->buf.push_back(0x20);
-  sp->buf.resize(sp->text_bytes + kTailPad, 0);
-  sp->cps.push_back(0x20);
+  const size_t n = cur.size();
+  sp->cps.resize(n + 2);
+  uint32_t* cps = sp->cps.data();
+  cps[0] = 0x20;
+  if (n) std::memcpy(cps + 1, cur.data(), n * sizeof(uint32_t));
+  cps[n + 1] = 0x20;
+  size_t nb = 1;  // leading space
+  for (size_t i = 0; i < n; i++) nb += u8len_of(cur[i]);
+  sp->text_bytes = (int)nb;
+  // sized writes through a raw pointer: the per-byte push_back capacity
+  // checks were ~14% of single-core pack time
+  sp->buf.resize(nb + kTailPad);
+  uint8_t* p = sp->buf.data();
+  *p++ = 0x20;
+  for (size_t i = 0; i < n; i++) {
+    uint32_t cp = cur[i];
+    if (cp < 0x80) {
+      *p++ = (uint8_t)cp;
+    } else if (cp < 0x800) {
+      *p++ = (uint8_t)(0xC0 | (cp >> 6));
+      *p++ = (uint8_t)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      *p++ = (uint8_t)(0xE0 | (cp >> 12));
+      *p++ = (uint8_t)(0x80 | ((cp >> 6) & 0x3F));
+      *p++ = (uint8_t)(0x80 | (cp & 0x3F));
+    } else {
+      *p++ = (uint8_t)(0xF0 | (cp >> 18));
+      *p++ = (uint8_t)(0x80 | ((cp >> 12) & 0x3F));
+      *p++ = (uint8_t)(0x80 | ((cp >> 6) & 0x3F));
+      *p++ = (uint8_t)(0x80 | (cp & 0x3F));
+    }
+  }
+  p[0] = p[1] = p[2] = 0x20;
+  std::memset(p + 3, 0, kTailPad - 3);
 }
 
 // Reusable per-thread segmentation scratch: all vectors keep their
 // capacity across documents, making steady-state packing allocation-free
 // (the malloc + first-touch cost was ~25% of single-thread pack time).
 struct SegScratch {
-  std::vector<uint32_t> cps, lower, cur;
+  std::vector<uint32_t> lower, cur;
   std::vector<uint8_t> script;
   std::vector<int8_t> u8l;
   std::vector<int64_t> byte_before;
@@ -347,7 +400,7 @@ struct SegScratch {
   // Bound long-lived retention: one pathological multi-MB document must
   // not pin worst-case capacity on a persistent thread forever.
   void maybe_shrink() {
-    if (cps.capacity() > (1 << 20) || spans.size() > 512)
+    if (lower.capacity() > (1 << 20) || spans.size() > 512)
       *this = SegScratch();
   }
 };
@@ -416,32 +469,58 @@ void squeeze_span(Span* sp) {
 
 void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
   ss->n_spans = 0;
-  std::vector<uint32_t>& cps = ss->cps;
-  cps.clear();
-  cps.reserve(text_len);
-  u8decode(text, text_len, &cps);
-  const int n = (int)cps.size();
-  if (n == 0) return;
-
+  if (text_len == 0) return;
+  // Single fused pass: decode + script/lower classification + byte
+  // accounting (the decode increment IS the codepoint's u8 length for
+  // the valid UTF-8 a Python str encodes to, so no second u8len pass)
   std::vector<uint8_t>& script = ss->script;
   std::vector<uint32_t>& lower = ss->lower;
   std::vector<int8_t>& u8l = ss->u8l;
   std::vector<int64_t>& byte_before = ss->byte_before;
-  script.resize(n);
-  lower.resize(n);
-  u8l.resize(n);
-  byte_before.resize(n + 1);
-  int64_t acc = 0;
-  for (int i = 0; i < n; i++) {
-    uint32_t cp = cps[i] > 0x10FFFF ? 0x10FFFF : cps[i];
-    script[i] = g.script_of_cp[cp];
-    lower[i] = g.lower_map[cp];
-    u8l[i] = (int8_t)u8len_of(cp);
-    byte_before[i] = acc;
-    acc += u8l[i];
+  script.resize(text_len);
+  lower.resize(text_len);
+  u8l.resize(text_len);
+  byte_before.resize(text_len + 1);
+  int n = 0;
+  {
+    int i = 0;
+    while (i < text_len) {
+      uint8_t c = text[i];
+      uint32_t cp;
+      int incr;
+      if (c >= 0xC0 && i + (c < 0xE0 ? 2 : c < 0xF0 ? 3 : 4) > text_len) {
+        // truncated multibyte tail (reachable via the C ABI, which takes
+        // arbitrary bytes): consume the lead byte alone instead of
+        // reading past the buffer
+        cp = c;
+        incr = 1;
+      } else if (c < 0x80) {
+        cp = c;
+        incr = 1;
+      } else if (c < 0xE0) {
+        cp = ((c & 0x1F) << 6) | (text[i + 1] & 0x3F);
+        incr = 2;
+      } else if (c < 0xF0) {
+        cp = ((c & 0x0F) << 12) | ((text[i + 1] & 0x3F) << 6) |
+             (text[i + 2] & 0x3F);
+        incr = 3;
+      } else {
+        cp = ((c & 0x07) << 18) | ((text[i + 1] & 0x3F) << 12) |
+             ((text[i + 2] & 0x3F) << 6) | (text[i + 3] & 0x3F);
+        incr = 4;
+      }
+      uint32_t cpc = cp > 0x10FFFF ? 0x10FFFF : cp;
+      script[n] = g.script_of_cp[cpc];
+      lower[n] = g.lower_map[cpc];
+      u8l[n] = (int8_t)incr;
+      byte_before[n] = i;
+      n++;
+      i += incr;
+    }
+    byte_before[n] = i;
   }
-  byte_before[n] = acc;
-  const int64_t total_bytes = acc;
+  if (n == 0) return;
+  const int64_t total_bytes = byte_before[n];
 
   int i = 0;
   while (i < n) {
@@ -820,46 +899,86 @@ inline Resolved resolve_rec(const Rec& r) {
 // fp=indirect address, fp_hi=word-B flag) and returns next_offset (the
 // next candidate position when the fill hits kMaxScoringHits, else the
 // scan end). Repeat cache is round-local (GetQuadHits, cldutil.cc:334).
+// *n_quota / *n_emit accumulate resolved hits and emitted slots (a + b).
+//
+// Two-phase per 512-quad block: phase A is pure byte work (positions +
+// hashes) and issues a software prefetch for each hash's probe row;
+// phase B runs the repeat cache + 4-way probes over lines that are
+// already inbound. The probes' random access into the multi-MB bucket
+// array was the single largest pack cost (~200 cycles/miss).
 int64_t scan_quad_round(const Span& sp, int64_t start,
-                        std::vector<Rec>* recs) {
+                        std::vector<Rec>* recs, int* n_quota,
+                        int* n_emit) {
   const uint8_t* b = sp.buf.data();
   const int limit = sp.text_bytes;
   int64_t src = start;
   if (b[src] == 0x20) src++;
   uint32_t cache[2] = {0, 0};
-  int nxt = 0, hits = 0;
+  int nxt = 0, hits = 0, emitted = 0;
+  static thread_local std::vector<int32_t> qpos, qnext;
+  static thread_local std::vector<uint32_t> qfp;
+  constexpr int kBlock = 512;  // prefetched lines stay L1/L2-resident
+  const uint32_t qmask = rt.size[QUAD] - 1;
+  const uint32_t* qbase = rt.cat_buckets + 4 * rt.bucket_off[QUAD];
   while (src < limit) {
-    int64_t e = src;
-    e += adv.but_space[b[e]];
-    e += adv.but_space[b[e]];
-    int64_t mid = e;
-    e += adv.but_space[b[e]];
-    e += adv.but_space[b[e]];
-    uint32_t fp = quad_hash(b, src, e - src);
-    int64_t rec_pos = src;
-    src = b[e] == 0x20 ? e : mid;
-    if (src < limit) src += adv.space_vowel[b[src]];
-    else src = limit;
-    if (fp != cache[0] && fp != cache[1]) {
-      Rec raw{(int32_t)rec_pos, QUAD, 0, 0, 0, fp};
-      Resolved rs = resolve_rec(raw);
-      if (rs.a) {
-        cache[nxt] = fp;
-        nxt = 1 - nxt;
-        recs->push_back({(int32_t)rec_pos, QUAD, 0,
-                         (uint8_t)(rs.b ? 1 : 0), 1, (uint32_t)rs.ia});
-        if (++hits >= kMaxScoringHits) return src;
+    qpos.clear();
+    qfp.clear();
+    qnext.clear();
+    while (src < limit && (int)qpos.size() < kBlock) {
+      int64_t e = src;
+      e += adv.but_space[b[e]];
+      e += adv.but_space[b[e]];
+      int64_t mid = e;
+      e += adv.but_space[b[e]];
+      e += adv.but_space[b[e]];
+      uint32_t fp = quad_hash(b, src, e - src);
+      qpos.push_back((int32_t)src);
+      qfp.push_back(fp);
+      __builtin_prefetch(qbase + 4 * ((fp + (fp >> 12)) & qmask));
+      src = b[e] == 0x20 ? e : mid;
+      if (src < limit) src += adv.space_vowel[b[src]];
+      else src = limit;
+      qnext.push_back((int32_t)src);
+    }
+    const size_t nq = qpos.size();
+    for (size_t i = 0; i < nq; i++) {
+      uint32_t fp = qfp[i];
+      if (fp != cache[0] && fp != cache[1]) {
+        Rec raw{qpos[i], QUAD, 0, 0, 0, fp};
+        Resolved rs = resolve_rec(raw);
+        if (rs.a) {
+          cache[nxt] = fp;
+          nxt = 1 - nxt;
+          recs->push_back({qpos[i], QUAD, 0, (uint8_t)(rs.b ? 1 : 0), 1,
+                           (uint32_t)rs.ia});
+          emitted += 1 + (rs.b ? 1 : 0);
+          if (++hits >= kMaxScoringHits) {
+            *n_quota += hits;
+            *n_emit += emitted;
+            return qnext[i];
+          }
+        }
       }
     }
   }
+  *n_quota += hits;
+  *n_emit += emitted;
   return src;
 }
 
 // Word (octa) hits over [start, end): RESOLVED delta + distinct + pair
 // records (Rec.pad_=1), caches and HIT caps round-local (GetOctaHits,
-// cldutil.cc:416-533; Python spec grams.py get_octa_hits).
+// cldutil.cc:416-533; Python spec grams.py get_octa_hits). *n_emit
+// accumulates pushed records (1 emitted slot each).
+//
+// Two-phase per 512-word block like scan_quad_round: the repeat cache
+// here advances independently of table resolution, so phase A applies
+// it while hashing + prefetching the three probe rows each word needs
+// (pair / delta / distinct), and phase B probes warm lines. DELTA is
+// pushed before DISTINCT at each offset: emission order IS the final
+// merge order (offset, then kind priority) — there is no sort.
 void scan_word_range(const Span& sp, int64_t start, int64_t end,
-                     std::vector<Rec>* recs) {
+                     std::vector<Rec>* recs, int* n_emit) {
   const uint8_t* b = sp.buf.data();
   const int64_t buflen = (int64_t)sp.buf.size();
   int64_t src = start;
@@ -870,56 +989,86 @@ void scan_word_range(const Span& sp, int64_t start, int64_t end,
   int64_t srclimit = end + 1;  // include trailing space off the end
   int charcount = 0;
   int64_t prior_word_start = src, word_start = src, word_end = word_start;
-  while (src < srclimit) {
-    if (b[src] == 0x20) {
-      if (word_end > word_start) {
-        uint64_t fpw = octa_hash40(b, word_start, word_end - word_start,
-                                   buflen);
-        if (fpw != cache[0] && fpw != cache[1]) {
-          cache[nxt] = fpw;
-          nxt = 1 - nxt;
-          uint64_t prior = cache[nxt];
-          if (prior != 0 && prior != fpw) {
-            uint64_t pfp = pair_hash(prior, fpw);
-            Rec raw{(int32_t)prior_word_start, DISTINCT_OCTA, 0,
-                    (uint8_t)(pfp >> 32), 0, (uint32_t)pfp};
-            Resolved rs = resolve_rec(raw);
-            if (rs.a) {
-              recs->push_back({(int32_t)prior_word_start, DISTINCT_OCTA, 0,
-                               0, 1, (uint32_t)rs.ia});
-              n_distinct++;
-            }
+  struct WordEnt {
+    int32_t prior_start, start;
+    uint64_t fpw, pfp;  // pfp == 0: no pair record
+  };
+  static thread_local std::vector<WordEnt> ents;
+  constexpr int kBlock = 512;
+  const uint32_t dmask = rt.size[DELTA_OCTA] - 1;
+  const uint32_t xmask = rt.size[DISTINCT_OCTA] - 1;
+  const uint32_t* dbase = rt.cat_buckets + 4 * rt.bucket_off[DELTA_OCTA];
+  const uint32_t* xbase =
+      rt.cat_buckets + 4 * rt.bucket_off[DISTINCT_OCTA];
+  auto octa_sub = [](uint64_t fp64, uint32_t mask) {
+    uint32_t lo = (uint32_t)fp64, hi = (uint32_t)(fp64 >> 32) & 0xFF;
+    return (lo + ((lo >> 12) | (hi << 20))) & mask;
+  };
+  bool capped = false;
+  while (src < srclimit && !capped) {
+    ents.clear();
+    while (src < srclimit && (int)ents.size() < kBlock) {
+      if (b[src] == 0x20) {
+        if (word_end > word_start) {
+          uint64_t fpw = octa_hash40(b, word_start, word_end - word_start,
+                                     buflen);
+          if (fpw != cache[0] && fpw != cache[1]) {
+            cache[nxt] = fpw;
+            nxt = 1 - nxt;
+            uint64_t prior = cache[nxt];
+            uint64_t pfp =
+                prior != 0 && prior != fpw ? pair_hash(prior, fpw) : 0;
+            if (pfp) __builtin_prefetch(xbase + 4 * octa_sub(pfp, xmask));
+            __builtin_prefetch(dbase + 4 * octa_sub(fpw, dmask));
+            __builtin_prefetch(xbase + 4 * octa_sub(fpw, xmask));
+            ents.push_back({(int32_t)prior_word_start, (int32_t)word_start,
+                            fpw, pfp});
           }
-          Rec rawx{(int32_t)word_start, DISTINCT_OCTA, 0,
-                   (uint8_t)(fpw >> 32), 0, (uint32_t)fpw};
-          Resolved rx = resolve_rec(rawx);
-          if (rx.a) {
-            recs->push_back({(int32_t)word_start, DISTINCT_OCTA, 0, 0, 1,
-                             (uint32_t)rx.ia});
-            n_distinct++;
-          }
-          Rec rawd{(int32_t)word_start, DELTA_OCTA, 0,
-                   (uint8_t)(fpw >> 32), 0, (uint32_t)fpw};
-          Resolved rd = resolve_rec(rawd);
-          if (rd.a) {
-            recs->push_back({(int32_t)word_start, DELTA_OCTA, 0, 0, 1,
-                             (uint32_t)rd.ia});
-            n_delta++;
-          }
-          if (n_delta >= kMaxScoringHits ||
-              n_distinct >= kMaxScoringHits - 1)
-            break;
+        }
+        charcount = 0;
+        prior_word_start = word_start;
+        word_start = src + 1;
+        word_end = word_start;
+      } else {
+        charcount++;
+      }
+      src += adv.one[b[src]];
+      if (charcount <= 8) word_end = src;
+    }
+    for (const WordEnt& w : ents) {
+      if (w.pfp) {
+        Rec raw{w.prior_start, DISTINCT_OCTA, 0, (uint8_t)(w.pfp >> 32),
+                0, (uint32_t)w.pfp};
+        Resolved rs = resolve_rec(raw);
+        if (rs.a) {
+          recs->push_back({w.prior_start, DISTINCT_OCTA, 0, 0, 1,
+                           (uint32_t)rs.ia});
+          n_distinct++;
+          (*n_emit)++;
         }
       }
-      charcount = 0;
-      prior_word_start = word_start;
-      word_start = src + 1;
-      word_end = word_start;
-    } else {
-      charcount++;
+      Rec rawd{w.start, DELTA_OCTA, 0, (uint8_t)(w.fpw >> 32), 0,
+               (uint32_t)w.fpw};
+      Resolved rd = resolve_rec(rawd);
+      if (rd.a) {
+        recs->push_back({w.start, DELTA_OCTA, 0, 0, 1, (uint32_t)rd.ia});
+        n_delta++;
+        (*n_emit)++;
+      }
+      Rec rawx{w.start, DISTINCT_OCTA, 0, (uint8_t)(w.fpw >> 32), 0,
+               (uint32_t)w.fpw};
+      Resolved rx = resolve_rec(rawx);
+      if (rx.a) {
+        recs->push_back({w.start, DISTINCT_OCTA, 0, 0, 1,
+                         (uint32_t)rx.ia});
+        n_distinct++;
+        (*n_emit)++;
+      }
+      if (n_delta >= kMaxScoringHits || n_distinct >= kMaxScoringHits - 1) {
+        capped = true;
+        break;
+      }
     }
-    src += adv.one[b[src]];
-    if (charcount <= 8) word_end = src;
   }
 }
 
@@ -944,10 +1093,15 @@ struct CjkGeom {
 };
 
 // One CJK round from `start`: unigram candidates (cap 1000 ->
-// next_offset just past the capping char, cldutil.cc:233) + bigram
-// candidates over the round range.
+// next_offset just past the capping char, cldutil.cc:233) into *recs,
+// bigram candidates over the round range into *birecs (kept separate so
+// the caller's offset merge can order them without sorting). Unigrams
+// are pushed RESOLVED (fp=indirect address; fp_hi bit1=word A valid,
+// bit0=word B valid — a B-only unigram still consumes an entry rank);
+// *n_quota / *n_emit accumulate resolved hits and emitted slots.
 int64_t scan_cjk_round(const Span& sp, int64_t start, CjkGeom* gm,
-                       std::vector<Rec>* recs) {
+                       std::vector<Rec>* recs, std::vector<Rec>* birecs,
+                       int* n_quota, int* n_emit) {
   const int n = (int)sp.cps.size();
   const std::vector<int64_t>& starts = gm->starts;
   const std::vector<int64_t>& ends = gm->ends;
@@ -958,7 +1112,16 @@ int64_t scan_cjk_round(const Span& sp, int64_t start, CjkGeom* gm,
     uint32_t cp = sp.cps[i] > 0x10FFFF ? 0x10FFFF : sp.cps[i];
     uint8_t prop = g.cjk_prop[cp];
     if (prop > 0 && starts[i] >= start && starts[i] < sp.text_bytes) {
-      recs->push_back({(int32_t)ends[i], UNI, 0, 0, 0, prop});
+      Resolved rs = resolve_rec({(int32_t)ends[i], UNI, 0, 0, 0, prop});
+      if (rs.a || rs.b) {
+        recs->push_back({(int32_t)ends[i], UNI, 0,
+                         (uint8_t)((rs.a ? 2 : 0) | (rs.b ? 1 : 0)), 1,
+                         (uint32_t)rs.ia});
+        if (rs.a) {
+          (*n_quota)++;
+          *n_emit += 1 + (rs.b ? 1 : 0);
+        }
+      }
       if (++hits >= kMaxScoringHits) {
         next_offset = ends[i];
         gm->resume = i + 1;
@@ -977,18 +1140,20 @@ int64_t scan_cjk_round(const Span& sp, int64_t start, CjkGeom* gm,
         Resolved rs = resolve_rec(
             {(int32_t)starts[i], BI_DELTA, 0, 0, 0, fp});
         if (rs.a) {
-          recs->push_back({(int32_t)starts[i], BI_DELTA, 0, 0, 1,
-                           (uint32_t)rs.ia});
+          birecs->push_back({(int32_t)starts[i], BI_DELTA, 0, 0, 1,
+                             (uint32_t)rs.ia});
           nd++;
+          (*n_emit)++;
         }
       }
       if (!g.distinctbi_empty && nx < kMaxScoringHits - 1) {
         Resolved rs = resolve_rec(
             {(int32_t)starts[i], BI_DISTINCT, 0, 0, 0, fp});
         if (rs.a) {
-          recs->push_back({(int32_t)starts[i], BI_DISTINCT, 0, 0, 1,
-                           (uint32_t)rs.ia});
+          birecs->push_back({(int32_t)starts[i], BI_DISTINCT, 0, 0, 1,
+                             (uint32_t)rs.ia});
           nx++;
+          (*n_emit)++;
         }
       }
     }
@@ -1061,7 +1226,10 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   int slot, chunk_base, n_direct, round_no, open_chunk;
   int64_t total;
   bool ok;
-  static thread_local std::vector<Rec> recs;
+  // scanner outputs, each offset-ordered by construction: brecs = base
+  // kinds (QUAD / CJK UNI), wrecs = word kinds (OCTA deltas/distincts/
+  // pairs, CJK BI) which outrank base kinds at equal offsets
+  static thread_local std::vector<Rec> brecs, wrecs;
   // Repetitive documents restart the whole doc with span squeezing, like
   // the reference's recursive kCLDFlagSqueeze call (impl.cc:1867-1901) —
   // previously such docs fell back to the (much slower) scalar engine.
@@ -1165,53 +1333,20 @@ restart:
     if (cjk) geom.init(sp);
     int64_t lo_pos = 1;
     while (lo_pos < sp.text_bytes && ok) {
-      recs.clear();
-      int64_t round_end = cjk ? scan_cjk_round(sp, lo_pos, &geom, &recs)
-                              : scan_quad_round(sp, lo_pos, &recs);
-      if (!cjk) scan_word_range(sp, lo_pos, round_end, &recs);
-      recs.push_back({(int32_t)lo_pos, SEED, 0, 0, 0, seed_lp});
-      for (size_t i = 0; i < recs.size(); i++)
-        recs[i].prio = prio_of(recs[i].kind);
-      std::stable_sort(recs.begin(), recs.end(),
-                       [](const Rec& a, const Rec& c) {
-                         if (a.offset != c.offset) return a.offset < c.offset;
-                         return a.prio < c.prio;
-                       });
-
-      // ---- pass 1: finish resolution; count quota/entries ----
-      // (most kinds arrive pre-resolved from the scanners: pad_ == 1,
-      // fp = indirect address, fp_hi = word-B flag for quads)
-      struct RRec { int32_t offset; int32_t ia; int8_t a, b, kind, rec; };
-      static thread_local std::vector<RRec> rres;
-      rres.clear();
-      int quota = 0;
-      for (const Rec& r : recs) {
-        RRec rr{r.offset, 0, 0, 0, r.kind, 0};
-        if (r.pad_) {  // pre-resolved hit
-          rr.ia = (int32_t)r.fp;
-          rr.a = 1;
-          rr.b = r.kind == QUAD ? (int8_t)(r.fp_hi & 1) : 0;
-          if (r.kind == QUAD) { rr.rec = 1; quota++; }
-        } else if (r.kind == SEED) {
-          if (r.fp) {
-            rr.ia = rt.seed_ind_base + sp.ulscript;
-            rr.a = 1;
-          }
-        } else if (r.kind == UNI) {
-          Resolved rs = resolve_rec(r);
-          rr.ia = rs.ia;
-          rr.a = rs.a;
-          rr.b = rs.b;
-          if (rs.a) { rr.rec = 1; quota++; }
-        }
-        rres.push_back(rr);
-      }
+      brecs.clear();
+      wrecs.clear();
+      int quota = 0, emit = 0;
+      int64_t round_end =
+          cjk ? scan_cjk_round(sp, lo_pos, &geom, &brecs, &wrecs,
+                               &quota, &emit)
+              : scan_quad_round(sp, lo_pos, &brecs, &quota, &emit);
+      if (!cjk) scan_word_range(sp, lo_pos, round_end, &wrecs, &emit);
+      const bool seed_valid = seed_lp != 0;
+      emit += seed_valid;
 
       // round chunk count from quota (chunk_boundaries grid)
       int round_chunks = quota <= 0 ? 1
           : chunk_of_rank(quota - 1, quota, chunksize) + 1;
-      int emit = 0;
-      for (const RRec& rr : rres) emit += rr.a + (rr.a && rr.b);
       // budget: emitted hits + per-chunk boost flush (4 rotating + up
       // to 4 hint priors when the doc carries hints)
       int per_chunk = o.hint_boost != nullptr ? 8 : 4;
@@ -1222,16 +1357,48 @@ restart:
       }
       zero_chunks(chunk_base, chunk_base + round_chunks);
 
-      // ---- pass 2: chunk assignment + emission + boosts ----
-      // Device-exact accounting (ops/score.py stages 4-8): entry RANKS
-      // consume a+b for base kinds regardless of word-A validity; scores,
-      // grams, lo_off, and chunk realness require word A (slot_valid).
+      // ---- single merged emission pass: seed first, then the offset
+      // merge of the two scanner lists (each offset-ordered by
+      // construction; word kinds precede base kinds at equal offsets —
+      // the canonical order the per-round stable_sort used to produce).
+      // Chunk assignment with device-exact accounting (ops/score.py
+      // stages 4-8): entry RANKS consume a+b for base kinds regardless
+      // of word-A validity; scores, grams, lo_off, and chunk realness
+      // require word A (slot_valid).
       int cum_entries = 0;  // consumed base entries, exclusive
-      for (const RRec& rr : rres) {
-        bool base_kind = rr.kind == SEED || rr.kind == QUAD ||
-                         rr.kind == UNI;
-        int contrib = base_kind ? rr.a + rr.b : 0;
-        if (!rr.a) {
+      size_t mb = 0, mw = 0;
+      bool on_seed = true;
+      while (on_seed || mb < brecs.size() || mw < wrecs.size()) {
+        int32_t r_offset, r_ia;
+        int8_t r_a, r_b, r_kind;
+        if (on_seed) {
+          on_seed = false;
+          r_offset = (int32_t)lo_pos;
+          r_kind = SEED;
+          r_a = seed_valid;
+          r_b = 0;
+          r_ia = rt.seed_ind_base + sp.ulscript;
+        } else {
+          bool take_w =
+              mw < wrecs.size() &&
+              (mb >= brecs.size() ||
+               wrecs[mw].offset <= brecs[mb].offset);
+          const Rec& r = take_w ? wrecs[mw++] : brecs[mb++];
+          r_offset = r.offset;
+          r_ia = (int32_t)r.fp;
+          r_kind = r.kind;
+          if (r.kind == UNI) {  // a/b validity in fp_hi (scan_cjk_round)
+            r_a = (r.fp_hi >> 1) & 1;
+            r_b = r.fp_hi & 1;
+          } else {
+            r_a = 1;
+            r_b = r.kind == QUAD ? (int8_t)(r.fp_hi & 1) : 0;
+          }
+        }
+        bool base_kind = r_kind == SEED || r_kind == QUAD ||
+                         r_kind == UNI;
+        int contrib = base_kind ? r_a + r_b : 0;
+        if (!r_a) {
           cum_entries += contrib;  // UNI word-B rank quirk
           continue;
         }
@@ -1243,17 +1410,17 @@ restart:
           flush_boosts(open_chunk);
           open_chunk = c;
         }
-        idx[slot] = (uint16_t)rr.ia;
+        idx[slot] = (uint16_t)r_ia;
         chk[slot] = (uint16_t)c;
         slot++;
-        if (rr.b) {
-          idx[slot] = (uint16_t)(rr.ia + 1);
+        if (r_b) {
+          idx[slot] = (uint16_t)(r_ia + 1);
           chk[slot] = (uint16_t)c;
           slot++;
         }
         cum_entries += contrib;
-        if (base_kind) c_grams[c] += rr.a + rr.b;
-        if (rr.offset < c_lo[c]) c_lo[c] = rr.offset;
+        if (base_kind) c_grams[c] += r_a + r_b;
+        if (r_offset < c_lo[c]) c_lo[c] = r_offset;
         c_real[c] = 1;
         c_side[c] = (int8_t)side;
         c_span[c] = (int16_t)round_no;
@@ -1261,8 +1428,8 @@ restart:
         cscript[c] = (uint8_t)sp.ulscript;
         // rotating distinct boost (device scan: update AFTER scoring the
         // slot, state read by the chunk containing the slot)
-        if (rr.kind == DISTINCT_OCTA || rr.kind == BI_DISTINCT) {
-          boosts[side][bptr[side]] = rr.ia;
+        if (r_kind == DISTINCT_OCTA || r_kind == BI_DISTINCT) {
+          boosts[side][bptr[side]] = r_ia;
           bptr[side] = (bptr[side] + 1) & 3;
         }
       }

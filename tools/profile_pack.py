@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Stage-level cycle profile of the native packer's hot path.
+
+Generates native/packer_prof.cc from packer.cc by inserting
+LDT_PROF_SCOPE markers at the stage boundaries (the scaffolding —
+counters + ProfScope — is compiled into packer.cc only under
+-DLDT_PROF), builds a side-by-side instrumented .so, and runs the
+bench corpus through it, printing per-stage cycle minima over N runs
+(minimum-of-runs is the least host-interfered measurement on this
+shared single-core machine; see docs/PERF.md).
+
+Usage: python tools/profile_pack.py [batch_size] [runs]
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+NATIVE = REPO / "language_detector_tpu" / "native"
+
+# (anchor line, scope slot) — anchors are the exact signatures in
+# packer.cc; a failed anchor is a hard error so the profile can never
+# silently measure the wrong stage
+SCOPES = [
+    ("void segment_text(const uint8_t* text, int text_len, "
+     "SegScratch* ss) {\n", 0),
+    ("int64_t scan_quad_round(const Span& sp, int64_t start,\n"
+     "                        std::vector<Rec>* recs, int* n_quota,\n"
+     "                        int* n_emit) {\n", 1),
+    ("void scan_word_range(const Span& sp, int64_t start, int64_t end,\n"
+     "                     std::vector<Rec>* recs, int* n_emit) {\n", 2),
+    ("      int cum_entries = 0;  // consumed base entries, exclusive", 4),
+    ("void build_span(const std::vector<uint32_t>& cur, int ulscript,\n"
+     "                Span* sp) {\n", 5),
+    ("void pack_resolve_one_doc(const uint8_t* text, int text_len, "
+     "int b,\n                          const ROut& o) {\n", 7),
+]
+NAMES = ["segment", "quad_scan", "word_scan", "-", "emit",
+         "build_span", "-", "total_doc"]
+
+
+def build_instrumented() -> Path:
+    src = (NATIVE / "packer.cc").read_text()
+    for anchor, slot in SCOPES:
+        if anchor not in src:
+            sys.exit(f"profile anchor not found in packer.cc: "
+                     f"{anchor.splitlines()[0]!r}")
+        ins = f"  LDT_PROF_SCOPE({slot});\n"
+        if not anchor.endswith("\n"):  # mid-line anchor: break the line
+            ins = "\n    " + ins
+        src = src.replace(anchor, anchor + ins, 1)
+    prof_cc = NATIVE / "packer_prof.cc"
+    prof_cc.write_text(src)
+    so = NATIVE / "libldtpack_prof.so"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-funroll-loops", "-DLDT_PROF",
+         "-shared", "-fPIC", "-std=c++17", "-o", str(so),
+         str(prof_cc), str(NATIVE / "epilogue.cc"), "-lpthread"],
+        check=True)
+    # ISA sidecar for the loader's -march=native staleness check
+    # (native/__init__.py _isa_matches), same contract as build.sh
+    from language_detector_tpu import native
+    so.with_suffix(".so.host").write_text(native._host_isa())
+    return so
+
+
+def main(batch_size: int = 16384, runs: int = 8):
+    so = build_instrumented()
+    from language_detector_tpu import native
+    native._SO = so  # load the instrumented twin instead of the real lib
+    from bench import make_corpus
+    from language_detector_tpu.registry import registry as reg
+    from language_detector_tpu.tables import load_tables
+    tables = load_tables()
+    docs = make_corpus(batch_size)
+    native.pack_chunks_native(docs, tables, reg, flags=0)  # warm + init
+    lib = native._load()
+    prof = (ctypes.c_uint64 * 8).in_dll(lib, "ldt_prof_cycles")
+    best = [float("inf")] * 8
+    best_wall = float("inf")
+    for _ in range(runs):
+        for i in range(8):
+            prof[i] = 0
+        t0 = time.time()
+        native.pack_chunks_native(docs, tables, reg, flags=0)
+        best_wall = min(best_wall, time.time() - t0)
+        for i in range(8):
+            best[i] = min(best[i], prof[i])
+    print(f"pack wall (best of {runs}): {best_wall * 1e3:.1f} ms "
+          f"/ {batch_size} docs")
+    for name, v in zip(NAMES, best):
+        if name != "-":
+            print(f"{name:12s} {v / 1e6:8.1f} Mcycles")
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:]))
