@@ -25,6 +25,8 @@ Everything agrees with `detect_scalar` on every document
 """
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
@@ -172,9 +174,9 @@ class NgramBatchEngine:
                 yield (chunk, texts[pos:pos + n], hbs[pos:pos + n])
                 pos += n
 
-        def dispatch(job):
+        def pack(job):
             chunk, _, hb_slice = job
-            return self._dispatch(chunk, hint_boosts=hb_slice)
+            return self._pack(chunk, hint_boosts=hb_slice)
 
         def finish(job, cb, fut):
             # hinted twin of _epilogue/_finish: BOTH exception classes
@@ -205,9 +207,33 @@ class NgramBatchEngine:
             return out
 
         results: list = []
-        for part in self._pipelined_jobs(jobs(), dispatch, finish):
-            results.extend(part)
+        with self._gc_paused():
+            for part in self._pipelined_jobs(jobs(), pack, finish):
+                results.extend(part)
         return results
+
+    @staticmethod
+    @contextlib.contextmanager
+    def _gc_paused():
+        """Pause the cyclic GC for a bulk-detection call: each batch
+        creates ~2 small objects per document (epilogue row list +
+        result view), which trips several young-gen scans per batch —
+        measured ~19ms/batch of the single core, with zero cyclic
+        garbage to find (rows and views are acyclic; refcounting frees
+        them). Used by the non-generator entry points only, so the
+        try/finally always restores the collector — never from inside
+        a generator, whose finally could be stranded by an abandoned
+        iterator. Trade-off: cycles made by OTHER threads during the
+        call collect after it returns."""
+        import gc
+        paused = gc.isenabled()
+        if paused:
+            gc.disable()
+        try:
+            yield
+        finally:
+            if paused:
+                gc.enable()
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list:
@@ -218,8 +244,9 @@ class NgramBatchEngine:
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
         out: list = []
-        for part in self._pipelined(texts, batch_size, self._finish):
-            out.extend(part)
+        with self._gc_paused():
+            for part in self._pipelined(texts, batch_size, self._finish):
+                out.extend(part)
         return out
 
     def _pipelined(self, texts: list[str], batch_size: int, finish):
@@ -227,18 +254,20 @@ class NgramBatchEngine:
         yields finish()'s per-slice values in order."""
         yield from self._pipelined_jobs(
             self._slices(texts, batch_size),
-            self._dispatch, finish)
+            self._pack, finish)
 
-    def _pipelined_jobs(self, jobs, dispatch, finish):
-        """Shared pipeline core: the main thread packs + dispatches job
-        N+1 while pool workers force job N's device execution and run
-        its epilogue (the C++ pack, the epilogue, and the readback all
-        release the GIL). Yields finish(job, cb, fut) values in job
-        order. Depth 3 keeps the device queue full across the ~95ms
-        dispatch latency of this host's TPU tunnel (>= 3 concurrent
-        fetches reach the backend's overlap ceiling). A single-job call
-        (the service batcher's common flush) skips the pool entirely —
-        its flushes already overlap on the batcher's worker pool, and
+    def _pipelined_jobs(self, jobs, pack, finish):
+        """Shared pipeline core: the main thread ONLY packs (C++,
+        GIL-released); each pool worker launches its slice's device
+        program — paying the host->device wire transfer there, off the
+        critical path — then forces execution and runs the epilogue.
+        Yields finish(job, cb, fut) values in job order. Depth 3 keeps
+        the device queue full across the ~95ms dispatch latency of this
+        host's TPU tunnel (>= 3 concurrent fetches reach the backend's
+        overlap ceiling; concurrent launches from worker threads are the
+        service batcher's proven pattern). A single-job call (the
+        service batcher's common flush) skips the pool entirely — its
+        flushes already overlap on the batcher's worker pool, and
         per-call thread spawning is real cost on the single-core
         host."""
         jobs = iter(jobs)
@@ -247,16 +276,20 @@ class NgramBatchEngine:
             return
         second = next(jobs, None)
         if second is None:
-            cb, fut = dispatch(first)
-            yield finish(first, cb, fut)
+            cb = pack(first)
+            yield finish(first, cb, self._score_fn(self.dt, cb.wire))
             return
         from concurrent.futures import ThreadPoolExecutor
         import itertools
+
+        def launch_and_finish(job, cb):
+            return finish(job, cb, self._score_fn(self.dt, cb.wire))
+
         pending: list = []
         with ThreadPoolExecutor(3) as pool:
             for job in itertools.chain([first, second], jobs):
-                cb, fut = dispatch(job)
-                pending.append(pool.submit(finish, job, cb, fut))
+                cb = pack(job)
+                pending.append(pool.submit(launch_and_finish, job, cb))
                 while len(pending) > 3:
                     yield pending.pop(0).result()
             for f in pending:
@@ -278,20 +311,28 @@ class NgramBatchEngine:
         if out:
             yield out
 
-    def _dispatch(self, texts: list[str], flags: int | None = None,
-                  hint_boosts: list | None = None):
-        """Pack + launch the device program asynchronously; returns
-        (ChunkBatch, device future)."""
+    def _pack(self, texts: list[str], flags: int | None = None,
+              hint_boosts: list | None = None):
+        """Pack only (no device launch): the pipeline core launches on
+        its worker pool so slice N's host->device transfer never blocks
+        slice N+1's pack on the single-core host."""
         from .. import native
         fl = self.flags if flags is None else flags
         pad = -len(texts) % self._mesh_size
         padded = list(texts) + [""] * pad if pad else texts
         if pad and hint_boosts is not None:
             hint_boosts = list(hint_boosts) + [None] * pad
-        cb = native.pack_chunks_native(
+        return native.pack_chunks_native(
             padded, self.tables, self.reg, flags=fl,
             n_shards=self._mesh_size, l_doc=self.max_slots,
             c_doc=self.max_chunks, hint_boosts=hint_boosts)
+
+    def _dispatch(self, texts: list[str], flags: int | None = None,
+                  hint_boosts: list | None = None):
+        """Pack + launch the device program asynchronously; returns
+        (ChunkBatch, device future). Single-shot path (detect_batch,
+        the gate-failure retry); the multi-slice pipeline uses _pack."""
+        cb = self._pack(texts, flags, hint_boosts)
         return cb, self._score_fn(self.dt, cb.wire)
 
     def _epilogue(self, texts: list[str], cb, fut):
@@ -367,8 +408,9 @@ class NgramBatchEngine:
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return [self.reg.code(r.summary_lang)
                     for r in self.detect_batch(texts)]
-        parts = list(self._pipelined(texts, batch_size,
-                                     self._finish_codes))
+        with self._gc_paused():
+            parts = list(self._pipelined(texts, batch_size,
+                                         self._finish_codes))
         ids = np.concatenate(parts) if parts else np.zeros(0, np.int32)
         return self.reg.lang_code[ids].tolist()
 

@@ -40,7 +40,7 @@ _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
             "ldt_pack_flat_begin", "ldt_pack_flat_finish",
             "ldt_pack_flat_free", "ldt_epilogue_flat", "ldt_init_detect",
             "detect_language", "ldt_detect_batch_codes")
-_ABI_VERSION = 7  # must match packer.cc ldt_abi_version()
+_ABI_VERSION = 8  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -292,9 +292,11 @@ class ChunkBatch:
     program shape depends only on content volume (N slots, Gs chunks per
     shard, K = fattest chunk) — never on batch size or document length.
     """
-    wire: dict               # idx [D,N] u16; cstart [D,Gs] i32;
-                             # cnsl [D,Gs] u16; cmeta [D,Gs] u32;
-                             # cscript [D,Gs] u8; k_iota [K] u8
+    wire: dict               # idx [D,N] u16; cnsl [D,Gs] u8 (chunk
+                             # starts derive on device by cumsum);
+                             # cmeta [D,Gs] u32; cscript [D,Gs] u8;
+                             # cwhack [D,Gs] u16 or [D,1] dummy when no
+                             # doc carries whacks; k_iota [K] u8
     doc_chunk_start: np.ndarray  # [B] i64 first chunk row in flat [D*Gs]
     direct_adds: np.ndarray  # [B, Dcap, 3] i32
     text_bytes: np.ndarray   # [B] i32
@@ -444,14 +446,21 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         K = next(k for k in _K_BUCKETS if k >= max(int(max_nsl.value), 1))
 
         idx = np.zeros((D, N), np.uint16)
-        cstart = np.zeros((D, Gs), np.int32)
-        cnsl = np.zeros((D, Gs), np.uint16)
+        cnsl = np.zeros((D, Gs), np.uint8)
         cmeta = np.zeros((D, Gs), np.uint32)
         cscript = np.zeros((D, Gs), np.uint8)
-        cwhack = np.zeros((D, Gs), np.uint16)
+        # hint-free batches (the overwhelmingly common case) ship a
+        # 1-wide dummy whack lane: the scorer skips the whack gather at
+        # trace time and ~64KB/batch stays off the wire
+        cwhack = np.zeros((D, Gs if doc_whack is not None else 1),
+                          np.uint16)
         doc_chunk_start = np.zeros(B, np.int64)
-        # hint leaves pad to power-of-two buckets so the hint-free and
-        # hinted paths share compiled programs per (N, Gs, K) shape
+        # hint leaves pad to power-of-two buckets to bound program-count
+        # growth with hint-table size. Per (N, Gs, K) shape there are
+        # exactly TWO program variants — whack-free (1-wide cwhack
+        # dummy, the overwhelmingly common case, 64KB/batch lighter) and
+        # whacked — a deliberate trade of one extra compile at a warm
+        # shape's first whacked batch for wire off every plain batch
         Hb = _next_pow2_min(len(hint_lp) if hint_lp is not None else 1,
                             32)
         hint_lp_w = np.zeros(Hb, np.uint32)
@@ -473,11 +482,13 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         _ptr(n_slots, np.int32), _ptr(n_chunks, np.int32),
         _ptr(doc_whack, np.int32) if doc_whack is not None
         else ctypes.c_void_p(None),
-        _ptr(idx, np.uint16), _ptr(cstart, np.int32),
-        _ptr(cnsl, np.uint16), _ptr(cmeta, np.uint32),
-        _ptr(cscript, np.uint8), _ptr(cwhack, np.uint16),
+        _ptr(idx, np.uint16),
+        _ptr(cnsl, np.uint8), _ptr(cmeta, np.uint32),
+        _ptr(cscript, np.uint8),
+        _ptr(cwhack, np.uint16) if doc_whack is not None
+        else ctypes.c_void_p(None),
         _ptr(doc_chunk_start, np.int64))
-    wire = dict(idx=idx, cstart=cstart, cnsl=cnsl, cmeta=cmeta,
+    wire = dict(idx=idx, cnsl=cnsl, cmeta=cmeta,
                 cscript=cscript, cwhack=cwhack, hint_lp=hint_lp_w,
                 whack_tbl=whack_w, k_iota=np.zeros(K, np.uint8))
     return ChunkBatch(wire=wire, doc_chunk_start=doc_chunk_start,

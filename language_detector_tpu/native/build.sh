@@ -1,14 +1,25 @@
 #!/bin/bash
 # Build the native packer shared library.
+#
+# Owns the flag set and the ISA sidecar for EVERY build of this library:
+# tools/profile_pack.py reuses it with LDT_SRC/LDT_EXTRA_FLAGS for the
+# instrumented twin, so production and profile binaries can never drift
+# to different compile flags.
+#
+#   $1               output .so name (default libldtpack.so)
+#   LDT_SRC          packer source (default packer.cc)
+#   LDT_EXTRA_FLAGS  extra compile flags (e.g. -DLDT_PROF)
 set -e
 cd "$(dirname "$0")"
+OUT="${1:-libldtpack.so}"
 # -march=native: the library is always built on the host that runs it
 # (build-on-demand via native/__init__.py; the wheel ships sources).
 # The .host sidecar records the build host's ISA so the loader rebuilds
 # instead of SIGILL-ing when a copied working tree lands on a host with
 # a different instruction set (native/__init__.py _host_isa()).
-g++ -O3 -march=native -funroll-loops -shared -fPIC -std=c++17 \
-    -o libldtpack.so packer.cc epilogue.cc -lpthread
+g++ -O3 -march=native -funroll-loops ${LDT_EXTRA_FLAGS:-} \
+    -shared -fPIC -std=c++17 \
+    -o "$OUT" "${LDT_SRC:-packer.cc}" epilogue.cc -lpthread
 { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
-    > libldtpack.so.host 2>/dev/null || true
-echo "built $(pwd)/libldtpack.so"
+    > "$OUT.host" 2>/dev/null || true
+echo "built $(pwd)/$OUT"

@@ -27,6 +27,11 @@
 // 4 emission pass, 5 build_span, 7 whole pack_resolve_one_doc.
 #ifdef LDT_PROF
 #include <x86intrin.h>
+
+#include <atomic>
+// Plain u64 storage for the ctypes reader, updated with atomic RMWs:
+// the flat pack runs docs on multiple worker threads, and non-atomic
+// += would silently drop increments on multi-core hosts.
 extern "C" uint64_t ldt_prof_cycles[8];
 uint64_t ldt_prof_cycles[8] = {};
 namespace {
@@ -34,7 +39,10 @@ struct ProfScope {
   int i;
   uint64_t t0;
   explicit ProfScope(int i) : i(i), t0(__rdtsc()) {}
-  ~ProfScope() { ldt_prof_cycles[i] += __rdtsc() - t0; }
+  ~ProfScope() {
+    reinterpret_cast<std::atomic<uint64_t>*>(&ldt_prof_cycles[i])
+        ->fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+  }
 };
 }  // namespace
 #define LDT_PROF_CAT2(a, b) a##b
@@ -322,7 +330,7 @@ void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
   int i = 0;
   while (i < len) {
     uint8_t c = s[i];
-    if (c >= 0xC0 && i + (c < 0xE0 ? 2 : c < 0xF0 ? 3 : 4) > len) {
+    if (c >= 0x80 && i + (c < 0xF0 ? (c < 0xE0 ? 2 : 3) : 4) > len) {
       out->push_back(c);
       i += 1;
     }
@@ -488,10 +496,10 @@ void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
       uint8_t c = text[i];
       uint32_t cp;
       int incr;
-      if (c >= 0xC0 && i + (c < 0xE0 ? 2 : c < 0xF0 ? 3 : 4) > text_len) {
-        // truncated multibyte tail (reachable via the C ABI, which takes
-        // arbitrary bytes): consume the lead byte alone instead of
-        // reading past the buffer
+      if (c >= 0x80 && i + (c < 0xF0 ? (c < 0xE0 ? 2 : 3) : 4) > text_len) {
+        // truncated multibyte tail OR stray continuation byte at the end
+        // (reachable via the C ABI, which takes arbitrary bytes):
+        // consume one byte instead of reading past the buffer
         cp = c;
         incr = 1;
       } else if (c < 0x80) {
@@ -1501,9 +1509,10 @@ restart:
 
 // A chunk holds <= ~20 quads / ~50 CJK unigrams (a+b pairs), trailing
 // runt merges (x1.5), interleaved word hits, and a 4-slot boost flush;
-// 256 covers every real text with margin. Fatter chunks (adversarial
+// 255 covers every real text with margin (and lets per-chunk slot
+// counts ride the wire as u8). Fatter chunks (adversarial
 // constructions) route the doc to the scalar fallback.
-constexpr int kMaxChunkSlots = 256;
+constexpr int kMaxChunkSlots = 255;
 
 struct FlatThreadBuf {
   std::vector<uint16_t> idx;     // resolved slots, concat over this
@@ -1654,7 +1663,7 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 7; }
+int32_t ldt_abi_version() { return 8; }
 
 // Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
 // text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
@@ -1866,9 +1875,12 @@ void ldt_pack_flat_finish(
     int64_t handle, int32_t B, int32_t D, int32_t N, int32_t Gs,
     const int32_t* n_slots, const int32_t* n_chunks,
     const int32_t* doc_whack_row,  // [B] whack-table rows, or null
-    uint16_t* idx_flat, int32_t* cstart, uint16_t* cnsl_flat,
+    uint16_t* idx_flat, uint8_t* cnsl_flat,
     uint32_t* cmeta_flat, uint8_t* cscript_flat, uint16_t* cwhack_flat,
     int64_t* doc_chunk_start) {
+  // No chunk-start lane on the wire: slots concatenate in chunk order,
+  // so the device derives starts as an exclusive cumsum of cnsl.
+  // cwhack_flat may be null (hint-free batches carry a 1-wide dummy).
   FlatPackState* st = (FlatPackState*)(intptr_t)handle;
   int Bd = B / D;
   for (int d = 0; d < D; d++) {
@@ -1881,29 +1893,24 @@ void ldt_pack_flat_finish(
                   tb.idx.data() + st->doc_slot_off[b],
                   (size_t)ns * sizeof(uint16_t));
       doc_chunk_start[b] = (int64_t)d * Gs + gpos;
-      int64_t cpos = spos;
       int64_t src = st->doc_chunk_off[b];
       int64_t dst = (int64_t)d * Gs + gpos;
       uint16_t wrow = doc_whack_row ? (uint16_t)doc_whack_row[b] : 0;
       for (int c = 0; c < nc; c++) {
-        cstart[dst + c] = (int32_t)cpos;
-        uint16_t n = tb.cnsl[src + c];
-        cnsl_flat[dst + c] = n;
+        cnsl_flat[dst + c] = (uint8_t)tb.cnsl[src + c];
         cmeta_flat[dst + c] = tb.cmeta[src + c];
         cscript_flat[dst + c] = tb.cscript[src + c];
-        cwhack_flat[dst + c] = wrow;
-        cpos += n;
+        if (cwhack_flat) cwhack_flat[dst + c] = wrow;
       }
       spos += ns;
       gpos += nc;
     }
     for (int64_t g = gpos; g < Gs; g++) {
       int64_t dst = (int64_t)d * Gs + g;
-      cstart[dst] = 0;
       cnsl_flat[dst] = 0;
       cmeta_flat[dst] = 0;
       cscript_flat[dst] = 0;
-      cwhack_flat[dst] = 0;
+      if (cwhack_flat) cwhack_flat[dst] = 0;
     }
   }
   delete st;

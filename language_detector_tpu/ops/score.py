@@ -157,21 +157,26 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
     p (built by native.pack_chunks_native):
       idx       [N]        u16  cat_ind2 index per resolved slot (flat);
                                 values >= HINT_BASE address hint_lp
-      cstart    [G]        i32  chunk's first slot (shard-local)
-      cnsl      [G]        u16  chunk's slot count
+      cnsl      [G]        u8   chunk's slot count (chunk starts derive
+                                here as a per-shard exclusive cumsum —
+                                slots concatenate in chunk order)
       cmeta     [G]        u32  chunk meta (CM2_* layout)
       cscript   [G]        u8   chunk ULScript
-      cwhack    [G]        u16  whack-table row (0 = no whacks)
+      cwhack    [G]        u16  whack-table row (0 = no whacks), or a
+                                1-wide dummy when no doc carries whacks
+                                (the gather drops out at trace time)
       hint_lp   [H]        u32  hint-prior langprob window (per batch)
       whack_tbl [W,2,256]  u8   close-set whack masks per side
       k_iota    [K]        u8   dense chunk-row length carrier
 
     Reductions are chunk-local: safe under jit and shard_map over the
-    chunk axis with zero collectives."""
+    chunk axis with zero collectives (the cnsl cumsum is per shard
+    row, i.e. over the trailing axis of the shard's own block)."""
     idxf = p["idx"].reshape(-1)
     N = idxf.shape[0]
-    cstart = p["cstart"].reshape(-1).astype(jnp.int32)
-    cnsl = p["cnsl"].reshape(-1).astype(jnp.int32)
+    cnsl2 = p["cnsl"].astype(jnp.int32)            # [D, Gs]
+    cstart = (jnp.cumsum(cnsl2, axis=-1) - cnsl2).reshape(-1)
+    cnsl = cnsl2.reshape(-1)
     cmeta = p["cmeta"].reshape(-1).astype(jnp.uint32)
     G = cstart.shape[0]
     K = p["k_iota"].shape[0]
@@ -212,11 +217,17 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
 
     # close-set whacks (ZeroPSLang, scoreonescriptspan.cc:144-151):
     # zero hinted-out rival languages AFTER all tote adds, per chunk;
-    # the group-in-use mask keeps the pre-whack adds (tote semantics)
-    cwhack = p["cwhack"].reshape(-1).astype(jnp.int32)
-    wmask = p["whack_tbl"][jnp.clip(cwhack, 0,
-                                    p["whack_tbl"].shape[0] - 1), side]
-    whacked = jnp.where(wmask > 0, 0, scores)
+    # the group-in-use mask keeps the pre-whack adds (tote semantics).
+    # Hint-free batches ship a 1-wide dummy whack lane — the gather
+    # (and 64KB/batch of wire) drops out of the traced program.
+    if p["cwhack"].shape[-1] == 1:
+        whacked = scores
+    else:
+        cwhack = p["cwhack"].reshape(-1).astype(jnp.int32)
+        wmask = p["whack_tbl"][jnp.clip(cwhack, 0,
+                                        p["whack_tbl"].shape[0] - 1),
+                               side]
+        whacked = jnp.where(wmask > 0, 0, scores)
     return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
                            script, group_scores=scores)
 

@@ -38,10 +38,11 @@ def sharded_score_chunks_fn(mesh: Mesh):
     """Jitted score_chunks with the CHUNK axis sharded over the mesh.
 
     The flat wire has no document axis; each shard row carries the slots
-    and chunk rows of its contiguous document range (pack_chunks_native
-    lays shards out with shard-local cstart offsets), so the body is
-    communication-free exactly like the doc-major scorer."""
-    wire_specs = dict(idx=P(BATCH_AXIS), cstart=P(BATCH_AXIS),
+    and chunk rows of its contiguous document range (chunk starts derive
+    per shard row as a cumsum of cnsl, so every shard's program is
+    identical), keeping the body communication-free exactly like the
+    doc-major scorer."""
+    wire_specs = dict(idx=P(BATCH_AXIS),
                       cnsl=P(BATCH_AXIS), cmeta=P(BATCH_AXIS),
                       cscript=P(BATCH_AXIS), cwhack=P(BATCH_AXIS),
                       hint_lp=P(), whack_tbl=P(), k_iota=P())

@@ -57,15 +57,13 @@ def build_instrumented() -> Path:
     prof_cc = NATIVE / "packer_prof.cc"
     prof_cc.write_text(src)
     so = NATIVE / "libldtpack_prof.so"
-    subprocess.run(
-        ["g++", "-O3", "-march=native", "-funroll-loops", "-DLDT_PROF",
-         "-shared", "-fPIC", "-std=c++17", "-o", str(so),
-         str(prof_cc), str(NATIVE / "epilogue.cc"), "-lpthread"],
-        check=True)
-    # ISA sidecar for the loader's -march=native staleness check
-    # (native/__init__.py _isa_matches), same contract as build.sh
-    from language_detector_tpu import native
-    so.with_suffix(".so.host").write_text(native._host_isa())
+    # build.sh owns the flag set and the ISA sidecar — the instrumented
+    # twin differs from production ONLY by -DLDT_PROF and the source file
+    import os
+    env = dict(os.environ, LDT_SRC=prof_cc.name,
+               LDT_EXTRA_FLAGS="-DLDT_PROF")
+    subprocess.run(["bash", str(NATIVE / "build.sh"), so.name],
+                   check=True, env=env)
     return so
 
 
