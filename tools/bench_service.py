@@ -161,20 +161,26 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
         server_task = asyncio.create_task(
             serve(0, 0, svc=svc, ready=ready))
         port, _ = await ready
-        # warm-up: several requests so compiles + retry shapes settle
-        # before the timed window
-        results = {"docs": 0, "errors": 0}
-        await client(port, list(payloads[:3]), results)
-        results = {"docs": 0, "errors": 0}
-        work = list(payloads)
-        t0 = time.time()
-        await asyncio.gather(*[client(port, work, results)
-                               for _ in range(clients)])
-        took = time.time() - t0
-        server_task.cancel()
-        return results, took
 
-    results, took = asyncio.run(main())
+        async def one_pass():
+            results = {"docs": 0, "errors": 0}
+            work = list(payloads)
+            t0 = time.time()
+            await asyncio.gather(*[client(port, work, results)
+                                   for _ in range(clients)])
+            return results, time.time() - t0
+
+        # Cold pass first (compiles + first-flush shapes land inside it;
+        # reported as cold_docs_sec), then the warm timed pass. Sequential
+        # small warm-ups are NOT enough: the full-size flush shapes only
+        # appear under concurrent load, so a cold "warmed" window used to
+        # pay them and read ~40% low.
+        cold_results, cold_took = await one_pass()
+        results, took = await one_pass()
+        server_task.cancel()
+        return results, took, cold_results, cold_took
+
+    results, took, cold_results, cold_took = asyncio.run(main())
     docs_sec = results["docs"] / took
     return dict(
         metric="service_http_throughput_aio",
@@ -182,7 +188,10 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
         unit="docs/sec",
         detail=dict(total_docs=results["docs"], errors=results["errors"],
                     clients=clients, docs_per_request=docs_per_request,
-                    took_sec=round(took, 2)),
+                    took_sec=round(took, 2),
+                    cold_docs_sec=round(
+                        cold_results["docs"] / cold_took, 1),
+                    cold_errors=cold_results["errors"]),
     )
 
 
