@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import dataclasses
 
-from .engine_scalar import ScalarResult, detect_scalar
+from . import native
+from .engine_scalar import (ScalarResult, detect_scalar,
+                            result_from_epilogue_row)
 from .registry import Registry, UNKNOWN_LANGUAGE, registry as default_registry
 from .tables import ScoringTables, load_tables
 
@@ -61,7 +63,22 @@ class LanguageDetector:
         encoding / explicit language priors; ExtDetectLanguageSummary
         contract, compact_lang_det.h:168+). return_chunks additionally
         fills `.chunks` with per-byte-range languages over the original
-        input (the ResultChunkVector overload, compact_lang_det.h:380)."""
+        input (the ResultChunkVector overload, compact_lang_det.h:380).
+
+        Plain, unhinted, chunk-less calls run the all-C single-document
+        pipeline (native detect_one_row: pack -> C chunk scorer ->
+        epilogue -> gate recursion, agreement-pinned against the device
+        and scalar engines) — ~1000x the Python scalar engine. Exotic
+        surfaces (hints, HTML, chunk vectors, non-default flags, docs
+        past the C seam's 160KB reference subset, or no native library)
+        keep the scalar engine."""
+        if (is_plain_text and hints is None and not return_chunks
+                and self.flags == 0):
+            row = native.detect_one_native(text, self.tables,
+                                           self.registry)
+            if row is not None:
+                return DetectionResult.from_scalar(
+                    result_from_epilogue_row(row), self.registry)
         r = detect_scalar(text, self.tables, self.registry, self.flags,
                           is_plain_text=is_plain_text, hints=hints,
                           want_chunks=return_chunks)

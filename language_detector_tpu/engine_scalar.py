@@ -1069,3 +1069,16 @@ def detect_scalar(text: str, tables: ScoringTables | None = None,
                         percent3=percent3, normalized_score3=ns3,
                         text_bytes=total, is_reliable=reliable,
                         chunks=chunks)
+
+
+def result_from_epilogue_row(row) -> ScalarResult:
+    """ldt_epilogue_flat [14]-lane row -> ScalarResult (shared by the
+    batched engine's retry path and the all-C detect() fast path —
+    lives here so the C path needs no jax import)."""
+    return ScalarResult(
+        summary_lang=int(row[0]),
+        language3=[int(row[1]), int(row[2]), int(row[3])],
+        percent3=[int(row[4]), int(row[5]), int(row[6])],
+        normalized_score3=[float(row[7]), float(row[8]), float(row[9])],
+        text_bytes=int(row[10]),
+        is_reliable=bool(row[11]))

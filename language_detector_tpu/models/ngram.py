@@ -31,7 +31,8 @@ import numpy as np
 
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              FLAG_SQUEEZE, FLAG_TOP40,
-                             ScalarResult, detect_scalar)
+                             ScalarResult, detect_scalar,
+                             result_from_epilogue_row as _result_from_row)
 from ..ops.device_tables import DeviceTables
 from ..ops.score import score_chunks, unpack_chunks_out
 from ..registry import Registry, registry as default_registry
@@ -117,6 +118,11 @@ class NgramBatchEngine:
     # retries overlap on the pipeline (+16% mixed, clean unchanged —
     # a clean 16K-doc service batch stays a single slice either way).
     DISPATCH_CHAR_BUDGET = 3 << 20
+
+    # detect_codes batches at or under this size answer on the all-C
+    # path instead of dispatching: 64 docs x ~1ms/doc stays under the
+    # backend's fixed ~95ms dispatch latency
+    TINY_BATCH_C_PATH = 64
 
     def detect_batch(self, texts: list[str], hints=None,
                      is_plain_text: bool = True) -> list:
@@ -408,6 +414,23 @@ class NgramBatchEngine:
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return [self.reg.code(r.summary_lang)
                     for r in self.detect_batch(texts)]
+        # tiny batches (a low-traffic service flush) skip the device:
+        # the all-C pipeline answers in ~1ms/doc while any dispatch
+        # pays the backend's fixed ~95ms latency — and the C path is
+        # agreement-pinned against the device path (test_c_abi)
+        if len(texts) <= self.TINY_BATCH_C_PATH and self.flags == 0:
+            from .. import native
+            ids = native.detect_batch_codes_native(texts, self.tables,
+                                                   self.reg)
+            if ids is not None:
+                # count the flush: the service Prometheus gauges read
+                # eng.stats, and a low-traffic service whose every
+                # flush is tiny must not render as idle
+                with self._stats_lock:
+                    self.stats["batches"] += 1
+                    self.stats["c_path_docs"] = \
+                        self.stats.get("c_path_docs", 0) + len(texts)
+                return self.reg.lang_code[ids].tolist()
         with self._gc_paused():
             parts = list(self._pipelined(texts, batch_size,
                                          self._finish_codes))
@@ -477,12 +500,4 @@ class EpilogueResult:
                 f"is_reliable={self.is_reliable})")
 
 
-def _result_from_row(row) -> ScalarResult:
-    """ldt_epilogue_flat [14]-lane row -> ScalarResult."""
-    return ScalarResult(
-        summary_lang=int(row[0]),
-        language3=[int(row[1]), int(row[2]), int(row[3])],
-        percent3=[int(row[4]), int(row[5]), int(row[6])],
-        normalized_score3=[float(row[7]), float(row[8]), float(row[9])],
-        text_bytes=int(row[10]),
-        is_reliable=bool(row[11]))
+

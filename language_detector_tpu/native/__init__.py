@@ -39,8 +39,9 @@ def _build() -> bool:
 _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
             "ldt_pack_flat_begin", "ldt_pack_flat_finish",
             "ldt_pack_flat_free", "ldt_epilogue_flat", "ldt_init_detect",
-            "detect_language", "ldt_detect_batch_codes")
-_ABI_VERSION = 8  # must match packer.cc ldt_abi_version()
+            "detect_language", "detect_language_n",
+            "ldt_detect_one_full", "ldt_detect_batch_codes")
+_ABI_VERSION = 9  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -57,6 +58,10 @@ def _try_load_all():
         lib.ldt_pack_flat_begin.restype = ctypes.c_int64
         lib.detect_language.restype = ctypes.c_char_p
         lib.detect_language.argtypes = [ctypes.c_char_p]
+        lib.detect_language_n.restype = ctypes.c_char_p
+        lib.detect_language_n.argtypes = [ctypes.c_char_p,
+                                          ctypes.c_int32]
+        lib.ldt_detect_one_full.restype = ctypes.c_int32
         return lib
     except (OSError, AttributeError):
         return None
@@ -495,6 +500,67 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
                       direct_adds=direct_adds, text_bytes=text_bytes,
                       fallback=fallback, squeezed=squeezed,
                       n_slots=n_slots, n_chunks=n_chunks, n_docs=B)
+
+
+# Reference 160KB-per-document scoring subset (packer.cc
+# kCabiMaxScoreBytes; compact_lang_det_impl.h:159-161): the all-C
+# single-doc path answers anything real at or under this (only
+# adversarial >32K-script-flip constructions exceed its budget ladder,
+# and those report failure so callers can fall back).
+MAX_SCORE_BYTES = 160 << 10
+
+
+def detect_one_native(text: str, tables: ScoringTables, reg: Registry):
+    """One document through the all-C pipeline (pack -> C chunk scorer
+    -> epilogue -> gate recursion; packer.cc detect_one_row): the fast
+    path behind the public detect(). Returns the ldt_epilogue_flat
+    14-lane row as a list, or None when the native library is
+    unavailable or the text exceeds the C seam's 160KB scoring subset
+    (the scalar engine scans everything, so oversized docs keep
+    Python-visible behavior)."""
+    lib = _load()
+    if not lib:
+        return None
+    enc = text.encode("utf-8", errors="surrogatepass")
+    if len(enc) > MAX_SCORE_BYTES:
+        return None
+    _ensure_init(tables, reg)
+    out = (ctypes.c_int64 * 14)()
+    if not lib.ldt_detect_one_full(enc, ctypes.c_int32(len(enc)), out):
+        return None  # adversarial budget overflow: caller goes scalar
+    return list(out)
+
+
+def detect_batch_codes_native(texts: list[str], tables: ScoringTables,
+                              reg: Registry,
+                              n_threads: int = 0) -> np.ndarray | None:
+    """Language ids for a small batch through the all-C pipeline
+    (ldt_detect_batch_codes) — no device dispatch, so a tiny service
+    flush answers in ~1ms instead of paying the backend's fixed
+    dispatch latency. Returns None when the native library is
+    unavailable or any document exceeds the 160KB C-path subset."""
+    lib = _load()
+    if not lib:
+        return None
+    enc = [t.encode("utf-8", errors="surrogatepass") for t in texts]
+    if any(len(e) > MAX_SCORE_BYTES for e in enc):
+        return None
+    _ensure_init(tables, reg)
+    B = len(enc)
+    bounds = np.zeros(B + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=bounds[1:])
+    blob = np.frombuffer(b"".join(enc), dtype=np.uint8) if bounds[-1] \
+        else np.zeros(1, np.uint8)
+    blob = np.ascontiguousarray(blob)
+    out = np.zeros(B, np.int32)
+    if n_threads <= 0:
+        import os
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.ldt_detect_batch_codes(
+        _ptr(blob, np.uint8), _ptr(bounds, np.int64),
+        ctypes.c_int32(B), ctypes.c_int32(n_threads),
+        _ptr(out, np.int32))
+    return out
 
 
 def epilogue_flat_native(rows: np.ndarray, cb: ChunkBatch, flags: int,

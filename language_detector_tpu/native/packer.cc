@@ -1225,7 +1225,8 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   // to an already-seen size is O(1), and entries zero lazily at
   // allocation — see zero_chunks)
   static thread_local std::vector<int32_t> c_grams, c_lo, c_span_end;
-  static thread_local std::vector<int16_t> c_span;
+  static thread_local std::vector<int32_t> c_span;  // i32: tier-2 round
+                                                    // counts pass 32767
   static thread_local std::vector<int8_t> c_side, c_real;
   c_grams.resize(C); c_lo.resize(C); c_span_end.resize(C);
   c_span.resize(C); c_side.resize(C); c_real.resize(C);
@@ -1431,7 +1432,7 @@ restart:
         if (r_offset < c_lo[c]) c_lo[c] = r_offset;
         c_real[c] = 1;
         c_side[c] = (int8_t)side;
-        c_span[c] = (int16_t)round_no;
+        c_span[c] = round_no;
         c_span_end[c] = (int32_t)round_end;
         cscript[c] = (uint8_t)sp.ulscript;
         // rotating distinct boost (device scan: update AFTER scoring the
@@ -1444,7 +1445,7 @@ restart:
       // mark allocated-but-empty chunks of this round (runt grids)
       for (int c = chunk_base; c < chunk_base + round_chunks; c++) {
         if (c_span[c] < 0) {
-          c_span[c] = (int16_t)round_no;
+          c_span[c] = round_no;
           c_span_end[c] = (int32_t)round_end;
           c_side[c] = (int8_t)side;
           cscript[c] = (uint8_t)sp.ulscript;
@@ -1589,6 +1590,9 @@ void score_chunks_host(const uint16_t* idx, const uint16_t* chk, int ns,
                        int nc, const uint32_t* cmeta,
                        const uint8_t* cscript, int32_t* rows) {
   static thread_local std::vector<int32_t> scores;
+  // one tier-2 adversarial doc would otherwise pin ~64MB per thread
+  if (scores.capacity() > (size_t)(8 << 20))
+    std::vector<int32_t>().swap(scores);
   scores.assign((size_t)nc * 256, 0);
   for (int i = 0; i < ns; i++) {
     uint32_t lp = rt.cat_ind[idx[i]];
@@ -1663,7 +1667,7 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 8; }
+int32_t ldt_abi_version() { return 9; }
 
 // Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
 // text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
@@ -1788,44 +1792,75 @@ void ldt_init_detect(const uint8_t* lg_prob3, const int32_t* plang_to_lang,
                    closest_alt, is_figs, codes, n_lang, code_stride, true};
 }
 
+// Scoring subset cap, mirroring the reference's 160KB-per-document
+// subsetting (compact_lang_det_impl.h:159-161, impl.cc:192): detection
+// quality saturates long before this, and the cap is what lets the
+// budget ladder below GUARANTEE an answer for any input.
+constexpr int32_t kCabiMaxScoreBytes = 160 << 10;
+
 // One full C-side detection: pack -> score -> epilogue, plus the
 // reference's gate-failure recursion (impl.cc:2061-2105) as a second
-// pass with the recursion flags. Returns a language id; budget-overflow
-// documents (pathological input) answer UNKNOWN.
-static int32_t detect_one_c(const uint8_t* text, int32_t len) {
-  if (!rt_ready || !dctx.ready) return kCabiUnknown;
+// pass with the recursion flags. Fills the 14-lane epilogue row
+// (ldt_epilogue_flat contract). Documents that overflow the default
+// per-doc budgets retry once with a large tier instead of giving up —
+// the reference's wrapper never answers "un" for mere size
+// (wrapper.cc:7-16): 512K slots / 64K chunks / 64K direct adds cover
+// every real 160KB-capped document (~3 resolved hits per 6-byte word
+// plus per-chunk boost flushes < 512K slots; a chunk or direct add
+// needs a fresh hit round or a script flip).
+static bool detect_one_row(const uint8_t* text, int32_t len,
+                           int64_t* out) {
+  if (!rt_ready || !dctx.ready) return false;
+  if (len > kCabiMaxScoreBytes) len = kCabiMaxScoreBytes;
   static thread_local std::vector<uint16_t> sidx, schk;
   static thread_local std::vector<uint32_t> scmeta;
   static thread_local std::vector<uint8_t> scscript;
   static thread_local std::vector<int32_t> rows, dadds;
-  const int L = 1 << 17, C = 1 << 14, D = 64;
-  sidx.resize(L); schk.resize(L);
-  scmeta.resize(C); scscript.resize(C);
-  dadds.resize(D * 3);
-  int32_t text_bytes = 0, n_slots = 0, n_chunks = 0;
-  uint8_t fallback = 0, squeezed = 0;
-  int flags = 0;
-  for (int pass = 0; pass < 2; pass++) {
-    ROut o{sidx.data(), schk.data(), scmeta.data(), scscript.data(),
-           dadds.data(), &text_bytes, &fallback, &squeezed, &n_slots,
-           &n_chunks, L, C, D, flags};
-    pack_resolve_one_doc(text, len, 0, o);
-    if (fallback) return kCabiUnknown;
-    rows.assign((size_t)n_chunks * 5, 0);
-    score_chunks_host(sidx.data(), schk.data(), n_slots, n_chunks,
-                      scmeta.data(), scscript.data(), rows.data());
-    int64_t dcs = 0;
-    uint8_t skip = 0;
-    int64_t out[14];
-    ldt_epilogue_flat(rows.data(), &dcs, &n_chunks, dadds.data(),
-                      &text_bytes, &skip, 1, D, flags, dctx.close_set,
-                      dctx.closest_alt, dctx.is_figs, dctx.n_lang, out);
-    if (!out[12]) return (int32_t)out[0];
-    // good-answer gate failed: one recursion pass (FINISH forces it)
-    flags = kCabiFlagTop40 | kCabiFlagRepeats | kCabiFlagFinish |
-            (squeezed ? kCabiFlagSqueeze : 0);
+  struct Tier { int L, C, D; };
+  // The chunk-id lane is u16 (ROut.chk), so no tier may budget more
+  // than 1<<16 chunks; 64K chunks need >32K script alternations inside
+  // the 160KB cap, so only adversarial constructions exceed tier 2 —
+  // those return false here (the Python caller falls back to the
+  // scalar engine; the raw C ABI answers "un").
+  const Tier tiers[2] = {{1 << 17, 1 << 14, 64},
+                         {1 << 19, 1 << 16, 1 << 16}};
+  for (const Tier& bud : tiers) {
+    sidx.resize(bud.L);
+    schk.resize(bud.L);
+    scmeta.resize(bud.C);
+    scscript.resize(bud.C);
+    dadds.resize((size_t)bud.D * 3);
+    int32_t text_bytes = 0, n_slots = 0, n_chunks = 0;
+    uint8_t fallback = 0, squeezed = 0;
+    int flags = 0;
+    for (int pass = 0; pass < 2; pass++) {
+      ROut o{sidx.data(), schk.data(), scmeta.data(), scscript.data(),
+             dadds.data(), &text_bytes, &fallback, &squeezed, &n_slots,
+             &n_chunks, bud.L, bud.C, bud.D, flags};
+      pack_resolve_one_doc(text, len, 0, o);
+      if (fallback) break;  // budget overflow: try the large tier
+      rows.assign((size_t)n_chunks * 5, 0);
+      score_chunks_host(sidx.data(), schk.data(), n_slots, n_chunks,
+                        scmeta.data(), scscript.data(), rows.data());
+      int64_t dcs = 0;
+      uint8_t skip = 0;
+      ldt_epilogue_flat(rows.data(), &dcs, &n_chunks, dadds.data(),
+                        &text_bytes, &skip, 1, bud.D, flags,
+                        dctx.close_set, dctx.closest_alt, dctx.is_figs,
+                        dctx.n_lang, out);
+      if (!out[12]) return true;
+      // good-answer gate failed: one recursion pass (FINISH forces it)
+      flags = kCabiFlagTop40 | kCabiFlagRepeats | kCabiFlagFinish |
+              (squeezed ? kCabiFlagSqueeze : 0);
+    }
   }
-  return kCabiUnknown;  // unreachable: FINISH always passes the gate
+  return false;  // adversarial: >64K chunks inside the 160KB cap
+}
+
+static int32_t detect_one_c(const uint8_t* text, int32_t len) {
+  int64_t out[14];
+  if (!detect_one_row(text, len, out)) return kCabiUnknown;
+  return (int32_t)out[0];
 }
 
 // The reference seam (wrapper.h:8 / wrapper.cc:7-16): NUL-terminated
@@ -1837,6 +1872,31 @@ const char* detect_language(const char* src) {
                               (int32_t)strlen(src));
   if (lang < 0 || lang >= dctx.n_lang) lang = kCabiUnknown;
   return dctx.codes + (size_t)lang * dctx.code_stride;
+}
+
+// Length-taking twin of detect_language (embedded NULs are legal in
+// the length-delimited contract; the NUL-terminated seam cannot carry
+// them). Same static-string return semantics.
+const char* detect_language_n(const char* src, int32_t len) {
+  if (src == nullptr || len < 0 || !dctx.ready) return "un";
+  int32_t lang = detect_one_c((const uint8_t*)src, len);
+  if (lang < 0 || lang >= dctx.n_lang) lang = kCabiUnknown;
+  return dctx.codes + (size_t)lang * dctx.code_stride;
+}
+
+// Full 14-lane epilogue row for one document (the richer
+// ExtDetectLanguageSummary surface, compact_lang_det.h:168-426, over
+// the C pipeline): summary lang, top-3 languages / percents /
+// normalized scores, text bytes, reliability. Returns 1 on success.
+int32_t ldt_detect_one_full(const uint8_t* text, int32_t len,
+                            int64_t* out14) {
+  if (text == nullptr || out14 == nullptr || len < 0) return 0;
+  if (!detect_one_row(text, len, out14)) {
+    for (int i = 0; i < 14; i++) out14[i] = 0;
+    out14[0] = kCabiUnknown;
+    return 0;
+  }
+  return 1;
 }
 
 // Batched variant: concatenated UTF-8 docs + bounds, language ids out.

@@ -50,3 +50,28 @@ def test_detect_parity(oracle, base_tables, text):
     assert mine_top3 == [(c, p) for c, p, _ in top3], (text, mine_top3, top3)
     assert r.is_reliable == reliable, (text, r.is_reliable, reliable)
     assert r.text_bytes == tb, (text, r.text_bytes, tb)
+
+
+def test_public_detect_fast_path_matches_scalar(base_tables):
+    """The public detect() routes plain unhinted calls through the all-C
+    pipeline (native detect_one_row); its full DetectionResult — summary,
+    top-3, percents, normalized scores, reliability, text_bytes — must
+    match the scalar engine document for document. Includes the
+    squeeze / repeat / gate-retry constructions and a tier-2 budget doc."""
+    from language_detector_tpu import native
+    from language_detector_tpu.detector import (DetectionResult,
+                                                LanguageDetector)
+    if not native.available():
+        pytest.skip("native library unavailable")
+    det = LanguageDetector(tables=base_tables)
+    texts = TEXTS + [
+        "buy cheap now " * 400,
+        "word " * 600,
+        ("καλημέρα κόσμε 世界 " * 200).strip(),   # tier-2 budget ladder
+        "🎉🎊", "\x00abc", "한국어 텍스트 \ud800 lone surrogate",
+    ]
+    for t in texts:
+        got = det.detect(t)
+        want = DetectionResult.from_scalar(
+            detect_scalar(t, base_tables, registry, 0), registry)
+        assert got == want, t[:50]
