@@ -124,17 +124,22 @@ class LanguageDetector:
         return r
 
     def detect_batch(self, texts: list[str], hints=None,
-                     is_plain_text: bool = True) -> list[DetectionResult]:
+                     is_plain_text: bool = True,
+                     return_chunks: bool = False) -> list[DetectionResult]:
         """Batched detection (device engine when available). hints /
         is_plain_text ride the device path too: priors become wire-level
-        chunk boosts, HTML cleans host-side before packing."""
+        chunk boosts, HTML cleans host-side before packing.
+        return_chunks fills per-byte-range vectors from the batched
+        path's offset sidecars (result_vector.py)."""
         eng = self._get_batch_engine()
         if eng is None:  # no usable accelerator backend: scalar per doc
             return [self.detect(t, hints=hints,
-                                is_plain_text=is_plain_text)
+                                is_plain_text=is_plain_text,
+                                return_chunks=return_chunks)
                     for t in texts]
         rs = eng.detect_batch(texts, hints=hints,
-                              is_plain_text=is_plain_text)
+                              is_plain_text=is_plain_text,
+                              return_chunks=return_chunks)
         return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
     def _get_batch_engine(self):
@@ -181,7 +186,8 @@ def detect(text: str, is_plain_text: bool = True, hints=None,
                                  hints=hints, return_chunks=return_chunks)
 
 
-def detect_batch(texts: list[str], hints=None,
-                 is_plain_text: bool = True) -> list[DetectionResult]:
+def detect_batch(texts: list[str], hints=None, is_plain_text: bool = True,
+                 return_chunks: bool = False) -> list[DetectionResult]:
     return _get_default().detect_batch(texts, hints=hints,
-                                       is_plain_text=is_plain_text)
+                                       is_plain_text=is_plain_text,
+                                       return_chunks=return_chunks)

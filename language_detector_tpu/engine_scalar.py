@@ -854,7 +854,9 @@ def build_result_chunks(orig_text: str, records: list, reg: Registry,
     segmenter-input char), the optional HTML clean-text offset map
     (clean char -> original char), and the original text's char->byte
     cumsum — the index-array equivalent of the reference's composed
-    OffsetMaps (offsetmap.cc:428-496)."""
+    OffsetMaps (offsetmap.cc:428-496). The merge itself runs in
+    merge_mapped_records, shared with the batched engine's chunk-vector
+    path (which arrives with offsets already mapped)."""
     raw = orig_text.encode("utf-8", "surrogatepass")
     cps = np.frombuffer(orig_text.encode("utf-32-le", "surrogatepass"),
                         np.uint32)
@@ -870,13 +872,30 @@ def build_result_chunks(orig_text: str, records: list, reg: Registry,
                 if len(html_offsets) else 0
         return int(byte_of_char[min(src, len(byte_of_char) - 1)])
 
-    # Raw mapped starts first: the reference's continuous offset maps make
-    # consecutive chunks contiguous (each ends where the next begins), so
-    # a chunk's end is the next chunk's mapped start.
-    raw_starts = [map_back(span, lo)
-                  for span, _, lo, *_ in records]
+    # map ends lazily: merge_mapped_records consults `end` only for the
+    # final record (consecutive chunks are contiguous)
+    mapped = [(rid,
+               map_back(span, lo),
+               map_back(span, lo + nbytes) if i == len(records) - 1
+               else 0,
+               lang1, lang2, rd, rs, is_one)
+              for i, (span, rid, lo, nbytes, lang1, lang2, rd, rs,
+                      is_one) in enumerate(records)]
+    return merge_mapped_records(raw, mapped, reg)
+
+
+def merge_mapped_records(raw: bytes, records: list, reg: Registry) -> list:
+    """Mapped chunk records -> merged ResultChunk vector. records:
+    (rid, start, end, lang1, lang2, rd, rs, is_one) with start/end in
+    ORIGINAL byte offsets; `end` is consulted only for the final record
+    (the reference's continuous offset maps make consecutive chunks
+    contiguous, so every other end IS the next record's start). The
+    word-boundary trim, reliability/close-set relabeling, same-language
+    merge, and FinishResultVector semantics live here, shared verbatim
+    between the scalar engine and the batched engine's vector path."""
+    raw_starts = [start for _, start, *_ in records]
     vec: list = []
-    for i, (span, rid, lo, nbytes, lang1, lang2, rd, rs, is_one) in \
+    for i, (rid, start, end_mapped, lang1, lang2, rd, rs, is_one) in \
             enumerate(records):
         mapped_offset = raw_starts[i]
         # Trim back to a word boundary (scoreonescriptspan.cc:419-460);
@@ -897,7 +916,7 @@ def build_result_chunks(orig_text: str, records: list, reg: Registry,
                 vec[-1].bytes -= n
                 mapped_offset -= n
         end = raw_starts[i + 1] if i + 1 < len(records) \
-            else map_back(span, lo + nbytes)
+            else end_mapped
         mapped_len = end - mapped_offset
 
         new_lang = lang1
@@ -916,8 +935,8 @@ def build_result_chunks(orig_text: str, records: list, reg: Registry,
                 new_lang = prior_lang
                 rd_bad = False
             # next chunk's lang1, within the same hitbuffer round only
-            next_lang = records[i + 1][4] if i + 1 < len(records) and \
-                records[i + 1][1] == rid else UNKNOWN_LANGUAGE
+            next_lang = records[i + 1][3] if i + 1 < len(records) and \
+                records[i + 1][0] == rid else UNKNOWN_LANGUAGE
             if rd_bad and prior_lang == lang2 and next_lang == lang2:
                 new_lang = prior_lang
                 rd_bad = False

@@ -125,26 +125,94 @@ class NgramBatchEngine:
     TINY_BATCH_C_PATH = 64
 
     def detect_batch(self, texts: list[str], hints=None,
-                     is_plain_text: bool = True) -> list:
+                     is_plain_text: bool = True,
+                     return_chunks: bool = False) -> list:
         """ScalarResult-compatible results, one per text (EpilogueResult
         views for device-scored docs, real ScalarResults for scalar-path
         docs). hints: optional hints.CLDHints applied to every document
         of the call; is_plain_text=False strips HTML host-side and scans
         lang= tags into per-document hint priors — both stay on the
-        device path."""
+        device path. return_chunks fills each result's per-byte-range
+        vector via the device path's want_ranges sidecars (exotic docs
+        — squeeze, fallback, gate retry — resolve via the scalar
+        engine, exactness over speed)."""
         if not texts:
             return []
         if self.flags & ~_DEVICE_OK_FLAGS:
             return [detect_scalar(t, self.tables, self.reg, self.flags,
                                   hints=hints,
-                                  is_plain_text=is_plain_text)
+                                  is_plain_text=is_plain_text,
+                                  want_chunks=return_chunks)
                     for t in texts]
+        if return_chunks:
+            if hints is not None or not is_plain_text:
+                # hinted/HTML chunk vectors keep the scalar engine (the
+                # composed HTML offset maps live there)
+                return [detect_scalar(t, self.tables, self.reg,
+                                      self.flags, hints=hints,
+                                      is_plain_text=is_plain_text,
+                                      want_chunks=True)
+                        for t in texts]
+            return self._detect_with_chunks(texts)
         if hints is not None or not is_plain_text:
             return self._detect_hinted(texts, hints, is_plain_text)
         if sum(len(t) for t in texts) > self.DISPATCH_CHAR_BUDGET:
             return self.detect_many(texts, batch_size=len(texts))
         cb, fut = self._dispatch(texts)
         return self._finish(texts, cb, fut)
+
+    def _detect_with_chunks(self, texts: list[str]) -> list:
+        """Batched detection WITH per-range chunk vectors: want_ranges
+        pack (per-slot/per-chunk offset sidecars) + the full-output
+        device word (lang2/rd/rs), then the host replays the scalar
+        vector path exactly — boundary sharpening over the resolved hit
+        lanes (shifting the epilogue's chunk byte weights, like the
+        reference's vector mode), the shared record merge, and scalar
+        resolution for any doc whose offsets cannot map back (squeeze /
+        fallback / gate retry). Low-volume API path: no pipelining."""
+        from .. import native
+        from ..ops.device_tables import host_tables
+        from ..ops.score import score_chunks_full, unpack_chunks_out2
+        from ..result_vector import build_doc_records, chunks_for_doc
+        out: list = []
+        for chunk in self._slices(texts, 16384):
+            cb = native.pack_chunks_native(
+                chunk, self.tables, self.reg, flags=self.flags,
+                l_doc=self.max_slots, c_doc=self.max_chunks,
+                want_ranges=True)
+            full = np.asarray(score_chunks_full(self.dt, cb.wire))
+            rows = unpack_chunks_out(full[..., 0], cb.wire["cmeta"])
+            rows2 = unpack_chunks_out2(full[..., 1])
+            cnsl2 = cb.wire["cnsl"].astype(np.int64)
+            cstart_flat = (np.cumsum(cnsl2, axis=-1) - cnsl2).reshape(-1)
+            cat_ind2 = host_tables(self.tables, self.reg).cat_ind2
+            # records first: sharpening edits rows' byte weights, which
+            # the epilogue must consume (vector-mode DocTote semantics)
+            doc_recs = [build_doc_records(b, cb, rows, rows2,
+                                          cstart_flat, cat_ind2,
+                                          self.tables, self.reg)
+                        for b in range(len(chunk))]
+            ep = native.epilogue_flat_native(rows, cb, self.flags,
+                                             self.reg)
+            n_fb = n_retry = 0
+            for b, text in enumerate(chunk):
+                if doc_recs[b] is None or ep[b, 12]:
+                    if cb.fallback[b]:
+                        n_fb += 1
+                    else:
+                        n_retry += 1
+                    out.append(detect_scalar(
+                        text, self.tables, self.reg, self.flags,
+                        want_chunks=True))
+                    continue
+                res = _result_from_row(ep[b])
+                res.chunks = chunks_for_doc(text, doc_recs[b], self.reg)
+                out.append(res)
+            with self._stats_lock:
+                self.stats["batches"] += 1
+                self.stats["fallback_docs"] += n_fb
+                self.stats["scalar_recursion_docs"] += n_retry
+        return out
 
     def _detect_hinted(self, texts: list[str], hints,
                        is_plain_text: bool) -> list:

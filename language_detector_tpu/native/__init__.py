@@ -41,7 +41,7 @@ _SYMBOLS = ("ldt_init", "ldt_pack_batch", "ldt_init_tables",
             "ldt_pack_flat_free", "ldt_epilogue_flat", "ldt_init_detect",
             "detect_language", "detect_language_n",
             "ldt_detect_one_full", "ldt_detect_batch_codes")
-_ABI_VERSION = 9  # must match packer.cc ldt_abi_version()
+_ABI_VERSION = 10  # must match packer.cc ldt_abi_version()
 
 
 def _try_load_all():
@@ -310,6 +310,12 @@ class ChunkBatch:
     n_slots: np.ndarray      # [B] i32 (0 for fallback docs)
     n_chunks: np.ndarray     # [B] i32
     n_docs: int = 0
+    # want_ranges packs only — host-side result-vector sidecars, never
+    # shipped to the device: soff/sorig [D,N] i32 per-slot span/original
+    # offsets (-1 = boost/hint slot), clo/chi [D,Gs] i32 chunk ranges in
+    # original bytes, crid [D,Gs] i32 hit-round ids (-1 = direct-add),
+    # cdir [D,Gs] u8 direct-add flags
+    ranges: dict | None = None
 
 
 def _next_pow2_min(n: int, lo: int) -> int:
@@ -394,7 +400,8 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
                        reg: Registry, flags: int = 0, n_shards: int = 1,
                        l_doc: int = 1 << 17, c_doc: int = 1 << 14,
                        max_direct: int = 64, n_threads: int = 0,
-                       hint_boosts: list | None = None) -> ChunkBatch:
+                       hint_boosts: list | None = None,
+                       want_ranges: bool = False) -> ChunkBatch:
     """texts -> chunk-major flat wire (one dispatch regardless of the
     batch's document-length mix). len(texts) must divide n_shards.
     hint_boosts: optional per-doc hints.HintBoosts (None entries fine) —
@@ -431,6 +438,7 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         ctypes.c_int32(B), ctypes.c_int32(l_doc), ctypes.c_int32(c_doc),
         ctypes.c_int32(Dc), ctypes.c_int32(flags),
         ctypes.c_int32(n_threads),
+        ctypes.c_int32(1 if want_ranges else 0),
         _ptr(hint_boost, np.int32) if hint_boost is not None
         else ctypes.c_void_p(None),
         _ptr(direct_adds, np.int32), _ptr(text_bytes, np.int32),
@@ -476,6 +484,15 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         whack_w = np.zeros((Wb, 2, 256), np.uint8)
         if whack_tbl is not None:
             whack_w[:whack_tbl.shape[0]] = whack_tbl
+        if want_ranges:
+            ranges = dict(soff=np.zeros((D, N), np.int32),
+                          sorig=np.zeros((D, N), np.int32),
+                          clo=np.zeros((D, Gs), np.int32),
+                          chi=np.zeros((D, Gs), np.int32),
+                          crid=np.zeros((D, Gs), np.int32),
+                          cdir=np.zeros((D, Gs), np.uint8))
+        else:
+            ranges = None
     except BaseException:
         # finish() is the only free-er; without this the C++-owned
         # compacted batch would leak on allocation failure / interrupt
@@ -492,14 +509,27 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         _ptr(cscript, np.uint8),
         _ptr(cwhack, np.uint16) if doc_whack is not None
         else ctypes.c_void_p(None),
-        _ptr(doc_chunk_start, np.int64))
+        _ptr(doc_chunk_start, np.int64),
+        _ptr(ranges["soff"], np.int32) if ranges is not None
+        else ctypes.c_void_p(None),
+        _ptr(ranges["sorig"], np.int32) if ranges is not None
+        else ctypes.c_void_p(None),
+        _ptr(ranges["clo"], np.int32) if ranges is not None
+        else ctypes.c_void_p(None),
+        _ptr(ranges["chi"], np.int32) if ranges is not None
+        else ctypes.c_void_p(None),
+        _ptr(ranges["crid"], np.int32) if ranges is not None
+        else ctypes.c_void_p(None),
+        _ptr(ranges["cdir"], np.uint8) if ranges is not None
+        else ctypes.c_void_p(None))
     wire = dict(idx=idx, cnsl=cnsl, cmeta=cmeta,
                 cscript=cscript, cwhack=cwhack, hint_lp=hint_lp_w,
                 whack_tbl=whack_w, k_iota=np.zeros(K, np.uint8))
     return ChunkBatch(wire=wire, doc_chunk_start=doc_chunk_start,
                       direct_adds=direct_adds, text_bytes=text_bytes,
                       fallback=fallback, squeezed=squeezed,
-                      n_slots=n_slots, n_chunks=n_chunks, n_docs=B)
+                      n_slots=n_slots, n_chunks=n_chunks, n_docs=B,
+                      ranges=ranges)
 
 
 # Reference 160KB-per-document scoring subset (packer.cc

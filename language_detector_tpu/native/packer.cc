@@ -299,6 +299,10 @@ int cheap_squeeze_inplace(uint8_t* b, int src_len) {
 struct Span {
   std::vector<uint8_t> buf;      // ' ' + lowered letters + "   \0" + pad
   std::vector<uint32_t> cps;     // decoded buf codepoints + trailing space
+  std::vector<int32_t> b2o;      // span byte -> ORIGINAL byte (result-
+                                 // vector packs only; else empty; the
+                                 // segment.py src_idx composed with the
+                                 // char->byte cumsum)
   int text_bytes;
   int ulscript;
 };
@@ -351,7 +355,7 @@ void u8decode(const uint8_t* s, int len, std::vector<uint32_t>* out) {
 }
 
 void build_span(const std::vector<uint32_t>& cur, int ulscript,
-                Span* sp) {
+                Span* sp, const std::vector<int32_t>* src = nullptr) {
   sp->ulscript = ulscript;
   const size_t n = cur.size();
   sp->cps.resize(n + 2);
@@ -387,6 +391,20 @@ void build_span(const std::vector<uint32_t>& cur, int ulscript,
   }
   p[0] = p[1] = p[2] = 0x20;
   std::memset(p + 3, 0, kTailPad - 3);
+  // span byte -> original byte (segment.py _build_span src_idx: each
+  // cp's source repeated over its encoded length, leading space
+  // inheriting the first letter's source, one trailing duplicate)
+  sp->b2o.clear();
+  if (src != nullptr) {
+    sp->b2o.reserve(nb + 1);
+    int32_t lead = n ? (*src)[0] : 0;
+    sp->b2o.push_back(lead);  // leading space (1 byte)
+    for (size_t i = 0; i < n; i++) {
+      int l = u8len_of(cur[i]);
+      for (int k = 0; k < l; k++) sp->b2o.push_back((*src)[i]);
+    }
+    sp->b2o.push_back(sp->b2o.back());
+  }
 }
 
 // Reusable per-thread segmentation scratch: all vectors keep their
@@ -394,6 +412,7 @@ void build_span(const std::vector<uint32_t>& cur, int ulscript,
 // (the malloc + first-touch cost was ~25% of single-thread pack time).
 struct SegScratch {
   std::vector<uint32_t> lower, cur;
+  std::vector<int32_t> cur_src;  // orig byte per cur entry (ranges mode)
   std::vector<uint8_t> script;
   std::vector<int8_t> u8l;
   std::vector<int64_t> byte_before;
@@ -461,6 +480,7 @@ int cheap_rep_words_inplace(uint8_t* b, int src_len, int* hash,
 
 // Rebuild a span around rewritten (shorter) text
 void respan(Span* sp, int n) {
+  sp->b2o.clear();  // offsets no longer map to the original input
   sp->text_bytes = n;
   sp->buf.resize(n + kTailPad);
   sp->buf[n] = sp->buf[n + 1] = sp->buf[n + 2] = 0x20;
@@ -475,7 +495,8 @@ void squeeze_span(Span* sp) {
   respan(sp, cheap_squeeze_inplace(sp->buf.data(), sp->text_bytes));
 }
 
-void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
+void segment_text(const uint8_t* text, int text_len, SegScratch* ss,
+                  bool collect_src = false) {
   ss->n_spans = 0;
   if (text_len == 0) return;
   // Single fused pass: decode + script/lower classification + byte
@@ -540,7 +561,9 @@ void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
     if (i >= n) break;
     const int spanscript = script[i];
     std::vector<uint32_t>& cur = ss->cur;
+    std::vector<int32_t>& cur_src = ss->cur_src;
     cur.clear();
+    cur_src.clear();
     int put = 1;
 
     while (i < n) {
@@ -555,19 +578,24 @@ void segment_text(const uint8_t* text, int text_len, SegScratch* ss) {
           if (sc2 != 0 && sc2 != spanscript) break;
         }
         cur.push_back(lower[i]);
+        if (collect_src) cur_src.push_back((int32_t)byte_before[i]);
         put += u8l[i];
         i++;
         if (put >= kMaxSpanPutBytes) break;
       }
       // non-letter run -> single space
       cur.push_back(0x20);
+      if (collect_src)
+        cur_src.push_back((int32_t)byte_before[i < n ? i : n - 1]);
       put += 1;
       while (i < n && script[i] == 0) i++;
       if (i >= n) break;
       if (script[i] != spanscript && script[i] != kUlScriptInherited) break;
       if (put >= soft_limit) break;
     }
-    if (cur.size() > 1) build_span(cur, spanscript, ss->alloc_span());
+    if (cur.size() > 1)
+      build_span(cur, spanscript, ss->alloc_span(),
+                 collect_src ? &cur_src : nullptr);
   }
 }
 
@@ -1202,6 +1230,16 @@ struct ROut {
   // per-doc hint boosts: window indices into the batch hint_lp table,
   // [2 sides][4 slots], -1 = empty; nullptr = no hints (the common case)
   const int32_t* hint_boost = nullptr;
+  // result-vector sidecars (all null unless the caller asked for chunk
+  // ranges; never read by the device — they feed the host-side
+  // ResultChunkVector builder):
+  int32_t* slot_soff = nullptr;  // [L] span-coord offset per slot
+                                 //     (-1: boost/hint slot, no offset)
+  int32_t* slot_orig = nullptr;  // [L] original-byte offset (-1 boosts)
+  int32_t* c_orig_lo = nullptr;  // [C] chunk range in original bytes
+  int32_t* c_orig_hi = nullptr;  // [C]
+  int32_t* c_rid = nullptr;      // [C] hit round id (-1 direct-add)
+  uint8_t* c_isdir = nullptr;    // [C] direct-add (JustOneItem) chunk
 };
 
 void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
@@ -1212,7 +1250,7 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   // calling-thread path.
   static thread_local SegScratch seg;
   seg.maybe_shrink();
-  segment_text(text, text_len, &seg);
+  segment_text(text, text_len, &seg, o.slot_soff != nullptr);
 
   const int L = o.L, C = o.C;
   uint16_t* idx = o.idx + (int64_t)b * L;
@@ -1227,9 +1265,12 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
   static thread_local std::vector<int32_t> c_grams, c_lo, c_span_end;
   static thread_local std::vector<int32_t> c_span;  // i32: tier-2 round
                                                     // counts pass 32767
-  static thread_local std::vector<int8_t> c_side, c_real;
+  static thread_local std::vector<int8_t> c_side, c_real, c_dir;
+  static thread_local std::vector<int32_t> c_spanix;
   c_grams.resize(C); c_lo.resize(C); c_span_end.resize(C);
   c_span.resize(C); c_side.resize(C); c_real.resize(C);
+  const bool want_ranges = o.slot_soff != nullptr;
+  if (want_ranges) { c_dir.resize(C); c_spanix.resize(C); }
   int32_t boosts[2][4];
   int bptr[2];
   int slot, chunk_base, n_direct, round_no, open_chunk;
@@ -1256,6 +1297,7 @@ void pack_resolve_one_doc(const uint8_t* text, int text_len, int b,
       c_grams[c] = 0;
       c_lo[c] = 1 << 30; c_span_end[c] = 0;
       c_side[c] = 0; c_real[c] = 0; c_span[c] = -1;
+      if (want_ranges) { c_dir[c] = 0; c_spanix[c] = 0; }
     }
   };
 
@@ -1285,6 +1327,10 @@ restart:
         if (w >= 0 && slot < L) {
           idx[slot] = (uint16_t)(kHintBase + w);
           chk[slot] = (uint16_t)c;
+          if (want_ranges) {
+            o.slot_soff[slot] = -1;
+            o.slot_orig[slot] = -1;
+          }
           slot++;
         }
       }
@@ -1293,6 +1339,10 @@ restart:
       if (boosts[side][s] && slot < L) {
         idx[slot] = (uint16_t)boosts[side][s];
         chk[slot] = (uint16_t)c;
+        if (want_ranges) {
+          o.slot_soff[slot] = -1;
+          o.slot_orig[slot] = -1;
+        }
         slot++;
       }
     }
@@ -1308,7 +1358,7 @@ restart:
                cheap_squeeze_trigger(sp.buf.data(), sp.text_bytes)) {
       // re-scan the whole document with squeezing on
       squeeze = true;
-      segment_text(text, text_len, &seg);
+      segment_text(text, text_len, &seg, want_ranges);
       goto restart;
     }
     if (o.flags & 4) {
@@ -1325,6 +1375,14 @@ restart:
       dadds[n_direct * 3 + 2] = sp.text_bytes;
       n_direct++;
       zero_chunks(chunk_base, chunk_base + 1);
+      if (want_ranges) {
+        // JustOneItem record range: [1, text_bytes) in span coords
+        // (scoreonescriptspan.cc:513-548)
+        c_dir[chunk_base] = 1;
+        c_spanix[chunk_base] = _si;
+        c_lo[chunk_base] = 1;
+        c_span_end[chunk_base] = sp.text_bytes;
+      }
       chunk_base++;
       continue;
     }
@@ -1421,6 +1479,20 @@ restart:
         }
         idx[slot] = (uint16_t)r_ia;
         chk[slot] = (uint16_t)c;
+        if (want_ranges) {
+          int32_t orig = -1;
+          if (!sp.b2o.empty()) {
+            size_t k = r_offset < 0 ? 0 : (size_t)r_offset;
+            if (k >= sp.b2o.size()) k = sp.b2o.size() - 1;
+            orig = sp.b2o[k];
+          }
+          o.slot_soff[slot] = r_offset;
+          o.slot_orig[slot] = orig;
+          if (r_b) {
+            o.slot_soff[slot + 1] = r_offset;
+            o.slot_orig[slot + 1] = orig;
+          }
+        }
         slot++;
         if (r_b) {
           idx[slot] = (uint16_t)(r_ia + 1);
@@ -1435,6 +1507,7 @@ restart:
         c_span[c] = round_no;
         c_span_end[c] = (int32_t)round_end;
         cscript[c] = (uint8_t)sp.ulscript;
+        if (want_ranges) c_spanix[c] = _si;
         // rotating distinct boost (device scan: update AFTER scoring the
         // slot, state read by the chunk containing the slot)
         if (r_kind == DISTINCT_OCTA || r_kind == BI_DISTINCT) {
@@ -1449,6 +1522,7 @@ restart:
           c_span_end[c] = (int32_t)round_end;
           c_side[c] = (int8_t)side;
           cscript[c] = (uint8_t)sp.ulscript;
+          if (want_ranges) c_spanix[c] = _si;
         }
       }
       chunk_base += round_chunks;
@@ -1476,6 +1550,37 @@ restart:
     cmeta[c] = (uint32_t)cbytes | ((uint32_t)grams << 16) |
                ((uint32_t)(c_side[c] & 1) << 28) | (1u << 29);
   }
+  // result-vector sidecar: per-chunk ranges mapped to ORIGINAL bytes
+  // (spans hold their byte->orig maps until the next segment_text)
+  if (want_ranges && o.c_orig_lo != nullptr) {
+    for (int c = 0; c < chunk_base && c < C; c++) {
+      const Span& sps = seg.spans[c_spanix[c]];
+      auto mp = [&](int off) -> int32_t {
+        if (sps.b2o.empty()) return -1;  // squeezed/respun: unmappable
+        size_t k = off < 0 ? 0 : (size_t)off;
+        if (k >= sps.b2o.size()) k = sps.b2o.size() - 1;
+        return sps.b2o[k];
+      };
+      int lo, hi;
+      if (c_dir[c]) {
+        lo = c_lo[c];
+        hi = c_span_end[c];
+      } else if (c_real[c]) {
+        lo = c_lo[c];
+        hi = c_span_end[c];
+        if (c + 1 < chunk_base && c_real[c + 1] &&
+            c_span[c + 1] == c_span[c])
+          hi = c_lo[c + 1];
+      } else {
+        lo = hi = c_span_end[c];  // runt: zero-length at the round end
+      }
+      o.c_orig_lo[c] = mp(lo);
+      o.c_orig_hi[c] = mp(hi);
+      o.c_rid[c] = c_dir[c] ? -1 : c_span[c];
+      o.c_isdir[c] = c_dir[c];
+    }
+  }
+
   // Tails are NOT cleared: every consumer respects the n_slots/n_chunks
   // bounds (the flat compaction copies exactly [0, n_chunks) rows).
   // direct_adds pads with -1 sentinels (the epilogue's stop condition).
@@ -1521,6 +1626,10 @@ struct FlatThreadBuf {
   std::vector<uint16_t> cnsl;    // per-chunk slot count
   std::vector<uint32_t> cmeta;   // per-chunk meta (ROut layout)
   std::vector<uint8_t> cscript;  // per-chunk ULScript
+  // result-vector sidecars (filled only in want_ranges packs)
+  std::vector<int32_t> soff, sorig;          // per slot
+  std::vector<int32_t> clo, chi, crid;       // per chunk
+  std::vector<uint8_t> cdir;                 // per chunk
 };
 
 struct FlatPackState {
@@ -1667,7 +1776,7 @@ extern "C" {
 // Bumped on ANY change to the exported function signatures or wire
 // layouts; the Python loader refuses (and rebuilds) on mismatch so a
 // stale .so can never silently corrupt results across an ABI change.
-int32_t ldt_abi_version() { return 9; }
+int32_t ldt_abi_version() { return 10; }
 
 // Phase 1: pack + compact. Per-doc outputs (direct_adds [B, D_cap, 3],
 // text_bytes/fallback/squeezed/n_slots/n_chunks [B]) land in caller
@@ -1680,7 +1789,7 @@ int32_t ldt_abi_version() { return 9; }
 int64_t ldt_pack_flat_begin(
     const uint8_t* texts, const int64_t* bounds, int32_t n_docs,
     int32_t L_doc, int32_t C_doc, int32_t D_cap, int32_t flags,
-    int32_t n_threads,
+    int32_t n_threads, int32_t want_ranges,
     const int32_t* hint_boost,  // [B, 2, 4] hint-window indices, or null
     int32_t* direct_adds, int32_t* text_bytes, uint8_t* fallback,
     uint8_t* squeezed, int32_t* n_slots, int32_t* n_chunks,
@@ -1715,10 +1824,21 @@ int64_t ldt_pack_flat_begin(
     static thread_local std::vector<uint32_t> scmeta;
     static thread_local std::vector<uint8_t> scscript;
     static thread_local std::vector<int32_t> counts;
+    static thread_local std::vector<int32_t> ssoff, ssorig, sclo, schi,
+        scrid;
+    static thread_local std::vector<uint8_t> scdir;
     sidx.resize(L_doc);
     schk.resize(L_doc);
     scmeta.resize(C_doc);
     scscript.resize(C_doc);
+    if (want_ranges) {
+      ssoff.resize(L_doc);
+      ssorig.resize(L_doc);
+      sclo.resize(C_doc);
+      schi.resize(C_doc);
+      scrid.resize(C_doc);
+      scdir.resize(C_doc);
+    }
     for (int b = lo; b < hi; b++) {
       // per-doc views: scratch for slot/chunk lanes (b=0 addressing),
       // caller rows for everything per-doc
@@ -1727,6 +1847,14 @@ int64_t ldt_pack_flat_begin(
              fallback + b, squeezed + b, n_slots + b, n_chunks + b,
              L_doc, C_doc, D_cap, flags,
              hint_boost ? hint_boost + (int64_t)b * 8 : nullptr};
+      if (want_ranges) {
+        o.slot_soff = ssoff.data();
+        o.slot_orig = ssorig.data();
+        o.c_orig_lo = sclo.data();
+        o.c_orig_hi = schi.data();
+        o.c_rid = scrid.data();
+        o.c_isdir = scdir.data();
+      }
       pack_resolve_one_doc(texts + bounds[b],
                            (int)(bounds[b + 1] - bounds[b]), 0, o);
       st->doc_buf[b] = t;
@@ -1748,6 +1876,18 @@ int64_t ldt_pack_flat_begin(
                           scmeta.begin() + nc);
           tb.cscript.insert(tb.cscript.end(), scscript.begin(),
                             scscript.begin() + nc);
+          if (want_ranges) {
+            tb.soff.insert(tb.soff.end(), ssoff.begin(),
+                           ssoff.begin() + ns);
+            tb.sorig.insert(tb.sorig.end(), ssorig.begin(),
+                            ssorig.begin() + ns);
+            tb.clo.insert(tb.clo.end(), sclo.begin(), sclo.begin() + nc);
+            tb.chi.insert(tb.chi.end(), schi.begin(), schi.begin() + nc);
+            tb.crid.insert(tb.crid.end(), scrid.begin(),
+                           scrid.begin() + nc);
+            tb.cdir.insert(tb.cdir.end(), scdir.begin(),
+                           scdir.begin() + nc);
+          }
         }
       }
       if (fallback[b]) {
@@ -1937,12 +2077,18 @@ void ldt_pack_flat_finish(
     const int32_t* doc_whack_row,  // [B] whack-table rows, or null
     uint16_t* idx_flat, uint8_t* cnsl_flat,
     uint32_t* cmeta_flat, uint8_t* cscript_flat, uint16_t* cwhack_flat,
-    int64_t* doc_chunk_start) {
+    int64_t* doc_chunk_start,
+    // result-vector sidecars, [D,N] / [D,Gs] like the wire lanes; all
+    // null unless the pack ran with want_ranges (host-only — never
+    // shipped to the device)
+    int32_t* soff_flat, int32_t* sorig_flat, int32_t* clo_flat,
+    int32_t* chi_flat, int32_t* crid_flat, uint8_t* cdir_flat) {
   // No chunk-start lane on the wire: slots concatenate in chunk order,
   // so the device derives starts as an exclusive cumsum of cnsl.
   // cwhack_flat may be null (hint-free batches carry a 1-wide dummy).
   FlatPackState* st = (FlatPackState*)(intptr_t)handle;
   int Bd = B / D;
+  const bool ranges = soff_flat != nullptr;
   for (int d = 0; d < D; d++) {
     int64_t spos = 0, gpos = 0;
     for (int i = 0; i < Bd; i++) {
@@ -1952,6 +2098,14 @@ void ldt_pack_flat_finish(
       std::memcpy(idx_flat + (int64_t)d * N + spos,
                   tb.idx.data() + st->doc_slot_off[b],
                   (size_t)ns * sizeof(uint16_t));
+      if (ranges && !tb.soff.empty()) {
+        std::memcpy(soff_flat + (int64_t)d * N + spos,
+                    tb.soff.data() + st->doc_slot_off[b],
+                    (size_t)ns * sizeof(int32_t));
+        std::memcpy(sorig_flat + (int64_t)d * N + spos,
+                    tb.sorig.data() + st->doc_slot_off[b],
+                    (size_t)ns * sizeof(int32_t));
+      }
       doc_chunk_start[b] = (int64_t)d * Gs + gpos;
       int64_t src = st->doc_chunk_off[b];
       int64_t dst = (int64_t)d * Gs + gpos;
@@ -1961,6 +2115,12 @@ void ldt_pack_flat_finish(
         cmeta_flat[dst + c] = tb.cmeta[src + c];
         cscript_flat[dst + c] = tb.cscript[src + c];
         if (cwhack_flat) cwhack_flat[dst + c] = wrow;
+        if (ranges && !tb.clo.empty()) {
+          clo_flat[dst + c] = tb.clo[src + c];
+          chi_flat[dst + c] = tb.chi[src + c];
+          crid_flat[dst + c] = tb.crid[src + c];
+          cdir_flat[dst + c] = tb.cdir[src + c];
+        }
       }
       spos += ns;
       gpos += nc;
@@ -1971,6 +2131,11 @@ void ldt_pack_flat_finish(
       cmeta_flat[dst] = 0;
       cscript_flat[dst] = 0;
       if (cwhack_flat) cwhack_flat[dst] = 0;
+      if (ranges) {
+        clo_flat[dst] = chi_flat[dst] = -1;
+        crid_flat[dst] = -1;
+        cdir_flat[dst] = 0;
+      }
     }
   }
   delete st;

@@ -82,10 +82,14 @@ CM2_REAL_SHIFT = 29
 OUTW_S1_SHIFT = 10
 OUTW_REL_SHIFT = 24
 OUTW_REAL_SHIFT = 31
+# second output word (result-vector batches only):
+#   lang2(10) | rd(7) << 10 | rs(7) << 17
+OUTW2_RD_SHIFT = 10
+OUTW2_RS_SHIFT = 17
 
 
 def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
-                    group_scores=None):
+                    group_scores=None, full_out=False):
     """[..., 256] chunk totes + chunk meta -> packed u32 chunk summary:
     group-in-use top-2 (tote.cc:30-100), reliability (cldutil.cc:553-605),
     output word OUTW_* layout. Leading dims are free.
@@ -132,10 +136,23 @@ def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
     # s1 clips at 16383 — chunk totes are bounded far below (<= ~110
     # entries x qprob 12 + 4x12 boosts); the batch-agreement suite pins
     # exactness against the scalar engine.
-    return (lang1.astype(jnp.uint32) |
-            (jnp.clip(s1, 0, 0x3FFF).astype(jnp.uint32) << OUTW_S1_SHIFT) |
-            (jnp.clip(crel, 0, 127).astype(jnp.uint32) << OUTW_REL_SHIFT) |
-            (real.astype(jnp.uint32) << OUTW_REAL_SHIFT))
+    word1 = (lang1.astype(jnp.uint32) |
+             (jnp.clip(s1, 0, 0x3FFF).astype(jnp.uint32)
+              << OUTW_S1_SHIFT) |
+             (jnp.clip(crel, 0, 127).astype(jnp.uint32)
+              << OUTW_REL_SHIFT) |
+             (real.astype(jnp.uint32) << OUTW_REAL_SHIFT))
+    if not full_out:
+        return word1
+    # result-vector batches read lang2 / rd / rs separately: the chunk
+    # relabeling pass (SummaryBufferToVector, scoreonescriptspan.cc:
+    # 462-505) consults each, not just min(rd, rs)
+    word2 = (lang2.astype(jnp.uint32) |
+             (jnp.clip(rd, 0, 127).astype(jnp.uint32)
+              << OUTW2_RD_SHIFT) |
+             (jnp.clip(rs, 0, 127).astype(jnp.uint32)
+              << OUTW2_RS_SHIFT))
+    return jnp.stack([word1, word2], axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -151,7 +168,7 @@ def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
 # ---------------------------------------------------------------------------
 
 
-def score_chunks_impl(dt: DeviceTables, p: dict):
+def score_chunks_impl(dt: DeviceTables, p: dict, full_out: bool = False):
     """Score a chunk-major flat wire into packed chunk outputs [G] u32.
 
     p (built by native.pack_chunks_native):
@@ -229,10 +246,23 @@ def score_chunks_impl(dt: DeviceTables, p: dict):
                                side]
         whacked = jnp.where(wmask > 0, 0, scores)
     return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
-                           script, group_scores=scores)
+                           script, group_scores=scores,
+                           full_out=full_out)
 
 
 score_chunks = jax.jit(score_chunks_impl)
+# result-vector variant: [G, 2] u32 (word1 as above + lang2/rd/rs word)
+score_chunks_full = jax.jit(
+    lambda dt, p: score_chunks_impl(dt, p, full_out=True))
+
+
+def unpack_chunks_out2(out2: np.ndarray) -> np.ndarray:
+    """Second output word [G] u32 -> [G, 3] int32 (lang2, rd, rs)."""
+    out2 = np.asarray(out2).reshape(-1)
+    lang2 = (out2 & 0x3FF).astype(np.int32)
+    rd = ((out2 >> OUTW2_RD_SHIFT) & 0x7F).astype(np.int32)
+    rs = ((out2 >> OUTW2_RS_SHIFT) & 0x7F).astype(np.int32)
+    return np.stack([lang2, rd, rs], axis=-1)
 
 
 def unpack_chunks_out(out: np.ndarray, cmeta: np.ndarray) -> np.ndarray:

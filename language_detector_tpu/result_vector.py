@@ -1,0 +1,178 @@
+"""Per-range ResultChunkVector from the batched (device) path.
+
+The reference exposes per-byte-range languages on its main Ext entry
+points (compact_lang_det.h:147-154, :380) by post-processing scored
+chunks on the host (SummaryBufferToVector scoreonescriptspan.cc:389-509,
+SharpenBoundaries :780-845, FinishResultVector impl.cc:1688-1704). The
+batched engine does the same: the packer's want_ranges sidecars carry
+per-slot span/original offsets and per-chunk original byte ranges, the
+device's full-output word adds lang2/rd/rs per chunk, and this module
+replays the EXACT scalar-path algorithms — boundary sharpening over the
+resolved hit lanes, then the shared merge_mapped_records — so the
+batched vector agrees with the scalar engine (itself oracle-pinned,
+tests/test_result_vector.py) document for document.
+
+Sharpening runs only on the vector path, exactly like the reference,
+and shifts chunk byte counts BEFORE the document epilogue consumes them
+— build_doc_records therefore also edits the epilogue rows in place.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .engine_scalar import (UNKNOWN_LANGUAGE, _better_boundary,
+                            _same_close_set, merge_mapped_records)
+from .registry import Registry
+from .tables import ScoringTables
+
+
+def _sharpen_round(reg: Registry, lg: np.ndarray, ulscript: int,
+                   offs: list, origs: list, lps: list,
+                   chunk_starts: list, langs: list,
+                   starts_out: list, deltas_out: list) -> None:
+    """SharpenBoundaries (scoreonescriptspan.cc:780-845) over one hit
+    round's filtered linear lanes; identical control flow to the scalar
+    engine's _sharpen_boundaries. starts_out[i] (original-byte chunk
+    starts) and deltas_out[i] (span-coord byte shifts) update in place;
+    chunk_starts updates so later boundaries see earlier moves."""
+    n = len(langs)
+    if n < 2:
+        return
+    lps = np.asarray(lps)
+    prior_linear = chunk_starts[0]
+    prior_lang = langs[0]
+    for i in range(1, n):
+        this_lang = langs[i]
+        if this_lang == prior_lang:
+            prior_linear = chunk_starts[i]
+            continue
+        this_linear = chunk_starts[i]
+        next_linear = chunk_starts[i + 1]
+        if _same_close_set(reg, prior_lang, this_lang):
+            prior_linear = this_linear
+            prior_lang = this_lang
+            continue
+        pslang0 = reg.per_script_number(ulscript, prior_lang)
+        pslang1 = reg.per_script_number(ulscript, this_lang)
+        better = _better_boundary(lps, lg, pslang0, pslang1,
+                                  prior_linear, this_linear, next_linear)
+        old_offset = offs[this_linear]
+        new_offset = offs[better]
+        chunk_starts[i] = better
+        starts_out[i] = origs[better]
+        deltas_out[i] -= new_offset - old_offset
+        deltas_out[i - 1] += new_offset - old_offset
+        prior_linear = better
+        prior_lang = this_lang
+
+
+def build_doc_records(b: int, cb, rows: np.ndarray, rows2: np.ndarray,
+                     cstart_flat: np.ndarray, cat_ind2: np.ndarray,
+                     tables: ScoringTables, reg: Registry):
+    """One packed document -> mapped chunk records for
+    merge_mapped_records, or None when the doc's offsets cannot map back
+    (squeeze/repeat rewrites — the caller resolves such docs via the
+    scalar engine). Also applies the sharpened byte shifts to rows[:, 1]
+    (the epilogue's chunk byte weights), mirroring the scalar vector
+    path where sharpening precedes the DocTote adds."""
+    if cb.fallback[b] or cb.squeezed[b]:
+        return None
+    r = cb.ranges
+    g0 = int(cb.doc_chunk_start[b])
+    nc = int(cb.n_chunks[b])
+    idx = cb.wire["idx"].reshape(-1)
+    cnsl = cb.wire["cnsl"].reshape(-1)
+    cscript = cb.wire["cscript"].reshape(-1)
+    soff = r["soff"].reshape(-1)
+    sorig = r["sorig"].reshape(-1)
+    clo = r["clo"].reshape(-1)
+    chi = r["chi"].reshape(-1)
+    crid = r["crid"].reshape(-1)
+    cdir = r["cdir"].reshape(-1)
+    lg = tables.lg_prob
+
+    # direct-add chunk -> language (doc-local chunk position, packer
+    # dadds rows [chunk_pos, lang, bytes], -1-terminated)
+    dir_lang = {}
+    for pos, lang, _ in cb.direct_adds[b]:
+        if pos < 0:
+            break
+        dir_lang[int(pos)] = int(lang)
+
+    chunks = range(g0, g0 + nc)
+    if any(clo[c] < 0 for c in chunks):
+        return None  # unmappable range (rewritten span)
+
+    # per-chunk working state
+    langs1 = [int(rows[c, 0]) for c in chunks]
+    starts = [int(clo[c]) for c in chunks]
+    deltas = [0] * nc
+
+    # sharpen per hit round (consecutive same-rid non-direct chunks)
+    i = 0
+    while i < nc:
+        if cdir[g0 + i]:
+            i += 1
+            continue
+        j = i
+        while j < nc and not cdir[g0 + j] and \
+                crid[g0 + j] == crid[g0 + i]:
+            j += 1
+        if j - i >= 2:
+            offs: list = []
+            origs: list = []
+            lps: list = []
+            chunk_starts: list = []
+            for k in range(i, j):
+                c = g0 + k
+                chunk_starts.append(len(offs))
+                s0 = int(cstart_flat[c])
+                for s in range(s0, s0 + int(cnsl[c])):
+                    if soff[s] < 0:
+                        continue  # boost/hint slot: not a linear hit
+                    offs.append(int(soff[s]))
+                    origs.append(int(sorig[s]))
+                    lps.append(int(cat_ind2[int(idx[s])]))
+            chunk_starts.append(len(offs))
+            sub_starts = starts[i:j]
+            sub_deltas = deltas[i:j]
+            _sharpen_round(reg, lg, int(cscript[g0 + i]), offs, origs,
+                           lps, chunk_starts, langs1[i:j], sub_starts,
+                           sub_deltas)
+            starts[i:j] = sub_starts
+            deltas[i:j] = sub_deltas
+        i = j
+
+    # apply byte shifts to the epilogue rows (vector-path DocTote
+    # weights use the SHARPENED chunk bytes, impl.cc:1099-1111)
+    for k in range(nc):
+        if deltas[k]:
+            rows[g0 + k, 1] += deltas[k]
+
+    # records in scalar round-id order: hit rounds and JustOneItem spans
+    # consume ids from one sequence (scalar ctx.round_id)
+    recs: list = []
+    rid_seq = -1
+    prev_crid = None
+    for k in range(nc):
+        c = g0 + k
+        if cdir[c]:
+            rid_seq += 1
+            prev_crid = None
+            recs.append((rid_seq, int(clo[c]), int(chi[c]),
+                         dir_lang.get(k, UNKNOWN_LANGUAGE),
+                         UNKNOWN_LANGUAGE, 100, 100, True))
+            continue
+        if prev_crid is None or crid[c] != prev_crid:
+            rid_seq += 1
+            prev_crid = crid[c]
+        recs.append((rid_seq, starts[k], int(chi[c]), langs1[k],
+                     int(rows2[c, 0]), int(rows2[c, 1]),
+                     int(rows2[c, 2]), False))
+    return recs
+
+
+def chunks_for_doc(text: str, records: list, reg: Registry):
+    """Mapped records -> ResultChunk vector over the original bytes."""
+    raw = text.encode("utf-8", "surrogatepass")
+    return merge_mapped_records(raw, records, reg)

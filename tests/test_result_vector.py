@@ -114,3 +114,36 @@ def test_detector_api_chunks(base_tables):
     assert "ja" in codes and "el" in codes
     # default path leaves chunks unset
     assert det.detect("hello world").chunks is None
+
+
+def test_device_path_chunks_match_scalar(base_tables):
+    """The batched engine's result-chunk vector (want_ranges sidecars +
+    full-output device word + host sharpening/merge, result_vector.py)
+    must agree with the scalar engine — which this file pins against the
+    oracle — on EVERY document: the plain TEXTS corpus, a golden-suite
+    sample, and squeeze/degenerate constructions (those resolve via the
+    scalar engine inside the batched call, so equality is the contract
+    either way). Summary fields must match too: sharpening shifts chunk
+    byte weights before the epilogue, exactly like the scalar vector
+    path."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from golden_data import golden_pairs
+    from language_detector_tpu import native
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    if not native.available():
+        pytest.skip("native library unavailable")
+    texts = [t for is_plain, t in TEXTS if is_plain]
+    texts += [raw.decode("utf-8", errors="replace")
+              for _, _, raw in golden_pairs()][::8]
+    texts += ["buy cheap now " * 400, "word " * 600]
+    eng = NgramBatchEngine(tables=base_tables)
+    got = eng.detect_batch(texts, return_chunks=True)
+    for t, g in zip(texts, got):
+        w = detect_scalar(t, base_tables, want_chunks=True)
+        gch = [(c.offset, c.bytes, c.lang1) for c in (g.chunks or [])]
+        wch = [(c.offset, c.bytes, c.lang1) for c in (w.chunks or [])]
+        assert gch == wch, (t[:60], gch[:6], wch[:6])
+        assert g.summary_lang == w.summary_lang, t[:60]
+        assert list(g.percent3) == list(w.percent3), t[:60]
