@@ -86,42 +86,67 @@ def load_artifact(path: str | Path) -> dict:
     buffer reference)."""
     with open(path, "rb") as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-    if len(mm) < _HDR.size:
-        raise ValueError(f"{path}: not an LDTA artifact (truncated)")
-    magic, version, n, _, header_bytes, total = _HDR.unpack_from(mm, 0)
-    if magic != MAGIC:
-        raise ValueError(f"{path}: bad magic {magic:#x}")
-    if version != VERSION:
-        raise ValueError(f"{path}: format version {version}, "
-                         f"expected {VERSION}")
-    if total != len(mm):
-        raise ValueError(f"{path}: size {len(mm)} != recorded {total} "
-                         "(truncated or corrupt)")
-    # a corrupted n_arrays/header_bytes must fail the ValueError
-    # contract, not crash struct.unpack past the mapping
-    if header_bytes != _HDR.size + n * _DESC.size or \
-            header_bytes > total:
-        raise ValueError(f"{path}: header_bytes {header_bytes} "
-                         f"inconsistent with {n} descriptors (corrupt)")
-    out: dict = {}
-    buf = memoryview(mm)
-    for i in range(n):
-        name_b, dt_b, ndim, s0, s1, s2, s3, off, nb = _DESC.unpack_from(
-            mm, _HDR.size + i * _DESC.size)
-        name = name_b.rstrip(b"\0").decode()
+    try:
+        if len(mm) < _HDR.size:
+            raise ValueError(f"{path}: not an LDTA artifact (truncated)")
+        magic, version, n, _, header_bytes, total = _HDR.unpack_from(mm, 0)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: bad magic {magic:#x}")
+        if version != VERSION:
+            raise ValueError(f"{path}: format version {version}, "
+                             f"expected {VERSION}")
+        if total != len(mm):
+            raise ValueError(f"{path}: size {len(mm)} != recorded {total} "
+                             "(truncated or corrupt)")
+        # a corrupted n_arrays/header_bytes must fail the ValueError
+        # contract, not crash struct.unpack past the mapping
+        if header_bytes != _HDR.size + n * _DESC.size or \
+                header_bytes > total:
+            raise ValueError(f"{path}: header_bytes {header_bytes} "
+                             f"inconsistent with {n} descriptors (corrupt)")
+        data_start = -(-header_bytes // ALIGN) * ALIGN
+        out: dict = {}
+        buf = memoryview(mm)
+        for i in range(n):
+            name_b, dt_b, ndim, s0, s1, s2, s3, off, nb = \
+                _DESC.unpack_from(mm, _HDR.size + i * _DESC.size)
+            name = name_b.rstrip(b"\0").decode()
+            try:
+                dtype = np.dtype(dt_b.rstrip(b"\0").decode())
+            except TypeError as e:
+                raise ValueError(f"{path}: {name} bad dtype ({e})") \
+                    from None
+            shape = (s0, s1, s2, s3)[:ndim]
+            # offsets must land in the data region: a corrupt descriptor
+            # must not alias array views over the header/descriptor table
+            if ndim > 4 or off < data_start or off + nb > total:
+                raise ValueError(f"{path}: {name} descriptor out of "
+                                 "bounds")
+            count = 1
+            for s in shape:
+                count *= s
+            if nb != count * dtype.itemsize:
+                raise ValueError(f"{path}: {name} nbytes {nb} != shape "
+                                 f"{shape} x itemsize {dtype.itemsize}")
+            a = np.frombuffer(buf[off:off + nb], dtype=dtype)
+            out[name] = a.reshape(shape)
+    except BaseException:
+        # no view escaped: close the mapping instead of leaking it (a
+        # successful return keeps mm alive via the views' buffer refs).
+        # Partially-built views and the memoryview must drop first or
+        # their live buffer exports would block the close.
+        try:  # a: loop-local view of the last successfully parsed
+            # array before the corrupt descriptor
+            del a
+        except NameError:
+            pass
         try:
-            dtype = np.dtype(dt_b.rstrip(b"\0").decode())
-        except TypeError as e:
-            raise ValueError(f"{path}: {name} bad dtype ({e})") from None
-        shape = (s0, s1, s2, s3)[:ndim]
-        if ndim > 4 or off + nb > total:
-            raise ValueError(f"{path}: {name} descriptor out of bounds")
-        count = 1
-        for s in shape:
-            count *= s
-        if nb != count * dtype.itemsize:
-            raise ValueError(f"{path}: {name} nbytes {nb} != shape "
-                             f"{shape} x itemsize {dtype.itemsize}")
-        a = np.frombuffer(buf[off:off + nb], dtype=dtype)
-        out[name] = a.reshape(shape)
+            del out, buf
+        except NameError:
+            pass
+        try:
+            mm.close()
+        except BufferError:  # an export still alive: GC reclaims later
+            pass
+        raise
     return out

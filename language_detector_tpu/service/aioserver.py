@@ -178,7 +178,22 @@ class AioService:
                             if not chunk:
                                 break
                             left -= len(chunk)
-                    resp = await self._route(method, path, headers, body)
+                    try:
+                        resp = await self._route(method, path, headers,
+                                                 body)
+                    except (asyncio.IncompleteReadError, ConnectionError,
+                            TimeoutError):
+                        raise
+                    except Exception:  # noqa: BLE001 - keep-alive: any
+                        # engine/handler error answers a 500 instead of
+                        # dropping the connection mid-stream
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "request handler error (answering 500)")
+                        self.svc.metrics.inc(
+                            "augmentation_errors_logged_total")
+                        resp = _http_response(
+                            500, b'{"error":"internal error"}')
                     writer.write(resp)
                     await writer.drain()
                 except (asyncio.IncompleteReadError, ConnectionError,

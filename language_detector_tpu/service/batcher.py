@@ -50,10 +50,15 @@ class Batcher:
         return fut
 
     def close(self):
+        """Bounded shutdown: a wedged flush (device hang) must not pin
+        close() forever — the collector re-checks _stop while waiting
+        for a flush slot, the join is time-limited, and the pool
+        shutdown cancels queued (not yet running) flushes rather than
+        waiting behind them."""
         self._stop.set()
         self._q.put(None)  # wake the collector
         self._thread.join(timeout=5)
-        self._pool.shutdown(wait=True)
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     # -- collector -----------------------------------------------------------
 
@@ -79,14 +84,33 @@ class Batcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
-            # block for a flush slot, then submit. close() keeps the
-            # pool alive until in-flight flushes finish (shutdown
-            # wait=True after joining this thread), so a batch in hand
-            # at shutdown still gets served; only a pool that is truly
-            # gone fails the waiters instead of killing the collector.
-            self._slots.acquire()
+            # wait for a flush slot, re-checking _stop so a wedged
+            # device (every slot held by a stuck flush) cannot pin the
+            # collector — and with it close()'s join — forever. A
+            # HEALTHY close still serves the batch in hand: the grace
+            # window comfortably covers normal ~95ms flushes and stays
+            # inside close()'s 5s join budget.
+            import time as _time
+            grace_until = None
+            while not self._slots.acquire(timeout=0.5):
+                if self._stop.is_set():
+                    now = _time.monotonic()
+                    if grace_until is None:
+                        grace_until = now + 3.0
+                    elif now >= grace_until:
+                        self._fail(pending,
+                                   RuntimeError(
+                                       "batcher closed while waiting "
+                                       "for a flush slot"))
+                        return
             try:
-                self._pool.submit(self._flush, pending)
+                f = self._pool.submit(self._flush, pending)
+                # a close() that cancels queued flushes must fail their
+                # waiters, not leave them to their submit timeouts
+                f.add_done_callback(
+                    lambda ftr, p=pending: self._fail(
+                        p, RuntimeError("batcher closed"))
+                    if ftr.cancelled() else None)
             except RuntimeError as e:  # pool shut down first
                 self._slots.release()
                 self._fail(pending, e)

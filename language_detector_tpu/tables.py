@@ -185,12 +185,35 @@ def load_tables(path: Path = _DATA) -> ScoringTables:
     """Default table loading: the single-file mmap artifact
     (data/model.ldta, zero-copy) when present next to the npz bundle,
     else the npz pair. tools/artifact_tool.py --pack builds the
-    artifact; both sources are bit-identical (test_artifact_mmap)."""
+    artifact; both sources are bit-identical (test_artifact_mmap).
+
+    The chosen source is logged once, and a stale artifact (npz bundle
+    newer than the packed file — retrained tables without re-running
+    artifact_tool --pack) logs a warning at load time rather than
+    waiting for ci.sh --verify to notice the drift."""
+    import logging
     key = str(path)
     if key not in _tables_cache:
+        log = logging.getLogger(__name__)
         ldta = Path(path).parent / "model.ldta"
         if str(path) == str(_DATA) and ldta.exists():
+            npz_mtime = 0.0
+            for src in (Path(path),
+                        Path(path).parent / "quad_tables.npz"):
+                try:
+                    npz_mtime = max(npz_mtime, src.stat().st_mtime)
+                except OSError:
+                    pass  # optional bundle absent (quadgram disabled)
+            if npz_mtime > ldta.stat().st_mtime:
+                log.warning(
+                    "serving tables from %s but the npz bundle is newer "
+                    "— retrained tables without artifact_tool --pack? "
+                    "(run tools/artifact_tool.py --pack, or ci.sh "
+                    "--verify to check content drift)", ldta)
+            else:
+                log.info("loading tables from %s (mmap artifact)", ldta)
             _tables_cache[key] = ScoringTables.load_mmap(ldta)
         else:
+            log.info("loading tables from %s (npz bundle)", path)
             _tables_cache[key] = ScoringTables.load(path)
     return _tables_cache[key]

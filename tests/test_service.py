@@ -290,3 +290,32 @@ def test_aio_server_contract():
         loop = loop_holder.get("loop")
         if loop is not None:
             loop.call_soon_threadsafe(loop.stop)
+
+
+def test_strip_extras_fast_slow_path_agreement():
+    """strip_extras' fast path returns the ORIGINAL text while the slow
+    path collapses whitespace and leaves a trailing space — different
+    byte streams. The invariant the fast path relies on: segmentation
+    maps every non-letter run to a single space, so detection output is
+    identical either way. Pinned here over whitespace-heavy inputs so a
+    future byte-sensitive consumer can't silently break it."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.registry import registry
+    from language_detector_tpu.service.server import strip_extras
+    from language_detector_tpu.tables import load_tables
+    tables = load_tables()
+    texts = [
+        "Le  gouvernement\t\ta annoncé\n\nde   nouvelles mesures",
+        "  leading and   trailing   whitespace   ",
+        "日本語の　テキスト　です。",  # ideographic spaces
+        "word\r\nword\r\nword des mots encore des mots",
+        "tabs\tbetween\tevery\tsingle\tword ici aussi",
+    ]
+    for t in texts:
+        fast = strip_extras(t)
+        assert fast == t  # no @/http: scan-only fast path
+        slow = "".join(w + " " for w in t.split())
+        rf = detect_scalar(fast, tables, registry, 0)
+        rs = detect_scalar(slow, tables, registry, 0)
+        assert registry.code(rf.summary_lang) == \
+            registry.code(rs.summary_lang), t
