@@ -1,0 +1,41 @@
+# language_detector_tpu serving container — the reference's deployment
+# surface (/root/reference/Dockerfile: build in-image, expose 3000 +
+# 30000, run the service) rebuilt for this framework.
+#
+# The CMD runs the worker under the in-repo supervisor, which restarts
+# it on planned self-recycles (LDT_MAX_DISPATCHES / LDT_MAX_RSS_MB —
+# the tunneled TPU backend's plugin leaks host RSS per dispatch,
+# docs/PERF.md; real TPU hosts can leave the bounds unset). Pair with
+# `--restart on-failure` so crashes restart too, like the reference.
+#
+# Build:  docker build -t language-detector-tpu .
+# Run:    docker run -p 3000:3000 -p 30000:30000 \
+#             -e LDT_MAX_DISPATCHES=20000 --restart on-failure \
+#             language-detector-tpu
+#
+# Base: a jax-capable python image. On TPU VMs use a base with the TPU
+# jaxlib preinstalled (e.g. the Cloud TPU pytorch/jax images) — the
+# requirements below install CPU jax as the fallback compute path.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY language_detector_tpu ./language_detector_tpu
+COPY bench.py ./
+
+# jax pinned loosely: the engine needs any recent CPU jax; TPU images
+# bring their own. The native packer builds on first import (build.sh,
+# -march=native on the RUNTIME host's ISA), so no compile step here
+# beyond having g++ available.
+RUN pip install --no-cache-dir "jax>=0.4" numpy && \
+    pip install --no-cache-dir --no-deps .
+
+EXPOSE 3000
+EXPOSE 30000
+
+ENV LISTEN_PORT=3000 PROMETHEUS_PORT=30000
+
+CMD ["python", "-m", "language_detector_tpu.service.supervisor"]

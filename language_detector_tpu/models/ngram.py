@@ -93,7 +93,11 @@ class NgramBatchEngine:
         # scored, packer-fallback docs, and docs that failed the
         # good-answer gate into the batched recursion
         self.stats = {"batches": 0, "fallback_docs": 0,
-                      "scalar_recursion_docs": 0}
+                      "scalar_recursion_docs": 0,
+                      # DEVICE program launches (excludes the all-C tiny
+                      # path) — what the recycle watcher meters, since
+                      # the tunneled plugin's RSS leak is per dispatch
+                      "device_dispatches": 0}
         import threading
         self._stats_lock = threading.Lock()
 
@@ -210,6 +214,7 @@ class NgramBatchEngine:
                 out.append(res)
             with self._stats_lock:
                 self.stats["batches"] += 1
+                self.stats["device_dispatches"] += 1
                 self.stats["fallback_docs"] += n_fb
                 self.stats["scalar_recursion_docs"] += n_retry
         return out
@@ -276,6 +281,7 @@ class NgramBatchEngine:
                     out.append(EpilogueResult(ep[b].tolist()))
             with self._stats_lock:
                 self.stats["batches"] += 1
+                self.stats["device_dispatches"] += 1
                 self.stats["fallback_docs"] += n_fb
                 self.stats["scalar_recursion_docs"] += n_retry
             return out
@@ -424,6 +430,7 @@ class NgramBatchEngine:
         B = len(texts)
         with self._stats_lock:
             self.stats["batches"] += 1
+            self.stats["device_dispatches"] += 1
             self.stats["fallback_docs"] += int(cb.fallback[:B].sum())
         ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
         patches: dict[int, ScalarResult] = {}
@@ -513,6 +520,8 @@ class NgramBatchEngine:
         engine's own flags, exactly like a first-pass fallback."""
         from .. import native
         cb, fut = self._dispatch(texts, flags=flags)
+        with self._stats_lock:
+            self.stats["device_dispatches"] += 1
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
         ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
         results = []

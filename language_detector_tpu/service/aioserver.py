@@ -129,6 +129,7 @@ class AioService:
         self.batcher = AioBatcher(self.svc._detect, max_batch,
                                   max_delay_ms)
         self._usage = json.dumps(USAGE).encode()
+        self.recycling = False  # set by _recycle_watch; read by serve()
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
@@ -272,6 +273,38 @@ class AioService:
                 pass
 
 
+async def _recycle_watch(aio: "AioService", server, mserver):
+    """Planned self-recycle for the plugin's per-dispatch host RSS leak
+    (docs/PERF.md; tunneled backend only): past LDT_MAX_DISPATCHES /
+    LDT_MAX_RSS_MB, stop accepting, give in-flight handlers a moment,
+    and exit with RECYCLE_EXIT_CODE for the supervisor / container
+    restart policy (service/recycle.py). No-op when neither env bound
+    is set."""
+    from .recycle import (check_interval_sec, limits_from_env,
+                          should_recycle)
+    max_d, max_r = limits_from_env()
+    if max_d is None and max_r is None:
+        return
+    while True:
+        await asyncio.sleep(check_interval_sec())
+        stats = aio.svc.metrics.engine_stats()
+        # the leak tracks DEVICE dispatches; all-C tiny flushes never
+        # touch the plugin and must not burn recycle budget
+        n = stats.get("device_dispatches", stats.get("batches", 0))
+        reason = should_recycle(n, max_d, max_r)
+        if reason:
+            print(json.dumps({"msg": f"recycling worker: {reason}"}),
+                  flush=True)
+            # flag + close; serve() swallows the resulting cancellation,
+            # drains briefly, and returns the recycle indicator so
+            # main() exits with the code (exiting from THIS task would
+            # race the loop teardown cancelling it first)
+            aio.recycling = True
+            server.close()
+            mserver.close()
+            return
+
+
 async def serve(port: int = 3000, metrics_port: int = 30000,
                 svc: DetectorService | None = None,
                 ready: "asyncio.Future | None" = None):
@@ -294,18 +327,33 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
           flush=True)
     if ready is not None and not ready.done():
         ready.set_result(ports)
-    async with server, mserver:
-        await asyncio.gather(server.serve_forever(),
-                             mserver.serve_forever())
+    watch = asyncio.get_running_loop().create_task(
+        _recycle_watch(aio, server, mserver))
+    try:
+        async with server, mserver:
+            await asyncio.gather(server.serve_forever(),
+                                 mserver.serve_forever())
+    except asyncio.CancelledError:
+        if not aio.recycling:
+            raise
+        await asyncio.sleep(0.5)  # drain in-flight responses
+    finally:
+        watch.cancel()
+    return "recycle" if aio.recycling else None
 
 
 def main():
+    import sys
+
+    from .recycle import RECYCLE_EXIT_CODE
     port = int(os.environ.get("LISTEN_PORT", 3000))
     metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
     try:
-        asyncio.run(serve(port, metrics_port))
+        result = asyncio.run(serve(port, metrics_port))
     except KeyboardInterrupt:
-        pass
+        return
+    if result == "recycle":
+        sys.exit(RECYCLE_EXIT_CODE)
 
 
 if __name__ == "__main__":

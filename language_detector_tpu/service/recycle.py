@@ -1,0 +1,79 @@
+"""Worker self-recycle: bounded memory for long-lived serving processes.
+
+The tunneled TPU backend's platform plugin leaks ~1.2MB of host RSS per
+device dispatch (characterized in docs/PERF.md — engine-side allocation
+measures flat; real, non-tunneled TPU hosts are unaffected). The
+reference's production mitigation is the container restart
+(/root/reference/Dockerfile); this module makes that story operational
+in-process: both HTTP fronts periodically evaluate `should_recycle` and,
+past a dispatch-count or RSS bound, exit cleanly with RECYCLE_EXIT_CODE
+so the supervisor (service/supervisor.py, or a container restart policy)
+replaces the worker without dropping the listening story.
+
+Configuration (env, unset = feature off):
+  LDT_MAX_DISPATCHES  recycle after this many engine batch dispatches
+  LDT_MAX_RSS_MB      recycle when process RSS exceeds this many MB
+"""
+from __future__ import annotations
+
+import os
+
+# Distinct from error exits so supervisors/restart policies can tell a
+# planned recycle from a crash (and bare `docker restart: on-failure`
+# still catches both).
+RECYCLE_EXIT_CODE = 77
+
+def check_interval_sec() -> float:
+    """Watcher period (LDT_RECYCLE_CHECK_SEC env override, for tests)."""
+    try:
+        return max(float(os.environ.get("LDT_RECYCLE_CHECK_SEC", 5.0)),
+                   0.05)
+    except ValueError:
+        return 5.0
+
+
+def rss_mb() -> float:
+    """Resident set size of this process in MB (0.0 if unreadable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0.0
+
+
+def limits_from_env() -> tuple[int | None, float | None]:
+    """(max_dispatches, max_rss_mb) from the environment; None = off."""
+    def _num(name, cast):
+        v = os.environ.get(name)
+        if not v:
+            return None
+        try:
+            n = cast(v)
+        except ValueError:
+            # a mis-typed bound must not silently disable the guard the
+            # operator thinks is active
+            import logging
+            logging.getLogger(__name__).warning(
+                "%s=%r is not a valid %s — recycle bound DISABLED",
+                name, v, cast.__name__)
+            return None
+        return n if n > 0 else None
+    return _num("LDT_MAX_DISPATCHES", int), _num("LDT_MAX_RSS_MB", float)
+
+
+def should_recycle(dispatches: int,
+                   max_dispatches: int | None,
+                   max_rss_mb: float | None,
+                   current_rss_mb: float | None = None) -> str | None:
+    """Reason string when a bound is exceeded, else None."""
+    if max_dispatches is not None and dispatches >= max_dispatches:
+        return (f"dispatch bound reached ({dispatches} >= "
+                f"{max_dispatches})")
+    if max_rss_mb is not None:
+        rss = rss_mb() if current_rss_mb is None else current_rss_mb
+        if rss >= max_rss_mb:
+            return f"RSS bound reached ({rss:.0f}MB >= {max_rss_mb:.0f}MB)"
+    return None

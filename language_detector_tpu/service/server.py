@@ -432,19 +432,68 @@ def make_server(port: int = 0, metrics_port: int = 0,
     return httpd, metricsd, svc
 
 
+def _recycle_watch_thread(svc: DetectorService, httpd):
+    """Threaded-front twin of aioserver._recycle_watch: planned worker
+    self-recycle past LDT_MAX_DISPATCHES / LDT_MAX_RSS_MB (the tunneled
+    backend's per-dispatch RSS leak, docs/PERF.md). No thread when
+    neither bound is set."""
+    from .recycle import (check_interval_sec, limits_from_env,
+                          should_recycle)
+    max_d, max_r = limits_from_env()
+    if max_d is None and max_r is None:
+        return
+
+    def run():
+        while True:
+            time.sleep(check_interval_sec())
+            stats = svc.metrics.engine_stats()
+            # the leak tracks DEVICE dispatches; all-C tiny flushes
+            # don't touch the plugin and must not burn recycle budget
+            n = stats.get("device_dispatches", stats.get("batches", 0))
+            reason = should_recycle(n, max_d, max_r)
+            if reason:
+                print(json.dumps(
+                    {"msg": f"recycling worker: {reason}"}), flush=True)
+                # flag + shutdown; the MAIN thread exits with the
+                # recycle code after serve_forever returns (a daemon
+                # thread racing os._exit against the interpreter's own
+                # exit would sometimes lose and report rc=0)
+                httpd._ldt_recycle = True
+                httpd.shutdown()  # finish in-flight, stop accepting
+                return
+
+    threading.Thread(target=run, daemon=True,
+                     name="ldt-recycle").start()
+
+
 def main():
+    import sys
+
+    from .recycle import RECYCLE_EXIT_CODE
     port = int(os.environ.get("LISTEN_PORT", 3000))
     metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
     httpd, metricsd, svc = make_server(port, metrics_port)
+    _recycle_watch_thread(svc, httpd)
     threading.Thread(target=metricsd.serve_forever, daemon=True).start()
-    print(json.dumps({"msg": f"language-detector listening on :{port}, "
-                             f"metrics on :{metrics_port}"}), flush=True)
+    # report the BOUND ports (port 0 picks ephemerals — supervised and
+    # test runs parse this line)
+    print(json.dumps({"msg": "language-detector listening on "
+                             f":{httpd.server_address[1]}, metrics on "
+                             f":{metricsd.server_address[1]}"}),
+          flush=True)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if getattr(httpd, "_ldt_recycle", False):
+            # shutdown() only stops the accept loop; give in-flight
+            # handler threads a moment to finish writing before the
+            # batcher closes under them (the aio front drains the same)
+            time.sleep(0.5)
         svc.batcher.close()
+    if getattr(httpd, "_ldt_recycle", False):
+        sys.exit(RECYCLE_EXIT_CODE)
 
 
 if __name__ == "__main__":

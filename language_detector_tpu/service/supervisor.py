@@ -1,0 +1,69 @@
+"""Worker supervisor: restart the serving process on planned recycles.
+
+The reference ships its restart story as a container policy
+(/root/reference/Dockerfile); this is the same story for bare-metal and
+for the repo's own Dockerfile CMD: run the HTTP front as a child, and
+while it exits with RECYCLE_EXIT_CODE (a planned self-recycle — see
+service/recycle.py), start a fresh one. Any other exit propagates, so
+crashes still surface to the outer restart policy / operator.
+
+Run: python -m language_detector_tpu.service.supervisor [module]
+     (module defaults to language_detector_tpu.service.aioserver, the
+      single-core production front; pass .service.server for the
+      threaded one)
+"""
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import time
+
+from .recycle import RECYCLE_EXIT_CODE
+
+
+def main() -> int:
+    module = sys.argv[1] if len(sys.argv) > 1 else \
+        "language_detector_tpu.service.aioserver"
+    generation = 0
+    child: subprocess.Popen | None = None
+    stopping = False
+
+    # PID-1 duty (the Dockerfile CMD): forward SIGTERM/SIGINT to the
+    # worker so `docker stop` gives it a graceful shutdown instead of
+    # the namespace teardown SIGKILLing it mid-request; then stop
+    # restarting and exit with the worker's code.
+    def _forward(signum, frame):
+        nonlocal stopping
+        stopping = True
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    while True:
+        generation += 1
+        print(json.dumps({"msg": f"supervisor: starting {module} "
+                                 f"(generation {generation})"}),
+              flush=True)
+        t0 = time.time()
+        child = subprocess.Popen([sys.executable, "-m", module])
+        while True:
+            try:
+                rc = child.wait()
+                break
+            except KeyboardInterrupt:  # Ctrl+C raced the handler
+                continue
+        if stopping or rc != RECYCLE_EXIT_CODE:
+            print(json.dumps({"msg": f"supervisor: worker exited rc={rc} "
+                                     f"after {time.time() - t0:.1f}s — "
+                                     "propagating"}), flush=True)
+            return rc
+        print(json.dumps({"msg": "supervisor: worker recycled after "
+                                 f"{time.time() - t0:.1f}s"}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

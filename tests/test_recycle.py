@@ -1,0 +1,148 @@
+"""Worker self-recycle + supervisor (service/recycle.py, supervisor.py).
+
+The tunneled TPU backend leaks host RSS per dispatch (docs/PERF.md);
+the mitigation is a planned worker exit past a dispatch/RSS bound, with
+the supervisor (or a container restart policy) starting a fresh one.
+These tests pin the bound logic, the supervisor's restart/propagate
+behavior, and the threaded front's end-to-end recycle exit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+from language_detector_tpu.service.recycle import (  # noqa: E402
+    RECYCLE_EXIT_CODE, limits_from_env, rss_mb, should_recycle)
+
+
+def test_should_recycle_bounds():
+    assert should_recycle(10, None, None) is None
+    assert should_recycle(10, 11, None) is None
+    assert "dispatch bound" in should_recycle(11, 11, None)
+    assert should_recycle(0, None, 100.0, current_rss_mb=50.0) is None
+    assert "RSS bound" in should_recycle(0, None, 100.0,
+                                         current_rss_mb=150.0)
+
+
+def test_rss_and_env_limits(monkeypatch):
+    assert rss_mb() > 1.0  # this test process certainly exceeds 1MB
+    monkeypatch.delenv("LDT_MAX_DISPATCHES", raising=False)
+    monkeypatch.delenv("LDT_MAX_RSS_MB", raising=False)
+    assert limits_from_env() == (None, None)
+    monkeypatch.setenv("LDT_MAX_DISPATCHES", "500")
+    monkeypatch.setenv("LDT_MAX_RSS_MB", "2048")
+    assert limits_from_env() == (500, 2048.0)
+    monkeypatch.setenv("LDT_MAX_DISPATCHES", "junk")
+    monkeypatch.setenv("LDT_MAX_RSS_MB", "-1")
+    assert limits_from_env() == (None, None)
+
+
+def test_supervisor_restarts_on_recycle_and_propagates(tmp_path):
+    """The supervisor restarts the worker while it exits with
+    RECYCLE_EXIT_CODE and propagates any other exit code."""
+    state = tmp_path / "count"
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(state)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        f"sys.exit({RECYCLE_EXIT_CODE} if n < 2 else 3)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+         "stub_worker"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+        env={**os.environ,
+             "PYTHONPATH": f"{tmp_path}:{REPO}:"
+                           f"{os.environ.get('PYTHONPATH', '')}"})
+    assert r.returncode == 3  # third run's exit propagated
+    assert int(state.read_text()) == 3  # ran exactly 3 generations
+    assert r.stdout.count("worker recycled") == 2
+
+
+def test_threaded_server_recycles_end_to_end():
+    """Drive the real threaded front (module entry) with
+    LDT_MAX_DISPATCHES=1: one detection flush must trip the watcher
+    into a clean RECYCLE_EXIT_CODE exit (the supervisor's restart
+    signal), after serve_forever returns so in-flight work finishes."""
+    env = {**os.environ, "LISTEN_PORT": "0", "PROMETHEUS_PORT": "0",
+           "LDT_MAX_DISPATCHES": "1", "LDT_RECYCLE_CHECK_SEC": "0.2",
+           # APPEND to PYTHONPATH: replacing it would drop the jax
+           # platform plugin's path on hosts that ship one there, and
+           # the child would silently fall back to the scalar engine
+           # (no dispatches -> no recycle)
+           "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "language_detector_tpu.service.server"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = p.stdout.readline()
+            if "listening on" in line:
+                msg = json.loads(line)["msg"]
+                port = int(msg.split(":")[1].split(",")[0])
+                break
+        assert port, "server never reported its port"
+        # > TINY_BATCH_C_PATH docs: a tiny flush rides the all-C path
+        # and correctly burns NO recycle budget (the watcher meters
+        # device_dispatches — the leak is per DEVICE dispatch)
+        docs = [{"text": f"bonjour le monde numero {i}"}
+                for i in range(100)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"request": docs}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = urllib.request.urlopen(req, timeout=90).read()
+        assert body.count(b"iso6391code") == 100
+        try:
+            rc = p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate(timeout=10)
+            raise AssertionError(
+                f"worker did not recycle; stdout={out[-400:]!r} "
+                f"stderr={err[-400:]!r}")
+        assert rc == RECYCLE_EXIT_CODE, (rc, p.stderr.read()[-500:])
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_supervisor_forwards_sigterm(tmp_path):
+    """PID-1 duty (the Dockerfile CMD): SIGTERM to the supervisor is
+    forwarded to the worker, whose graceful exit code propagates —
+    `docker stop` must not SIGKILL a worker mid-request."""
+    import signal
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(
+        "import signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(42))\n"
+        "print('stub ready', flush=True)\n"
+        "time.sleep(60)\n")
+    p = subprocess.Popen(
+        [sys.executable, "-m",
+         "language_detector_tpu.service.supervisor", "stub_worker"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ,
+             "PYTHONPATH": f"{tmp_path}:{REPO}:"
+                           f"{os.environ.get('PYTHONPATH', '')}"})
+    try:
+        for line in p.stdout:  # wait until the worker installed handlers
+            if "stub ready" in line:
+                break
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=15) == 42
+    finally:
+        if p.poll() is None:
+            p.kill()
