@@ -130,9 +130,15 @@ class AioService:
                                   max_delay_ms)
         self._usage = json.dumps(USAGE).encode()
         self.recycling = False  # set by _recycle_watch; read by serve()
+        # open client connections: the recycle path must force-close
+        # idle keep-alive connections (a Prometheus scraper's persistent
+        # socket would otherwise pin Server.wait_closed() forever on
+        # Python 3.12.1+, which waits for every accepted connection)
+        self._writers: set = set()
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
+        self._writers.add(writer)
         try:
             sock = writer.get_extra_info("socket")
             if sock is not None:
@@ -204,6 +210,7 @@ class AioService:
                     # clients would otherwise spam task tracebacks)
                     break
         finally:
+            self._writers.discard(writer)
             try:
                 writer.close()
             except Exception:  # noqa: BLE001 - already torn down
@@ -255,6 +262,7 @@ class AioService:
 
     async def handle_metrics(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
+        self._writers.add(writer)
         try:
             while True:
                 try:
@@ -267,6 +275,7 @@ class AioService:
                     200, body, b"text/plain; version=0.0.4"))
                 await writer.drain()
         finally:
+            self._writers.discard(writer)
             try:
                 writer.close()
             except Exception:  # noqa: BLE001
@@ -295,13 +304,24 @@ async def _recycle_watch(aio: "AioService", server, mserver):
         if reason:
             print(json.dumps({"msg": f"recycling worker: {reason}"}),
                   flush=True)
-            # flag + close; serve() swallows the resulting cancellation,
-            # drains briefly, and returns the recycle indicator so
-            # main() exits with the code (exiting from THIS task would
-            # race the loop teardown cancelling it first)
+            # flag + close; serve() swallows the resulting cancellation
+            # and returns the recycle indicator so main() exits with the
+            # code (exiting from THIS task would race the loop teardown
+            # cancelling it first). The drain + connection abort happen
+            # HERE: serve()'s `async with` exit awaits wait_closed()
+            # DURING exception propagation — before any except clause —
+            # and on 3.12.1+ that waits for every accepted connection,
+            # so an idle keep-alive socket would pin the recycle forever
+            # unless aborted first.
             aio.recycling = True
             server.close()
             mserver.close()
+            await asyncio.sleep(0.5)  # drain in-flight responses
+            for w in list(aio._writers):
+                try:
+                    w.transport.abort()
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
             return
 
 
@@ -335,8 +355,7 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
                                  mserver.serve_forever())
     except asyncio.CancelledError:
         if not aio.recycling:
-            raise
-        await asyncio.sleep(0.5)  # drain in-flight responses
+            raise  # external cancellation (tests, embedding callers)
     finally:
         watch.cancel()
     return "recycle" if aio.recycling else None

@@ -124,6 +124,11 @@ class Metrics:
         es = self.engine_stats()
         lines.append("# TYPE ldt_batch_flushes_total counter")
         lines.append(f"ldt_batch_flushes_total {es.get('batches', 0)}")
+        # what the recycle watcher meters against LDT_MAX_DISPATCHES
+        # (excludes all-C tiny flushes, which burn no recycle budget)
+        lines.append("# TYPE ldt_device_dispatches_total counter")
+        lines.append("ldt_device_dispatches_total "
+                     f"{es.get('device_dispatches', 0)}")
         lines.append("# TYPE ldt_fallback_documents_total counter")
         lines.append("ldt_fallback_documents_total "
                      f"{es.get('fallback_docs', 0) + es.get('scalar_recursion_docs', 0)}")

@@ -50,6 +50,8 @@ def main() -> int:
               flush=True)
         t0 = time.time()
         child = subprocess.Popen([sys.executable, "-m", module])
+        if stopping:  # signal raced the spawn: stop the new worker too
+            child.send_signal(signal.SIGTERM)
         while True:
             try:
                 rc = child.wait()
@@ -63,6 +65,8 @@ def main() -> int:
             return rc
         print(json.dumps({"msg": "supervisor: worker recycled after "
                                  f"{time.time() - t0:.1f}s"}), flush=True)
+        if stopping:  # SIGTERM landed in the reap/restart gap
+            return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,54 @@
+"""Golden-suite accuracy gate: the table-change regression tripwire.
+
+Policy (README "Expected-score policy"): the shipped scoring tables —
+quadgram weights, kAvgDeltaOctaScore expected scores, everything in
+data/ — must keep golden-suite accuracy at its established level. Any
+"improvement" applied to the tables (a gen_expected_score.py override,
+a quad retrain, an artifact re-pack) that silently mis-calibrates
+scoring fails HERE instead of shipping: a round-3 expected-score
+regeneration from synthetic text regressed accuracy by 42% and was only
+caught by hand.
+
+The gate runs the scalar engine (compile-free, deterministic,
+oracle-parity-pinned; the batched engines agree with it exactly per the
+agreement suites, so one engine's accuracy is every engine's).
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from golden_data import golden_pairs  # noqa: E402
+
+from language_detector_tpu.engine_scalar import detect_scalar  # noqa: E402
+from language_detector_tpu.registry import registry  # noqa: E402
+from language_detector_tpu.tables import load_tables  # noqa: E402
+
+# Established level: 306/402 (76.1%) since round 3 (docs/eval_goldens_*).
+# The floor leaves ~2% slack for genuinely neutral table rebuilds; a
+# mis-calibration like the round-3 incident lands ~40 points below it.
+ACCURACY_FLOOR = 0.74
+ALIASES = {("hmn", "blu")}
+
+
+def test_golden_accuracy_floor():
+    pairs = golden_pairs()
+    if not pairs:
+        pytest.skip("reference snapshot unavailable")
+    tables = load_tables()
+    correct = 0
+    for _, want, raw in pairs:
+        text = raw.decode("utf-8", errors="replace")
+        got = registry.code(
+            detect_scalar(text, tables, registry).summary_lang)
+        if got == want or (got, want) in ALIASES:
+            correct += 1
+    acc = correct / len(pairs)
+    assert acc >= ACCURACY_FLOOR, (
+        f"golden accuracy {acc:.1%} ({correct}/{len(pairs)}) fell below "
+        f"the {ACCURACY_FLOOR:.0%} gate — a table change (expected-score "
+        "override? quad retrain? artifact re-pack?) regressed scoring; "
+        "see README 'Expected-score policy'")
