@@ -517,13 +517,27 @@ void segment_text(const uint8_t* text, int text_len, SegScratch* ss,
       uint8_t c = text[i];
       uint32_t cp;
       int incr;
-      if (c >= 0x80 && i + (c < 0xF0 ? (c < 0xE0 ? 2 : 3) : 4) > text_len) {
+      if (c < 0x80) {
+        // ASCII run fast path: one-byte classification straight from
+        // the low end of the global tables, no decode branches (most
+        // service traffic is Latin; this loop was the largest single
+        // pack cost after the scanners)
+        do {
+          script[n] = g.script_of_cp[c];
+          lower[n] = g.lower_map[c];
+          u8l[n] = 1;
+          byte_before[n] = i;
+          n++;
+          i++;
+          if (i >= text_len) break;
+          c = text[i];
+        } while (c < 0x80);
+        continue;
+      }
+      if (i + (c < 0xF0 ? (c < 0xE0 ? 2 : 3) : 4) > text_len) {
         // truncated multibyte tail OR stray continuation byte at the end
         // (reachable via the C ABI, which takes arbitrary bytes):
         // consume one byte instead of reading past the buffer
-        cp = c;
-        incr = 1;
-      } else if (c < 0x80) {
         cp = c;
         incr = 1;
       } else if (c < 0xE0) {
