@@ -58,6 +58,12 @@ class NgramBatchEngine:
     length — one small program set serves every traffic mix.
     """
 
+    # process-global interpreter-tuning state for _gc_paused (shared
+    # across engines: the knobs it guards are process-global too)
+    _bulk_lock = __import__("threading").Lock()
+    _bulk_depth = 0
+    _bulk_saved = (True, 0.005)
+
     def __init__(self, tables: ScoringTables | None = None,
                  reg: Registry | None = None, flags: int = 0,
                  max_slots: int = 1 << 17, max_chunks: int = 1 << 14,
@@ -295,25 +301,50 @@ class NgramBatchEngine:
     @staticmethod
     @contextlib.contextmanager
     def _gc_paused():
-        """Pause the cyclic GC for a bulk-detection call: each batch
-        creates ~2 small objects per document (epilogue row list +
-        result view), which trips several young-gen scans per batch —
-        measured ~19ms/batch of the single core, with zero cyclic
-        garbage to find (rows and views are acyclic; refcounting frees
-        them). Used by the non-generator entry points only, so the
-        try/finally always restores the collector — never from inside
-        a generator, whose finally could be stranded by an abandoned
-        iterator. Trade-off: cycles made by OTHER threads during the
-        call collect after it returns."""
+        """Interpreter tuning for a bulk-detection call, always restored
+        on exit. Two knobs:
+
+        - pause the cyclic GC: each batch creates ~2 small acyclic
+          objects per document (epilogue row list + result view), which
+          trips several young-gen scans per batch — measured ~19ms of
+          the single core per 16K docs, with zero cyclic garbage to
+          find (refcounting frees them);
+        - drop the GIL switch interval 5ms -> 1ms: the main thread
+          re-acquires the GIL after every C++ pack while pool workers
+          hold it for result building — at the default interval each
+          handoff can stall the pack loop for most of 5ms (measured
+          ~2-6% end-to-end on the single-core host).
+
+        Used by the non-generator entry points only, so the try/finally
+        always runs — never from inside a generator, whose finally
+        could be stranded by an abandoned iterator. Both knobs are
+        process-global, so a depth counter makes overlapping bulk
+        calls from different threads safe: the first entry saves and
+        sets, the last exit restores (naive save/restore would leave a
+        stale value behind whichever call exits last). Trade-off:
+        cycles made by OTHER threads during the call collect after it
+        returns."""
         import gc
-        paused = gc.isenabled()
-        if paused:
-            gc.disable()
+        import sys
+        cls = NgramBatchEngine
+        with cls._bulk_lock:
+            cls._bulk_depth += 1
+            if cls._bulk_depth == 1:
+                cls._bulk_saved = (gc.isenabled(),
+                                   sys.getswitchinterval())
+                if cls._bulk_saved[0]:
+                    gc.disable()
+                sys.setswitchinterval(0.001)
         try:
             yield
         finally:
-            if paused:
-                gc.enable()
+            with cls._bulk_lock:
+                cls._bulk_depth -= 1
+                if cls._bulk_depth == 0:
+                    was_enabled, prev_si = cls._bulk_saved
+                    sys.setswitchinterval(prev_si)
+                    if was_enabled:
+                        gc.enable()
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list:

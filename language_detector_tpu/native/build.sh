@@ -23,3 +23,13 @@ g++ -O3 -march=native -funroll-loops ${LDT_EXTRA_FLAGS:-} \
 { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
     > "$OUT.host" 2>/dev/null || true
 echo "built $(pwd)/$OUT"
+# Optional GIL-held marshalling helper (ctypes.PyDLL; symbols resolve
+# from the running interpreter, no libpython link). Best effort: hosts
+# without CPython headers keep the pure-Python marshalling path.
+PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])' \
+        2>/dev/null || true)"
+if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
+    gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c && \
+        cp "$OUT.host" libldtglue.so.host 2>/dev/null && \
+        echo "built $(pwd)/libldtglue.so" || true
+fi
