@@ -354,11 +354,34 @@ class NgramBatchEngine:
         for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
-        out: list = []
         with self._gc_paused():
-            for part in self._pipelined(texts, batch_size, self._finish):
-                out.extend(part)
+            parts, patches = self._detect_stream(texts, batch_size,
+                                                 self._finish)
+            out = [r for part in parts for r in part]
+            for g, r in patches.items():
+                out[g] = r
         return out
+
+    def _detect_stream(self, texts: list[str], batch_size: int,
+                       finish_fn):
+        """Pipeline the stream with per-slice DEFERRED gate retries,
+        then run ONE batched recursion pass for the whole stream
+        (per-slice retries would serialize a device round per slice).
+        Returns (per-slice parts, {global index: ScalarResult})."""
+        parts: list = []
+        all_deferred: list = []  # (global index, text, squeezed)
+        n = 0
+
+        def finish(texts_, cb, fut):
+            d: list = []
+            return finish_fn(texts_, cb, fut, deferred=d), d
+
+        for part, d in self._pipelined(texts, batch_size, finish):
+            for b, t, sq in d:
+                all_deferred.append((n + b, t, sq))
+            parts.append(part)
+            n += len(part)
+        return parts, self._retry_deferred(all_deferred)
 
     def _pipelined(self, texts: list[str], batch_size: int, finish):
         """Slice texts by count + content volume and pipeline them;
@@ -446,16 +469,22 @@ class NgramBatchEngine:
         cb = self._pack(texts, flags, hint_boosts)
         return cb, self._score_fn(self.dt, cb.wire)
 
-    def _epilogue(self, texts: list[str], cb, fut):
+    def _epilogue(self, texts: list[str], cb, fut, deferred=None):
         """Fetch the device result, run the C++ document epilogue, and
         resolve the exception docs: packer fallbacks go scalar; docs
         failing the good-answer gate re-score as a BATCH with the
         recursion flags (TOP40|REPEATS|FINISH, plus SQUEEZE for docs
         whose first pass squeezed) — the reference's recursive
         DetectLanguageSummaryV2 call (impl.cc:2061-2105) run on the
-        device instead of per-doc in the scalar engine. Returns
-        (ep [B, 14], {doc index: ScalarResult} patches). Runs on
-        detect_many's worker pool, so stats updates take the lock."""
+        device instead of per-doc in the scalar engine.
+
+        deferred: when given (the multi-slice pipeline), gate-failed
+        docs are appended as (local index, text, squeezed) instead of
+        retried here — the caller retries ONCE for the whole stream, so
+        a mixed corpus split into S slices pays 1-2 retry rounds
+        instead of up to 2S serial device rounds. Returns (ep [B, 14],
+        {doc index: ScalarResult} patches). Runs on detect_many's
+        worker pool, so stats updates take the lock."""
         from .. import native
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
         B = len(texts)
@@ -474,25 +503,49 @@ class NgramBatchEngine:
             if cb.fallback[b]:
                 patches[b] = detect_scalar(texts[b], self.tables,
                                            self.reg, self.flags)
+            elif deferred is not None:
+                deferred.append((b, texts[b], bool(cb.squeezed[b])))
             else:
                 retry[bool(cb.squeezed[b])].append((b, texts[b]))
         n_retry = len(retry[False]) + len(retry[True])
         if n_retry:
             with self._stats_lock:
                 self.stats["scalar_recursion_docs"] += n_retry
-            extra = FLAG_TOP40 | FLAG_REPEATS | FLAG_FINISH
             for squeezed, group in retry.items():
                 if not group:
                     continue
-                flags = self.flags | extra | \
-                    (FLAG_SQUEEZE if squeezed else 0)
-                rs = self._score_with_flags([t for _, t in group], flags)
+                rs = self._score_with_flags(
+                    [t for _, t in group],
+                    self._retry_flags(squeezed))
                 for (b, _), r in zip(group, rs):
                     patches[b] = r
         return ep, patches
 
-    def _finish(self, texts: list[str], cb, fut) -> list:
-        ep, patches = self._epilogue(texts, cb, fut)
+    def _retry_flags(self, squeezed: bool) -> int:
+        return (self.flags | FLAG_TOP40 | FLAG_REPEATS | FLAG_FINISH |
+                (FLAG_SQUEEZE if squeezed else 0))
+
+    def _retry_deferred(self, deferred: list) -> dict:
+        """One batched recursion pass over every gate-failed doc of a
+        multi-slice stream: {global index: ScalarResult}."""
+        if not deferred:
+            return {}
+        with self._stats_lock:
+            self.stats["scalar_recursion_docs"] += len(deferred)
+        patches: dict = {}
+        for squeezed in (False, True):
+            group = [(g, t) for g, t, sq in deferred if sq == squeezed]
+            if not group:
+                continue
+            rs = self._score_with_flags([t for _, t in group],
+                                        self._retry_flags(squeezed))
+            for (g, _), r in zip(group, rs):
+                patches[g] = r
+        return patches
+
+    def _finish(self, texts: list[str], cb, fut,
+                deferred=None) -> list:
+        ep, patches = self._epilogue(texts, cb, fut, deferred)
         # lazy row views instead of eager dataclasses: constructing 16K
         # ScalarResults costs ~70ms on the single-core host while most
         # consumers read one or two fields; the view defers field
@@ -502,9 +555,10 @@ class NgramBatchEngine:
             results[b] = r
         return results
 
-    def _finish_codes(self, texts: list[str], cb, fut) -> np.ndarray:
+    def _finish_codes(self, texts: list[str], cb, fut,
+                      deferred=None) -> np.ndarray:
         """Summary-language ids only (no per-doc result objects)."""
-        ep, patches = self._epilogue(texts, cb, fut)
+        ep, patches = self._epilogue(texts, cb, fut, deferred)
         out = ep[:len(texts), 0].astype(np.int32)
         for b, r in patches.items():
             out[b] = r.summary_lang
@@ -538,31 +592,37 @@ class NgramBatchEngine:
                         self.stats.get("c_path_docs", 0) + len(texts)
                 return self.reg.lang_code[ids].tolist()
         with self._gc_paused():
-            parts = list(self._pipelined(texts, batch_size,
-                                         self._finish_codes))
-        ids = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+            parts, patches = self._detect_stream(texts, batch_size,
+                                                 self._finish_codes)
+            ids = np.concatenate(parts) if parts \
+                else np.zeros(0, np.int32)
+            for g, r in patches.items():
+                ids[g] = r.summary_lang
         return self.reg.lang_code[ids].tolist()
 
     def _score_with_flags(self, texts: list[str],
                           flags: int) -> list[ScalarResult]:
-        """One device pass with explicit flags (the gate-failure retry;
-        FINISH forces the gate so no further recursion happens). Docs the
-        packer cannot place fall back to the scalar engine with the
-        engine's own flags, exactly like a first-pass fallback."""
+        """Device passes with explicit flags (the gate-failure retry;
+        FINISH forces the gate so no further recursion happens), sliced
+        by the same content-volume budget as the main path — a deferred
+        retry group can span the whole stream. Docs the packer cannot
+        place fall back to the scalar engine with the engine's own
+        flags, exactly like a first-pass fallback."""
         from .. import native
-        cb, fut = self._dispatch(texts, flags=flags)
-        with self._stats_lock:
-            self.stats["device_dispatches"] += 1
-        rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
-        ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
-        results = []
-        for b, text in enumerate(texts):
-            row = ep[b]
-            if cb.fallback[b] or row[12]:
-                results.append(detect_scalar(text, self.tables, self.reg,
-                                             self.flags))
-                continue
-            results.append(_result_from_row(row))
+        results: list = []
+        for chunk in self._slices(texts, 16384):
+            cb, fut = self._dispatch(chunk, flags=flags)
+            with self._stats_lock:
+                self.stats["device_dispatches"] += 1
+            rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+            ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
+            for b, text in enumerate(chunk):
+                row = ep[b]
+                if cb.fallback[b] or row[12]:
+                    results.append(detect_scalar(text, self.tables,
+                                                 self.reg, self.flags))
+                    continue
+                results.append(_result_from_row(row))
         return results
 
 

@@ -121,50 +121,79 @@ _glue = None
 _GLUE_VERSION = 1  # must match pyglue.c ldt_glue_version()
 
 
-def _load_glue():
-    """Optional GIL-held marshalling helper (libldtglue.so, built by
-    build.sh when CPython headers exist). ctypes.PyDLL: the GIL stays
-    held across calls — every function inside touches CPython API.
-    A stale binary (missing, older than its source, wrong contract
-    version, or foreign-ISA sidecar) triggers one rebuild attempt;
-    anything still wrong falls back to the Python marshalling path."""
-    global _glue
-    if _glue is not None:
-        return _glue or None
-    so = _DIR / "libldtglue.so"
-    try:
-        stale = (not so.exists()
-                 or so.stat().st_mtime <
-                 (_DIR / "pyglue.c").stat().st_mtime
-                 or so.with_suffix(".so.host").read_text()
-                 != _host_isa())
-    except OSError:
-        stale = True
-    if stale:
-        _build()  # build.sh builds the glue alongside the packer
+def _try_load_glue(so: Path):
+    """Load + contract-check the glue; None when unusable."""
     try:
         g = ctypes.PyDLL(str(so))
         g.ldt_glue_version.restype = ctypes.c_int64
         if g.ldt_glue_version() != _GLUE_VERSION:
-            raise OSError("glue contract version mismatch")
+            return None
         g.ldt_blob_from_list.restype = ctypes.c_int64
         g.ldt_blob_from_list.argtypes = [
             ctypes.py_object, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_void_p]
         g.ldt_blob_size.restype = ctypes.c_int64
         g.ldt_blob_size.argtypes = [ctypes.py_object]
-        _glue = g
+        return g
     except (OSError, AttributeError):
-        _glue = False
-    return _glue or None
+        return None
+
+
+def _load_glue():
+    """Optional GIL-held marshalling helper (libldtglue.so, built by
+    build.sh when CPython headers exist). ctypes.PyDLL: the GIL stays
+    held across calls — every function inside touches CPython API.
+
+    A unusable binary (missing, older than its source, wrong contract
+    version, or foreign-ISA sidecar) triggers ONE glue-only rebuild —
+    never the full build, which would rewrite the already-dlopen'd
+    libldtpack.so in place — and only where CPython headers exist (a
+    host without them must not recompile the packer per process).
+    Failure after that caches False: Python marshalling path."""
+    global _glue
+    if _glue is not None:
+        return _glue or None
+    with _lock:
+        if _glue is not None:
+            return _glue or None
+        so = _DIR / "libldtglue.so"
+        try:
+            fresh = (so.exists()
+                     and so.stat().st_mtime >=
+                     (_DIR / "pyglue.c").stat().st_mtime
+                     and so.with_suffix(".so.host").read_text()
+                     == _host_isa())
+        except OSError:
+            fresh = False
+        g = _try_load_glue(so) if fresh else None
+        if g is None:
+            import sysconfig
+            inc = Path(sysconfig.get_paths()["include"]) / "Python.h"
+            if inc.exists():
+                try:
+                    subprocess.run([str(_DIR / "build.sh"),
+                                    "--glue-only"], check=True,
+                                   capture_output=True, timeout=120)
+                    g = _try_load_glue(so)
+                except Exception:  # noqa: BLE001 - fall back quietly
+                    g = None
+        _glue = g if g is not None else False
+        return _glue or None
 
 
 def _marshal_texts(texts: list):
     """list[str] -> (utf-8 blob u8 ndarray, bounds i64 ndarray). The C
-    glue path is one encode + one memcpy with zero transient bytes
-    objects (~6ms/16K docs saved on the single-core host); the Python
-    path handles everything else — non-list inputs, lone surrogates
-    (encoded surrogatepass, exactly as before), or a missing glue .so."""
+    glue path is one encode + one memcpy with no per-doc bytes objects
+    (~6ms/16K docs saved on the single-core host); the Python path
+    handles everything else — non-list inputs, lone surrogates (encoded
+    surrogatepass, exactly as before), or a missing glue .so.
+
+    Memory trade-off, deliberate: PyUnicode_AsUTF8AndSize caches each
+    non-ASCII str's UTF-8 form ON the str for its lifetime. Service
+    texts are request-scoped (cache freed with them); a caller that
+    detects a long-lived in-memory corpus pays ~2x its non-ASCII text
+    RSS — such callers can pre-encode and use the bytes-based eval
+    harness instead."""
     B = len(texts)
     g = _load_glue()
     if g is not None and type(texts) is list:

@@ -11,6 +11,19 @@
 #   LDT_EXTRA_FLAGS  extra compile flags (e.g. -DLDT_PROF)
 set -e
 cd "$(dirname "$0")"
+if [ "${1:-}" = "--glue-only" ]; then
+    # rebuild ONLY the marshalling helper: never rewrite libldtpack.so
+    # in place — it may be dlopen'd by the calling process already
+    PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])' \
+            2>/dev/null || true)"
+    if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
+        gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c
+        { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
+            > libldtglue.so.host 2>/dev/null || true
+        echo "built $(pwd)/libldtglue.so"
+    fi
+    exit 0
+fi
 OUT="${1:-libldtpack.so}"
 # -march=native: the library is always built on the host that runs it
 # (build-on-demand via native/__init__.py; the wheel ships sources).
