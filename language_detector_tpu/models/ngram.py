@@ -430,14 +430,22 @@ class NgramBatchEngine:
                 yield f.result()
 
     def _slices(self, texts: list[str], batch_size: int):
-        """Greedy batch slicing by document count AND content volume
-        (DISPATCH_CHAR_BUDGET), preserving order; every slice holds at
-        least one document."""
+        """Batch slicing by document count AND content volume,
+        preserving order; every slice holds at least one document.
+        The volume target is BALANCED: total content divided over the
+        minimum number of budget-respecting slices, so a 4.3M-char
+        stream cuts into two ~2.1M slices instead of 3M + 1.3M — equal
+        slices overlap on the pipeline, a runt tail mostly waits
+        (never exceeding DISPATCH_CHAR_BUDGET, the device memory
+        bound)."""
+        total = sum(len(t) for t in texts)
+        n_slices = max(-(-total // self.DISPATCH_CHAR_BUDGET), 1)
+        target = max(-(-total // n_slices), 1)
         out: list[str] = []
         vol = 0
         for t in texts:
             if out and (len(out) >= batch_size or
-                        vol + len(t) > self.DISPATCH_CHAR_BUDGET):
+                        vol + len(t) > target):
                 yield out
                 out, vol = [], 0
             out.append(t)
@@ -497,7 +505,7 @@ class NgramBatchEngine:
         need = np.flatnonzero(ep[:B, 12])
         if not need.size:
             return ep, patches
-        retry = {False: [], True: []}  # squeezed? -> [(index, text)]
+        local_retry: list = []  # (index, text, squeezed)
         for b in need:
             b = int(b)
             if cb.fallback[b]:
@@ -506,19 +514,8 @@ class NgramBatchEngine:
             elif deferred is not None:
                 deferred.append((b, texts[b], bool(cb.squeezed[b])))
             else:
-                retry[bool(cb.squeezed[b])].append((b, texts[b]))
-        n_retry = len(retry[False]) + len(retry[True])
-        if n_retry:
-            with self._stats_lock:
-                self.stats["scalar_recursion_docs"] += n_retry
-            for squeezed, group in retry.items():
-                if not group:
-                    continue
-                rs = self._score_with_flags(
-                    [t for _, t in group],
-                    self._retry_flags(squeezed))
-                for (b, _), r in zip(group, rs):
-                    patches[b] = r
+                local_retry.append((b, texts[b], bool(cb.squeezed[b])))
+        patches.update(self._retry_deferred(local_retry))
         return ep, patches
 
     def _retry_flags(self, squeezed: bool) -> int:
@@ -605,24 +602,36 @@ class NgramBatchEngine:
         """Device passes with explicit flags (the gate-failure retry;
         FINISH forces the gate so no further recursion happens), sliced
         by the same content-volume budget as the main path — a deferred
-        retry group can span the whole stream. Docs the packer cannot
-        place fall back to the scalar engine with the engine's own
-        flags, exactly like a first-pass fallback."""
+        retry group can span the whole stream — and run through the
+        shared pipeline core so multi-slice retries overlap instead of
+        paying a serial device round each. Docs the packer cannot place
+        fall back to the scalar engine with the engine's own flags,
+        exactly like a first-pass fallback."""
         from .. import native
-        results: list = []
-        for chunk in self._slices(texts, 16384):
-            cb, fut = self._dispatch(chunk, flags=flags)
+
+        def pack(chunk):
+            return self._pack(chunk, flags=flags)
+
+        def finish(chunk, cb, fut):
             with self._stats_lock:
                 self.stats["device_dispatches"] += 1
-            rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+            rows = unpack_chunks_out(np.asarray(fut),
+                                     cb.wire["cmeta"])
             ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
+            out: list = []
             for b, text in enumerate(chunk):
                 row = ep[b]
                 if cb.fallback[b] or row[12]:
-                    results.append(detect_scalar(text, self.tables,
-                                                 self.reg, self.flags))
+                    out.append(detect_scalar(text, self.tables,
+                                             self.reg, self.flags))
                     continue
-                results.append(_result_from_row(row))
+                out.append(_result_from_row(row))
+            return out
+
+        results: list = []
+        for part in self._pipelined_jobs(self._slices(texts, 16384),
+                                         pack, finish):
+            results.extend(part)
         return results
 
 

@@ -167,14 +167,25 @@ def _load_glue():
             fresh = False
         g = _try_load_glue(so) if fresh else None
         if g is None:
+            import os
             import sysconfig
-            inc = Path(sysconfig.get_paths()["include"]) / "Python.h"
-            if inc.exists():
+            incdir = sysconfig.get_paths()["include"]
+            if (Path(incdir) / "Python.h").exists():
                 try:
-                    subprocess.run([str(_DIR / "build.sh"),
-                                    "--glue-only"], check=True,
-                                   capture_output=True, timeout=120)
-                    g = _try_load_glue(so)
+                    subprocess.run(
+                        [str(_DIR / "build.sh"), "--glue-only"],
+                        check=True, capture_output=True, timeout=120,
+                        env={**os.environ, "LDT_PYINC": incdir})
+                    # re-verify freshness: build.sh exits 0 even when
+                    # it could not compile, and loading the stale
+                    # binary the check above just rejected would
+                    # bypass the mtime/ISA protection entirely
+                    if (so.exists()
+                            and so.stat().st_mtime >=
+                            (_DIR / "pyglue.c").stat().st_mtime
+                            and so.with_suffix(".so.host").read_text()
+                            == _host_isa()):
+                        g = _try_load_glue(so)
                 except Exception:  # noqa: BLE001 - fall back quietly
                     g = None
         _glue = g if g is not None else False

@@ -13,9 +13,12 @@ set -e
 cd "$(dirname "$0")"
 if [ "${1:-}" = "--glue-only" ]; then
     # rebuild ONLY the marshalling helper: never rewrite libldtpack.so
-    # in place — it may be dlopen'd by the calling process already
-    PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])' \
-            2>/dev/null || true)"
+    # in place — it may be dlopen'd by the calling process already.
+    # LDT_PYINC: the CALLING interpreter's header dir (native/__init__
+    # passes it) — PATH python3 may be a different CPython, and glue
+    # compiled against the wrong headers would mis-marshal silently.
+    PYINC="${LDT_PYINC:-$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])' \
+            2>/dev/null || true)}"
     if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
         gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c
         { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
