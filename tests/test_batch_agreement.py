@@ -179,6 +179,14 @@ def test_detect_many_matches_detect_batch(engine):
         [_result_tuple(r) for r in want]
 
 
+def _fuzz_docs(n: int, seed: int = 20260730) -> list:
+    rng = random.Random(seed)
+    texts = _golden_texts()
+    docs: list = []
+    _fill_fuzz_docs(docs, rng, texts, n)
+    return docs
+
+
 def test_fuzz_mixed_traffic_agreement(engine):
     """Randomized traffic soup: slices and concatenations of golden text
     across scripts, plus spam runs, entities, punctuation storms, and
@@ -188,7 +196,12 @@ def test_fuzz_mixed_traffic_agreement(engine):
     rng = random.Random(20260730)
     texts = _golden_texts()
     docs = []
-    for i in range(160):
+    _fill_fuzz_docs(docs, rng, texts, 160)
+    _assert_batch_agrees(engine, docs)
+
+
+def _fill_fuzz_docs(docs, rng, texts, n):
+    for i in range(n):
         kind = i % 8
         if kind == 0:    # cross-script concatenation
             docs.append(" ".join(
@@ -220,7 +233,6 @@ def test_fuzz_mixed_traffic_agreement(engine):
         else:            # whitespace-heavy
             t = texts[rng.randrange(len(texts))][:200]
             docs.append(t.replace(" ", "   \n\t "))
-    _assert_batch_agrees(engine, docs)
 
 
 def test_hinted_detection_agreement(engine):
@@ -294,3 +306,15 @@ def test_lone_surrogate_inputs(engine):
     want = detect_scalar(big_html, engine.tables, engine.reg,
                          is_plain_text=False)
     assert _result_tuple(got[0]) == _result_tuple(want)
+
+
+def test_fuzz_multi_slice_deferred_retry(engine):
+    """The cross-slice deferred gate-retry (detect_many/_detect_stream)
+    must answer exactly like the single-slice path: run the fuzz corpus
+    at a batch size that forces many slices (retries collect globally,
+    one batched recursion pass) and compare against one-call codes."""
+    docs = _fuzz_docs(96, seed=20260731)
+    want = [engine.reg.code(r.summary_lang)
+            for r in engine.detect_batch(docs)]
+    got = engine.detect_codes(docs, batch_size=13)  # ragged multi-slice
+    assert got == want

@@ -147,3 +147,28 @@ def test_device_path_chunks_match_scalar(base_tables):
         assert gch == wch, (t[:60], gch[:6], wch[:6])
         assert g.summary_lang == w.summary_lang, t[:60]
         assert list(g.percent3) == list(w.percent3), t[:60]
+
+
+def test_device_path_chunks_fuzz(base_tables):
+    """Randomized construction soup through the batched vector path:
+    the same generator the batch-agreement fuzz uses, asserted
+    chunk-vector- and summary-exact against the scalar engine (device
+    sharpening, offset map-back, and the scalar fallback for
+    squeeze/retry docs all get hit)."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from test_batch_agreement import _fuzz_docs
+    from language_detector_tpu import native
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    if not native.available():
+        pytest.skip("native library unavailable")
+    docs = _fuzz_docs(48, seed=20260801)
+    eng = NgramBatchEngine(tables=base_tables)
+    got = eng.detect_batch(docs, return_chunks=True)
+    for t, g in zip(docs, got):
+        w = detect_scalar(t, base_tables, want_chunks=True)
+        gch = [(c.offset, c.bytes, c.lang1) for c in (g.chunks or [])]
+        wch = [(c.offset, c.bytes, c.lang1) for c in (w.chunks or [])]
+        assert gch == wch, (t[:60], gch[:5], wch[:5])
+        assert g.summary_lang == w.summary_lang, t[:60]
