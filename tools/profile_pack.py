@@ -28,7 +28,8 @@ NATIVE = REPO / "language_detector_tpu" / "native"
 # silently measure the wrong stage
 SCOPES = [
     ("void segment_text(const uint8_t* text, int text_len, "
-     "SegScratch* ss) {\n", 0),
+     "SegScratch* ss,\n                  bool collect_src = false) {\n",
+     0),
     ("int64_t scan_quad_round(const Span& sp, int64_t start,\n"
      "                        std::vector<Rec>* recs, int* n_quota,\n"
      "                        int* n_emit) {\n", 1),
@@ -36,7 +37,8 @@ SCOPES = [
      "                     std::vector<Rec>* recs, int* n_emit) {\n", 2),
     ("      int cum_entries = 0;  // consumed base entries, exclusive", 4),
     ("void build_span(const std::vector<uint32_t>& cur, int ulscript,\n"
-     "                Span* sp) {\n", 5),
+     "                Span* sp, const std::vector<int32_t>* src = "
+     "nullptr) {\n", 5),
     ("void pack_resolve_one_doc(const uint8_t* text, int text_len, "
      "int b,\n                          const ROut& o) {\n", 7),
 ]
