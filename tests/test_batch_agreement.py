@@ -318,3 +318,39 @@ def test_fuzz_multi_slice_deferred_retry(engine):
             for r in engine.detect_batch(docs)]
     got = engine.detect_codes(docs, batch_size=13)  # ragged multi-slice
     assert got == want
+
+
+def test_slices_invariants(engine):
+    """_slices guards the device memory bound: order-preserving, every
+    slice within the doc-count cap, every multi-doc slice within the
+    content budget (a single oversized doc may stand alone), and
+    balanced — no 3M + runt split of a 4.3M stream."""
+    rng = random.Random(7)
+    budget = engine.DISPATCH_CHAR_BUDGET
+    for case in range(6):
+        if case == 0:
+            docs = ["x" * rng.randint(50, 300) for _ in range(5000)]
+        elif case == 1:
+            docs = ["y" * rng.randint(1, 40000) for _ in range(300)]
+        elif case == 2:
+            docs = ["z" * (budget + 1000)]  # single over-budget doc
+        elif case == 3:
+            docs = []
+        elif case == 4:
+            docs = ["", "", "a"]
+        else:
+            docs = ["w" * rng.randint(100, 9000) for _ in range(2000)]
+        slices = list(engine._slices(docs, 1024))
+        flat = [t for s in slices for t in s]
+        assert flat == docs  # order + completeness
+        total = sum(len(t) for t in docs)
+        n_min = max(-(-total // budget), 1)
+        for s in slices:
+            assert len(s) <= 1024
+            vol = sum(len(t) for t in s)
+            assert vol <= budget or len(s) == 1
+            if docs:
+                # balance: no slice exceeds the even share by more
+                # than one document's worth
+                assert vol <= -(-total // n_min) + max(
+                    (len(t) for t in docs), default=0)
