@@ -531,7 +531,13 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
     max_nsl = ctypes.c_int32(0)
     if n_threads <= 0:
         import os
-        n_threads = min(16, 2 * (os.cpu_count() or 1) + 6)
+        # CPU-bound work: one worker per core. Oversubscribing a
+        # single-core host costs ~4ms/16K-doc batch in fresh-thread
+        # page faults and context switches (workers spawn per batch,
+        # so their thread-local scratch never stays warm), while the
+        # nt=1 path packs on the calling thread with persistent
+        # scratch.
+        n_threads = min(16, os.cpu_count() or 1)
     handle = lib.ldt_pack_flat_begin(
         _ptr(blob, np.uint8), _ptr(bounds, np.int64),
         ctypes.c_int32(B), ctypes.c_int32(l_doc), ctypes.c_int32(c_doc),
