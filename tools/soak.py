@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""End-to-round divergence soak: every engine path vs the scalar oracle.
+
+Runs thousands of randomized fuzz documents (the construction soup from
+tests/test_batch_agreement.py) through each production path and counts
+exact-result mismatches against the scalar engine — the strongest
+whole-system check the repo has, used as the round-end stability bake:
+
+  plain    detect_batch, full ScalarResult tuple equality
+  codes    multi-slice detect_codes (ragged slices force the deferred
+           cross-slice gate-retry path)
+  hints    TLD + content-language hints
+  html     is_plain_text=False with rotating lang= attributes
+  vectors  return_chunks: per-range vector AND summary equality
+  c-abi    raw ctypes detect_language_n vs the device engine
+
+Exits non-zero on any mismatch. Usage: python3 tools/soak.py [scale]
+(scale multiplies the per-path document counts; default 1 ~ 4K docs,
+a few minutes on the single-core host).
+"""
+from __future__ import annotations
+
+import ctypes
+import random
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+from language_detector_tpu import enable_jit_cache  # noqa: E402
+
+enable_jit_cache()
+
+
+def main(scale: int = 1) -> int:
+    from test_batch_agreement import _fuzz_docs
+
+    from language_detector_tpu import native
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.hints import CLDHints
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    from language_detector_tpu.registry import registry
+    from language_detector_tpu.tables import load_tables
+
+    eng = NgramBatchEngine()
+    failures = 0
+
+    def stuple(r):
+        return (r.summary_lang, list(r.language3), list(r.percent3),
+                r.text_bytes, r.is_reliable)
+
+    def report(name, bad, n):
+        nonlocal failures
+        failures += bad
+        print(f"{name:28s} {n - bad}/{n} exact", flush=True)
+
+    n = 2048 * scale
+    docs = _fuzz_docs(n, seed=99001)
+    got = eng.detect_batch(docs)
+    report("plain detect_batch", sum(
+        1 for t, g in zip(docs, got)
+        if stuple(g) != stuple(detect_scalar(t, eng.tables, eng.reg, 0))),
+        n)
+
+    codes = eng.detect_codes(docs, batch_size=257)
+    report("codes multi-slice+retry", sum(
+        1 for g, c in zip(got, codes)
+        if eng.reg.code(g.summary_lang) != c), n)
+
+    nh = 256 * scale
+    hdocs = _fuzz_docs(nh, seed=99002)
+    for hint in (CLDHints(tld_hint="fr"),
+                 CLDHints(content_language_hint="de,en")):
+        hgot = eng.detect_batch(hdocs, hints=hint)
+        report(f"hints {hint.tld_hint or hint.content_language_hint}",
+               sum(1 for t, g in zip(hdocs, hgot)
+                   if stuple(g) != stuple(detect_scalar(
+                       t, eng.tables, eng.reg, 0, hints=hint))), nh)
+
+    rng = random.Random(99003)
+    html_docs = [
+        f"<html lang='{rng.choice(['fr', 'ja', '', 'de'])}'>"
+        f"<p>{d[:400]}</p></html>"
+        for d in _fuzz_docs(nh, seed=99004)]
+    hg = eng.detect_batch(html_docs, is_plain_text=False)
+    report("html", sum(
+        1 for t, g in zip(html_docs, hg)
+        if stuple(g) != stuple(detect_scalar(
+            t, eng.tables, eng.reg, 0, is_plain_text=False))), nh)
+
+    nv = 192 * scale
+    vdocs = _fuzz_docs(nv, seed=99005)
+    vg = eng.detect_batch(vdocs, return_chunks=True)
+    vbad = 0
+    for t, g in zip(vdocs, vg):
+        w = detect_scalar(t, eng.tables, eng.reg, 0, want_chunks=True)
+        gch = [(c.offset, c.bytes, c.lang1) for c in (g.chunks or [])]
+        wch = [(c.offset, c.bytes, c.lang1) for c in (w.chunks or [])]
+        if gch != wch or g.summary_lang != w.summary_lang:
+            vbad += 1
+    report("chunk vectors", vbad, nv)
+
+    native.ensure_init(load_tables(), registry)
+    lib = ctypes.CDLL(str(Path(native.__file__).parent /
+                          "libldtpack.so"))
+    lib.detect_language_n.restype = ctypes.c_char_p
+    lib.detect_language_n.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    nc = 1024 * scale
+    cdocs = _fuzz_docs(nc, seed=99010)
+    cwant = eng.detect_codes(cdocs, batch_size=16384)
+    cbad = 0
+    for t, w in zip(cdocs, cwant):
+        enc = t.encode("utf-8", "surrogatepass")
+        if lib.detect_language_n(enc, len(enc)).decode() != w:
+            cbad += 1
+    report("raw C ABI", cbad, nc)
+
+    print("SOAK", "CLEAN" if failures == 0 else f"FAILED ({failures})")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(int(a) for a in sys.argv[1:])))
