@@ -146,3 +146,58 @@ def test_supervisor_forwards_sigterm(tmp_path):
     finally:
         if p.poll() is None:
             p.kill()
+
+
+def test_aioserver_recycles_end_to_end():
+    """The asyncio (production) front's recycle path: a device-sized
+    request trips LDT_MAX_DISPATCHES=1 and the worker exits with
+    RECYCLE_EXIT_CODE even while an idle keep-alive connection is held
+    open (Server.wait_closed on 3.12.1+ waits for every accepted
+    connection; the watcher aborts survivors first)."""
+    import socket
+    env = {**os.environ, "LISTEN_PORT": "0", "PROMETHEUS_PORT": "0",
+           "LDT_MAX_DISPATCHES": "1", "LDT_RECYCLE_CHECK_SEC": "0.2",
+           "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
+    p = subprocess.Popen(
+        [sys.executable, "-m",
+         "language_detector_tpu.service.aioserver"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    idle = None
+    try:
+        port = mport = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = p.stdout.readline()
+            if "listening on" in line:
+                msg = json.loads(line)["msg"]
+                port = int(msg.split(":")[1].split(",")[0])
+                mport = int(msg.rsplit(":", 1)[1])
+                break
+        assert port, "aioserver never reported its ports"
+        # idle keep-alive socket on the metrics port (scraper scenario)
+        idle = socket.create_connection(("127.0.0.1", mport), timeout=5)
+        idle.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        idle.recv(64)
+        docs = [{"text": f"bonjour le monde numero {i}"}
+                for i in range(100)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"request": docs}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = urllib.request.urlopen(req, timeout=90).read()
+        assert body.count(b"iso6391code") == 100
+        try:
+            rc = p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate(timeout=10)
+            raise AssertionError(
+                f"aio worker did not recycle; stdout={out[-400:]!r} "
+                f"stderr={err[-400:]!r}")
+        assert rc == RECYCLE_EXIT_CODE, (rc, p.stderr.read()[-500:])
+    finally:
+        if idle is not None:
+            idle.close()
+        if p.poll() is None:
+            p.kill()
