@@ -22,6 +22,24 @@ echo "== graft entry =="
 python3 __graft_entry__.py
 
 echo "== bench smoke =="
-python3 bench.py --smoke
+python3 bench.py --smoke | tee /tmp/ldt_bench_smoke.out
+# scheduler invariants on the smoke numbers: the mixed corpus must
+# never hit the packer-fallback path, and the bucketed-scheduler
+# counters (cache hit rate, per-tier dispatches, dedup) must report
+python3 - <<'EOF'
+import json
+line = [ln for ln in open("/tmp/ldt_bench_smoke.out")
+        if ln.startswith("{")][-1]
+d = json.loads(line)["detail"]
+assert d["mixed_fallback_docs"] == 0, \
+    f"mixed_fallback_docs = {d['mixed_fallback_docs']} (want 0)"
+assert d["cache_hit_rate"] is not None and d["cache_hit_rate"] > 0, \
+    f"cache_hit_rate = {d['cache_hit_rate']} (want > 0)"
+print("bucketed scheduler:",
+      "cache_hit_rate", d["cache_hit_rate"],
+      "| tier_dispatches", d["tier_dispatches"],
+      "| dedup_docs", d["mixed_dedup_docs"],
+      "| retry_lane_dispatches", d["mixed_retry_lane_dispatches"])
+EOF
 
 echo "CI OK"

@@ -184,6 +184,26 @@ def _format_trace_html(trace: DetectionTrace, reg: Registry) -> str:
             + "\n".join(rows) + "</body></html>")
 
 
+def format_engine_stats(stats: dict) -> str:
+    """Human-readable render of the batched engine's scheduler counters
+    (models/ngram.py NgramBatchEngine.stats / detector.engine_stats):
+    dispatch lanes per shape tier, retry-lane overlap, dedup savings,
+    fallback/recursion volume. The service /metrics endpoint exports the
+    same counters as Prometheus series; this is their terminal twin for
+    bench output and the CLI."""
+    order = ["batches", "device_dispatches", "c_path_docs",
+             "tier_short_dispatches", "tier_mid_dispatches",
+             "tier_long_dispatches", "tier_mixed_dispatches",
+             "retry_lane_dispatches", "dedup_docs",
+             "fallback_docs", "scalar_recursion_docs"]
+    keys = ([k for k in order if k in stats] +
+            sorted(k for k in stats if k not in order))
+    if not keys:
+        return "(no engine stats)"
+    w = max(len(k) for k in keys)
+    return "\n".join(f"{k:<{w}}  {stats[k]}" for k in keys)
+
+
 def _main(argv=None):
     """CLI harness (the reference's compact_lang_det_test.cc interactive
     tool): text from args/stdin -> summary + optional score trace and
@@ -207,7 +227,22 @@ def _main(argv=None):
     ap.add_argument("--render-html", metavar="FILE",
                     help="write the colored per-chunk HTML dump to FILE "
                          "(the kCLDFlagHtml debug render)")
+    ap.add_argument("--engine-stats", action="store_true",
+                    help="run the input through the batched engine "
+                         "(each arg / stdin line = one document) and "
+                         "print the scheduler's dispatch/tier/dedup "
+                         "counters instead of a scalar trace")
     args = ap.parse_args(argv)
+    if args.engine_stats:
+        docs = list(args.text) if args.text \
+            else [ln for ln in sys.stdin.read().splitlines() if ln]
+        from .models.ngram import NgramBatchEngine
+        eng = NgramBatchEngine()
+        for d, r in zip(docs, eng.detect_many(docs)):
+            code = default_registry.code(r.summary_lang)
+            print(f"{code:4s} {d[:60]!r}")
+        print(format_engine_stats(eng.stats))
+        return 0
     text = " ".join(args.text) if args.text else sys.stdin.read()
 
     tr = trace_detect(text, is_plain_text=not args.html,

@@ -142,6 +142,17 @@ class LanguageDetector:
                               return_chunks=return_chunks)
         return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
+    def engine_stats(self) -> dict:
+        """Snapshot of the batched engine's scheduler counters (batches,
+        device dispatches, per-tier lanes, retry lane, dedup — see
+        models/ngram.py NgramBatchEngine.stats). {} when the batched
+        engine is unavailable or not yet built; never builds one."""
+        eng = self._batch_engine or None
+        if eng is None:
+            return {}
+        with eng._stats_lock:
+            return dict(eng.stats)
+
     def _get_batch_engine(self):
         if self._batch_engine is None:
             try:

@@ -63,6 +63,12 @@ class NgramBatchEngine:
     _bulk_lock = __import__("threading").Lock()
     _bulk_depth = 0
     _bulk_saved = (True, 0.005)
+    # bulk calls completed since the last forced gc.collect(): under
+    # sustained overlapping flushes the pause depth may never return to
+    # 0, so cyclic garbage made by OTHER threads while the GC is paused
+    # must be bounded by forcing a collection every N bulk exits
+    _bulk_since_collect = 0
+    GC_COLLECT_EVERY = 64
 
     def __init__(self, tables: ScoringTables | None = None,
                  reg: Registry | None = None, flags: int = 0,
@@ -103,7 +109,18 @@ class NgramBatchEngine:
                       # DEVICE program launches (excludes the all-C tiny
                       # path) — what the recycle watcher meters, since
                       # the tunneled plugin's RSS leak is per dispatch
-                      "device_dispatches": 0}
+                      "device_dispatches": 0,
+                      # bucketed-scheduler counters: dispatches per shape
+                      # tier ("mixed" = small streams that skip the tier
+                      # split), retry-lane dispatches (gate recursions
+                      # overlapped with main lanes), and documents
+                      # answered by batch-internal dedup
+                      "tier_short_dispatches": 0,
+                      "tier_mid_dispatches": 0,
+                      "tier_long_dispatches": 0,
+                      "tier_mixed_dispatches": 0,
+                      "retry_lane_dispatches": 0,
+                      "dedup_docs": 0}
         import threading
         self._stats_lock = threading.Lock()
 
@@ -321,9 +338,15 @@ class NgramBatchEngine:
         process-global, so a depth counter makes overlapping bulk
         calls from different threads safe: the first entry saves and
         sets, the last exit restores (naive save/restore would leave a
-        stale value behind whichever call exits last). Trade-off:
-        cycles made by OTHER threads during the call collect after it
-        returns."""
+        stale value behind whichever call exits last).
+
+        Cyclic garbage made by OTHER threads while the GC is paused is
+        bounded two ways: a forced gc.collect() every GC_COLLECT_EVERY
+        bulk exits (under sustained overlapping service flushes the
+        pause depth may NEVER reach 0, so exit-only collection would be
+        unbounded), and the normal re-enable when depth does return to
+        0. The collect runs outside the lock — it can take tens of ms
+        and must not stall other flushes' enter/exit."""
         import gc
         import sys
         cls = NgramBatchEngine
@@ -338,57 +361,241 @@ class NgramBatchEngine:
         try:
             yield
         finally:
+            collect_now = False
             with cls._bulk_lock:
                 cls._bulk_depth -= 1
+                cls._bulk_since_collect += 1
                 if cls._bulk_depth == 0:
                     was_enabled, prev_si = cls._bulk_saved
                     sys.setswitchinterval(prev_si)
                     if was_enabled:
                         gc.enable()
+                if cls._bulk_since_collect >= cls.GC_COLLECT_EVERY:
+                    cls._bulk_since_collect = 0
+                    collect_now = True
+            if collect_now:
+                gc.collect()
+
+    # Streams with more unique documents than this partition into
+    # per-tier dispatch lanes (the preprocess.pack shape-tier ladder);
+    # smaller streams keep one mixed lane — every dispatch pays the
+    # backend's fixed ~95ms latency, so splitting a small flush three
+    # ways buys nothing and costs two extra launches.
+    TIER_MIN_DOCS = 1024
+
+    # A tier lane below this many docs folds into the next wider lane
+    # instead of paying its own dispatch (e.g. a mixed stream whose
+    # "mid" tier holds 74 docs). Routing-only, like the ladder itself.
+    TIER_COALESCE_MIN = 256
+
+    # Retry lane: gate-failed docs accumulate across slices and dispatch
+    # as soon as this many are pending, overlapping the recursion pass
+    # with still-running main lanes instead of serializing one batched
+    # pass at stream end. Smaller residues flush during the drain.
+    RETRY_LANE_MIN = 64
 
     def detect_many(self, texts: list[str],
                     batch_size: int = 16384) -> list:
-        """Multi-batch detection with host/device pipelining; returns
-        ScalarResult-compatible rows (EpilogueResult views; scalar-path
-        docs get real ScalarResults). Sustained-throughput entry point
-        for the service layer and bench."""
+        """Multi-batch detection through the shape-bucketed scheduler;
+        returns ScalarResult-compatible rows (EpilogueResult views;
+        scalar-path docs get real ScalarResults). Sustained-throughput
+        entry point for the service layer and bench."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
         with self._gc_paused():
-            parts, patches = self._detect_stream(texts, batch_size,
-                                                 self._finish)
-            out = [r for part in parts for r in part]
-            for g, r in patches.items():
-                out[g] = r
-        return out
+            return self._detect_stream(texts, batch_size, self._finish)
 
     def _detect_stream(self, texts: list[str], batch_size: int,
-                       finish_fn):
-        """Pipeline the stream with per-slice DEFERRED gate retries,
-        then run ONE batched recursion pass for the whole stream
-        (per-slice retries would serialize a device round per slice).
-        Returns (per-slice parts, {global index: ScalarResult})."""
-        parts: list = []
-        all_deferred: list = []  # (global index, text, squeezed)
-        n = 0
+                       finish_fn, patch_value=None):
+        """Shape-bucketed stream scheduler. Three moves on top of the
+        round-5 pipeline:
 
-        def finish(texts_, cb, fut):
+        1. batch-internal DEDUP: each distinct text is scored once and
+           its result fanned out to every duplicate position (hot
+           documents — retweets, boilerplate, spam — are the dominant
+           repeat pattern at service scale);
+        2. TIER PARTITION: unique docs split by estimated slot demand
+           into the pack ladder's short/mid/long lanes, so each lane's
+           slices bucket-pad against peers instead of the global worst
+           case (an all-one-tier stream degenerates to exactly the old
+           single-lane behavior);
+        3. pipelined RETRY LANE: gate-failed docs aggregate across
+           slices and re-dispatch mid-stream on the same worker pool,
+           overlapping the recursion with the next main batch instead
+           of serializing one pass after the stream.
+
+        finish_fn is _finish or _finish_codes (must accept deferred=);
+        patch_value converts a retry/fallback ScalarResult into the
+        stream's value type (identity for results, summary id for
+        codes). Returns the complete per-doc value list in input
+        order."""
+        if patch_value is None:
+            patch_value = lambda r: r  # noqa: E731
+        out: list = [None] * len(texts)
+        # -- dedup: first occurrence scores, the rest copy ------------
+        first: dict = {}
+        uniq_idx: list = []   # global index of each unique doc
+        uniq_txt: list = []
+        dups: list = []       # (duplicate global index, unique position)
+        for i, t in enumerate(texts):
+            p = first.get(t)
+            if p is None:
+                first[t] = len(uniq_txt)
+                uniq_idx.append(i)
+                uniq_txt.append(t)
+            else:
+                dups.append((i, p))
+        if dups:
+            with self._stats_lock:
+                self.stats["dedup_docs"] += len(dups)
+        # -- tier partition + per-lane volume slicing -----------------
+        from ..preprocess.pack import N_TIERS, TIER_NAMES, tier_of_text
+        if len(uniq_txt) > self.TIER_MIN_DOCS:
+            by_tier: list = [[] for _ in range(N_TIERS)]
+            for p, t in enumerate(uniq_txt):
+                by_tier[tier_of_text(t)].append(p)
+            # coalesce undersized lanes upward into the next wider
+            # budget (routing-only: a wider lane holds smaller docs
+            # bit-exactly) — a near-empty lane is a full dispatch
+            # latency spent on a handful of docs. The widest lane
+            # never coalesces: isolating the fat tail from the main
+            # lane is the point of the ladder.
+            for k in range(N_TIERS - 1):
+                if 0 < len(by_tier[k]) < self.TIER_COALESCE_MIN:
+                    by_tier[k + 1] = sorted(by_tier[k] + by_tier[k + 1])
+                    by_tier[k] = []
+            lanes = [(TIER_NAMES[k], lane)
+                     for k, lane in enumerate(by_tier) if lane]
+        else:
+            lanes = [("mixed", list(range(len(uniq_txt))))]
+        jobs: list = []  # (tier name, global indices, texts)
+        for name, lane in lanes:
+            ltxt = [uniq_txt[p] for p in lane]
+            for s, e in self._slice_bounds([len(t) for t in ltxt],
+                                           batch_size):
+                jobs.append((name,
+                             [uniq_idx[lane[p]] for p in range(s, e)],
+                             ltxt[s:e]))
+        # -- dispatch -------------------------------------------------
+        if len(jobs) == 1:
+            # single-dispatch fast path (the service batcher's common
+            # flush): no pool, local deferred retry as before
+            name, idxs, txts = jobs[0]
+            self._count_tier(name)
+            cb = self._pack(txts)
             d: list = []
-            return finish_fn(texts_, cb, fut, deferred=d), d
+            vals = finish_fn(txts, cb, self._score_fn(self.dt, cb.wire),
+                             deferred=d)
+            for g, v in zip(idxs, vals):
+                out[g] = v
+            for g, r in self._retry_deferred(
+                    [(idxs[b], t, sq) for b, t, sq in d]).items():
+                out[g] = patch_value(r)
+        elif jobs:
+            self._run_scheduler(jobs, batch_size, finish_fn,
+                                patch_value, out)
+        for i, p in dups:
+            out[i] = out[uniq_idx[p]]
+        return out
 
-        for part, d in self._pipelined(texts, batch_size, finish):
-            for b, t, sq in d:
-                all_deferred.append((n + b, t, sq))
-            parts.append(part)
-            n += len(part)
-        return parts, self._retry_deferred(all_deferred)
+    def _run_scheduler(self, jobs, batch_size, finish_fn, patch_value,
+                       out):
+        """Multi-lane pipeline with the overlapped retry lane. The main
+        thread only packs (C++, GIL-released); pool workers launch the
+        device program and run the epilogue (same depth-3 structure as
+        _pipelined_jobs — see its docstring for why 3). Main jobs drop
+        their gate failures into per-flag retry bins; whenever a bin
+        reaches RETRY_LANE_MIN the bin re-packs and dispatches as a
+        retry job on the SAME pending queue, so recursion rounds overlap
+        main-lane scoring. Retry jobs carry FINISH so they can never
+        defer again — the drain loop terminates."""
+        import threading
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        from .. import native
 
-    def _pipelined(self, texts: list[str], batch_size: int, finish):
-        """Slice texts by count + content volume and pipeline them;
-        yields finish()'s per-slice values in order."""
-        yield from self._pipelined_jobs(
-            self._slices(texts, batch_size),
-            self._pack, finish)
+        retry_lock = threading.Lock()
+        retry_bins = {False: [], True: []}  # squeezed -> [(gidx, text)]
+
+        def run_main(idxs, txts, cb):
+            fut = self._score_fn(self.dt, cb.wire)
+            d: list = []
+            vals = finish_fn(txts, cb, fut, deferred=d)
+            if d:
+                with self._stats_lock:
+                    self.stats["scalar_recursion_docs"] += len(d)
+                with retry_lock:
+                    for b, t, sq in d:
+                        retry_bins[sq].append((idxs[b], t))
+            return ("main", idxs, vals)
+
+        def run_retry(idxs, txts, cb, flags):
+            rows = unpack_chunks_out(
+                np.asarray(self._score_fn(self.dt, cb.wire)),
+                cb.wire["cmeta"])
+            with self._stats_lock:
+                self.stats["device_dispatches"] += 1
+                self.stats["retry_lane_dispatches"] += 1
+            ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
+            patches: dict = {}
+            for b, text in enumerate(txts):
+                # FINISH pass: a doc the packer still can't place, or
+                # that still fails the (now forced) gate, goes scalar —
+                # identical to _score_with_flags resolution
+                if cb.fallback[b] or ep[b, 12]:
+                    patches[idxs[b]] = detect_scalar(
+                        text, self.tables, self.reg, self.flags)
+                else:
+                    patches[idxs[b]] = _result_from_row(ep[b])
+            return ("retry", patches)
+
+        pending: deque = deque()
+
+        def collect(res):
+            if res[0] == "main":
+                _, idxs, vals = res
+                for g, v in zip(idxs, vals):
+                    out[g] = v
+            else:
+                for g, r in res[1].items():
+                    out[g] = patch_value(r)
+
+        with ThreadPoolExecutor(3) as pool:
+
+            def submit_retries(min_docs):
+                grabbed = []
+                with retry_lock:
+                    for sq in (False, True):
+                        if len(retry_bins[sq]) >= max(min_docs, 1):
+                            grabbed.append((sq, retry_bins[sq]))
+                            retry_bins[sq] = []
+                for sq, group in grabbed:
+                    flags = self._retry_flags(sq)
+                    gidx = [g for g, _ in group]
+                    gtxt = [t for _, t in group]
+                    for s, e in self._slice_bounds(
+                            [len(t) for t in gtxt], batch_size):
+                        cb = self._pack(gtxt[s:e], flags=flags)
+                        pending.append(pool.submit(
+                            run_retry, gidx[s:e], gtxt[s:e], cb, flags))
+
+            for name, idxs, txts in jobs:
+                self._count_tier(name)
+                cb = self._pack(txts)
+                pending.append(pool.submit(run_main, idxs, txts, cb))
+                while len(pending) > 3:
+                    collect(pending.popleft().result())
+                submit_retries(self.RETRY_LANE_MIN)
+            # drain: once pending empties no worker is running, so the
+            # bins are stable and min_docs=1 flushes the residue
+            while pending or retry_bins[False] or retry_bins[True]:
+                if pending:
+                    collect(pending.popleft().result())
+                submit_retries(self.RETRY_LANE_MIN if pending else 1)
+
+    def _count_tier(self, name: str) -> None:
+        with self._stats_lock:
+            self.stats[f"tier_{name}_dispatches"] += 1
 
     def _pipelined_jobs(self, jobs, pack, finish):
         """Shared pipeline core: the main thread ONLY packs (C++,
@@ -438,20 +645,27 @@ class NgramBatchEngine:
         slices overlap on the pipeline, a runt tail mostly waits
         (never exceeding DISPATCH_CHAR_BUDGET, the device memory
         bound)."""
-        total = sum(len(t) for t in texts)
+        for s, e in self._slice_bounds([len(t) for t in texts],
+                                       batch_size):
+            yield texts[s:e]
+
+    def _slice_bounds(self, lengths: list[int], batch_size: int):
+        """_slices' core over lengths alone: yields (start, end) bounds
+        so the bucketed scheduler can slice index lists without building
+        intermediate text lists. Same balanced-volume contract."""
+        total = sum(lengths)
         n_slices = max(-(-total // self.DISPATCH_CHAR_BUDGET), 1)
         target = max(-(-total // n_slices), 1)
-        out: list[str] = []
+        start = 0
         vol = 0
-        for t in texts:
-            if out and (len(out) >= batch_size or
-                        vol + len(t) > target):
-                yield out
-                out, vol = [], 0
-            out.append(t)
-            vol += len(t)
-        if out:
-            yield out
+        for i, ln in enumerate(lengths):
+            if i > start and (i - start >= batch_size or
+                              vol + ln > target):
+                yield start, i
+                start, vol = i, 0
+            vol += ln
+        if start < len(lengths):
+            yield start, len(lengths)
 
     def _pack(self, texts: list[str], flags: int | None = None,
               hint_boosts: list | None = None):
@@ -589,12 +803,11 @@ class NgramBatchEngine:
                         self.stats.get("c_path_docs", 0) + len(texts)
                 return self.reg.lang_code[ids].tolist()
         with self._gc_paused():
-            parts, patches = self._detect_stream(texts, batch_size,
-                                                 self._finish_codes)
-            ids = np.concatenate(parts) if parts \
-                else np.zeros(0, np.int32)
-            for g, r in patches.items():
-                ids[g] = r.summary_lang
+            vals = self._detect_stream(
+                texts, batch_size, self._finish_codes,
+                patch_value=lambda r: int(r.summary_lang))
+        ids = np.fromiter((int(v) for v in vals), np.int32,
+                          count=len(vals))
         return self.reg.lang_code[ids].tolist()
 
     def _score_with_flags(self, texts: list[str],

@@ -71,7 +71,11 @@ def _host_isa() -> str:
     """Fingerprint of this host's instruction set (build.sh writes the
     builder's into the .host sidecar). A mismatch means the cached .so
     was -march=native-compiled on different hardware — loading it risks
-    SIGILL, so the loader rebuilds instead."""
+    SIGILL, so the loader rebuilds instead. md5 here is a checksum, not
+    crypto (it matches build.sh's md5sum) — declared as such so FIPS
+    OpenSSL builds allow it; where even that raises (md5 compiled out
+    entirely), a constant that can never match any md5sum sidecar makes
+    the loader rebuild once instead of crashing every native load."""
     import hashlib
     import platform
     flags = b""
@@ -82,16 +86,31 @@ def _host_isa() -> str:
                 break
     except OSError:
         pass
-    digest = hashlib.md5(flags).hexdigest()
+    try:
+        digest = hashlib.md5(flags, usedforsecurity=False).hexdigest()
+    except ValueError:
+        digest = "md5-unavailable"
     return f"{platform.machine()}\n{digest}  -\n"
 
 
-def _isa_matches() -> bool:
-    sidecar = _SO.with_suffix(".so.host")
+def _sidecar_ok(so: Path) -> bool:
+    """ISA check for one .so via its .host sidecar. A MISSING or
+    unreadable sidecar next to an existing .so means "ISA unknown, load
+    anyway": read-only installs (containers, wheels) can never write
+    sidecars, and rebuild-once-per-check would turn into
+    rebuild-every-process there. Only a sidecar that EXISTS and
+    disagrees forces a rebuild."""
+    sidecar = so.with_suffix(".so.host")
     try:
+        if not sidecar.exists():
+            return True
         return sidecar.read_text() == _host_isa()
     except OSError:
-        return False  # no sidecar: pre-sidecar build, rebuild once
+        return True  # unreadable: treat as unknown, load anyway
+
+
+def _isa_matches() -> bool:
+    return _sidecar_ok(_SO)
 
 
 def _load():
@@ -161,8 +180,7 @@ def _load_glue():
             fresh = (so.exists()
                      and so.stat().st_mtime >=
                      (_DIR / "pyglue.c").stat().st_mtime
-                     and so.with_suffix(".so.host").read_text()
-                     == _host_isa())
+                     and _sidecar_ok(so))
         except OSError:
             fresh = False
         g = _try_load_glue(so) if fresh else None
@@ -183,8 +201,7 @@ def _load_glue():
                     if (so.exists()
                             and so.stat().st_mtime >=
                             (_DIR / "pyglue.c").stat().st_mtime
-                            and so.with_suffix(".so.host").read_text()
-                            == _host_isa()):
+                            and _sidecar_ok(so)):
                         g = _try_load_glue(so)
                 except Exception:  # noqa: BLE001 - fall back quietly
                     g = None

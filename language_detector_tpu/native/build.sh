@@ -11,6 +11,21 @@
 #   LDT_EXTRA_FLAGS  extra compile flags (e.g. -DLDT_PROF)
 set -e
 cd "$(dirname "$0")"
+
+# ISA sidecar writer. LOUD on failure: a silently missing sidecar used
+# to force a rebuild every process; the loader now treats missing as
+# "unknown, load anyway" (read-only installs), but an unwritable build
+# dir is still worth a warning — this build just wrote a .so there.
+write_sidecar() {
+    if ! { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
+            > "$1"; then
+        echo "WARNING: could not write ISA sidecar $1;" \
+             "the loader will treat $2 as unknown-ISA and load it" \
+             "anyway (SIGILL risk if this tree moves to different" \
+             "hardware)" >&2
+    fi
+}
+
 if [ "${1:-}" = "--glue-only" ]; then
     # rebuild ONLY the marshalling helper: never rewrite libldtpack.so
     # in place — it may be dlopen'd by the calling process already.
@@ -21,8 +36,7 @@ if [ "${1:-}" = "--glue-only" ]; then
             2>/dev/null || true)}"
     if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
         gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c
-        { uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
-            > libldtglue.so.host 2>/dev/null || true
+        write_sidecar libldtglue.so.host libldtglue.so
         echo "built $(pwd)/libldtglue.so"
     fi
     exit 0
@@ -36,8 +50,7 @@ OUT="${1:-libldtpack.so}"
 g++ -O3 -march=native -funroll-loops ${LDT_EXTRA_FLAGS:-} \
     -shared -fPIC -std=c++17 \
     -o "$OUT" "${LDT_SRC:-packer.cc}" epilogue.cc -lpthread
-{ uname -m; grep -m1 '^flags' /proc/cpuinfo 2>/dev/null | md5sum; } \
-    > "$OUT.host" 2>/dev/null || true
+write_sidecar "$OUT.host" "$OUT"
 echo "built $(pwd)/$OUT"
 # Optional GIL-held marshalling helper (ctypes.PyDLL; symbols resolve
 # from the running interpreter, no libpython link). Best effort: hosts
@@ -45,7 +58,11 @@ echo "built $(pwd)/$OUT"
 PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])' \
         2>/dev/null || true)"
 if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
-    gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c && \
-        cp "$OUT.host" libldtglue.so.host 2>/dev/null && \
-        echo "built $(pwd)/libldtglue.so" || true
+    if gcc -O2 -shared -fPIC -I"$PYINC" -o libldtglue.so pyglue.c; then
+        write_sidecar libldtglue.so.host libldtglue.so
+        echo "built $(pwd)/libldtglue.so"
+    else
+        echo "WARNING: glue build failed; keeping the pure-Python" \
+             "marshalling path" >&2
+    fi
 fi

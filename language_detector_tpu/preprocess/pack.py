@@ -30,6 +30,49 @@ from .squeeze import TEST_THRESH, cheap_squeeze_trigger_test
 PAD, SEED, QUAD, UNI, DELTA_OCTA, DISTINCT_OCTA, BI_DELTA, BI_DISTINCT = \
     range(8)
 
+# -- shape-tier ladder (bucketed batch scheduler) ---------------------------
+#
+# The scheduler in models/ngram.py partitions large streams by estimated
+# per-doc slot demand into a small fixed ladder of dispatch lanes, so the
+# ~160-byte median service doc shares padded shapes with its peers instead
+# of with the 100KB tail. Budgets are SLOT counts (the wire's padded unit);
+# the estimate is deliberately cheap — one len() per doc — because it runs
+# on the packing hot path. ~1 candidate slot per 4 text chars holds across
+# the corpus mix (Latin quads + word grams dominate), plus a fixed floor
+# for the per-span seed/dummy slots of short docs.
+#
+# Two budgets -> three tiers:
+#   short  <= 128 slots  (~0.5KB of text: tweets, chat, the service median)
+#   mid    <= 1024 slots (~4KB: articles, product pages)
+#   long   everything else (the heavy tail gets its own lane)
+SLOT_TIER_BUDGETS = (128, 1024)
+TIER_NAMES = ("short", "mid", "long")
+N_TIERS = len(SLOT_TIER_BUDGETS) + 1
+_TIER_BASE_SLOTS = 8
+
+
+def est_slot_demand(text: str) -> int:
+    """Cheap per-doc slot-demand estimate for tier routing: a fixed
+    per-span floor plus ~1 slot per 4 chars. Routing only — the packer
+    still computes exact n_slots; a misrouted doc just pads a little
+    more, it can never change results."""
+    return _TIER_BASE_SLOTS + (len(text) >> 2)
+
+
+def tier_of_text(text: str) -> int:
+    """Tier index (0..N_TIERS-1) for a document."""
+    est = est_slot_demand(text)
+    for k, budget in enumerate(SLOT_TIER_BUDGETS):
+        if est <= budget:
+            return k
+    return N_TIERS - 1
+
+
+def tier_max_chars(k: int) -> int:
+    """Largest text length (in chars) routed to tier k — the exact
+    bucket boundary, for boundary-parity tests and the soak."""
+    return (SLOT_TIER_BUDGETS[k] - _TIER_BASE_SLOTS) * 4 + 3
+
 # Kinds that count as base hits (chunk quota; UNIHIT/QUADHIT analogue)
 BASE_KINDS = (SEED, QUAD, UNI)
 

@@ -19,11 +19,16 @@ import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 
-from .batcher import _FLUSH_WORKERS
+from .batcher import _FLUSH_WORKERS, _MISS, ResultCache
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
                      parse_post_body, post_detect, pre_detect)
 
 _MAX_HEADER_BYTES = 16384
+
+# planned recycle: bounded window for in-flight handlers to finish
+# their response before their sockets are aborted too
+_RECYCLE_DRAIN_SEC = float(os.environ.get("LDT_RECYCLE_DRAIN_SEC",
+                                          "5.0") or 5.0)
 
 
 class AioBatcher:
@@ -32,7 +37,7 @@ class AioBatcher:
     resolve asyncio futures back on the loop."""
 
     def __init__(self, detect_fn, max_batch: int = 16384,
-                 max_delay_ms: float = 5.0):
+                 max_delay_ms: float = 5.0, cache_bytes: int = 0):
         self._detect = detect_fn
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
@@ -40,6 +45,13 @@ class AioBatcher:
         self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
                                         thread_name_prefix="ldt-aioflush")
         self._task: asyncio.Task | None = None
+        # same LRU result cache as the sync Batcher (this front has no
+        # per-request hints, so the key is just the exact text)
+        self._cache = ResultCache(cache_bytes) if cache_bytes > 0 \
+            else None
+
+    def cache_stats(self) -> dict | None:
+        return self._cache.stats() if self._cache is not None else None
 
     def start(self):
         self._task = asyncio.get_running_loop().create_task(
@@ -78,9 +90,31 @@ class AioBatcher:
                 n += len(nxt[0])
             await slots.acquire()
             texts = [t for ts, _ in pending for t in ts]
-            task = loop.run_in_executor(self._pool, self._detect, texts)
 
-            def _done(ftr, pending=pending):
+            def _resolve(results, pending=pending):
+                i = 0
+                for ts, fut in pending:
+                    if not fut.done():
+                        fut.set_result(results[i:i + len(ts)])
+                    i += len(ts)
+
+            if self._cache is not None:
+                vals = [self._cache.get((None, t)) for t in texts]
+                miss = [i for i, v in enumerate(vals) if v is _MISS]
+                if not miss:
+                    slots.release()
+                    _resolve(vals)
+                    continue
+            else:
+                vals, miss = None, None
+            miss_texts = texts if miss is None \
+                else [texts[i] for i in miss]
+            task = loop.run_in_executor(self._pool, self._detect,
+                                        miss_texts)
+
+            def _done(ftr, pending=pending, vals=vals, miss=miss,
+                      texts=texts, miss_texts=miss_texts,
+                      _resolve=_resolve):
                 slots.release()
                 err = ftr.exception()
                 if err is not None:
@@ -89,11 +123,13 @@ class AioBatcher:
                             fut.set_exception(err)
                     return
                 results = ftr.result()
-                i = 0
-                for ts, fut in pending:
-                    if not fut.done():
-                        fut.set_result(results[i:i + len(ts)])
-                    i += len(ts)
+                if miss is None:
+                    _resolve(results)
+                    return
+                for i, v in zip(miss, results):
+                    vals[i] = v
+                    self._cache.put((None, texts[i]), v, texts[i])
+                _resolve(vals)
             task.add_done_callback(_done)
 
 
@@ -127,7 +163,12 @@ class AioService:
             self.svc.batcher.close()
             self.svc.batcher = None
         self.batcher = AioBatcher(self.svc._detect, max_batch,
-                                  max_delay_ms)
+                                  max_delay_ms,
+                                  cache_bytes=self.svc.cache_bytes)
+        if self.batcher._cache is not None:
+            # the sync Batcher (just closed, if any) registered its own
+            # unused cache; the gauges must read the live one
+            self.svc.metrics.cache_stats = self.batcher.cache_stats
         self._usage = json.dumps(USAGE).encode()
         self.recycling = False  # set by _recycle_watch; read by serve()
         # open client connections: the recycle path must force-close
@@ -135,6 +176,10 @@ class AioService:
         # socket would otherwise pin Server.wait_closed() forever on
         # Python 3.12.1+, which waits for every accepted connection)
         self._writers: set = set()
+        # connections currently INSIDE a request (body read -> response
+        # drained): the recycle watcher aborts idle sockets immediately
+        # but gives these a bounded window to finish their response
+        self._busy: set = set()
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
@@ -172,6 +217,7 @@ class AioService:
                 except ValueError:
                     length = 0
                 body = b""
+                self._busy.add(writer)
                 try:
                     if length > 0:
                         # truncate at the 1MB contract limit, draining
@@ -209,7 +255,10 @@ class AioService:
                     # the connection quietly (health probes and impatient
                     # clients would otherwise spam task tracebacks)
                     break
+                finally:
+                    self._busy.discard(writer)
         finally:
+            self._busy.discard(writer)
             self._writers.discard(writer)
             try:
                 writer.close()
@@ -270,10 +319,14 @@ class AioService:
                 except (asyncio.IncompleteReadError, ConnectionError,
                         asyncio.LimitOverrunError):
                     break
-                body = self.svc.metrics.render().encode()
-                writer.write(_http_response(
-                    200, body, b"text/plain; version=0.0.4"))
-                await writer.drain()
+                self._busy.add(writer)
+                try:
+                    body = self.svc.metrics.render().encode()
+                    writer.write(_http_response(
+                        200, body, b"text/plain; version=0.0.4"))
+                    await writer.drain()
+                finally:
+                    self._busy.discard(writer)
         finally:
             self._writers.discard(writer)
             try:
@@ -307,21 +360,38 @@ async def _recycle_watch(aio: "AioService", server, mserver):
             # flag + close; serve() swallows the resulting cancellation
             # and returns the recycle indicator so main() exits with the
             # code (exiting from THIS task would race the loop teardown
-            # cancelling it first). The drain + connection abort happen
+            # cancelling it first). The connection teardown happens
             # HERE: serve()'s `async with` exit awaits wait_closed()
             # DURING exception propagation — before any except clause —
             # and on 3.12.1+ that waits for every accepted connection,
             # so an idle keep-alive socket would pin the recycle forever
-            # unless aborted first.
+            # unless aborted first. IDLE sockets (not inside a request;
+            # a Prometheus scraper between scrapes, a pooled client
+            # between calls) abort immediately — there is no response to
+            # lose. Sockets with an IN-FLIGHT request get a bounded
+            # window to finish writing their response instead of the old
+            # fixed 0.5s guillotine, then any stragglers abort too.
             aio.recycling = True
             server.close()
             mserver.close()
-            await asyncio.sleep(0.5)  # drain in-flight responses
-            for w in list(aio._writers):
+
+            def _abort(w):
                 try:
                     w.transport.abort()
                 except Exception:  # noqa: BLE001 - already gone
                     pass
+
+            for w in list(aio._writers):
+                if w not in aio._busy:
+                    _abort(w)
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + _RECYCLE_DRAIN_SEC
+            while aio._busy and loop.time() < deadline:
+                await asyncio.sleep(0.05)
+            # stragglers past the bound + connections that went idle
+            # (and may have picked up a new request) since the sweep
+            for w in list(aio._writers):
+                _abort(w)
             return
 
 

@@ -1,5 +1,5 @@
 """Request batcher: many concurrent HTTP requests -> few large device
-batches.
+batches, with an optional bounded LRU result cache.
 
 The reference calls the detector once per item inside the handler loop
 (handlers.go:133-186, one cgo call each); the TPU redesign accumulates
@@ -11,26 +11,101 @@ arrived; flushes run on a small worker pool so batch N+1 accumulates and
 dispatches while batch N is still in flight on the device — without
 this, every flush pays the backend's full ~95ms dispatch latency
 serially and HTTP throughput collapses to flush_size/latency.
+
+The result cache (off by default, `cache_bytes` > 0 enables) keys on
+(hints_key, normalized text) — the service normalizes via strip_extras
+BEFORE submit, so equal keys imply byte-identical detector input.
+Entries from requests with different hint configurations can never
+serve each other: the hints_key is part of the key, full stop. At
+millions-of-users scale the traffic is dominated by repeated hot
+documents (retweets, boilerplate, spam campaigns), so a small cache
+absorbs a large fraction of the stream before it ever reaches the
+engine; the hit rate exports as a /metrics gauge.
 """
 from __future__ import annotations
 
 import queue
 import threading
+from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
-# ceiling (models/ngram.py _pipelined uses the same depth)
+# ceiling (models/ngram.py's scheduler pool uses the same depth)
 _FLUSH_WORKERS = 3
+
+_MISS = object()  # cache sentinel: any real result (even None) differs
+
+
+def _value_nbytes(v) -> int:
+    """Charged size of a cached result: exact for the code-string
+    production path, a flat estimate for result objects."""
+    if isinstance(v, (str, bytes)):
+        return len(v)
+    return 64
+
+
+class ResultCache:
+    """Bounded LRU over detection results, keyed (hints_key, text).
+
+    Byte accounting charges each entry its text bytes + result bytes +
+    a fixed per-entry structure overhead, and eviction keeps the total
+    at or under max_bytes — the bound is a real memory ceiling, not an
+    entry count. Thread-safe: flush workers probe and fill
+    concurrently."""
+
+    ENTRY_OVERHEAD = 96  # dict slot + key tuple + bookkeeping, amortized
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._d: OrderedDict = OrderedDict()  # key -> (value, nbytes)
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        """Returns the cached value or the module's _MISS sentinel."""
+        with self._lock:
+            ent = self._d.get(key)
+            if ent is None:
+                self.misses += 1
+                return _MISS
+            self._d.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def put(self, key, value, text: str):
+        nbytes = (len(text.encode("utf-8", "surrogatepass")) +
+                  _value_nbytes(value) + self.ENTRY_OVERHEAD)
+        if nbytes > self.max_bytes:
+            return  # a single oversized doc must not wipe the cache
+        with self._lock:
+            if key in self._d:
+                return
+            self._d[key] = (value, nbytes)
+            self.bytes += nbytes
+            while self.bytes > self.max_bytes and self._d:
+                _, (_, nb) = self._d.popitem(last=False)
+                self.bytes -= nb
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes": self.bytes, "entries": len(self._d),
+                    "hit_rate": self.hits / total if total else 0.0}
 
 
 class Batcher:
     """Deadline/size-batched dispatcher over a detection engine."""
 
     def __init__(self, detect_fn, max_batch: int = 16384,
-                 max_delay_ms: float = 5.0):
+                 max_delay_ms: float = 5.0, cache_bytes: int = 0):
         self._detect = detect_fn          # list[str] -> list[results]
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
+        self._cache = ResultCache(cache_bytes) if cache_bytes > 0 \
+            else None
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
@@ -42,12 +117,19 @@ class Batcher:
                                         name="ldt-batcher")
         self._thread.start()
 
-    def submit(self, texts: list) -> Future:
+    def submit(self, texts: list, hints_key=None) -> Future:
         """Queue one request's texts; resolves to their results (in
-        order) once a batch containing them completes."""
+        order) once a batch containing them completes. hints_key: any
+        hashable token identifying the request's hint configuration —
+        cached results are only ever shared within one hints_key."""
         fut: Future = Future()
-        self._q.put((texts, fut))
+        self._q.put((texts, hints_key, fut))
         return fut
+
+    def cache_stats(self) -> dict | None:
+        """Live hit/miss/byte counters, or None when the cache is
+        disabled (the /metrics exporter reads this)."""
+        return self._cache.stats() if self._cache else None
 
     def close(self):
         """Bounded shutdown: a wedged flush (device hang) must not pin
@@ -118,24 +200,51 @@ class Batcher:
 
     @staticmethod
     def _fail(pending: list, err: Exception):
-        for _, fut in pending:
+        for *_, fut in pending:
             if not fut.cancelled():
                 fut.set_exception(err)
 
     def _flush(self, pending: list):
         try:
-            texts = [t for ts, _ in pending for t in ts]
-            try:
-                results = self._detect(texts)
-            except Exception as e:  # noqa: BLE001 - fail every waiter
-                for _, fut in pending:
+            if self._cache is None:
+                texts = [t for ts, _, _ in pending for t in ts]
+                try:
+                    results = self._detect(texts)
+                except Exception as e:  # noqa: BLE001 - fail every waiter
+                    self._fail(pending, e)
+                    return
+                i = 0
+                for ts, _, fut in pending:
                     if not fut.cancelled():
-                        fut.set_exception(e)
+                        fut.set_result(results[i:i + len(ts)])
+                    i += len(ts)
                 return
-            i = 0
-            for ts, fut in pending:
+            # cached flush: probe per item, detect only the misses, fill
+            # the cache, then assemble each request's results in order
+            plans: list = []       # one value list per request
+            miss_texts: list = []
+            miss_refs: list = []   # (plan, slot, key, text)
+            for ts, hk, _ in pending:
+                plan = []
+                for t in ts:
+                    key = (hk, t)
+                    v = self._cache.get(key)
+                    plan.append(v)
+                    if v is _MISS:
+                        miss_refs.append((plan, len(plan) - 1, key, t))
+                        miss_texts.append(t)
+                plans.append(plan)
+            try:
+                miss_results = self._detect(miss_texts) \
+                    if miss_texts else []
+            except Exception as e:  # noqa: BLE001 - fail every waiter
+                self._fail(pending, e)
+                return
+            for (plan, slot, key, t), v in zip(miss_refs, miss_results):
+                plan[slot] = v
+                self._cache.put(key, v, t)
+            for (ts, _, fut), plan in zip(pending, plans):
                 if not fut.cancelled():
-                    fut.set_result(results[i:i + len(ts)])
-                i += len(ts)
+                    fut.set_result(plan)
         finally:
             self._slots.release()

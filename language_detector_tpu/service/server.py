@@ -81,8 +81,12 @@ class Metrics:
         self.languages: dict = {}
         # live TPU-engine gauge source (set when a device engine exists):
         # () -> {"batches": int, "fallback_docs": int,
-        #        "scalar_recursion_docs": int}
+        #        "scalar_recursion_docs": int, "tier_*_dispatches": int,
+        #        "retry_lane_dispatches": int, "dedup_docs": int}
         self.engine_stats = lambda: {}
+        # live result-cache gauge source (set when the batcher cache is
+        # enabled): () -> batcher.ResultCache.stats() dict or None
+        self.cache_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -132,6 +136,27 @@ class Metrics:
         lines.append("# TYPE ldt_fallback_documents_total counter")
         lines.append("ldt_fallback_documents_total "
                      f"{es.get('fallback_docs', 0) + es.get('scalar_recursion_docs', 0)}")
+        # bucketed-scheduler lanes (models/ngram.py _detect_stream)
+        lines.append("# TYPE ldt_tier_dispatches_total counter")
+        for tier in ("short", "mid", "long", "mixed"):
+            lines.append(f'ldt_tier_dispatches_total{{tier="{tier}"}} '
+                         f"{es.get(f'tier_{tier}_dispatches', 0)}")
+        lines.append("# TYPE ldt_retry_lane_dispatches_total counter")
+        lines.append("ldt_retry_lane_dispatches_total "
+                     f"{es.get('retry_lane_dispatches', 0)}")
+        lines.append("# TYPE ldt_dedup_documents_total counter")
+        lines.append("ldt_dedup_documents_total "
+                     f"{es.get('dedup_docs', 0)}")
+        # result cache (service/batcher.py, LDT_RESULT_CACHE_MB)
+        cs = self.cache_stats()
+        lines.append("# TYPE ldt_result_cache_hit_rate gauge")
+        lines.append("ldt_result_cache_hit_rate "
+                     f"{cs['hit_rate'] if cs else 0.0}")
+        lines.append("# TYPE ldt_result_cache_hits_total counter")
+        lines.append("ldt_result_cache_hits_total "
+                     f"{cs['hits'] if cs else 0}")
+        lines.append("# TYPE ldt_result_cache_bytes gauge")
+        lines.append(f"ldt_result_cache_bytes {cs['bytes'] if cs else 0}")
         return "\n".join(lines) + "\n"
 
 
@@ -139,10 +164,12 @@ class DetectorService:
     """Engine + batcher + metrics shared by all handler threads."""
 
     def __init__(self, max_batch: int = 16384, max_delay_ms: float = 5.0,
-                 use_device: bool = True, start_batcher: bool = True):
+                 use_device: bool = True, start_batcher: bool = True,
+                 cache_bytes: int | None = None):
         """start_batcher=False skips the sync Batcher (its collector
         thread + flush pool) for fronts that bring their own batching
-        layer (aioserver.AioBatcher)."""
+        layer (aioserver.AioBatcher). cache_bytes: batcher result-cache
+        budget; None reads LDT_RESULT_CACHE_MB (0/unset = disabled)."""
         self.metrics = Metrics()
         self.known = json.loads(_CODES_FILE.read_text())
         # per-code pre-serialized response fragments (the reference
@@ -154,9 +181,22 @@ class DetectorService:
         self._num_processed = 0
         self._window_start = time.time()
         self._detect = self._make_detect(use_device)
+        if cache_bytes is None:
+            try:
+                cache_bytes = int(float(
+                    os.environ.get("LDT_RESULT_CACHE_MB", "0") or 0)
+                    * 1e6)
+            except ValueError:
+                cache_bytes = 0
+        # resolved budget, for fronts that bring their own batching
+        # layer (aioserver wires the same cache into its AioBatcher)
+        self.cache_bytes = cache_bytes
         self.batcher = Batcher(self._detect, max_batch=max_batch,
-                               max_delay_ms=max_delay_ms) \
+                               max_delay_ms=max_delay_ms,
+                               cache_bytes=cache_bytes) \
             if start_batcher else None
+        if self.batcher is not None and self.batcher._cache is not None:
+            self.metrics.cache_stats = self.batcher.cache_stats
 
     def _make_detect(self, use_device: bool):
         from ..registry import registry

@@ -204,7 +204,15 @@ def load_tables(path: Path = _DATA) -> ScoringTables:
                     npz_mtime = max(npz_mtime, src.stat().st_mtime)
                 except OSError:
                     pass  # optional bundle absent (quadgram disabled)
-            if npz_mtime > ldta.stat().st_mtime:
+            try:
+                ldta_mtime = ldta.stat().st_mtime
+            except OSError:
+                # concurrent delete/replace between exists() and stat():
+                # the staleness warning is informational only and must
+                # never fail table loading (load_mmap below re-raises if
+                # the file is truly gone)
+                ldta_mtime = None
+            if ldta_mtime is not None and npz_mtime > ldta_mtime:
                 log.warning(
                     "serving tables from %s but the npz bundle is newer "
                     "— retrained tables without artifact_tool --pack? "

@@ -1,0 +1,338 @@
+"""Shape-bucketed scheduler + batcher result cache (round 6).
+
+Self-contained (bench.py corpora, no golden data): exactness of every
+new scheduler path against the scalar oracle — tier-boundary routing,
+the pipelined retry lane, batch-internal dedup — plus the batcher LRU's
+byte bound and hint isolation, and the new metrics series.
+
+The engine constants TIER_MIN_DOCS / RETRY_LANE_MIN are class attrs
+read through self, so tests shadow them per-instance to force the
+multi-lane scheduler on small (fast) corpora; production thresholds
+stay untouched.
+"""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import bench
+from language_detector_tpu.preprocess import pack
+
+
+def _require_engine():
+    from language_detector_tpu import native
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = _require_engine()()
+    # force the bucketed machinery on test-sized corpora
+    eng.TIER_MIN_DOCS = 8
+    eng.RETRY_LANE_MIN = 2
+    eng.TIER_COALESCE_MIN = 1
+    return eng
+
+
+def _stuple(r):
+    return (r.summary_lang, list(r.language3), list(r.percent3),
+            r.text_bytes, r.is_reliable)
+
+
+def _scalar(eng, text):
+    from language_detector_tpu.engine_scalar import detect_scalar
+    return detect_scalar(text, eng.tables, eng.reg, 0)
+
+
+# -- tier ladder (pure host logic) ------------------------------------------
+
+
+def test_tier_ladder_boundaries():
+    """tier_of_text flips exactly at tier_max_chars(k), and tiers are
+    monotone in length."""
+    assert pack.N_TIERS == len(pack.SLOT_TIER_BUDGETS) + 1
+    for k in range(len(pack.SLOT_TIER_BUDGETS)):
+        m = pack.tier_max_chars(k)
+        assert pack.tier_of_text("x" * m) == k
+        assert pack.tier_of_text("x" * (m + 1)) == k + 1
+    assert pack.tier_of_text("") == 0
+    last = 0
+    for n in range(0, pack.tier_max_chars(len(
+            pack.SLOT_TIER_BUDGETS) - 1) + 100, 97):
+        t = pack.tier_of_text("y" * n)
+        assert t >= last
+        last = t
+
+
+# -- scheduler exactness ----------------------------------------------------
+
+
+def test_bucket_boundary_parity(engine):
+    """Documents straddling every slot-budget tier boundary (length
+    m-1, m, m+1 at each boundary) answer exactly the scalar engine
+    through the tiered detect_many path — a doc landing one lane over
+    must never change its result."""
+    base = " ".join(bench._SEEDS) + " "
+    boundary_docs = []
+    for k in range(len(pack.SLOT_TIER_BUDGETS)):
+        m = pack.tier_max_chars(k)
+        src = base * (m // len(base) + 2)
+        for delta in (-1, 0, 1):
+            boundary_docs.append(src[:m + delta])
+    # pad with short docs so multiple lanes exist and slices form
+    docs = boundary_docs + bench.make_corpus(48)
+    rng = random.Random(6)
+    rng.shuffle(docs)
+    got = engine.detect_many(docs, batch_size=16)
+    for t, g in zip(docs, got):
+        if t in boundary_docs:
+            assert _stuple(g) == _stuple(_scalar(engine, t)), \
+                f"boundary doc len={len(t)} diverged"
+    # tier lanes actually ran: the boundary docs span short+mid+long
+    st = engine.stats
+    assert st["tier_short_dispatches"] > 0
+    assert st["tier_mid_dispatches"] > 0
+    assert st["tier_long_dispatches"] > 0
+
+
+def test_undersized_lanes_coalesce_upward():
+    """Lanes below TIER_COALESCE_MIN fold into the next wider budget
+    rather than paying their own dispatch; results stay exact and only
+    the widest (receiving) lane's counter moves."""
+    eng = _require_engine()()
+    eng.TIER_MIN_DOCS = 8  # tier, but leave TIER_COALESCE_MIN at 256
+    docs = bench.make_corpus(20) + \
+        [" ".join(bench.make_corpus(30))] * 2  # 2-doc long tail
+    before = dict(eng.stats)
+    got = eng.detect_many(docs, batch_size=4096)
+    st = eng.stats
+    assert st["tier_short_dispatches"] == before["tier_short_dispatches"]
+    assert st["tier_mid_dispatches"] == before["tier_mid_dispatches"]
+    assert st["tier_long_dispatches"] > before["tier_long_dispatches"]
+    for t, g in zip(docs, got):
+        assert _stuple(g) == _stuple(_scalar(eng, t))
+
+
+def test_retry_lane_parity(engine):
+    """Gate-failing docs (squeeze spam + degenerate tails of the mixed
+    corpus) resolved through the pipelined retry lane stay exact vs the
+    scalar engine, under a batch size small enough to force many
+    overlapping slices."""
+    docs = bench.make_mixed_corpus(300)
+    before = engine.stats["retry_lane_dispatches"]
+    got = engine.detect_many(docs, batch_size=32)
+    assert engine.stats["retry_lane_dispatches"] > before, \
+        "mixed corpus under tiny slices must exercise the retry lane"
+    for t, g in zip(docs, got):
+        assert _stuple(g) == _stuple(_scalar(engine, t)), repr(t[:60])
+
+
+def test_dedup_parity_and_stats(engine):
+    """Heavy duplication: every duplicate position gets a value equal
+    to its representative's, results stay exact, and dedup_docs counts
+    exactly the collapsed positions."""
+    uniq = bench.make_corpus(40)
+    rng = random.Random(11)
+    docs = uniq + [uniq[rng.randrange(len(uniq))] for _ in range(120)]
+    rng.shuffle(docs)
+    before = engine.stats["dedup_docs"]
+    got = engine.detect_many(docs, batch_size=16)
+    assert engine.stats["dedup_docs"] - before == \
+        len(docs) - len(set(docs))
+    by_text: dict = {}
+    for t, g in zip(docs, got):
+        key = _stuple(g)
+        assert by_text.setdefault(t, key) == key, \
+            "same text answered differently within one stream"
+    for t in set(docs):
+        assert by_text[t] == _stuple(_scalar(engine, t))
+    # codes path shares the scheduler (patch_value seam)
+    codes = engine.detect_codes(docs, batch_size=16)
+    for g, c in zip(got, codes):
+        assert engine.reg.code(g.summary_lang) == c
+
+
+def test_single_flush_fast_path(engine):
+    """A batch that fits one dispatch (the service batcher's common
+    flush) takes the no-pool path and stays exact, duplicates
+    included."""
+    docs = bench.make_corpus(24) + bench.make_corpus(24)
+    got = engine.detect_many(docs, batch_size=4096)
+    for t, g in zip(docs, got):
+        assert _stuple(g) == _stuple(_scalar(engine, t))
+
+
+# -- gc satellite -----------------------------------------------------------
+
+
+def test_gc_paused_forces_periodic_collect(monkeypatch):
+    """Sustained bulk calls force a gc.collect() at least every
+    GC_COLLECT_EVERY exits, even though each call pauses the GC."""
+    import gc
+    NgramBatchEngine = _require_engine()
+    calls = []
+    real = gc.collect
+    monkeypatch.setattr(gc, "collect", lambda *a: calls.append(1) or 0)
+    monkeypatch.setattr(NgramBatchEngine, "GC_COLLECT_EVERY", 4)
+    monkeypatch.setattr(NgramBatchEngine, "_bulk_since_collect", 0)
+    try:
+        for _ in range(9):
+            with NgramBatchEngine._gc_paused():
+                pass
+    finally:
+        monkeypatch.setattr(gc, "collect", real)
+    assert len(calls) == 2
+    assert gc.isenabled()
+
+
+# -- batcher result cache ---------------------------------------------------
+
+
+def _counting_detect():
+    seen = []
+
+    def detect(texts):
+        seen.append(list(texts))
+        return [f"r:{t}" for t in texts]
+    detect.seen = seen
+    return detect
+
+
+def test_batcher_cache_hits_and_exactness():
+    from language_detector_tpu.service.batcher import Batcher
+    detect = _counting_detect()
+    b = Batcher(detect, max_delay_ms=1.0, cache_bytes=1 << 20)
+    try:
+        texts = [f"doc number {i}" for i in range(20)]
+        first = b.submit(texts).result(timeout=10)
+        second = b.submit(texts).result(timeout=10)
+        assert first == second == [f"r:{t}" for t in texts]
+        # the second submission was served without re-detection
+        assert sum(len(s) for s in detect.seen) == len(texts)
+        cs = b.cache_stats()
+        assert cs["hits"] == len(texts)
+        assert cs["misses"] == len(texts)
+        assert cs["hit_rate"] == pytest.approx(0.5)
+    finally:
+        b.close()
+
+
+def test_batcher_cache_never_crosses_hints():
+    """Identical text under different hints_key must re-detect — a
+    cached result may only serve submissions with the same hint
+    configuration."""
+    from language_detector_tpu.service.batcher import Batcher
+    detect = _counting_detect()
+    b = Batcher(detect, max_delay_ms=1.0, cache_bytes=1 << 20)
+    try:
+        b.submit(["bonjour le monde"], hints_key=None).result(timeout=10)
+        b.submit(["bonjour le monde"],
+                 hints_key=("tld", "fr")).result(timeout=10)
+        b.submit(["bonjour le monde"],
+                 hints_key=("tld", "de")).result(timeout=10)
+        assert sum(len(s) for s in detect.seen) == 3  # zero cross-hint hits
+        # and the SAME hints_key does hit
+        b.submit(["bonjour le monde"],
+                 hints_key=("tld", "fr")).result(timeout=10)
+        assert sum(len(s) for s in detect.seen) == 3
+    finally:
+        b.close()
+
+
+def test_batcher_cache_respects_byte_bound():
+    from language_detector_tpu.service.batcher import (Batcher,
+                                                       ResultCache)
+    detect = _counting_detect()
+    bound = 4096
+    b = Batcher(detect, max_delay_ms=1.0, cache_bytes=bound)
+    try:
+        for i in range(200):
+            b.submit([f"filler document {i} " + "x" * 100]).result(
+                timeout=10)
+        cs = b.cache_stats()
+        assert 0 < cs["bytes"] <= bound
+        assert cs["entries"] < 200  # eviction happened
+        # an evicted entry re-detects (LRU, oldest first)
+        n_before = sum(len(s) for s in detect.seen)
+        b.submit(["filler document 0 " + "x" * 100]).result(timeout=10)
+        assert sum(len(s) for s in detect.seen) == n_before + 1
+    finally:
+        b.close()
+    # oversized single entry is refused rather than wiping the cache
+    c = ResultCache(64)
+    c.put(("k", "y" * 1000), "v", "y" * 1000)
+    assert c.bytes == 0
+
+
+def test_aiobatcher_cache_hits_and_exactness():
+    """The asyncio front's batching layer shares the ResultCache — a
+    repeated flush must be served without re-detection there too (the
+    sync Batcher's cache never sees aioserver traffic)."""
+    import asyncio
+
+    from language_detector_tpu.service.aioserver import AioBatcher
+    detect = _counting_detect()
+
+    async def run():
+        b = AioBatcher(detect, max_delay_ms=1.0, cache_bytes=1 << 20)
+        b.start()
+        try:
+            texts = [f"aio doc {i}" for i in range(12)]
+            first = await b.submit(texts)
+            second = await b.submit(texts)
+            return first, second, b.cache_stats()
+        finally:
+            await b.close()
+
+    first, second, cs = asyncio.run(run())
+    assert first == second == [f"r:aio doc {i}" for i in range(12)]
+    assert sum(len(s) for s in detect.seen) == 12
+    assert cs["hits"] == 12
+
+
+def test_batcher_without_cache_unchanged():
+    from language_detector_tpu.service.batcher import Batcher
+    detect = _counting_detect()
+    b = Batcher(detect, max_delay_ms=1.0)
+    try:
+        assert b.cache_stats() is None
+        out = b.submit(["a", "b"]).result(timeout=10)
+        assert out == ["r:a", "r:b"]
+    finally:
+        b.close()
+
+
+# -- metrics export ---------------------------------------------------------
+
+
+def test_metrics_renders_scheduler_series():
+    from language_detector_tpu.service.server import Metrics
+    m = Metrics()
+    m.engine_stats = lambda: {
+        "batches": 3, "device_dispatches": 5, "fallback_docs": 0,
+        "scalar_recursion_docs": 2, "tier_short_dispatches": 2,
+        "tier_mid_dispatches": 1, "tier_long_dispatches": 1,
+        "tier_mixed_dispatches": 1, "retry_lane_dispatches": 4,
+        "dedup_docs": 7}
+    m.cache_stats = lambda: {"hits": 30, "misses": 10, "bytes": 1234,
+                             "entries": 10, "hit_rate": 0.75}
+    text = m.render()
+    assert 'ldt_tier_dispatches_total{tier="short"} 2' in text
+    assert 'ldt_tier_dispatches_total{tier="long"} 1' in text
+    assert "ldt_retry_lane_dispatches_total 4" in text
+    assert "ldt_dedup_documents_total 7" in text
+    assert "ldt_result_cache_hit_rate 0.75" in text
+    assert "ldt_result_cache_hits_total 30" in text
+    assert "ldt_result_cache_bytes 1234" in text
+
+
+def test_format_engine_stats():
+    from language_detector_tpu.debug import format_engine_stats
+    out = format_engine_stats({"batches": 2, "dedup_docs": 5,
+                               "tier_short_dispatches": 1})
+    assert "batches" in out and "dedup_docs" in out
+    assert format_engine_stats({}) == "(no engine stats)"

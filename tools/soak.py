@@ -34,9 +34,29 @@ from language_detector_tpu import enable_jit_cache  # noqa: E402
 enable_jit_cache()
 
 
-def main(scale: int = 1) -> int:
-    from test_batch_agreement import _fuzz_docs
+def _fuzz_docs(n: int, seed: int) -> list:
+    """test_batch_agreement's construction soup over the golden corpus
+    when available, else over bench.py's self-contained corpus — the
+    soak must run (and the new bucket/dedup passes must exercise) on
+    hosts without the reference snapshot."""
+    import random as _random
 
+    from test_batch_agreement import _fill_fuzz_docs, _golden_texts
+    try:
+        texts = _golden_texts()
+    except BaseException:  # pytest.skip escalates outside a test run
+        texts = []
+    if not texts:
+        import bench
+        base = bench.make_corpus(64)
+        texts = [" ".join(base[i:i + 12]) for i in range(0, 64, 4)]
+    rng = _random.Random(seed)
+    docs: list = []
+    _fill_fuzz_docs(docs, rng, texts, n)
+    return docs
+
+
+def main(scale: int = 1) -> int:
     from language_detector_tpu import native
     from language_detector_tpu.engine_scalar import detect_scalar
     from language_detector_tpu.hints import CLDHints
@@ -116,6 +136,72 @@ def main(scale: int = 1) -> int:
         if lib.detect_language_n(enc, len(enc)).decode() != w:
             cbad += 1
     report("raw C ABI", cbad, nc)
+
+    # bucket boundaries: docs whose length straddles each slot-budget
+    # tier (length m-1 / m / m+1 at every boundary) must route to
+    # adjacent shape lanes with identical results. Instance overrides
+    # force the tiered scheduler + retry lane at soak batch sizes.
+    from language_detector_tpu.preprocess.pack import (SLOT_TIER_BUDGETS,
+                                                       tier_max_chars)
+    src = " ".join(_fuzz_docs(48, seed=99020))
+    while len(src) < tier_max_chars(len(SLOT_TIER_BUDGETS) - 1) + 4096:
+        src += " " + src
+    bdocs = []
+    for k in range(len(SLOT_TIER_BUDGETS)):
+        m = tier_max_chars(k)
+        for i in range(8 * scale):
+            for delta in (-1, 0, 1):
+                start = (i * 241) % 1024
+                bdocs.append(src[start:start + m + delta])
+    bdocs += _fuzz_docs(64 * scale, seed=99022)
+    eng.TIER_MIN_DOCS = 16
+    eng.RETRY_LANE_MIN = 4
+    eng.TIER_COALESCE_MIN = 1
+    try:
+        bg = eng.detect_many(bdocs, batch_size=64)
+        report("bucket boundaries", sum(
+            1 for t, g in zip(bdocs, bg)
+            if stuple(g) != stuple(detect_scalar(t, eng.tables, eng.reg,
+                                                 0))), len(bdocs))
+
+        # dedup + result cache: heavy duplication through the batched
+        # path, then twice through a cache-enabled batcher — every
+        # repeat (engine dedup AND LRU hit) must answer the oracle
+        import random as _random
+        uniq = _fuzz_docs(64 * scale, seed=99021)
+        rngd = _random.Random(99023)
+        ddocs = [uniq[rngd.randrange(len(uniq))]
+                 for _ in range(256 * scale)]
+        want = {t: stuple(detect_scalar(t, eng.tables, eng.reg, 0))
+                for t in set(ddocs)}
+        dg = eng.detect_many(ddocs, batch_size=64)
+        report("dedup repeats", sum(
+            1 for t, g in zip(ddocs, dg) if stuple(g) != want[t]),
+            len(ddocs))
+
+        from language_detector_tpu.service.batcher import Batcher
+        want_codes = {t: registry.code(detect_scalar(
+            t, eng.tables, eng.reg, 0).summary_lang)
+            for t in set(ddocs)}
+        bat = Batcher(lambda ts: eng.detect_codes(ts, batch_size=128),
+                      cache_bytes=8 << 20)
+        try:
+            bad = 0
+            for _pass in range(2):  # second pass serves from the cache
+                got_codes = bat.submit(ddocs).result(timeout=600)
+                bad += sum(1 for t, c in zip(ddocs, got_codes)
+                           if want_codes[t] != c)
+            cs = bat.cache_stats()
+        finally:
+            bat.close()
+        report("cache hits", bad, 2 * len(ddocs))
+        print(f"{'cache hit rate':28s} {cs['hit_rate']:.3f} "
+              f"({cs['hits']} hits)", flush=True)
+        if cs["hits"] == 0:
+            failures += 1
+            print("cache hit soak: zero hits (cache inert?)")
+    finally:
+        del eng.TIER_MIN_DOCS, eng.RETRY_LANE_MIN, eng.TIER_COALESCE_MIN
 
     print("SOAK", "CLEAN" if failures == 0 else f"FAILED ({failures})")
     return 0 if failures == 0 else 1
