@@ -229,6 +229,14 @@ def bench(batch_size: int = 16384, n_batches: int = 6,
     t_lh = min(lruns)
     t_lh_med = sorted(lruns)[len(lruns) // 2]
 
+    # Per-stage latency percentiles from the shared telemetry registry:
+    # every engine run above observed dedup/tier_plan/pack/dispatch/
+    # epilogue/retry_lane stage histograms, so the bench reports WHERE
+    # the time went, not just end-to-end wall time.
+    from language_detector_tpu import telemetry
+    stage_latency = telemetry.REGISTRY.stage_percentiles()
+    xla_compiles = telemetry.REGISTRY.compile_counts()
+
     docs_sec = len(stream) / (t_e2e * n_batches)
     return dict(
         metric="batch_detect_throughput",
@@ -263,6 +271,8 @@ def bench(batch_size: int = 16384, n_batches: int = 6,
             longheavy_doc_bytes_avg=round(lh_bytes / lh_n, 1),
             http_docs_sec=http_docs_sec,
             http_cold_docs_sec=http_cold_docs_sec,
+            stage_latency_ms=stage_latency,
+            xla_compiles=xla_compiles,
             summary_sample=results[0].summary_lang,
         ),
     )

@@ -42,4 +42,53 @@ print("bucketed scheduler:",
       "| retry_lane_dispatches", d["mixed_retry_lane_dispatches"])
 EOF
 
+echo "== telemetry smoke =="
+# drive one request through the sync front and scrape /metrics: the new
+# per-stage + request histograms must be present with _count > 0, and
+# /debug/vars must answer statusz JSON (docs/OBSERVABILITY.md)
+python3 - <<'EOF'
+import json
+import threading
+import urllib.request
+
+from language_detector_tpu.service.server import make_server
+
+httpd, metricsd, svc = make_server(0, 0)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+threading.Thread(target=metricsd.serve_forever, daemon=True).start()
+port = httpd.server_address[1]
+mport = metricsd.server_address[1]
+
+body = json.dumps({"request": [{"text": f"hello world number {i}"}
+                               for i in range(100)]}).encode()
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/", data=body,
+    headers={"Content-Type": "application/json"})
+resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+assert len(resp["response"]) == 100, resp
+
+metrics = urllib.request.urlopen(
+    f"http://127.0.0.1:{mport}/", timeout=10).read().decode()
+
+
+def series_value(name):
+    for line in metrics.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"series {name} missing from /metrics")
+
+
+assert series_value("ldt_request_latency_ms_count") > 0
+assert series_value("ldt_stage_latency_ms_count") > 0
+assert "# HELP ldt_request_latency_ms" in metrics
+dv = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{mport}/debug/vars", timeout=10).read())
+assert dv["requests"]["count"] > 0, dv
+print("telemetry:",
+      "request_count", dv["requests"]["count"],
+      "| stages", sorted(dv["stage_latency_ms"]),
+      "| xla_compiles", dv["xla_compiles"])
+svc.batcher.close()
+EOF
+
 echo "CI OK"

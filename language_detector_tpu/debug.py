@@ -204,6 +204,46 @@ def format_engine_stats(stats: dict) -> str:
     return "\n".join(f"{k:<{w}}  {stats[k]}" for k in keys)
 
 
+def format_slow_traces(doc: dict) -> str:
+    """Pretty-print a /debug/slow JSON document (telemetry.SlowTraceRing
+    snapshot): one block per sampled request, spans indented by depth
+    with start offset and duration — the span-tree twin of
+    format_trace's indented scalar dump."""
+    lines = [f"slow traces: {len(doc.get('traces', []))} held / "
+             f"{doc.get('recorded', 0)} recorded "
+             f"(threshold {doc.get('threshold_ms', 0)}ms, "
+             f"ring {doc.get('capacity', 0)})"]
+    import datetime
+    for i, tr in enumerate(doc.get("traces", [])):
+        when = datetime.datetime.fromtimestamp(tr.get("ts", 0)) \
+            .strftime("%H:%M:%S.%f")[:-3]
+        meta = " ".join(f"{k}={v}" for k, v in
+                        sorted(tr.get("meta", {}).items()))
+        lines.append(f"\n#{i} {when} total={tr.get('total_ms', 0)}ms"
+                     + (f" [{meta}]" if meta else ""))
+        for sp in tr.get("spans", []):
+            pad = "  " * (sp.get("depth", 0) + 1)
+            lines.append(f"{pad}{sp.get('name', '?'):<12} "
+                         f"@{sp.get('start_ms', 0):>9.3f}ms "
+                         f"+{sp.get('dur_ms', 0):.3f}ms")
+    return "\n".join(lines)
+
+
+def _read_slow_source(src: str) -> dict:
+    """--slow-traces input: an http(s) URL (a running front's
+    GET /debug/slow), a JSON file path, or '-' for stdin."""
+    import json
+    import sys
+    if src == "-":
+        return json.loads(sys.stdin.read())
+    if src.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+        with urlopen(src, timeout=10) as r:
+            return json.loads(r.read())
+    from pathlib import Path
+    return json.loads(Path(src).read_text())
+
+
 def _main(argv=None):
     """CLI harness (the reference's compact_lang_det_test.cc interactive
     tool): text from args/stdin -> summary + optional score trace and
@@ -232,7 +272,16 @@ def _main(argv=None):
                          "(each arg / stdin line = one document) and "
                          "print the scheduler's dispatch/tier/dedup "
                          "counters instead of a scalar trace")
+    ap.add_argument("--slow-traces", metavar="SRC",
+                    help="pretty-print sampled slow-request span trees: "
+                         "SRC is a metrics-port URL (the front's "
+                         "GET /debug/slow), a JSON file, or '-' for "
+                         "stdin (requires LDT_SLOW_TRACE_MS set on the "
+                         "server)")
     args = ap.parse_args(argv)
+    if args.slow_traces:
+        print(format_slow_traces(_read_slow_source(args.slow_traces)))
+        return 0
     if args.engine_stats:
         docs = list(args.text) if args.text \
             else [ln for ln in sys.stdin.read().splitlines() if ln]

@@ -26,9 +26,11 @@ Everything agrees with `detect_scalar` on every document
 from __future__ import annotations
 
 import contextlib
+import time as _time
 
 import numpy as np
 
+from .. import telemetry
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              FLAG_SQUEEZE, FLAG_TOP40,
                              ScalarResult, detect_scalar,
@@ -126,10 +128,31 @@ class NgramBatchEngine:
 
     # -- device dispatch ----------------------------------------------------
 
+    def _launch(self, cb, lane: str = "main"):
+        """Launch the jitted scorer over a packed wire, metering compile
+        events: the first execution of a new padded wire shape on a lane
+        increments ldt_xla_compiles_total{lane=} and records the launch
+        wall time (jit traces + compiles synchronously inside the
+        dispatch call, so the elapsed time of a fresh-shape launch IS
+        the compile cost; warm launches return in microseconds and are
+        not timed at all — the hot path stays one set lookup)."""
+        key = (self._mesh_size,
+               tuple(sorted((k, tuple(np.shape(v)))
+                            for k, v in cb.wire.items())))
+        if not telemetry.REGISTRY.compiles.first_seen(lane, key):
+            return self._score_fn(self.dt, cb.wire)
+        t0 = _time.monotonic()
+        fut = self._score_fn(self.dt, cb.wire)
+        telemetry.REGISTRY.counter_inc("ldt_xla_compiles_total",
+                                       lane=lane)
+        telemetry.REGISTRY.histogram("ldt_xla_compile_ms", lane=lane) \
+            .observe((_time.monotonic() - t0) * 1e3)
+        return fut
+
     def score_chunk_batch(self, cb) -> np.ndarray:
         """Run the jitted device program over a ChunkBatch; returns the
         flat [G, 5] chunk-summary rows on host (test/debug seam)."""
-        out = np.asarray(self._score_fn(self.dt, cb.wire))
+        out = np.asarray(self._launch(cb))
         return unpack_chunks_out(out, cb.wire["cmeta"])
 
     # -- public API ---------------------------------------------------------
@@ -395,18 +418,21 @@ class NgramBatchEngine:
     RETRY_LANE_MIN = 64
 
     def detect_many(self, texts: list[str],
-                    batch_size: int = 16384) -> list:
+                    batch_size: int = 16384, trace=None) -> list:
         """Multi-batch detection through the shape-bucketed scheduler;
         returns ScalarResult-compatible rows (EpilogueResult views;
         scalar-path docs get real ScalarResults). Sustained-throughput
-        entry point for the service layer and bench."""
+        entry point for the service layer and bench. trace: optional
+        telemetry.Trace the scheduler records its stage spans into
+        (dedup, tier planning, pack, dispatch, retry lane)."""
         if self.flags & ~_DEVICE_OK_FLAGS or not texts:
             return self.detect_batch(texts)
         with self._gc_paused():
-            return self._detect_stream(texts, batch_size, self._finish)
+            return self._detect_stream(texts, batch_size, self._finish,
+                                       trace=trace)
 
     def _detect_stream(self, texts: list[str], batch_size: int,
-                       finish_fn, patch_value=None):
+                       finish_fn, patch_value=None, trace=None):
         """Shape-bucketed stream scheduler. Three moves on top of the
         round-5 pipeline:
 
@@ -433,6 +459,7 @@ class NgramBatchEngine:
             patch_value = lambda r: r  # noqa: E731
         out: list = [None] * len(texts)
         # -- dedup: first occurrence scores, the rest copy ------------
+        t_stage = _time.monotonic()
         first: dict = {}
         uniq_idx: list = []   # global index of each unique doc
         uniq_txt: list = []
@@ -448,6 +475,7 @@ class NgramBatchEngine:
         if dups:
             with self._stats_lock:
                 self.stats["dedup_docs"] += len(dups)
+        t_stage = telemetry.observe_stage("dedup", t_stage, trace=trace)
         # -- tier partition + per-lane volume slicing -----------------
         from ..preprocess.pack import N_TIERS, TIER_NAMES, tier_of_text
         if len(uniq_txt) > self.TIER_MIN_DOCS:
@@ -476,30 +504,36 @@ class NgramBatchEngine:
                 jobs.append((name,
                              [uniq_idx[lane[p]] for p in range(s, e)],
                              ltxt[s:e]))
+        telemetry.observe_stage("tier_plan", t_stage, trace=trace)
         # -- dispatch -------------------------------------------------
         if len(jobs) == 1:
             # single-dispatch fast path (the service batcher's common
             # flush): no pool, local deferred retry as before
             name, idxs, txts = jobs[0]
             self._count_tier(name)
+            t0 = _time.monotonic()
             cb = self._pack(txts)
+            telemetry.observe_stage("pack", t0, trace=trace)
             d: list = []
-            vals = finish_fn(txts, cb, self._score_fn(self.dt, cb.wire),
-                             deferred=d)
+            vals = finish_fn(txts, cb, self._launch(cb, name),
+                             deferred=d, trace=trace)
             for g, v in zip(idxs, vals):
                 out[g] = v
-            for g, r in self._retry_deferred(
-                    [(idxs[b], t, sq) for b, t, sq in d]).items():
-                out[g] = patch_value(r)
+            if d:
+                t0 = _time.monotonic()
+                for g, r in self._retry_deferred(
+                        [(idxs[b], t, sq) for b, t, sq in d]).items():
+                    out[g] = patch_value(r)
+                telemetry.observe_stage("retry_lane", t0, trace=trace)
         elif jobs:
             self._run_scheduler(jobs, batch_size, finish_fn,
-                                patch_value, out)
+                                patch_value, out, trace=trace)
         for i, p in dups:
             out[i] = out[uniq_idx[p]]
         return out
 
     def _run_scheduler(self, jobs, batch_size, finish_fn, patch_value,
-                       out):
+                       out, trace=None):
         """Multi-lane pipeline with the overlapped retry lane. The main
         thread only packs (C++, GIL-released); pool workers launch the
         device program and run the epilogue (same depth-3 structure as
@@ -517,10 +551,10 @@ class NgramBatchEngine:
         retry_lock = threading.Lock()
         retry_bins = {False: [], True: []}  # squeezed -> [(gidx, text)]
 
-        def run_main(idxs, txts, cb):
-            fut = self._score_fn(self.dt, cb.wire)
+        def run_main(lane, idxs, txts, cb):
+            fut = self._launch(cb, lane)
             d: list = []
-            vals = finish_fn(txts, cb, fut, deferred=d)
+            vals = finish_fn(txts, cb, fut, deferred=d, trace=trace)
             if d:
                 with self._stats_lock:
                     self.stats["scalar_recursion_docs"] += len(d)
@@ -530,8 +564,9 @@ class NgramBatchEngine:
             return ("main", idxs, vals)
 
         def run_retry(idxs, txts, cb, flags):
+            t0 = _time.monotonic()
             rows = unpack_chunks_out(
-                np.asarray(self._score_fn(self.dt, cb.wire)),
+                np.asarray(self._launch(cb, "retry")),
                 cb.wire["cmeta"])
             with self._stats_lock:
                 self.stats["device_dispatches"] += 1
@@ -547,6 +582,7 @@ class NgramBatchEngine:
                         text, self.tables, self.reg, self.flags)
                 else:
                     patches[idxs[b]] = _result_from_row(ep[b])
+            telemetry.observe_stage("retry_lane", t0, trace=trace)
             return ("retry", patches)
 
         pending: deque = deque()
@@ -575,14 +611,19 @@ class NgramBatchEngine:
                     gtxt = [t for _, t in group]
                     for s, e in self._slice_bounds(
                             [len(t) for t in gtxt], batch_size):
+                        t0 = _time.monotonic()
                         cb = self._pack(gtxt[s:e], flags=flags)
+                        telemetry.observe_stage("pack", t0, trace=trace)
                         pending.append(pool.submit(
                             run_retry, gidx[s:e], gtxt[s:e], cb, flags))
 
             for name, idxs, txts in jobs:
                 self._count_tier(name)
+                t0 = _time.monotonic()
                 cb = self._pack(txts)
-                pending.append(pool.submit(run_main, idxs, txts, cb))
+                telemetry.observe_stage("pack", t0, trace=trace)
+                pending.append(pool.submit(run_main, name, idxs, txts,
+                                           cb))
                 while len(pending) > 3:
                     collect(pending.popleft().result())
                 submit_retries(self.RETRY_LANE_MIN)
@@ -618,13 +659,13 @@ class NgramBatchEngine:
         second = next(jobs, None)
         if second is None:
             cb = pack(first)
-            yield finish(first, cb, self._score_fn(self.dt, cb.wire))
+            yield finish(first, cb, self._launch(cb))
             return
         from concurrent.futures import ThreadPoolExecutor
         import itertools
 
         def launch_and_finish(job, cb):
-            return finish(job, cb, self._score_fn(self.dt, cb.wire))
+            return finish(job, cb, self._launch(cb))
 
         pending: list = []
         with ThreadPoolExecutor(3) as pool:
@@ -689,9 +730,10 @@ class NgramBatchEngine:
         (ChunkBatch, device future). Single-shot path (detect_batch,
         the gate-failure retry); the multi-slice pipeline uses _pack."""
         cb = self._pack(texts, flags, hint_boosts)
-        return cb, self._score_fn(self.dt, cb.wire)
+        return cb, self._launch(cb)
 
-    def _epilogue(self, texts: list[str], cb, fut, deferred=None):
+    def _epilogue(self, texts: list[str], cb, fut, deferred=None,
+                  trace=None):
         """Fetch the device result, run the C++ document epilogue, and
         resolve the exception docs: packer fallbacks go scalar; docs
         failing the good-answer gate re-score as a BATCH with the
@@ -706,15 +748,21 @@ class NgramBatchEngine:
         a mixed corpus split into S slices pays 1-2 retry rounds
         instead of up to 2S serial device rounds. Returns (ep [B, 14],
         {doc index: ScalarResult} patches). Runs on detect_many's
-        worker pool, so stats updates take the lock."""
+        worker pool, so stats updates take the lock. The "dispatch"
+        stage is the device WAIT — from fetch start to rows on host —
+        which is where a dispatch's time shows up under the depth-3
+        pipeline (the launch itself is asynchronous)."""
         from .. import native
+        t0 = _time.monotonic()
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+        t0 = telemetry.observe_stage("dispatch", t0, trace=trace)
         B = len(texts)
         with self._stats_lock:
             self.stats["batches"] += 1
             self.stats["device_dispatches"] += 1
             self.stats["fallback_docs"] += int(cb.fallback[:B].sum())
         ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
+        telemetry.observe_stage("epilogue", t0, trace=trace)
         patches: dict[int, ScalarResult] = {}
         need = np.flatnonzero(ep[:B, 12])
         if not need.size:
@@ -755,8 +803,8 @@ class NgramBatchEngine:
         return patches
 
     def _finish(self, texts: list[str], cb, fut,
-                deferred=None) -> list:
-        ep, patches = self._epilogue(texts, cb, fut, deferred)
+                deferred=None, trace=None) -> list:
+        ep, patches = self._epilogue(texts, cb, fut, deferred, trace)
         # lazy row views instead of eager dataclasses: constructing 16K
         # ScalarResults costs ~70ms on the single-core host while most
         # consumers read one or two fields; the view defers field
@@ -767,16 +815,16 @@ class NgramBatchEngine:
         return results
 
     def _finish_codes(self, texts: list[str], cb, fut,
-                      deferred=None) -> np.ndarray:
+                      deferred=None, trace=None) -> np.ndarray:
         """Summary-language ids only (no per-doc result objects)."""
-        ep, patches = self._epilogue(texts, cb, fut, deferred)
+        ep, patches = self._epilogue(texts, cb, fut, deferred, trace)
         out = ep[:len(texts), 0].astype(np.int32)
         for b, r in patches.items():
             out[b] = r.summary_lang
         return out
 
     def detect_codes(self, texts: list[str],
-                     batch_size: int = 16384) -> list[str]:
+                     batch_size: int = 16384, trace=None) -> list[str]:
         """Summary ISO codes only — the reference's production semantic
         (wrapper.cc:7-16 discards everything but the code string), so
         the service (server.py) and eval harness consume this. Skips
@@ -791,9 +839,11 @@ class NgramBatchEngine:
         # agreement-pinned against the device path (test_c_abi)
         if len(texts) <= self.TINY_BATCH_C_PATH and self.flags == 0:
             from .. import native
+            t0 = _time.monotonic()
             ids = native.detect_batch_codes_native(texts, self.tables,
                                                    self.reg)
             if ids is not None:
+                telemetry.observe_stage("c_path", t0, trace=trace)
                 # count the flush: the service Prometheus gauges read
                 # eng.stats, and a low-traffic service whose every
                 # flush is tiny must not render as idle
@@ -805,7 +855,8 @@ class NgramBatchEngine:
         with self._gc_paused():
             vals = self._detect_stream(
                 texts, batch_size, self._finish_codes,
-                patch_value=lambda r: int(r.summary_lang))
+                patch_value=lambda r: int(r.summary_lang),
+                trace=trace)
         ids = np.fromiter((int(v) for v in vals), np.int32,
                           count=len(vals))
         return self.reg.lang_code[ids].tolist()

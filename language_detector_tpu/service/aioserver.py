@@ -19,7 +19,8 @@ import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 
-from .batcher import _FLUSH_WORKERS, _MISS, ResultCache
+from .. import telemetry
+from .batcher import _FLUSH_WORKERS, _MISS, ResultCache, _accepts_trace
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
                      parse_post_body, post_detect, pre_detect)
 
@@ -39,6 +40,8 @@ class AioBatcher:
     def __init__(self, detect_fn, max_batch: int = 16384,
                  max_delay_ms: float = 5.0, cache_bytes: int = 0):
         self._detect = detect_fn
+        # engine-backed detect fns take trace= (see batcher.Batcher)
+        self._detect_takes_trace = _accepts_trace(detect_fn)
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
         self._q: asyncio.Queue = asyncio.Queue()
@@ -57,9 +60,12 @@ class AioBatcher:
         self._task = asyncio.get_running_loop().create_task(
             self._collector())
 
-    async def submit(self, texts: list) -> list:
+    async def submit(self, texts: list, trace=None) -> list:
+        """trace: optional telemetry.Trace — the flush serving this
+        request grafts its engine stage spans into it (same contract as
+        batcher.Batcher.submit)."""
         fut = asyncio.get_running_loop().create_future()
-        await self._q.put((texts, fut))
+        await self._q.put((texts, trace, fut))
         # same 60s bound the sync path enforces via fut.result(60): a
         # wedged flush must fail the request, not pin the connection
         return await asyncio.wait_for(fut, timeout=60)
@@ -89,12 +95,18 @@ class AioBatcher:
                 pending.append(nxt)
                 n += len(nxt[0])
             await slots.acquire()
-            texts = [t for ts, _ in pending for t in ts]
+            texts = [t for ts, _, _ in pending for t in ts]
+            # one flush-scoped trace shared by every traced request in
+            # the batch (same grafting contract as batcher.Batcher)
+            ftrace = telemetry.Trace() \
+                if any(tr is not None for _, tr, _ in pending) else None
 
-            def _resolve(results, pending=pending):
+            def _resolve(results, pending=pending, ftrace=ftrace):
                 i = 0
-                for ts, fut in pending:
+                for ts, tr, fut in pending:
                     if not fut.done():
+                        if tr is not None and ftrace is not None:
+                            tr.graft(ftrace, depth=1)
                         fut.set_result(results[i:i + len(ts)])
                     i += len(ts)
 
@@ -109,8 +121,14 @@ class AioBatcher:
                 vals, miss = None, None
             miss_texts = texts if miss is None \
                 else [texts[i] for i in miss]
-            task = loop.run_in_executor(self._pool, self._detect,
-                                        miss_texts)
+            if self._detect_takes_trace:
+                task = loop.run_in_executor(
+                    self._pool,
+                    lambda mt=miss_texts, ft=ftrace:
+                        self._detect(mt, trace=ft))
+            else:
+                task = loop.run_in_executor(self._pool, self._detect,
+                                            miss_texts)
 
             def _done(ftr, pending=pending, vals=vals, miss=miss,
                       texts=texts, miss_texts=miss_texts,
@@ -118,7 +136,7 @@ class AioBatcher:
                 slots.release()
                 err = ftr.exception()
                 if err is not None:
-                    for _, fut in pending:
+                    for _, _, fut in pending:
                         if not fut.done():
                             fut.set_exception(err)
                     return
@@ -271,6 +289,8 @@ class AioService:
         m = svc.metrics
         import time
         t0 = time.time()
+        trace = None
+        meta: dict = {"front": "aio"}
         try:
             if method == b"GET":
                 if path in ("/", ""):
@@ -280,34 +300,48 @@ class AioService:
             if method != b"POST" or path not in ("/", ""):
                 m.inc("augmentation_invalid_requests_total")
                 return _http_response(404, b'{"error":"Not found"}')
+            trace = telemetry.Trace()
+            t = trace.t0
             ct = headers.get(b"content-type")
             doc, err = parse_post_body(
                 m, ct.decode("latin-1") if ct is not None else None, body)
             if err is not None:
+                meta["status"] = err[0]
                 return _http_response(*err)
             pre = pre_detect(svc, doc)
+            t = telemetry.observe_stage("parse", t, trace=trace)
             if pre is None:
                 m.inc("augmentation_errors_logged_total")
+                meta["status"] = 400
                 return _http_response(400, json.dumps(
                     {"error": "Unable to parse request - invalid JSON "
                               "detected"}).encode())
             texts, slots, responses, status = pre
+            meta["docs"] = len(texts)
             try:
-                codes = await self.batcher.submit(texts) if texts else []
+                codes = await self.batcher.submit(texts, trace=trace) \
+                    if texts else []
             except (asyncio.TimeoutError, TimeoutError):
                 # wedged flush: fail THIS request with a response (the
                 # disconnect handler upstream must not eat it — on 3.12
                 # asyncio.TimeoutError IS builtins.TimeoutError)
                 m.inc("augmentation_errors_logged_total")
+                meta["status"] = 500
                 return _http_response(
                     500, b'{"error":"detection timed out"}')
+            t = telemetry.observe_stage("detect", t, trace=trace)
             status, payload = post_detect(svc, codes, slots, responses,
                                           status)
+            telemetry.observe_stage("encode", t, trace=trace)
+            meta["status"] = status
             return _http_response(status, payload)
         finally:
             m.inc("augmentation_requests_total")
-            m.inc("augmentation_request_duration_milliseconds",
-                  (time.time() - t0) * 1e3)
+            if trace is not None:
+                # detect path: histogram + slow-ring via the trace
+                telemetry.finish_request(trace, meta=meta)
+            else:
+                m.observe_request_ms((time.time() - t0) * 1e3)
 
     async def handle_metrics(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
@@ -315,15 +349,32 @@ class AioService:
         try:
             while True:
                 try:
-                    await reader.readuntil(b"\r\n\r\n")
+                    head = await reader.readuntil(b"\r\n\r\n")
                 except (asyncio.IncompleteReadError, ConnectionError,
                         asyncio.LimitOverrunError):
                     break
+                parts = head.partition(b"\r\n")[0].split()
+                path = parts[1].decode("latin-1").split("?", 1)[0] \
+                    if len(parts) >= 2 else "/metrics"
                 self._busy.add(writer)
                 try:
-                    body = self.svc.metrics.render().encode()
-                    writer.write(_http_response(
-                        200, body, b"text/plain; version=0.0.4"))
+                    if path == "/debug/vars":
+                        body = json.dumps(telemetry.debug_vars(
+                            self.svc.metrics), indent=2).encode()
+                        writer.write(_http_response(200, body))
+                    elif path == "/debug/slow":
+                        ring = telemetry.REGISTRY.slow
+                        body = json.dumps(
+                            {"threshold_ms": ring.threshold_ms,
+                             "capacity": ring.capacity,
+                             "recorded": ring.recorded,
+                             "traces": ring.snapshot()},
+                            indent=2).encode()
+                        writer.write(_http_response(200, body))
+                    else:
+                        body = self.svc.metrics.render().encode()
+                        writer.write(_http_response(
+                            200, body, b"text/plain; version=0.0.4"))
                     await writer.drain()
                 finally:
                     self._busy.discard(writer)

@@ -24,16 +24,28 @@ engine; the hit rate exports as a /metrics gauge.
 """
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
+
+from .. import telemetry
 
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
 # ceiling (models/ngram.py's scheduler pool uses the same depth)
 _FLUSH_WORKERS = 3
 
 _MISS = object()  # cache sentinel: any real result (even None) differs
+
+
+def _accepts_trace(fn) -> bool:
+    """Does this detect callable take a trace= keyword? (Both batchers
+    pass the flush trace through when it does.)"""
+    try:
+        return "trace" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
 
 
 def _value_nbytes(v) -> int:
@@ -102,6 +114,10 @@ class Batcher:
     def __init__(self, detect_fn, max_batch: int = 16384,
                  max_delay_ms: float = 5.0, cache_bytes: int = 0):
         self._detect = detect_fn          # list[str] -> list[results]
+        # engine-backed detect fns accept trace= and record their
+        # scheduler spans into the flush trace; plain list->list
+        # callables (tests, bench harnesses) are served as-is
+        self._detect_takes_trace = _accepts_trace(detect_fn)
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
         self._cache = ResultCache(cache_bytes) if cache_bytes > 0 \
@@ -117,13 +133,16 @@ class Batcher:
                                         name="ldt-batcher")
         self._thread.start()
 
-    def submit(self, texts: list, hints_key=None) -> Future:
+    def submit(self, texts: list, hints_key=None, trace=None) -> Future:
         """Queue one request's texts; resolves to their results (in
         order) once a batch containing them completes. hints_key: any
         hashable token identifying the request's hint configuration —
-        cached results are only ever shared within one hints_key."""
+        cached results are only ever shared within one hints_key.
+        trace: optional telemetry.Trace; the flush serving this request
+        grafts its stage spans (dedup/pack/dispatch/...) into it before
+        resolving the future."""
         fut: Future = Future()
-        self._q.put((texts, hints_key, fut))
+        self._q.put((texts, hints_key, trace, fut))
         return fut
 
     def cache_stats(self) -> dict | None:
@@ -204,18 +223,37 @@ class Batcher:
             if not fut.cancelled():
                 fut.set_exception(err)
 
+    def _run_detect(self, texts: list, ftrace):
+        if self._detect_takes_trace:
+            return self._detect(texts, trace=ftrace)
+        return self._detect(texts)
+
+    @staticmethod
+    def _graft(tr, ftrace):
+        """Adopt the flush's stage spans as children of the request's
+        (still-open) detect span, just before its future resolves."""
+        if tr is not None and ftrace is not None:
+            tr.graft(ftrace, depth=1)
+
     def _flush(self, pending: list):
         try:
+            # one flush-scoped trace shared by every traced request in
+            # the batch: the engine records dedup/pack/dispatch spans
+            # into it, and each request adopts a copy at resolve time
+            ftrace = telemetry.Trace() \
+                if any(tr is not None for _, _, tr, _ in pending) \
+                else None
             if self._cache is None:
-                texts = [t for ts, _, _ in pending for t in ts]
+                texts = [t for ts, _, _, _ in pending for t in ts]
                 try:
-                    results = self._detect(texts)
+                    results = self._run_detect(texts, ftrace)
                 except Exception as e:  # noqa: BLE001 - fail every waiter
                     self._fail(pending, e)
                     return
                 i = 0
-                for ts, _, fut in pending:
+                for ts, _, tr, fut in pending:
                     if not fut.cancelled():
+                        self._graft(tr, ftrace)
                         fut.set_result(results[i:i + len(ts)])
                     i += len(ts)
                 return
@@ -224,7 +262,7 @@ class Batcher:
             plans: list = []       # one value list per request
             miss_texts: list = []
             miss_refs: list = []   # (plan, slot, key, text)
-            for ts, hk, _ in pending:
+            for ts, hk, _, _ in pending:
                 plan = []
                 for t in ts:
                     key = (hk, t)
@@ -235,7 +273,7 @@ class Batcher:
                         miss_texts.append(t)
                 plans.append(plan)
             try:
-                miss_results = self._detect(miss_texts) \
+                miss_results = self._run_detect(miss_texts, ftrace) \
                     if miss_texts else []
             except Exception as e:  # noqa: BLE001 - fail every waiter
                 self._fail(pending, e)
@@ -243,8 +281,9 @@ class Batcher:
             for (plan, slot, key, t), v in zip(miss_refs, miss_results):
                 plan[slot] = v
                 self._cache.put(key, v, t)
-            for (ts, _, fut), plan in zip(pending, plans):
+            for (ts, _, tr, fut), plan in zip(pending, plans):
                 if not fut.cancelled():
+                    self._graft(tr, ftrace)
                     fut.set_result(plan)
         finally:
             self._slots.release()

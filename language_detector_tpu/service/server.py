@@ -32,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
+from .. import telemetry
 from .batcher import Batcher
 
 BODY_LIMIT_BYTES = 1_000_000            # main.go:59
@@ -67,14 +68,19 @@ def strip_extras(text: str) -> str:
 
 
 class Metrics:
-    """Prometheus-style counters (main.go:137-147) + TPU batch stats."""
+    """Prometheus-style counters (main.go:137-147) + TPU batch stats.
+
+    Request durations live in a real histogram
+    (ldt_request_latency_ms, telemetry.REGISTRY — shared with the
+    asyncio front); the reference's raw running-sum series
+    `augmentation_request_duration_milliseconds` stays emitted for
+    backward compatibility, derived from the histogram's sum."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters = {
             "augmentation_requests_total": 0,
             "augmentation_invalid_requests_total": 0,
-            "augmentation_request_duration_milliseconds": 0.0,
             "augmentation_errors_logged_total": 0,
         }
         self.objects = {"successful": 0, "unsuccessful": 0}
@@ -91,6 +97,12 @@ class Metrics:
     def inc(self, name: str, amount: float = 1):
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + amount
+
+    def observe_request_ms(self, ms: float):
+        """One request's end-to-end latency into the shared histogram
+        (replaces the old running-sum inc)."""
+        telemetry.REGISTRY.histogram("ldt_request_latency_ms") \
+            .observe(ms)
 
     def inc_object(self, status: str, amount: int = 1):
         with self._lock:
@@ -109,55 +121,98 @@ class Metrics:
             for name, n in counts.items():
                 langs[name] = langs.get(name, 0) + n
 
+    _COUNTER_HELP = {
+        "augmentation_requests_total":
+            "Total HTTP requests served (main.go:137).",
+        "augmentation_invalid_requests_total":
+            "Requests rejected for shape/route/content-type.",
+        "augmentation_errors_logged_total":
+            "Error responses logged.",
+    }
+
     def render(self) -> str:
+        """Full Prometheus exposition body: every family carries # HELP
+        and # TYPE, label values are escaped, and the whole output
+        passes a strict parser (tests/test_telemetry.py lint)."""
+        fams: list = []
         with self._lock:
-            lines = []
             for k, v in sorted(self.counters.items()):
-                lines.append(f"# TYPE {k} counter")
-                lines.append(f"{k} {v}")
-            lines.append("# TYPE augmentation_objects_processed_total "
-                         "counter")
-            for status, v in sorted(self.objects.items()):
-                lines.append('augmentation_objects_processed_total'
-                             f'{{status="{status}"}} {v}')
-            lines.append("# TYPE augmentation_detected_language counter")
-            for lang, v in sorted(self.languages.items()):
-                lines.append('augmentation_detected_language'
-                             f'{{language="{lang}"}} {v}')
-        # engine gauges last, read live (the engine locks its own stats)
+                fams.append((k, "counter",
+                             self._COUNTER_HELP.get(k, k),
+                             [(k, None, v)]))
+            fams.append((
+                "augmentation_objects_processed_total", "counter",
+                "Documents processed, by outcome (main.go:141).",
+                [("augmentation_objects_processed_total",
+                  {"status": s}, v)
+                 for s, v in sorted(self.objects.items())]))
+            fams.append((
+                "augmentation_detected_language", "counter",
+                "Documents per detected language name (main.go:144).",
+                [("augmentation_detected_language",
+                  {"language": name}, v)
+                 for name, v in sorted(self.languages.items())]))
+        # legacy running-sum series (the reference's raw duration
+        # counter, main.go:139) — now derived from the histogram's sum
+        # so old dashboards keep working next to the real histogram
+        _, req_sum, _, _ = telemetry.REGISTRY.histogram(
+            "ldt_request_latency_ms").snapshot()
+        fams.append((
+            "augmentation_request_duration_milliseconds", "counter",
+            "DEPRECATED running sum of request milliseconds; prefer "
+            "ldt_request_latency_ms (histogram).",
+            [("augmentation_request_duration_milliseconds", None,
+              round(req_sum, 6))]))
+        # engine gauges, read live (the engine locks its own stats)
         es = self.engine_stats()
-        lines.append("# TYPE ldt_batch_flushes_total counter")
-        lines.append(f"ldt_batch_flushes_total {es.get('batches', 0)}")
+        fams.append(("ldt_batch_flushes_total", "counter",
+                     "Engine batch flushes (all paths).",
+                     [("ldt_batch_flushes_total", None,
+                       es.get("batches", 0))]))
         # what the recycle watcher meters against LDT_MAX_DISPATCHES
         # (excludes all-C tiny flushes, which burn no recycle budget)
-        lines.append("# TYPE ldt_device_dispatches_total counter")
-        lines.append("ldt_device_dispatches_total "
-                     f"{es.get('device_dispatches', 0)}")
-        lines.append("# TYPE ldt_fallback_documents_total counter")
-        lines.append("ldt_fallback_documents_total "
-                     f"{es.get('fallback_docs', 0) + es.get('scalar_recursion_docs', 0)}")
+        fams.append(("ldt_device_dispatches_total", "counter",
+                     "Device program launches (recycle-watcher meter).",
+                     [("ldt_device_dispatches_total", None,
+                       es.get("device_dispatches", 0))]))
+        fams.append(("ldt_fallback_documents_total", "counter",
+                     "Documents resolved off the device path "
+                     "(packer fallback + gate recursion).",
+                     [("ldt_fallback_documents_total", None,
+                       es.get("fallback_docs", 0) +
+                       es.get("scalar_recursion_docs", 0))]))
         # bucketed-scheduler lanes (models/ngram.py _detect_stream)
-        lines.append("# TYPE ldt_tier_dispatches_total counter")
-        for tier in ("short", "mid", "long", "mixed"):
-            lines.append(f'ldt_tier_dispatches_total{{tier="{tier}"}} '
-                         f"{es.get(f'tier_{tier}_dispatches', 0)}")
-        lines.append("# TYPE ldt_retry_lane_dispatches_total counter")
-        lines.append("ldt_retry_lane_dispatches_total "
-                     f"{es.get('retry_lane_dispatches', 0)}")
-        lines.append("# TYPE ldt_dedup_documents_total counter")
-        lines.append("ldt_dedup_documents_total "
-                     f"{es.get('dedup_docs', 0)}")
+        fams.append(("ldt_tier_dispatches_total", "counter",
+                     "Dispatches per shape-tier lane.",
+                     [("ldt_tier_dispatches_total", {"tier": tier},
+                       es.get(f"tier_{tier}_dispatches", 0))
+                      for tier in ("short", "mid", "long", "mixed")]))
+        fams.append(("ldt_retry_lane_dispatches_total", "counter",
+                     "Overlapped retry-lane dispatches.",
+                     [("ldt_retry_lane_dispatches_total", None,
+                       es.get("retry_lane_dispatches", 0))]))
+        fams.append(("ldt_dedup_documents_total", "counter",
+                     "Documents answered by batch-internal dedup.",
+                     [("ldt_dedup_documents_total", None,
+                       es.get("dedup_docs", 0))]))
         # result cache (service/batcher.py, LDT_RESULT_CACHE_MB)
         cs = self.cache_stats()
-        lines.append("# TYPE ldt_result_cache_hit_rate gauge")
-        lines.append("ldt_result_cache_hit_rate "
-                     f"{cs['hit_rate'] if cs else 0.0}")
-        lines.append("# TYPE ldt_result_cache_hits_total counter")
-        lines.append("ldt_result_cache_hits_total "
-                     f"{cs['hits'] if cs else 0}")
-        lines.append("# TYPE ldt_result_cache_bytes gauge")
-        lines.append(f"ldt_result_cache_bytes {cs['bytes'] if cs else 0}")
-        return "\n".join(lines) + "\n"
+        fams.append(("ldt_result_cache_hit_rate", "gauge",
+                     "Result-cache hit rate since start.",
+                     [("ldt_result_cache_hit_rate", None,
+                       cs["hit_rate"] if cs else 0.0)]))
+        fams.append(("ldt_result_cache_hits_total", "counter",
+                     "Result-cache hits.",
+                     [("ldt_result_cache_hits_total", None,
+                       cs["hits"] if cs else 0)]))
+        fams.append(("ldt_result_cache_bytes", "gauge",
+                     "Result-cache resident bytes.",
+                     [("ldt_result_cache_bytes", None,
+                       cs["bytes"] if cs else 0)]))
+        # shared telemetry registry: stage/request histograms + compile
+        # counters (both fronts render the same registry)
+        fams.extend(telemetry.REGISTRY.families())
+        return telemetry.render_exposition(fams)
 
 
 class DetectorService:
@@ -213,7 +268,7 @@ class DetectorService:
                 # race now that flushes run concurrently on worker pools
                 metrics.engine_stats = lambda: dict(eng.stats)
 
-                def detect(texts):
+                def detect(texts, trace=None):
                     # codes-only engine path: the handler needs just the
                     # ISO code per item (wrapper.cc:7-16 semantics), and
                     # skipping result materialization matters at 16K-doc
@@ -222,7 +277,8 @@ class DetectorService:
                     # device transfer, and fetch pipeline INSIDE the
                     # flush (a single 16K slice runs serially: measured
                     # 63K -> 75K docs/sec through the asyncio front)
-                    return eng.detect_codes(texts, batch_size=8192)
+                    return eng.detect_codes(texts, batch_size=8192,
+                                            trace=trace)
                 return detect
             except (ImportError, RuntimeError):
                 pass
@@ -231,14 +287,17 @@ class DetectorService:
         tables = load_tables()
         self._engine = None
 
-        def detect(texts):
-            return [registry.code(
+        def detect(texts, trace=None):
+            t0 = time.monotonic()
+            out = [registry.code(
                 detect_scalar(t, tables, registry).summary_lang)
                 for t in texts]
+            telemetry.observe_stage("scalar_detect", t0, trace=trace)
+            return out
         return detect
 
-    def detect_codes(self, texts: list) -> list:
-        fut = self.batcher.submit(texts)
+    def detect_codes(self, texts: list, trace=None) -> list:
+        fut = self.batcher.submit(texts, trace=trace)
         return fut.result(timeout=60)
 
     def log_processed(self, amount: int = 1):
@@ -304,13 +363,15 @@ class Handler(BaseHTTPRequestHandler):
             self._finish_metrics(t0)
             return
         self._detector(body)
-        self._finish_metrics(t0)
+        # the detector path observed its own (traced) duration via
+        # telemetry.finish_request — only count the request here
+        self._finish_metrics(t0, traced=True)
 
-    def _finish_metrics(self, t0: float):
+    def _finish_metrics(self, t0: float, traced: bool = False):
         m = self.service.metrics
         m.inc("augmentation_requests_total")
-        m.inc("augmentation_request_duration_milliseconds",
-              (time.time() - t0) * 1e3)
+        if not traced:
+            m.observe_request_ms((time.time() - t0) * 1e3)
 
     def _consume_body(self) -> bytes:
         """Read the request body, truncated at 1 MB, draining any excess
@@ -332,20 +393,32 @@ class Handler(BaseHTTPRequestHandler):
     def _detector(self, body: bytes):
         """LanguageDetectorHandler (handlers.go:105-186)."""
         svc = self.service
+        trace = telemetry.Trace()
+        t = trace.t0
         doc, err = parse_post_body(svc.metrics,
                                    self.headers.get("Content-Type"), body)
         if err is not None:
             self._send_json(*err)
+            telemetry.finish_request(
+                trace, meta={"front": "sync", "status": err[0]})
             return
         pre = pre_detect(svc, doc)
+        t = telemetry.observe_stage("parse", t, trace=trace)
         if pre is None:
             self._send_error_json(
                 "Unable to parse request - invalid JSON detected", 400)
+            telemetry.finish_request(
+                trace, meta={"front": "sync", "status": 400})
             return
         texts, slots, responses, status = pre
-        codes = svc.detect_codes(texts) if texts else []
+        codes = svc.detect_codes(texts, trace=trace) if texts else []
+        t = telemetry.observe_stage("detect", t, trace=trace)
         status, payload = post_detect(svc, codes, slots, responses, status)
+        telemetry.observe_stage("encode", t, trace=trace)
         self._send_json(status, payload)
+        telemetry.finish_request(
+            trace, meta={"front": "sync", "docs": len(texts),
+                         "status": status})
 
 
 # -- shared contract logic (sync Handler above + the asyncio server) --------
@@ -456,9 +529,25 @@ class MetricsHandler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        body = self.service.metrics.render().encode()
+        path = self.path.split("?", 1)[0]
+        if path == "/debug/vars":
+            body = json.dumps(
+                telemetry.debug_vars(self.service.metrics),
+                indent=2).encode()
+            ctype = "application/json; charset=utf-8"
+        elif path == "/debug/slow":
+            ring = telemetry.REGISTRY.slow
+            body = json.dumps(
+                {"threshold_ms": ring.threshold_ms,
+                 "capacity": ring.capacity,
+                 "recorded": ring.recorded,
+                 "traces": ring.snapshot()}, indent=2).encode()
+            ctype = "application/json; charset=utf-8"
+        else:
+            body = self.service.metrics.render().encode()
+            ctype = "text/plain; version=0.0.4"
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -1,0 +1,490 @@
+"""Process-wide telemetry: latency histograms, request-scoped span
+traces, compile-event tracking, and the slow-request sampler.
+
+The reference service exports four flat Prometheus counters
+(main.go:137-147); this module is the observability layer the TPU
+redesign needs to say WHERE a slow request spent its time. Four pieces:
+
+  Histogram        thread-safe fixed log-scaled latency buckets,
+                   rendered in Prometheus exposition format
+                   (`*_bucket`/`_sum`/`_count`) next to the counters
+  Trace            request-scoped span recorder: monotonic-clock pairs,
+                   one list append per span, no per-event allocation
+                   beyond the span tuple — cheap enough for every
+                   request on the hot path
+  CompileTracker   first-execution detection per padded wire shape per
+                   dispatch lane, exported as ldt_xla_compiles_total
+                   (bucket-ladder churn becomes visible instead of
+                   showing up as mystery multi-second requests)
+  SlowTraceRing    bounded ring of full span trees for requests over
+                   LDT_SLOW_TRACE_MS (off by default), served by
+                   GET /debug/slow and `debug.py --slow-traces`
+
+One module-level REGISTRY is shared by the sync and asyncio fronts, the
+batcher flush workers, and the engine scheduler — a request's span tree
+is assembled across all of them (handler spans + grafted flush spans),
+and /metrics on either front renders the same registry.
+
+Env knobs: LDT_SLOW_TRACE_MS (threshold, 0/unset = sampler off),
+LDT_SLOW_TRACE_RING (ring capacity, default 64).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+
+_mono = time.monotonic
+
+_PROCESS_START = time.time()
+
+# Log-scaled (base-2) latency bucket upper bounds in milliseconds:
+# 0.05ms .. ~105s. One fixed ladder for every latency series keeps the
+# exposition predictable and cross-stage comparisons trivial.
+BUCKET_EDGES_MS = tuple(0.05 * 2 ** k for k in range(22))
+
+
+class Histogram:
+    """Thread-safe fixed-bucket latency histogram.
+
+    Cumulative-bucket semantics match Prometheus: bucket i counts
+    observations <= BUCKET_EDGES_MS[i] when rendered (counts are stored
+    per-bucket and cumulated at render/percentile time, so observe()
+    stays one bisect + two adds under the lock)."""
+
+    __slots__ = ("edges", "counts", "sum", "count", "max", "_lock")
+
+    def __init__(self, edges=BUCKET_EDGES_MS):
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value_ms: float):
+        i = bisect_left(self.edges, value_ms)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value_ms
+            self.count += 1
+            if value_ms > self.max:
+                self.max = value_ms
+
+    def snapshot(self):
+        """(per-bucket counts, sum, count, max) under one lock."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count, self.max
+
+    def percentile(self, q: float):
+        """Approximate q-th percentile (q in [0, 100]) by linear
+        interpolation inside the holding bucket; the +Inf bucket answers
+        the observed max. None when empty."""
+        counts, _, total, vmax = self.snapshot()
+        if total == 0:
+            return None
+        target = total * q / 100.0
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.edges):
+                    return vmax
+                hi = min(self.edges[i], vmax) if vmax > 0 else \
+                    self.edges[i]
+                if hi < lo:
+                    hi = self.edges[i]
+                frac = (target - (cum - c)) / c
+                return lo + (hi - lo) * frac
+            if i < len(self.edges):
+                lo = self.edges[i]
+        return vmax
+
+
+class Trace:
+    """One request's span recorder.
+
+    Spans are (name, depth, start, end) tuples of monotonic seconds —
+    recorded with a single list append (GIL-atomic, so flush workers on
+    other threads may add() into a request's trace concurrently).
+    Parent/child structure is carried by `depth` plus time order; the
+    tree is reconstructed at render time, never maintained on the hot
+    path."""
+
+    __slots__ = ("t0", "t_wall", "spans")
+
+    def __init__(self):
+        self.t0 = _mono()
+        self.t_wall = time.time()
+        self.spans: list = []
+
+    def add(self, name: str, t0: float, t1: float, depth: int = 0):
+        self.spans.append((name, depth, t0, t1))
+
+    def graft(self, other: "Trace", depth: int = 1):
+        """Adopt another trace's spans (a batch flush shared by several
+        requests) as children at `depth` — called once per request per
+        flush, off the per-event path."""
+        self.spans.extend((n, d + depth, s, e)
+                          for n, d, s, e in other.spans)
+
+    def total_ms(self) -> float:
+        return (_mono() - self.t0) * 1e3
+
+    def span_ms(self, name: str) -> float:
+        """Total milliseconds across spans with this name (a request can
+        record several dispatch spans)."""
+        return sum((e - s) for n, _, s, e in self.spans if n == name) \
+            * 1e3
+
+    def to_dict(self, total_ms: float | None = None,
+                meta: dict | None = None) -> dict:
+        base = self.t0
+        spans = sorted(self.spans, key=lambda sp: (sp[2], sp[1]))
+        return {
+            "ts": self.t_wall,
+            "total_ms": round(self.total_ms()
+                              if total_ms is None else total_ms, 3),
+            "meta": meta or {},
+            "spans": [{"name": n, "depth": d,
+                       "start_ms": round((s - base) * 1e3, 3),
+                       "dur_ms": round((e - s) * 1e3, 3)}
+                      for n, d, s, e in spans],
+        }
+
+
+class CompileTracker:
+    """First-execution detection for jitted entry points: one padded
+    wire shape per dispatch lane counts exactly once. The engine keys on
+    (lane, mesh size, wire array shapes) — the same signature XLA's jit
+    cache keys on (dtypes are fixed), so a fresh key means the dispatch
+    about to run pays a trace + compile."""
+
+    def __init__(self):
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def first_seen(self, lane: str, key) -> bool:
+        k = (lane, key)
+        with self._lock:
+            if k in self._seen:
+                return False
+            self._seen.add(k)
+            return True
+
+    def __len__(self):
+        with self._lock:
+            return len(self._seen)
+
+    def clear(self):
+        with self._lock:
+            self._seen.clear()
+
+
+class SlowTraceRing:
+    """Bounded ring of span trees for requests over the threshold.
+
+    Off by default: LDT_SLOW_TRACE_MS unset/0 means maybe_record is a
+    single float compare. The deque's maxlen IS the eviction policy —
+    the newest `capacity` slow traces win."""
+
+    def __init__(self, capacity: int | None = None,
+                 threshold_ms: float | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("LDT_SLOW_TRACE_RING",
+                                              "64") or 64)
+            except ValueError:
+                capacity = 64
+        if threshold_ms is None:
+            try:
+                threshold_ms = float(os.environ.get("LDT_SLOW_TRACE_MS",
+                                                    "0") or 0)
+            except ValueError:
+                threshold_ms = 0.0
+        self.capacity = max(capacity, 1)
+        self.threshold_ms = threshold_ms
+        self.recorded = 0  # total ever recorded (evictions included)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def maybe_record(self, trace: Trace, total_ms: float,
+                     meta: dict | None = None) -> bool:
+        if self.threshold_ms <= 0 or total_ms < self.threshold_ms:
+            return False
+        d = trace.to_dict(total_ms=total_ms, meta=meta)
+        with self._lock:
+            self._ring.append(d)
+            self.recorded += 1
+        return True
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.recorded = 0
+
+
+# -- Prometheus exposition rendering ----------------------------------------
+
+
+def escape_label_value(v) -> str:
+    """Label-value escaping per the exposition format: backslash, double
+    quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def render_exposition(families) -> str:
+    """families: iterable of (name, type, help, samples); each sample is
+    (series_name, labels dict | None, value). Emits `# HELP` + `# TYPE`
+    for every family and escapes every label value — the whole /metrics
+    body passes a strict exposition parser
+    (tests/test_telemetry.py::test_metrics_exposition_lint)."""
+    lines: list = []
+    for name, mtype, help_text, samples in families:
+        lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for series, labels, value in samples:
+            lines.append(f"{series}{_fmt_labels(labels)} "
+                         f"{_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def histogram_family(name: str, help_text: str, labeled_hists) -> tuple:
+    """One histogram family from {labels-tuple: Histogram}: cumulative
+    `_bucket` series (le as the LAST label), `_sum`, `_count`."""
+    samples: list = []
+    for label_items, hist in sorted(labeled_hists.items()):
+        base = dict(label_items)
+        counts, total_sum, total, _ = hist.snapshot()
+        cum = 0
+        for i, edge in enumerate(hist.edges):
+            cum += counts[i]
+            samples.append((f"{name}_bucket",
+                            {**base, "le": repr(float(edge))}, cum))
+        cum += counts[len(hist.edges)]
+        samples.append((f"{name}_bucket", {**base, "le": "+Inf"}, cum))
+        samples.append((f"{name}_sum", base or None,
+                        round(total_sum, 6)))
+        samples.append((f"{name}_count", base or None, total))
+    return (name, "histogram", help_text, samples)
+
+
+# -- registry ---------------------------------------------------------------
+
+
+class TelemetryRegistry:
+    """Histograms + counters keyed (name, sorted label items), plus the
+    compile tracker and the slow-trace ring. Shared process-wide (module
+    REGISTRY below); reset() clears in place so every holder of the
+    reference sees the fresh state (tests)."""
+
+    _HELP = {
+        "ldt_stage_latency_ms":
+            "Per-stage wall time (ms) through the request pipeline.",
+        "ldt_request_latency_ms":
+            "End-to-end HTTP request wall time (ms).",
+        "ldt_xla_compiles_total":
+            "Jitted-scorer compilations: first execution of a new "
+            "padded wire shape, per dispatch lane.",
+        "ldt_xla_compile_ms":
+            "Dispatch wall time (ms) of first-execution (compiling) "
+            "launches, per lane.",
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: dict = {}     # (name, label items) -> Histogram
+        self._counters: dict = {}  # (name, label items) -> number
+        self.compiles = CompileTracker()
+        self.slow = SlowTraceRing()
+
+    @staticmethod
+    def _key(name: str, labels: dict):
+        return name, tuple(sorted(labels.items()))
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        k = self._key(name, labels)
+        h = self._hists.get(k)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(k, Histogram())
+        return h
+
+    def counter_inc(self, name: str, amount=1, **labels):
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + amount
+
+    def counter_value(self, name: str, **labels):
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0)
+
+    def families(self) -> list:
+        """Exposition families for everything in the registry."""
+        with self._lock:
+            hists = dict(self._hists)
+            counters = dict(self._counters)
+        fams: list = []
+        by_name: dict = {}
+        for (name, litems), h in hists.items():
+            by_name.setdefault(name, {})[litems] = h
+        for name in sorted(by_name):
+            fams.append(histogram_family(
+                name, self._HELP.get(name, name), by_name[name]))
+        cnt_by_name: dict = {}
+        for (name, litems), v in counters.items():
+            cnt_by_name.setdefault(name, []).append((litems, v))
+        for name in sorted(cnt_by_name):
+            samples = [(name, dict(litems) or None, v)
+                       for litems, v in sorted(cnt_by_name[name])]
+            fams.append((name, "counter",
+                         self._HELP.get(name, name), samples))
+        return fams
+
+    def stage_percentiles(self) -> dict:
+        """{stage: {count, p50, p95, p99, mean}} over the
+        ldt_stage_latency_ms histograms — bench.py's per-stage report
+        and /debug/vars both read this."""
+        with self._lock:
+            hists = {litems: h for (name, litems), h
+                     in self._hists.items()
+                     if name == "ldt_stage_latency_ms"}
+        out: dict = {}
+        for litems, h in hists.items():
+            stage = dict(litems).get("stage", "?")
+            _, total_sum, total, _ = h.snapshot()
+            if not total:
+                continue
+            out[stage] = {
+                "count": total,
+                "mean": round(total_sum / total, 3),
+                "p50": round(h.percentile(50), 3),
+                "p95": round(h.percentile(95), 3),
+                "p99": round(h.percentile(99), 3),
+            }
+        return out
+
+    def compile_counts(self) -> dict:
+        """{lane: count} view of ldt_xla_compiles_total."""
+        with self._lock:
+            items = [(dict(litems).get("lane", "?"), v)
+                     for (name, litems), v in self._counters.items()
+                     if name == "ldt_xla_compiles_total"]
+        return dict(items)
+
+    def reset(self):
+        """Clear in place (module REGISTRY is shared by reference)."""
+        with self._lock:
+            self._hists.clear()
+            self._counters.clear()
+        self.compiles.clear()
+        self.slow.clear()
+        # re-read env knobs so tests that monkeypatch them take effect
+        self.slow.__init__()
+
+
+REGISTRY = TelemetryRegistry()
+
+
+def observe_stage(stage: str, t0: float, t1: float | None = None,
+                  trace: Trace | None = None, depth: int = 0) -> float:
+    """Record one pipeline stage: observe its latency histogram and,
+    when a trace rides along, append the span. Returns t1 so callers can
+    chain stages without re-reading the clock."""
+    if t1 is None:
+        t1 = _mono()
+    REGISTRY.histogram("ldt_stage_latency_ms", stage=stage) \
+        .observe((t1 - t0) * 1e3)
+    if trace is not None:
+        trace.add(stage, t0, t1, depth)
+    return t1
+
+
+def finish_request(trace: Trace, meta: dict | None = None) -> float:
+    """End-of-request hook for both fronts: total latency into the
+    request histogram, span tree into the slow ring when over
+    threshold. Returns total ms."""
+    total = trace.total_ms()
+    REGISTRY.histogram("ldt_request_latency_ms").observe(total)
+    REGISTRY.slow.maybe_record(trace, total, meta=meta)
+    return total
+
+
+# -- /debug/vars ------------------------------------------------------------
+
+
+def _rss_bytes() -> int:
+    """Current RSS from /proc (Linux); ru_maxrss (peak) as fallback."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 - best-effort gauge
+            return 0
+
+
+def debug_vars(metrics=None) -> dict:
+    """statusz-style process snapshot: engine stats, cache stats,
+    request counters, process uptime/RSS, stage percentiles, compile
+    counts, slow-ring occupancy. One serializer shared by both fronts'
+    GET /debug/vars."""
+    d: dict = {
+        "pid": os.getpid(),
+        "uptime_sec": round(time.time() - _PROCESS_START, 3),
+        "rss_bytes": _rss_bytes(),
+    }
+    if metrics is not None:
+        with metrics._lock:
+            d["counters"] = dict(metrics.counters)
+            d["objects"] = dict(metrics.objects)
+            d["languages"] = dict(metrics.languages)
+        d["engine"] = dict(metrics.engine_stats() or {})
+        d["cache"] = metrics.cache_stats()
+    rh = REGISTRY.histogram("ldt_request_latency_ms")
+    _, rsum, rcount, rmax = rh.snapshot()
+    d["requests"] = {"count": rcount,
+                     "mean_ms": round(rsum / rcount, 3) if rcount else 0,
+                     "max_ms": round(rmax, 3),
+                     "p95_ms": round(rh.percentile(95) or 0, 3)}
+    d["stage_latency_ms"] = REGISTRY.stage_percentiles()
+    d["xla_compiles"] = REGISTRY.compile_counts()
+    d["slow_traces"] = {"threshold_ms": REGISTRY.slow.threshold_ms,
+                        "capacity": REGISTRY.slow.capacity,
+                        "recorded": REGISTRY.slow.recorded,
+                        "held": len(REGISTRY.slow.snapshot())}
+    return d

@@ -1,0 +1,430 @@
+"""Telemetry layer (round 7): histogram bucket math, span-tree
+nesting/grafting, compile-event tracking, the slow-request sampler, a
+strict exposition-format lint of the full /metrics body, and the
+end-to-end acceptance check — a request served through the sync front
+produces a span tree covering parse -> dedup -> pack -> dispatch ->
+encode whose span sum lands within 20% of the measured latency.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from language_detector_tpu import telemetry
+from language_detector_tpu.telemetry import (BUCKET_EDGES_MS, Histogram,
+                                             SlowTraceRing, Trace)
+
+
+def _require_engine():
+    from language_detector_tpu import native
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine
+
+
+# -- Histogram ---------------------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = Histogram()
+    # bucket edges are 0.05 * 2^k: 0.05, 0.1, 0.2, 0.4, ...
+    h.observe(0.05)   # == edge 0 -> bucket 0 (le is inclusive)
+    h.observe(0.06)   # -> bucket 1 (le 0.1)
+    h.observe(0.3)    # -> bucket 3 (le 0.4)
+    h.observe(1e9)    # -> +Inf overflow bucket
+    counts, total_sum, count, vmax = h.snapshot()
+    assert count == 4
+    assert total_sum == pytest.approx(0.05 + 0.06 + 0.3 + 1e9)
+    assert vmax == 1e9
+    assert counts[0] == 1 and counts[1] == 1 and counts[3] == 1
+    assert counts[len(BUCKET_EDGES_MS)] == 1  # overflow slot
+    assert sum(counts) == 4
+
+
+def test_histogram_percentiles():
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    p50 = h.percentile(50)
+    assert 0.8 <= p50 <= 3.2  # inside the holding bucket's range
+    assert h.percentile(100) == pytest.approx(100.0)
+    assert Histogram().percentile(50) is None
+
+
+def test_histogram_thread_safety():
+    h = Histogram()
+    n = 5000
+
+    def worker():
+        for _ in range(n):
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _, total_sum, count, _ = h.snapshot()
+    assert count == 4 * n
+    assert total_sum == pytest.approx(4 * n * 1.0)
+
+
+# -- Trace spans -------------------------------------------------------------
+
+
+def test_trace_nesting_and_ordering():
+    tr = Trace()
+    base = tr.t0
+    # request-level spans at depth 0; engine spans grafted at depth 1
+    tr.add("parse", base, base + 0.001)
+    tr.add("detect", base + 0.001, base + 0.010)
+    flush = Trace()
+    flush.add("dedup", base + 0.002, base + 0.003)
+    flush.add("pack", base + 0.003, base + 0.005)
+    flush.add("dispatch", base + 0.005, base + 0.009)
+    tr.graft(flush, depth=1)
+    tr.add("encode", base + 0.010, base + 0.011)
+    d = tr.to_dict(total_ms=11.0, meta={"front": "test"})
+    names = [s["name"] for s in d["spans"]]
+    # sorted by start time: children interleave inside their parent
+    assert names == ["parse", "detect", "dedup", "pack", "dispatch",
+                     "encode"]
+    depths = {s["name"]: s["depth"] for s in d["spans"]}
+    assert depths["parse"] == depths["detect"] == depths["encode"] == 0
+    assert depths["dedup"] == depths["pack"] == depths["dispatch"] == 1
+    assert d["total_ms"] == 11.0
+    assert d["meta"] == {"front": "test"}
+    # durations survive the render
+    by = {s["name"]: s for s in d["spans"]}
+    assert by["detect"]["dur_ms"] == pytest.approx(9.0, abs=0.01)
+    assert tr.span_ms("pack") == pytest.approx(2.0, abs=0.01)
+
+
+def test_observe_stage_returns_end_and_records():
+    telemetry.REGISTRY.reset()
+    tr = Trace()
+    t1 = telemetry.observe_stage("unit_stage", tr.t0, tr.t0 + 0.004,
+                                 trace=tr)
+    assert t1 == tr.t0 + 0.004
+    h = telemetry.REGISTRY.histogram("ldt_stage_latency_ms",
+                                     stage="unit_stage")
+    _, total_sum, count, _ = h.snapshot()
+    assert count == 1 and total_sum == pytest.approx(4.0)
+    assert tr.spans[0][0] == "unit_stage"
+
+
+# -- slow-request sampler ----------------------------------------------------
+
+
+def test_slow_ring_threshold_and_eviction():
+    ring = SlowTraceRing(capacity=3, threshold_ms=10.0)
+    fast = Trace()
+    assert not ring.maybe_record(fast, 5.0)
+    assert ring.snapshot() == []
+    for i in range(5):
+        tr = Trace()
+        tr.add("detect", tr.t0, tr.t0 + 0.02)
+        assert ring.maybe_record(tr, 20.0 + i, meta={"i": i})
+    held = ring.snapshot()
+    assert len(held) == 3                     # ring bound
+    assert ring.recorded == 5                 # evictions still counted
+    assert [t["meta"]["i"] for t in held] == [2, 3, 4]  # newest win
+    ring.clear()
+    assert ring.snapshot() == [] and ring.recorded == 0
+
+
+def test_slow_ring_off_by_default():
+    ring = SlowTraceRing(capacity=4, threshold_ms=0.0)
+    tr = Trace()
+    assert not ring.maybe_record(tr, 1e9)     # sampler disabled
+
+
+# -- compile-event tracking --------------------------------------------------
+
+
+def test_compile_counter_two_shapes():
+    """First execution of a new padded wire shape increments
+    ldt_xla_compiles_total{lane=...} exactly once; re-dispatching the
+    same shape does not."""
+    NgramBatchEngine = _require_engine()
+    import bench
+    telemetry.REGISTRY.reset()
+    eng = NgramBatchEngine()
+    short = bench.make_corpus(96)
+    eng.detect_batch(short)
+    lane_counts = telemetry.REGISTRY.compile_counts()
+    first = sum(lane_counts.values())
+    assert first >= 1
+    # same corpus -> same padded shapes -> no new compiles
+    eng.detect_batch(short)
+    assert sum(telemetry.REGISTRY.compile_counts().values()) == first
+    # much longer documents -> different padded chunk geometry -> at
+    # least one fresh shape per affected lane, counted exactly once
+    long_docs = [" ".join(bench.make_corpus(40)) + f" tail{i}"
+                 for i in range(96)]
+    eng.detect_batch(long_docs)
+    second = sum(telemetry.REGISTRY.compile_counts().values())
+    assert second > first
+    eng.detect_batch(long_docs)
+    assert sum(telemetry.REGISTRY.compile_counts().values()) == second
+    # compile wall-time histogram observed once per compile event
+    fams = dict((f[0], f) for f in telemetry.REGISTRY.families())
+    assert "ldt_xla_compile_ms" in fams
+    count_samples = [v for name, _, v in fams["ldt_xla_compile_ms"][3]
+                     if name.endswith("_count")]
+    assert sum(count_samples) == second
+
+
+# -- exposition rendering ----------------------------------------------------
+
+
+def _lint_exposition(body: str):
+    """Strict parse of a Prometheus text-format body: every sample
+    belongs to a HELP+TYPE'd family declared exactly once, label values
+    are well-formed, histogram buckets are cumulative and le="+Inf"
+    equals _count."""
+    import re
+    declared: dict = {}
+    samples: list = []
+    help_seen: set = set()
+    for line in body.strip("\n").split("\n"):
+        assert line == line.rstrip(), f"trailing whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in help_seen, f"duplicate HELP {name}"
+            help_seen.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            assert name not in declared, f"duplicate TYPE {name}"
+            assert mtype in ("counter", "gauge", "histogram", "summary")
+            assert name in help_seen, f"TYPE {name} before HELP"
+            declared[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = re.fullmatch(
+            r'([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*='
+            r'"(?:[^"\\\n]|\\\\|\\"|\\n)*",?)*)\})?'
+            r' (NaN|[-+]?(?:\d+\.?\d*(?:e[-+]?\d+)?|\.\d+|Inf))',
+            line)
+        assert m, f"malformed sample line: {line!r}"
+        series, labels, value = m.group(1), m.group(2), m.group(3)
+        family = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = series[:-len(suffix)] if series.endswith(suffix) \
+                else None
+            if base and declared.get(base) == "histogram":
+                family = base
+        assert family in declared, f"sample without TYPE: {line!r}"
+        samples.append((series, labels or "", float(value)
+                        if value not in ("NaN", "Inf") else value))
+    # histogram internal consistency
+    for name, mtype in declared.items():
+        if mtype != "histogram":
+            continue
+        buckets = [(lb, v) for s, lb, v in samples
+                   if s == f"{name}_bucket"]
+        assert buckets, f"histogram {name} has no buckets"
+        counts = {lb: v for s, lb, v in samples
+                  if s == f"{name}_count"}
+        # group by the labels minus le
+        groups: dict = {}
+        for lb, v in buckets:
+            le = re.search(r'le="([^"]*)"', lb).group(1)
+            rest = re.sub(r',?le="[^"]*"', "", lb).strip(",")
+            groups.setdefault(rest, []).append((le, v))
+        for rest, bs in groups.items():
+            vals = [v for _, v in bs]
+            assert vals == sorted(vals), \
+                f"{name}{{{rest}}} buckets not cumulative"
+            inf = [v for le, v in bs if le == "+Inf"]
+            assert len(inf) == 1, f"{name}{{{rest}}} missing le=+Inf"
+            total = next(v for lb, v in counts.items()
+                         if lb.strip(",") == rest)
+            assert inf[0] == total, \
+                f"{name}{{{rest}}} +Inf {inf[0]} != _count {total}"
+    return declared, samples
+
+
+def test_metrics_exposition_lint():
+    from language_detector_tpu.service.server import Metrics
+    telemetry.REGISTRY.reset()
+    m = Metrics()
+    m.inc("augmentation_requests_total")
+    m.inc_object("successful", 3)
+    # label values that need escaping
+    m.add_languages({'W"eird\\Lang\nName': 2, "English": 5})
+    m.observe_request_ms(12.5)
+    telemetry.REGISTRY.histogram("ldt_stage_latency_ms",
+                                 stage="pack").observe(1.25)
+    telemetry.REGISTRY.counter_inc("ldt_xla_compiles_total", lane="main")
+    body = m.render()
+    declared, samples = _lint_exposition(body)
+    assert declared["ldt_request_latency_ms"] == "histogram"
+    assert declared["ldt_stage_latency_ms"] == "histogram"
+    assert declared["ldt_xla_compiles_total"] == "counter"
+    # legacy series still emitted, derived from the histogram sum
+    assert declared["augmentation_request_duration_milliseconds"] == \
+        "counter"
+    legacy = [v for s, _, v in samples
+              if s == "augmentation_request_duration_milliseconds"]
+    assert legacy == [12.5]
+    # escaped label value round-trips
+    assert 'language="W\\"eird\\\\Lang\\nName"' in body
+
+
+# -- /debug/vars + acceptance through the sync front -------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_server():
+    """Sync front over the DEVICE engine (CPU backend): the acceptance
+    criterion needs the real scheduler's dedup/pack/dispatch spans."""
+    _require_engine()
+    from language_detector_tpu.service.server import (DetectorService,
+                                                      make_server)
+    telemetry.REGISTRY.reset()
+    # sample every request so the tests can read full span trees back
+    telemetry.REGISTRY.slow.threshold_ms = 0.0001
+    svc = DetectorService(use_device=True, max_delay_ms=1.0)
+    if svc._engine is None:
+        pytest.skip("device engine unavailable")
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+           "metrics_url":
+               f"http://127.0.0.1:{metricsd.server_address[1]}",
+           "svc": svc}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+    telemetry.REGISTRY.reset()
+
+
+def _post_docs(url, texts):
+    body = json.dumps(
+        {"request": [{"text": t} for t in texts]}).encode()
+    req = urllib.request.Request(
+        url + "/", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_request_span_tree_acceptance(traced_server):
+    """A request through the sync front yields a span tree covering
+    parse -> dedup -> pack -> dispatch -> encode whose depth-0 span sum
+    is within 20% of the recorded end-to-end latency."""
+    import bench
+    # > TINY_BATCH_C_PATH distinct docs so the flush takes the real
+    # pack/dispatch path rather than the all-C tiny shortcut
+    texts = bench.make_corpus(200)
+    telemetry.REGISTRY.slow.clear()
+    status, doc = _post_docs(traced_server["url"], texts)
+    assert status in (200, 203)
+    assert len(doc["response"]) == 200
+    held = telemetry.REGISTRY.slow.snapshot()
+    assert held, "slow sampler (threshold ~0) captured nothing"
+    tr = held[-1]
+    names = {s["name"] for s in tr["spans"]}
+    for required in ("parse", "dedup", "pack", "dispatch", "encode"):
+        assert required in names, f"span {required} missing: {names}"
+    # handler spans at depth 0, engine flush spans grafted deeper
+    depth = {s["name"]: s["depth"] for s in tr["spans"]}
+    assert depth["parse"] == depth["detect"] == depth["encode"] == 0
+    assert depth["dedup"] >= 1 and depth["pack"] >= 1
+    # depth-0 spans tile the request: their sum must explain the
+    # measured end-to-end latency to within 20%
+    top_ms = sum(s["dur_ms"] for s in tr["spans"] if s["depth"] == 0)
+    assert top_ms == pytest.approx(tr["total_ms"], rel=0.20), \
+        (top_ms, tr["total_ms"])
+
+
+def test_metrics_endpoint_lint_and_series(traced_server):
+    import bench
+    _post_docs(traced_server["url"], bench.make_corpus(100))
+    with urllib.request.urlopen(traced_server["metrics_url"] + "/",
+                                timeout=30) as resp:
+        body = resp.read().decode()
+    declared, samples = _lint_exposition(body)
+    by_series = {}
+    for s, lb, v in samples:
+        by_series.setdefault(s, []).append(v)
+    assert sum(by_series["ldt_request_latency_ms_count"]) > 0
+    assert sum(by_series["ldt_stage_latency_ms_count"]) > 0
+    assert sum(by_series.get("ldt_xla_compiles_total", [0])) > 0
+
+
+def test_debug_vars_endpoint(traced_server):
+    d = _get_json(traced_server["metrics_url"] + "/debug/vars")
+    assert d["pid"] > 0 and d["uptime_sec"] >= 0
+    assert d["rss_bytes"] > 0
+    assert d["requests"]["count"] > 0
+    assert "engine" in d and "counters" in d
+    assert d["counters"]["augmentation_requests_total"] > 0
+    assert isinstance(d["stage_latency_ms"], dict)
+    assert "dispatch" in d["stage_latency_ms"]
+    for stats in d["stage_latency_ms"].values():
+        assert set(stats) == {"count", "mean", "p50", "p95", "p99"}
+
+
+def test_debug_slow_endpoint_and_cli(traced_server, tmp_path, capsys):
+    d = _get_json(traced_server["metrics_url"] + "/debug/slow")
+    assert d["threshold_ms"] == telemetry.REGISTRY.slow.threshold_ms
+    assert d["recorded"] >= 1
+    assert d["traces"], "every request samples at threshold ~0"
+    # the CLI pretty-printer consumes the same JSON (file source)
+    src = tmp_path / "slow.json"
+    src.write_text(json.dumps(d))
+    from language_detector_tpu.debug import _main
+    assert _main(["--slow-traces", str(src)]) == 0
+    out = capsys.readouterr().out
+    assert "slow traces:" in out
+    assert "parse" in out and "dispatch" in out
+
+
+def test_debug_vars_shared_serializer_aio():
+    """Both fronts serve the SAME debug_vars serializer — the aio
+    metrics handler routes /debug/vars and /debug/slow too."""
+    import asyncio
+
+    from language_detector_tpu.service.aioserver import serve
+    from language_detector_tpu.service.server import DetectorService
+
+    async def run():
+        svc = DetectorService(use_device=False, start_batcher=False)
+        loop = asyncio.get_running_loop()
+        ready = loop.create_future()
+        task = loop.create_task(serve(0, 0, svc=svc, ready=ready))
+        port, mport = await asyncio.wait_for(ready, timeout=30)
+
+        def fetch(path):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}{path}", timeout=10).read())
+
+        dv = await loop.run_in_executor(None, fetch, "/debug/vars")
+        slow = await loop.run_in_executor(None, fetch, "/debug/slow")
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        return dv, slow
+
+    dv, slow = asyncio.run(run())
+    assert dv["pid"] > 0 and "requests" in dv
+    assert set(slow) == {"threshold_ms", "capacity", "recorded",
+                         "traces"}
