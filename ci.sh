@@ -91,4 +91,82 @@ print("telemetry:",
 svc.batcher.close()
 EOF
 
+echo "== overload smoke =="
+# tiny admission limits + concurrent clients: some requests must shed
+# with 429 + a sane Retry-After, nothing may hang, and once the burst
+# drains a plain request is served again (docs/OBSERVABILITY.md)
+python3 - <<'EOF'
+import json
+import threading
+import urllib.error
+import urllib.request
+
+from language_detector_tpu.service.admission import (AdmissionConfig,
+                                                     AdmissionController)
+from language_detector_tpu.service.server import (DetectorService,
+                                                  make_server)
+
+# ladder thresholds parked far above reachable occupancy: this smoke
+# pins the HARD-bound behavior (429), not brownout policy (503)
+ctrl = AdmissionController(AdmissionConfig(
+    max_queue_docs=8, max_inflight=2,
+    brownout_enter=(90.0, 95.0, 99.0), brownout_exit=(80.0, 85.0, 90.0)))
+svc = DetectorService(use_device=False, max_delay_ms=20.0,
+                      admission=ctrl)
+httpd, metricsd, svc = make_server(0, 0, service=svc)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+threading.Thread(target=metricsd.serve_forever, daemon=True).start()
+port = httpd.server_address[1]
+mport = metricsd.server_address[1]
+
+body = json.dumps({"request": [
+    {"text": f"hello overload world number {i}"} for i in range(4)
+]}).encode()
+results = []
+lock = threading.Lock()
+
+
+def hammer():
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            status, retry_after = r.status, None
+    except urllib.error.HTTPError as e:
+        status, retry_after = e.code, e.headers.get("Retry-After")
+        e.read()
+    with lock:
+        results.append((status, retry_after))
+
+
+threads = [threading.Thread(target=hammer) for _ in range(16)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=90)
+assert not any(t.is_alive() for t in threads), "overload burst hung"
+shed = [(s, ra) for s, ra in results if s == 429]
+served = [s for s, _ in results if s in (200, 203)]
+assert shed, f"no 429s under 16x burst vs 8-doc/2-inflight: {results}"
+assert served, f"every request shed — bounds too tight: {results}"
+assert all(ra is not None and int(ra) >= 1 for _, ra in shed), \
+    f"shed responses missing a sane Retry-After: {shed}"
+
+# recovery: the burst is over, a plain request is served again
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/", data=body,
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=60) as r:
+    assert r.status in (200, 203), r.status
+
+metrics = urllib.request.urlopen(
+    f"http://127.0.0.1:{mport}/", timeout=10).read().decode()
+assert "ldt_shed_total" in metrics
+assert "ldt_admission_queue_docs" in metrics
+print("overload:", len(shed), "shed /", len(served), "served,",
+      "retry_after", sorted({ra for _, ra in shed}))
+svc.batcher.close()
+EOF
+
 echo "CI OK"

@@ -195,6 +195,7 @@ def format_engine_stats(stats: dict) -> str:
              "tier_short_dispatches", "tier_mid_dispatches",
              "tier_long_dispatches", "tier_mixed_dispatches",
              "retry_lane_dispatches", "dedup_docs",
+             "retry_skipped_docs",
              "fallback_docs", "scalar_recursion_docs"]
     keys = ([k for k in order if k in stats] +
             sorted(k for k in stats if k not in order))
@@ -226,6 +227,43 @@ def format_slow_traces(doc: dict) -> str:
             lines.append(f"{pad}{sp.get('name', '?'):<12} "
                          f"@{sp.get('start_ms', 0):>9.3f}ms "
                          f"+{sp.get('dur_ms', 0):.3f}ms")
+    return "\n".join(lines)
+
+
+def format_admission(doc: dict) -> str:
+    """Human-readable render of the admission controller's state as
+    published under /debug/vars "admission" (service/admission.py
+    AdmissionController.stats): live queue occupancy against configured
+    bounds, brownout ladder position, breaker state, and shed counts by
+    reason — the operator's first stop when clients start seeing 429s."""
+    adm = doc.get("admission", doc)
+    if not adm:
+        return "(admission control idle: no stats published)"
+    limits = adm.get("limits", {})
+
+    def bound(v):
+        return "unbounded" if v is None else str(v)
+
+    lines = [
+        f"queue_docs   {adm.get('queue_docs', 0)} / "
+        f"{bound(limits.get('max_queue_docs'))}",
+        f"queue_bytes  {adm.get('queue_bytes', 0)} / "
+        f"{bound(limits.get('max_queue_bytes'))}",
+        f"inflight     {adm.get('inflight', 0)} / "
+        f"{bound(limits.get('max_inflight'))}",
+        f"brownout     level={adm.get('brownout_level', 0)} "
+        f"ema={adm.get('brownout_ema', 0.0):.3f}",
+    ]
+    br = adm.get("breaker", {})
+    lines.append(f"breaker      {br.get('state_name', 'closed')} "
+                 f"consec={br.get('consecutive_failures', 0)} "
+                 f"trips={br.get('trips', 0)} "
+                 f"probes={br.get('probes', 0)}")
+    shed = adm.get("shed", {})
+    total = sum(shed.values()) if shed else 0
+    lines.append(f"shed         total={total} " +
+                 " ".join(f"{k}={v}" for k, v in sorted(shed.items())))
+    lines.append(f"deadline_expired  {adm.get('deadline_expired', 0)}")
     return "\n".join(lines)
 
 
@@ -278,9 +316,18 @@ def _main(argv=None):
                          "GET /debug/slow), a JSON file, or '-' for "
                          "stdin (requires LDT_SLOW_TRACE_MS set on the "
                          "server)")
+    ap.add_argument("--admission", metavar="SRC",
+                    help="pretty-print admission-control state "
+                         "(queue occupancy, brownout level, breaker, "
+                         "shed counts): SRC is a metrics-port URL (the "
+                         "front's GET /debug/vars), a JSON file, or "
+                         "'-' for stdin")
     args = ap.parse_args(argv)
     if args.slow_traces:
         print(format_slow_traces(_read_slow_source(args.slow_traces)))
+        return 0
+    if args.admission:
+        print(format_admission(_read_slow_source(args.admission)))
         return 0
     if args.engine_stats:
         docs = list(args.text) if args.text \

@@ -122,7 +122,11 @@ class NgramBatchEngine:
                       "tier_long_dispatches": 0,
                       "tier_mixed_dispatches": 0,
                       "retry_lane_dispatches": 0,
-                      "dedup_docs": 0}
+                      "dedup_docs": 0,
+                      # gate-failed docs resolved scalar because the
+                      # flush was near its deadline or the brownout
+                      # ladder disabled the retry lane (trace.no_retry)
+                      "retry_skipped_docs": 0}
         import threading
         self._stats_lock = threading.Lock()
 
@@ -457,6 +461,16 @@ class NgramBatchEngine:
         order."""
         if patch_value is None:
             patch_value = lambda r: r  # noqa: E731
+        # near-deadline flushes skip the pipelined retry lane: a gate
+        # recursion is a second device round the budget cannot cover,
+        # while the scalar resolution in _epilogue is immediate and
+        # exact. 2x expected latency = this flush + a retry round.
+        if trace is not None and not getattr(trace, "no_retry", False):
+            dl = getattr(trace, "deadline", None)
+            if dl is not None:
+                from ..service.admission import expected_flush_ms
+                if dl.remaining_ms() < 2.0 * expected_flush_ms():
+                    trace.no_retry = True
         out: list = [None] * len(texts)
         # -- dedup: first occurrence scores, the rest copy ------------
         t_stage = _time.monotonic()
@@ -768,15 +782,30 @@ class NgramBatchEngine:
         if not need.size:
             return ep, patches
         local_retry: list = []  # (index, text, squeezed)
+        no_retry = trace is not None and getattr(trace, "no_retry",
+                                                 False)
+        n_skipped = 0
         for b in need:
             b = int(b)
             if cb.fallback[b]:
                 patches[b] = detect_scalar(texts[b], self.tables,
                                            self.reg, self.flags)
+            elif no_retry:
+                # deadline/brownout: resolve the gate failure scalar
+                # NOW instead of queueing another device round —
+                # detect_scalar runs the full reference algorithm
+                # (internal recursion included), so the answer is
+                # identical to the batched retry's
+                patches[b] = detect_scalar(texts[b], self.tables,
+                                           self.reg, self.flags)
+                n_skipped += 1
             elif deferred is not None:
                 deferred.append((b, texts[b], bool(cb.squeezed[b])))
             else:
                 local_retry.append((b, texts[b], bool(cb.squeezed[b])))
+        if n_skipped:
+            with self._stats_lock:
+                self.stats["retry_skipped_docs"] += n_skipped
         patches.update(self._retry_deferred(local_retry))
         return ep, patches
 

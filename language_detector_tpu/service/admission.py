@@ -1,0 +1,569 @@
+"""Admission control & graceful degradation for the serving stack.
+
+PR 1 built batching, PR 2 built telemetry; this is the third subsystem
+production TPU serving treats as first-class: deciding what work to
+ACCEPT. Without it both fronts enqueue unboundedly — a traffic spike or
+a slow XLA recompile turns into queue bloat, client-side timeouts, and
+RSS-driven recycles instead of fast, explicit 429/503s. Four pieces,
+shared by the sync and asyncio fronts through one controller per
+DetectorService:
+
+  bounded queues    per-request cost accounting (docs + byte-weighted
+                    slot-demand estimate from the pack tier ladder)
+                    against LDT_MAX_QUEUE_DOCS / LDT_MAX_QUEUE_BYTES /
+                    LDT_MAX_INFLIGHT; past a bound the request sheds
+                    with 429 and a Retry-After derived from the
+                    telemetry registry's recent flush p95
+  deadlines         X-LDT-Deadline-Ms (default LDT_DEFAULT_DEADLINE_MS)
+                    rides the request trace into the batcher and the
+                    engine scheduler; work already expired at dequeue
+                    fails with DeadlineExceeded (the front answers 504)
+                    instead of burning a flush, and near-deadline
+                    batches skip the pipelined retry lane
+  brownout ladder   a smoothed load signal (queue occupancy, optionally
+                    flush p95) walks four levels with hysteresis:
+                    0 healthy -> 1 skip-retry-lane -> 2 cache+scalar
+                    only -> 3 shed all non-priority (X-LDT-Priority
+                    requests keep being served)
+  circuit breaker   consecutive device-flush failures or a stalled
+                    dispatch (watchdog vs a multiple of compile-aware
+                    expected latency) trip open and route detection to
+                    the scalar engine; after a cooldown, half-open
+                    probes recover
+
+Everything exports through the PR 2 registry: ldt_admission_queue_docs
+/ _queue_bytes / _inflight, ldt_brownout_level, ldt_breaker_state
+(gauges in Metrics.render), ldt_shed_total{reason} and
+ldt_deadline_expired_total (counters here), all surfaced in
+/debug/vars. With no LDT_* overrides every limit is off and the
+subsystem is a per-request constant-time no-op.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+from .. import telemetry
+from ..preprocess.pack import est_slot_demand
+
+_mono = time.monotonic
+
+# shed reasons, in the order they are checked; pre-touched as counter
+# label values so every ldt_shed_total series renders from scrape one
+SHED_REASONS = ("brownout", "queue_docs", "queue_bytes", "inflight")
+
+BROWNOUT_LEVEL_NAMES = ("healthy", "skip_retry", "degraded", "shed")
+
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+BREAKER_STATE_NAMES = ("closed", "half_open", "open")
+
+# prior for expected flush latency before the stage histograms have any
+# observations: the tunneled backend's fixed dispatch cost (docs/PERF.md)
+DEFAULT_FLUSH_MS = 95.0
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before its batch dispatched."""
+
+
+class Deadline:
+    """One request's absolute deadline on the monotonic clock. Carried
+    on telemetry.Trace.deadline through the batcher into the engine
+    scheduler — a plain float wrapper so every layer shares one clock
+    and one expiry rule."""
+
+    __slots__ = ("t_end",)
+
+    def __init__(self, budget_ms: float, now: float | None = None):
+        self.t_end = (now if now is not None else _mono()) \
+            + budget_ms / 1e3
+
+    def remaining_ms(self, now: float | None = None) -> float:
+        return (self.t_end - (now if now is not None else _mono())) * 1e3
+
+    def expired(self, now: float | None = None) -> bool:
+        return (now if now is not None else _mono()) >= self.t_end
+
+
+def note_deadline_expired(n: int = 1):
+    """Both batchers report dequeue-time expiries here (the controller
+    is not plumbed into the batcher; the shared registry is)."""
+    telemetry.REGISTRY.counter_inc("ldt_deadline_expired_total", n)
+
+
+def expected_flush_ms(include_compiles: bool = False,
+                      default: float = DEFAULT_FLUSH_MS) -> float:
+    """Recent p95 of one engine flush, read from the stage histograms
+    (dispatch on the device path, scalar_detect / c_path otherwise).
+    include_compiles folds in the compile-time p95 for watchdog use —
+    a dispatch that recompiles legitimately takes many times the warm
+    latency and must not read as a stall. Peeks only: estimating load
+    must not create empty histogram series in the exposition."""
+    reg = telemetry.REGISTRY
+    p95 = None
+    for stage in ("dispatch", "scalar_detect", "c_path"):
+        h = reg.histogram_peek("ldt_stage_latency_ms", stage=stage)
+        if h is not None:
+            p = h.percentile(95)
+            if p:
+                p95 = p
+                break
+    if p95 is None:
+        p95 = default
+    if include_compiles:
+        c = reg.percentile_across("ldt_xla_compile_ms", 95)
+        if c:
+            p95 = max(p95, c)
+    return p95
+
+
+def request_cost(texts: list) -> int:
+    """Byte-weighted admission cost of a request: 4 bytes per estimated
+    packer slot (the tier ladder's est_slot_demand is ~len/4 plus a
+    fixed per-doc overhead, so this tracks text bytes plus a constant
+    per document — cheap, monotone, and the same signal the scheduler
+    buckets on)."""
+    return 4 * sum(est_slot_demand(t) for t in texts)
+
+
+def retry_after_sec(queue_docs: int, flush_docs: int = 16384,
+                    cap_sec: int = 30) -> int:
+    """Retry-After for a shed response: how long until the backlog in
+    front of the caller likely drains — (flushes queued + 1) x recent
+    flush p95, clamped to [1, cap]."""
+    flushes = 1 + queue_docs // max(flush_docs, 1)
+    sec = math.ceil(flushes * expected_flush_ms() / 1e3)
+    return max(1, min(int(sec), cap_sec))
+
+
+def _env_num(name: str, cast, default):
+    """Parse an LDT_* numeric knob; <= 0 or unset means feature off
+    (None default) / default value. A mistyped value logs loudly instead
+    of silently disabling the guard (recycle.limits_from_env rule)."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    try:
+        n = cast(v)
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            "%s=%r is not a valid %s — using default %r",
+            name, v, cast.__name__, default)
+        return default
+    return n
+
+
+def _env_bound(name: str, cast):
+    n = _env_num(name, cast, None)
+    return None if n is None or n <= 0 else n
+
+
+def _env_levels(name: str, default: tuple) -> tuple:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        parts = tuple(float(x) for x in v.split(","))
+    except ValueError:
+        parts = ()
+    if len(parts) != len(BROWNOUT_LEVEL_NAMES) - 1:
+        import logging
+        logging.getLogger(__name__).warning(
+            "%s=%r must be %d comma-separated numbers — using %r",
+            name, v, len(BROWNOUT_LEVEL_NAMES) - 1, default)
+        return default
+    return parts
+
+
+class AdmissionConfig:
+    """Env-derived knobs, all optional (docs/OBSERVABILITY.md table).
+    Bounds are None when off; with everything off the controller admits
+    unconditionally and the ladder never leaves healthy."""
+
+    def __init__(self, max_queue_docs: int | None = None,
+                 max_queue_bytes: int | None = None,
+                 max_inflight: int | None = None,
+                 default_deadline_ms: float | None = None,
+                 flush_docs: int = 16384,
+                 brownout_alpha: float = 0.3,
+                 brownout_enter: tuple = (0.60, 0.80, 0.95),
+                 brownout_exit: tuple = (0.45, 0.65, 0.80),
+                 brownout_p95_ms: float | None = None,
+                 breaker_failures: int = 5,
+                 breaker_cooldown_sec: float = 10.0,
+                 breaker_stall_factor: float = 10.0,
+                 breaker_stall_min_ms: float = 2000.0):
+        self.max_queue_docs = max_queue_docs
+        self.max_queue_bytes = max_queue_bytes
+        self.max_inflight = max_inflight
+        self.default_deadline_ms = default_deadline_ms
+        self.flush_docs = flush_docs
+        self.brownout_alpha = brownout_alpha
+        self.brownout_enter = brownout_enter
+        self.brownout_exit = brownout_exit
+        self.brownout_p95_ms = brownout_p95_ms
+        self.breaker_failures = breaker_failures
+        self.breaker_cooldown_sec = breaker_cooldown_sec
+        self.breaker_stall_factor = breaker_stall_factor
+        self.breaker_stall_min_ms = breaker_stall_min_ms
+
+    @classmethod
+    def from_env(cls) -> "AdmissionConfig":
+        return cls(
+            max_queue_docs=_env_bound("LDT_MAX_QUEUE_DOCS", int),
+            max_queue_bytes=_env_bound("LDT_MAX_QUEUE_BYTES", int),
+            max_inflight=_env_bound("LDT_MAX_INFLIGHT", int),
+            default_deadline_ms=_env_bound("LDT_DEFAULT_DEADLINE_MS",
+                                           float),
+            brownout_alpha=_env_num("LDT_BROWNOUT_ALPHA", float, 0.3),
+            brownout_enter=_env_levels("LDT_BROWNOUT_ENTER",
+                                       (0.60, 0.80, 0.95)),
+            brownout_exit=_env_levels("LDT_BROWNOUT_EXIT",
+                                      (0.45, 0.65, 0.80)),
+            brownout_p95_ms=_env_bound("LDT_BROWNOUT_P95_MS", float),
+            breaker_failures=_env_num("LDT_BREAKER_FAILURES", int, 5),
+            breaker_cooldown_sec=_env_num("LDT_BREAKER_COOLDOWN_SEC",
+                                          float, 10.0),
+            breaker_stall_factor=_env_num("LDT_BREAKER_STALL_FACTOR",
+                                          float, 10.0),
+            breaker_stall_min_ms=_env_num("LDT_BREAKER_STALL_MIN_MS",
+                                          float, 2000.0),
+        )
+
+
+class BrownoutLadder:
+    """Hysteretic degradation levels over an EWMA'd load signal.
+
+    Ascend from level L when the smoothed load reaches enter[L];
+    descend from L when it falls below exit[L-1]. exit thresholds sit
+    strictly below their enter twins, so a load hovering at a boundary
+    cannot flap the service between serving modes — it has to genuinely
+    recede before the ladder steps back down."""
+
+    def __init__(self, enter: tuple = (0.60, 0.80, 0.95),
+                 exit: tuple = (0.45, 0.65, 0.80),
+                 alpha: float = 0.3):
+        n = len(BROWNOUT_LEVEL_NAMES) - 1
+        if len(enter) != n or len(exit) != n:
+            raise ValueError(f"need {n} enter and exit thresholds")
+        if any(x >= e for x, e in zip(exit, enter)):
+            raise ValueError("exit thresholds must sit below enter "
+                             "thresholds (hysteresis)")
+        self.enter = tuple(enter)
+        self.exit = tuple(exit)
+        self.alpha = alpha
+        self.ema = 0.0
+        self.level = 0
+        self._lock = threading.Lock()
+
+    def observe(self, load: float) -> int:
+        """Fold one load sample in and return the (possibly stepped)
+        level. Called on every admit/release, so single samples move the
+        EMA by alpha — spikes must persist to climb the ladder."""
+        with self._lock:
+            self.ema += self.alpha * (load - self.ema)
+            top = len(self.enter)
+            while self.level < top and \
+                    self.ema >= self.enter[self.level]:
+                self.level += 1
+            while self.level > 0 and \
+                    self.ema < self.exit[self.level - 1]:
+                self.level -= 1
+            return self.level
+
+
+class CircuitBreaker:
+    """Trip the device detect path to scalar on consecutive failures or
+    stalls; recover through half-open probes.
+
+    States: closed (all traffic to the device), open (all traffic to
+    the scalar engine until the cooldown elapses), half-open (ONE probe
+    allowed through; success closes, failure re-opens). A success whose
+    wall time exceeds the stall watchdog counts as a failure — a device
+    that answers in 30x its expected latency is down for serving
+    purposes even if it eventually returns. The watchdog threshold is
+    compile-aware: it reads the compile-time p95 so a legitimate
+    recompile is not mistaken for a stall. clock is injectable for
+    tests."""
+
+    def __init__(self, failures: int = 5, cooldown_sec: float = 10.0,
+                 stall_factor: float = 10.0,
+                 stall_min_ms: float = 2000.0, clock=None):
+        self.failures = max(int(failures), 1)
+        self.cooldown_sec = cooldown_sec
+        self.stall_factor = stall_factor
+        self.stall_min_ms = stall_min_ms
+        self._clock = clock or _mono
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consec = 0
+        self._opened_at = 0.0
+        self._probe_at: float | None = None
+        self.trips = 0
+        self.probes = 0
+        self.failures_total = 0
+        self.stalls_total = 0
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def stall_ms(self) -> float:
+        """Current watchdog threshold: a flush slower than this counts
+        as a failure."""
+        return max(self.stall_min_ms,
+                   self.stall_factor *
+                   expected_flush_ms(include_compiles=True))
+
+    def allow_device(self) -> bool:
+        """Gate one detect call. closed: yes. open: no, until the
+        cooldown converts to half-open and admits a probe. half-open:
+        only if no probe is pending (or the pending probe itself looks
+        wedged past the watchdog, in which case a fresh probe goes)."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            now = self._clock()
+            if self._state == BREAKER_OPEN:
+                if now - self._opened_at < self.cooldown_sec:
+                    return False
+                self._state = BREAKER_HALF_OPEN
+                self._probe_at = now
+                self.probes += 1
+                return True
+            # half-open with a probe already in flight
+            if self._probe_at is not None and \
+                    (now - self._probe_at) * 1e3 < self.stall_ms():
+                return False
+            self._probe_at = now
+            self.probes += 1
+            return True
+
+    def record_success(self, elapsed_ms: float | None = None):
+        if elapsed_ms is not None and elapsed_ms >= self.stall_ms():
+            self.record_failure(stalled=True)
+            return
+        with self._lock:
+            self._consec = 0
+            self._state = BREAKER_CLOSED
+            self._probe_at = None
+
+    def record_failure(self, stalled: bool = False):
+        with self._lock:
+            self.failures_total += 1
+            if stalled:
+                self.stalls_total += 1
+            self._consec += 1
+            if self._state == BREAKER_HALF_OPEN or \
+                    self._consec >= self.failures:
+                if self._state != BREAKER_OPEN:
+                    self.trips += 1
+                self._state = BREAKER_OPEN
+                self._opened_at = self._clock()
+                self._probe_at = None
+                self._consec = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "state_name": BREAKER_STATE_NAMES[self._state],
+                    "consecutive_failures": self._consec,
+                    "failures_total": self.failures_total,
+                    "stalls_total": self.stalls_total,
+                    "trips": self.trips,
+                    "probes": self.probes}
+
+
+class Admit:
+    """One try_admit verdict. shed=False tickets MUST be released (the
+    fronts do it in a finally); shed=True carries the response the
+    front should send."""
+
+    __slots__ = ("shed", "status", "reason", "message", "retry_after",
+                 "level", "degrade", "docs", "cost")
+
+    def __init__(self, shed, status, reason, message, retry_after,
+                 level, degrade, docs, cost):
+        self.shed = shed
+        self.status = status
+        self.reason = reason
+        self.message = message
+        self.retry_after = retry_after
+        self.level = level
+        self.degrade = degrade
+        self.docs = docs
+        self.cost = cost
+
+
+_SHED_MESSAGES = {
+    "brownout": "server overloaded, shedding non-priority traffic",
+    "queue_docs": "server overloaded: document queue full",
+    "queue_bytes": "server overloaded: byte queue full",
+    "inflight": "server overloaded: too many requests in flight",
+}
+
+
+class AdmissionController:
+    """Per-service front door: cost-accounted bounds, the brownout
+    ladder, and the device circuit breaker behind one try_admit/release
+    pair. Thread-safe; the asyncio front calls it from the event loop
+    (every operation is a few arithmetic ops under a lock)."""
+
+    def __init__(self, config: AdmissionConfig | None = None):
+        self.config = config or AdmissionConfig.from_env()
+        c = self.config
+        self.ladder = BrownoutLadder(enter=c.brownout_enter,
+                                     exit=c.brownout_exit,
+                                     alpha=c.brownout_alpha)
+        self.breaker = CircuitBreaker(
+            failures=c.breaker_failures,
+            cooldown_sec=c.breaker_cooldown_sec,
+            stall_factor=c.breaker_stall_factor,
+            stall_min_ms=c.breaker_stall_min_ms)
+        self._lock = threading.Lock()
+        self.queue_docs = 0
+        self.queue_bytes = 0
+        self.inflight = 0
+        self._shed = dict.fromkeys(SHED_REASONS, 0)
+        # pre-touch the counter series so a scrape shows them at 0
+        # before the first shed/expiry, not only after trouble starts
+        for reason in SHED_REASONS:
+            telemetry.REGISTRY.counter_inc("ldt_shed_total", 0,
+                                           reason=reason)
+        telemetry.REGISTRY.counter_inc("ldt_deadline_expired_total", 0)
+
+    @classmethod
+    def from_env(cls) -> "AdmissionController":
+        return cls(AdmissionConfig.from_env())
+
+    def _occupancy(self, docs: int = 0, nbytes: int = 0,
+                   inflight: int = 0) -> float:
+        """Load in [0, 1+]: the worst occupancy across the configured
+        bounds, counting the candidate request, optionally maxed with
+        flush p95 against its target. Unbounded axes contribute 0."""
+        c = self.config
+        occ = 0.0
+        if c.max_queue_docs:
+            occ = max(occ, (self.queue_docs + docs) / c.max_queue_docs)
+        if c.max_queue_bytes:
+            occ = max(occ,
+                      (self.queue_bytes + nbytes) / c.max_queue_bytes)
+        if c.max_inflight:
+            occ = max(occ, (self.inflight + inflight) / c.max_inflight)
+        if c.brownout_p95_ms:
+            occ = max(occ, expected_flush_ms() / c.brownout_p95_ms)
+        return occ
+
+    def _shed_out(self, reason: str, status: int, level: int,
+                  docs: int, cost: int) -> Admit:
+        self._shed[reason] += 1
+        telemetry.REGISTRY.counter_inc("ldt_shed_total", reason=reason)
+        ra = retry_after_sec(self.queue_docs, self.config.flush_docs)
+        return Admit(True, status, reason, _SHED_MESSAGES[reason], ra,
+                     level, False, docs, cost)
+
+    def try_admit(self, texts: list, priority: bool = False) -> Admit:
+        """Admit or shed one request. Order: the brownout ladder sheds
+        non-priority traffic first (503 — the service is degrading by
+        policy), then the hard bounds shed anything over capacity (429
+        — priority included; a bound is a bound)."""
+        docs = len(texts)
+        cost = request_cost(texts)
+        c = self.config
+        with self._lock:
+            level = self.ladder.observe(
+                self._occupancy(docs, cost, 1))
+            if level >= 3 and not priority:
+                return self._shed_out("brownout", 503, level, docs,
+                                      cost)
+            if c.max_queue_docs is not None and \
+                    self.queue_docs + docs > c.max_queue_docs:
+                return self._shed_out("queue_docs", 429, level, docs,
+                                      cost)
+            if c.max_queue_bytes is not None and \
+                    self.queue_bytes + cost > c.max_queue_bytes:
+                return self._shed_out("queue_bytes", 429, level, docs,
+                                      cost)
+            if c.max_inflight is not None and \
+                    self.inflight + 1 > c.max_inflight:
+                return self._shed_out("inflight", 429, level, docs,
+                                      cost)
+            self.queue_docs += docs
+            self.queue_bytes += cost
+            self.inflight += 1
+            return Admit(False, 200, None, None, 0, level,
+                         level >= 2, docs, cost)
+
+    def release(self, admit: Admit):
+        """Return an admitted request's cost (fronts call from a
+        finally, so shed/error/success all balance). Feeds the ladder a
+        decay sample so it steps back down as load drains."""
+        if admit.shed:
+            return
+        with self._lock:
+            self.queue_docs = max(self.queue_docs - admit.docs, 0)
+            self.queue_bytes = max(self.queue_bytes - admit.cost, 0)
+            self.inflight = max(self.inflight - 1, 0)
+            self.ladder.observe(self._occupancy())
+
+    def deadline_from_header(self, value) -> Deadline | None:
+        """X-LDT-Deadline-Ms header (str/bytes/None) -> Deadline, using
+        the configured default when absent/unparseable. None when no
+        deadline applies. A non-positive budget is honored literally
+        (already expired: the batcher sheds it at dequeue, 504)."""
+        ms = None
+        if value is not None:
+            if isinstance(value, bytes):
+                value = value.decode("latin-1", "replace")
+            try:
+                ms = float(value)
+            except (TypeError, ValueError):
+                ms = None
+        if ms is None:
+            ms = self.config.default_deadline_ms
+        return None if ms is None else Deadline(ms)
+
+    def stats(self) -> dict:
+        """Live snapshot for Metrics.render gauges and /debug/vars."""
+        c = self.config
+        with self._lock:
+            d = {"queue_docs": self.queue_docs,
+                 "queue_bytes": self.queue_bytes,
+                 "inflight": self.inflight,
+                 "shed": dict(self._shed)}
+        d["brownout_level"] = self.ladder.level
+        d["brownout_ema"] = round(self.ladder.ema, 4)
+        d["breaker_state"] = self.breaker.state
+        d["breaker"] = self.breaker.stats()
+        d["deadline_expired"] = telemetry.REGISTRY.counter_value(
+            "ldt_deadline_expired_total")
+        d["limits"] = {"max_queue_docs": c.max_queue_docs,
+                       "max_queue_bytes": c.max_queue_bytes,
+                       "max_inflight": c.max_inflight,
+                       "default_deadline_ms": c.default_deadline_ms}
+        return d
+
+
+def degraded_detect(texts: list, scalar_fn, cache=None, hints_key=None,
+                    trace=None) -> list:
+    """Brownout level-2 serving path: answer from the result cache
+    where possible, run everything else through the scalar engine, and
+    keep filling the cache — exact results (the scalar engine is the
+    repo-wide equivalence oracle), bounded cost, zero batcher/device
+    involvement. scalar_fn: texts -> codes (DetectorService.scalar_codes
+    or the scalar detect closure)."""
+    from .batcher import _MISS
+    if cache is None:
+        return scalar_fn(texts, trace=trace)
+    vals = [cache.get((hints_key, t)) for t in texts]
+    miss = [i for i, v in enumerate(vals) if v is _MISS]
+    if miss:
+        fresh = scalar_fn([texts[i] for i in miss], trace=trace)
+        for i, v in zip(miss, fresh):
+            vals[i] = v
+            cache.put((hints_key, texts[i]), v, texts[i])
+    return vals

@@ -15,12 +15,15 @@ Run: python -m language_detector_tpu.service.aioserver
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import telemetry
-from .batcher import _FLUSH_WORKERS, _MISS, ResultCache, _accepts_trace
+from .admission import DeadlineExceeded, degraded_detect
+from .batcher import (_FLUSH_WORKERS, _MISS, Batcher, ResultCache,
+                      _accepts_trace)
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
                      parse_post_body, post_detect, pre_detect)
 
@@ -94,12 +97,21 @@ class AioBatcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            # dequeue-time deadline check (shared with the sync
+            # Batcher: (texts, trace, fut) has the same tail) — expired
+            # requests fail with DeadlineExceeded before this flush
+            # takes a slot
+            pending = Batcher._drop_expired(pending)
+            if not pending:
+                continue
             await slots.acquire()
             texts = [t for ts, _, _ in pending for t in ts]
             # one flush-scoped trace shared by every traced request in
             # the batch (same grafting contract as batcher.Batcher)
             ftrace = telemetry.Trace() \
                 if any(tr is not None for _, tr, _ in pending) else None
+            if ftrace is not None:
+                ftrace.adopt_constraints(tr for _, tr, _ in pending)
 
             def _resolve(results, pending=pending, ftrace=ftrace):
                 i = 0
@@ -153,14 +165,22 @@ class AioBatcher:
 
 def _http_response(status: int, body: bytes,
                    content_type: bytes = b"application/json; "
-                                         b"charset=utf-8") -> bytes:
+                                         b"charset=utf-8",
+                   extra_headers: tuple = ()) -> bytes:
     reason = {200: b"OK", 203: b"Non-Authoritative Information",
               400: b"Bad Request", 404: b"Not Found",
+              413: b"Payload Too Large",
+              429: b"Too Many Requests",
               431: b"Request Header Fields Too Large",
-              500: b"Internal Server Error"}.get(status, b"OK")
-    return (b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
-            b"Content-Length: %d\r\n\r\n"
-            % (status, reason, content_type, len(body))) + body
+              500: b"Internal Server Error",
+              503: b"Service Unavailable",
+              504: b"Gateway Timeout"}.get(status, b"OK")
+    head = (b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+            b"Content-Length: %d\r\n"
+            % (status, reason, content_type, len(body)))
+    for k, v in extra_headers:
+        head += k + b": " + v + b"\r\n"
+    return head + b"\r\n" + body
 
 
 class AioService:
@@ -234,21 +254,38 @@ class AioService:
                     length = int(headers.get(b"content-length", 0) or 0)
                 except ValueError:
                     length = 0
+                if length > BODY_LIMIT_BYTES:
+                    # oversize body: reject + close (the old
+                    # truncate-and-parse answered a misleading 400).
+                    # Discard the body up to a bounded cap first so a
+                    # client mid-upload gets the 413 instead of EPIPE;
+                    # past the cap we just close.
+                    self.svc.metrics.inc(
+                        "augmentation_invalid_requests_total")
+                    self.svc.metrics.inc(
+                        "augmentation_errors_logged_total")
+                    self.svc.metrics.inc_object("unsuccessful")
+                    self.svc.metrics.inc("augmentation_requests_total")
+                    with contextlib.suppress(Exception):
+                        remaining = min(length, 8 * BODY_LIMIT_BYTES)
+                        while remaining > 0:
+                            chunk = await reader.read(
+                                min(remaining, 65536))
+                            if not chunk:
+                                break
+                            remaining -= len(chunk)
+                    writer.write(_http_response(
+                        413, b'{"error":"Request body exceeds 1MB '
+                             b'limit"}',
+                        extra_headers=((b"Connection", b"close"),)))
+                    with contextlib.suppress(Exception):
+                        await writer.drain()
+                    break
                 body = b""
                 self._busy.add(writer)
                 try:
                     if length > 0:
-                        # truncate at the 1MB contract limit, draining
-                        # the rest so keep-alive stays in sync
-                        # (handlers.go:43)
-                        want = min(length, BODY_LIMIT_BYTES)
-                        body = await reader.readexactly(want)
-                        left = length - want
-                        while left > 0:
-                            chunk = await reader.read(min(left, 65536))
-                            if not chunk:
-                                break
-                            left -= len(chunk)
+                        body = await reader.readexactly(length)
                     try:
                         resp = await self._route(method, path, headers,
                                                  body)
@@ -318,9 +355,47 @@ class AioService:
                               "detected"}).encode())
             texts, slots, responses, status = pre
             meta["docs"] = len(texts)
+            adm = svc.admission
+            admit = None
+            if texts:
+                admit = adm.try_admit(
+                    texts,
+                    priority=headers.get(b"x-ldt-priority") is not None)
+                if admit.shed:
+                    m.inc("augmentation_errors_logged_total")
+                    meta["status"] = admit.status
+                    meta["shed"] = admit.reason
+                    return _http_response(
+                        admit.status,
+                        json.dumps({"error": admit.message}).encode(),
+                        extra_headers=((b"Retry-After",
+                                        str(admit.retry_after)
+                                        .encode()),))
+                trace.deadline = adm.deadline_from_header(
+                    headers.get(b"x-ldt-deadline-ms"))
+                if admit.level >= 1:
+                    trace.no_retry = True
             try:
-                codes = await self.batcher.submit(texts, trace=trace) \
-                    if texts else []
+                if admit is not None and admit.degrade:
+                    # brownout level 2: result cache + scalar engine on
+                    # the flush pool (the scalar loop would otherwise
+                    # block the event loop)
+                    loop = asyncio.get_running_loop()
+                    cache = self.batcher._cache
+                    codes = await loop.run_in_executor(
+                        self.batcher._pool,
+                        lambda: degraded_detect(texts, svc.scalar_codes,
+                                                cache=cache,
+                                                trace=trace))
+                else:
+                    codes = await self.batcher.submit(
+                        texts, trace=trace) if texts else []
+            except DeadlineExceeded:
+                m.inc("augmentation_errors_logged_total")
+                meta["status"] = 504
+                return _http_response(
+                    504,
+                    b'{"error":"deadline expired before dispatch"}')
             except (asyncio.TimeoutError, TimeoutError):
                 # wedged flush: fail THIS request with a response (the
                 # disconnect handler upstream must not eat it — on 3.12
@@ -329,6 +404,9 @@ class AioService:
                 meta["status"] = 500
                 return _http_response(
                     500, b'{"error":"detection timed out"}')
+            finally:
+                if admit is not None:
+                    adm.release(admit)
             t = telemetry.observe_stage("detect", t, trace=trace)
             status, payload = post_detect(svc, codes, slots, responses,
                                           status)

@@ -31,6 +31,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from .. import telemetry
+from .admission import DeadlineExceeded, note_deadline_expired
 
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
 # ceiling (models/ngram.py's scheduler pool uses the same depth)
@@ -142,6 +143,11 @@ class Batcher:
         grafts its stage spans (dedup/pack/dispatch/...) into it before
         resolving the future."""
         fut: Future = Future()
+        if self._stop.is_set():
+            # post-close submits fail fast instead of sitting in a
+            # queue nobody drains until the caller's 60s result timeout
+            fut.set_exception(RuntimeError("batcher closed"))
+            return fut
         self._q.put((texts, hints_key, trace, fut))
         return fut
 
@@ -160,6 +166,16 @@ class Batcher:
         self._q.put(None)  # wake the collector
         self._thread.join(timeout=5)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        # fail whatever is still sitting in the queue: with the
+        # collector gone nothing will ever drain it, and a submit()
+        # caller blocked on its future would hang to its full timeout
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._fail([item], RuntimeError("batcher closed"))
 
     # -- collector -----------------------------------------------------------
 
@@ -235,14 +251,45 @@ class Batcher:
         if tr is not None and ftrace is not None:
             tr.graft(ftrace, depth=1)
 
+    @staticmethod
+    def _drop_expired(pending: list) -> list:
+        """Dequeue-time deadline check: a request whose X-LDT-Deadline
+        budget passed while it queued fails with DeadlineExceeded (the
+        front answers 504) instead of burning flush capacity on an
+        answer nobody is waiting for. Returns the still-live items.
+        Items are (..., trace, fut) — shared with AioBatcher, whose
+        3-tuples have the same tail."""
+        live: list = []
+        expired = 0
+        for item in pending:
+            tr = item[-2]
+            dl = getattr(tr, "deadline", None) if tr is not None \
+                else None
+            if dl is not None and dl.expired():
+                expired += 1
+                fut = item[-1]
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        "deadline expired before dispatch"))
+            else:
+                live.append(item)
+        if expired:
+            note_deadline_expired(expired)
+        return live
+
     def _flush(self, pending: list):
         try:
+            pending = self._drop_expired(pending)
+            if not pending:
+                return
             # one flush-scoped trace shared by every traced request in
             # the batch: the engine records dedup/pack/dispatch spans
             # into it, and each request adopts a copy at resolve time
             ftrace = telemetry.Trace() \
                 if any(tr is not None for _, _, tr, _ in pending) \
                 else None
+            if ftrace is not None:
+                ftrace.adopt_constraints(tr for _, _, tr, _ in pending)
             if self._cache is None:
                 texts = [t for ts, _, _, _ in pending for t in ts]
                 try:

@@ -33,6 +33,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from .. import telemetry
+from .admission import (AdmissionController, DeadlineExceeded,
+                        degraded_detect)
 from .batcher import Batcher
 
 BODY_LIMIT_BYTES = 1_000_000            # main.go:59
@@ -93,6 +95,9 @@ class Metrics:
         # live result-cache gauge source (set when the batcher cache is
         # enabled): () -> batcher.ResultCache.stats() dict or None
         self.cache_stats = lambda: None
+        # live admission-control gauge source (set by DetectorService):
+        # () -> admission.AdmissionController.stats() dict or None
+        self.admission_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -209,6 +214,34 @@ class Metrics:
                      "Result-cache resident bytes.",
                      [("ldt_result_cache_bytes", None,
                        cs["bytes"] if cs else 0)]))
+        # admission control / graceful degradation (service/admission.py;
+        # ldt_shed_total and ldt_deadline_expired_total are registry
+        # counters and render with the families below)
+        ad = self.admission_stats() or {}
+        fams.append(("ldt_admission_queue_docs", "gauge",
+                     "Documents admitted and not yet completed.",
+                     [("ldt_admission_queue_docs", None,
+                       ad.get("queue_docs", 0))]))
+        fams.append(("ldt_admission_queue_bytes", "gauge",
+                     "Byte-weighted admission cost currently held "
+                     "(4 bytes per estimated packer slot).",
+                     [("ldt_admission_queue_bytes", None,
+                       ad.get("queue_bytes", 0))]))
+        fams.append(("ldt_admission_inflight", "gauge",
+                     "HTTP requests admitted and in flight.",
+                     [("ldt_admission_inflight", None,
+                       ad.get("inflight", 0))]))
+        fams.append(("ldt_brownout_level", "gauge",
+                     "Graceful-degradation level (0=healthy "
+                     "1=skip-retry-lane 2=cache+scalar-only "
+                     "3=shed-non-priority).",
+                     [("ldt_brownout_level", None,
+                       ad.get("brownout_level", 0))]))
+        fams.append(("ldt_breaker_state", "gauge",
+                     "Device-path circuit breaker (0=closed "
+                     "1=half-open 2=open).",
+                     [("ldt_breaker_state", None,
+                       ad.get("breaker_state", 0))]))
         # shared telemetry registry: stage/request histograms + compile
         # counters (both fronts render the same registry)
         fams.extend(telemetry.REGISTRY.families())
@@ -220,12 +253,17 @@ class DetectorService:
 
     def __init__(self, max_batch: int = 16384, max_delay_ms: float = 5.0,
                  use_device: bool = True, start_batcher: bool = True,
-                 cache_bytes: int | None = None):
+                 cache_bytes: int | None = None,
+                 admission: AdmissionController | None = None):
         """start_batcher=False skips the sync Batcher (its collector
         thread + flush pool) for fronts that bring their own batching
         layer (aioserver.AioBatcher). cache_bytes: batcher result-cache
-        budget; None reads LDT_RESULT_CACHE_MB (0/unset = disabled)."""
+        budget; None reads LDT_RESULT_CACHE_MB (0/unset = disabled).
+        admission: overload controller; None builds one from the LDT_*
+        env knobs (all off by default — tests inject configured ones)."""
         self.metrics = Metrics()
+        self.admission = admission or AdmissionController.from_env()
+        self.metrics.admission_stats = self.admission.stats
         self.known = json.loads(_CODES_FILE.read_text())
         # per-code pre-serialized response fragments (the reference
         # pre-renders its static JSON for the same reason, main.go:150-166;
@@ -256,12 +294,14 @@ class DetectorService:
     def _make_detect(self, use_device: bool):
         from ..registry import registry
         self._registry = registry
+        self._tables = None
         if use_device:
             try:
                 from ..models.ngram import NgramBatchEngine
                 eng = NgramBatchEngine()
                 self._engine = eng
                 metrics = self.metrics
+                breaker = self.admission.breaker
 
                 # engine TPU gauges (ldt_*) are read live from eng.stats
                 # at render time — per-flush before/after deltas would
@@ -276,9 +316,23 @@ class DetectorService:
                     # splits a full-size flush into 2+ slices so pack,
                     # device transfer, and fetch pipeline INSIDE the
                     # flush (a single 16K slice runs serially: measured
-                    # 63K -> 75K docs/sec through the asyncio front)
-                    return eng.detect_codes(texts, batch_size=8192,
-                                            trace=trace)
+                    # 63K -> 75K docs/sec through the asyncio front).
+                    # The circuit breaker wraps exactly this seam: a
+                    # tripped device routes flushes to the scalar
+                    # engine (identical answers, no device dispatch)
+                    # until a half-open probe succeeds
+                    if not breaker.allow_device():
+                        return self.scalar_codes(texts, trace=trace)
+                    t0 = time.monotonic()
+                    try:
+                        out = eng.detect_codes(texts, batch_size=8192,
+                                               trace=trace)
+                    except Exception:
+                        breaker.record_failure()
+                        raise
+                    breaker.record_success(
+                        (time.monotonic() - t0) * 1e3)
+                    return out
                 return detect
             except (ImportError, RuntimeError):
                 pass
@@ -286,6 +340,7 @@ class DetectorService:
         from ..tables import load_tables
         tables = load_tables()
         self._engine = None
+        self._tables = tables
 
         def detect(texts, trace=None):
             t0 = time.monotonic()
@@ -296,9 +351,30 @@ class DetectorService:
             return out
         return detect
 
+    def scalar_codes(self, texts: list, trace=None) -> list:
+        """Scalar-engine codes for the degradation paths (breaker open,
+        brownout level 2): exact answers, no batcher, no device."""
+        from ..engine_scalar import detect_scalar
+        tables = self._engine.tables if self._engine is not None \
+            else self._tables
+        reg = self._registry
+        t0 = time.monotonic()
+        out = [reg.code(detect_scalar(t, tables, reg).summary_lang)
+               for t in texts]
+        telemetry.observe_stage("scalar_detect", t0, trace=trace)
+        return out
+
     def detect_codes(self, texts: list, trace=None) -> list:
         fut = self.batcher.submit(texts, trace=trace)
         return fut.result(timeout=60)
+
+    def detect_codes_degraded(self, texts: list, trace=None) -> list:
+        """Brownout level-2 serving: result cache (when enabled) +
+        scalar engine, bypassing the batcher/device entirely."""
+        cache = self.batcher._cache if self.batcher is not None \
+            else None
+        return degraded_detect(texts, self.scalar_codes, cache=cache,
+                               trace=trace)
 
     def log_processed(self, amount: int = 1):
         """Throughput log every OBJECTS_PER_LOG objects (main.go:209)."""
@@ -328,17 +404,21 @@ class Handler(BaseHTTPRequestHandler):
 
     # -- helpers ------------------------------------------------------------
 
-    def _send_json(self, status: int, payload: bytes):
+    def _send_json(self, status: int, payload: bytes, headers=None):
         self.send_response(status)
         self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(payload)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
-    def _send_error_json(self, message: str, status: int):
+    def _send_error_json(self, message: str, status: int, headers=None):
         self.service.metrics.inc("augmentation_errors_logged_total")
         self._send_json(status,
-                        json.dumps({"error": message}).encode())
+                        json.dumps({"error": message}).encode(),
+                        headers=headers)
 
     def log_message(self, fmt, *args):  # quiet access log
         pass
@@ -356,7 +436,10 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         t0 = time.time()
-        body = self._consume_body()  # always drain: keep-alive stays sane
+        body = self._consume_body()
+        if body is None:  # oversize: 413 sent, connection closing
+            self._finish_metrics(t0)
+            return
         if self.path not in ("/", ""):
             self.service.metrics.inc("augmentation_invalid_requests_total")
             self._send_json(404, b'{"error":"Not found"}')
@@ -373,22 +456,38 @@ class Handler(BaseHTTPRequestHandler):
         if not traced:
             m.observe_request_ms((time.time() - t0) * 1e3)
 
-    def _consume_body(self) -> bytes:
-        """Read the request body, truncated at 1 MB, draining any excess
-        so a keep-alive connection stays in sync (handlers.go:43 LimitReader
-        semantics; Go's net/http drains automatically, http.server doesn't)."""
+    # oversize drain ceiling: keep reading a rejected body up to this
+    # much so a mid-upload client sees the 413 instead of EPIPE, but
+    # never let a hostile Content-Length make us stream gigabytes
+    DRAIN_CAP_BYTES = 8 * BODY_LIMIT_BYTES
+
+    def _consume_body(self) -> "bytes | None":
+        """Read the request body. A body DECLARING more than the 1 MB
+        contract limit is rejected with 413 and the connection closed —
+        the old truncate-then-parse answered a misleading 400. The
+        rejected body is drained (discarded, up to DRAIN_CAP_BYTES) so
+        a client still mid-upload receives the response rather than a
+        broken pipe; past the cap we just close. Returns None when the
+        request was answered here (413 path)."""
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
         except ValueError:
             length = 0  # malformed header: empty body -> 400 invalid JSON
-        body = self.rfile.read(min(max(length, 0), BODY_LIMIT_BYTES))
-        left = length - len(body)
-        while left > 0:
-            chunk = self.rfile.read(min(left, 65536))
-            if not chunk:
-                break
-            left -= len(chunk)
-        return body
+        if length > BODY_LIMIT_BYTES:
+            m = self.service.metrics
+            m.inc("augmentation_invalid_requests_total")
+            m.inc_object("unsuccessful")
+            self.close_connection = True
+            remaining = min(length, self.DRAIN_CAP_BYTES)
+            while remaining > 0:
+                chunk = self.rfile.read(min(remaining, 65536))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+            self._send_error_json("Request body exceeds 1MB limit", 413,
+                                  headers={"Connection": "close"})
+            return None
+        return self.rfile.read(max(length, 0))
 
     def _detector(self, body: bytes):
         """LanguageDetectorHandler (handlers.go:105-186)."""
@@ -411,7 +510,44 @@ class Handler(BaseHTTPRequestHandler):
                 trace, meta={"front": "sync", "status": 400})
             return
         texts, slots, responses, status = pre
-        codes = svc.detect_codes(texts, trace=trace) if texts else []
+        adm = svc.admission
+        admit = None
+        if texts:
+            admit = adm.try_admit(
+                texts,
+                priority=self.headers.get("X-LDT-Priority") is not None)
+            if admit.shed:
+                svc.metrics.inc("augmentation_errors_logged_total")
+                self._send_json(
+                    admit.status,
+                    json.dumps({"error": admit.message}).encode(),
+                    headers={"Retry-After": str(admit.retry_after)})
+                telemetry.finish_request(
+                    trace, meta={"front": "sync", "docs": len(texts),
+                                 "status": admit.status,
+                                 "shed": admit.reason})
+                return
+            trace.deadline = adm.deadline_from_header(
+                self.headers.get("X-LDT-Deadline-Ms"))
+            if admit.level >= 1:
+                trace.no_retry = True
+        try:
+            if admit is not None and admit.degrade:
+                codes = svc.detect_codes_degraded(texts, trace=trace)
+            else:
+                codes = svc.detect_codes(texts, trace=trace) \
+                    if texts else []
+        except DeadlineExceeded:
+            svc.metrics.inc("augmentation_errors_logged_total")
+            self._send_json(
+                504, b'{"error":"deadline expired before dispatch"}')
+            telemetry.finish_request(
+                trace, meta={"front": "sync", "docs": len(texts),
+                             "status": 504})
+            return
+        finally:
+            if admit is not None:
+                adm.release(admit)
         t = telemetry.observe_stage("detect", t, trace=trace)
         status, payload = post_detect(svc, codes, slots, responses, status)
         telemetry.observe_stage("encode", t, trace=trace)

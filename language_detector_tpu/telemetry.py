@@ -114,12 +114,18 @@ class Trace:
     tree is reconstructed at render time, never maintained on the hot
     path."""
 
-    __slots__ = ("t0", "t_wall", "spans")
+    __slots__ = ("t0", "t_wall", "spans", "deadline", "no_retry")
 
     def __init__(self):
         self.t0 = _mono()
         self.t_wall = time.time()
         self.spans: list = []
+        # admission-control freight riding the existing trace plumbing
+        # (service/admission.py): the request's Deadline, and whether
+        # the engine should resolve gate failures scalar instead of
+        # running the pipelined retry lane (brownout / near-deadline)
+        self.deadline = None
+        self.no_retry = False
 
     def add(self, name: str, t0: float, t1: float, depth: int = 0):
         self.spans.append((name, depth, t0, t1))
@@ -130,6 +136,21 @@ class Trace:
         flush, off the per-event path."""
         self.spans.extend((n, d + depth, s, e)
                           for n, d, s, e in other.spans)
+
+    def adopt_constraints(self, traces):
+        """Flush-scoped traces inherit the TIGHTEST deadline and any
+        no-retry flag of the request traces batched into them — the
+        engine scheduler reads constraints off the one trace it is
+        handed (both batchers call this when building a flush)."""
+        for tr in traces:
+            if tr is None:
+                continue
+            dl = tr.deadline
+            if dl is not None and (self.deadline is None or
+                                   dl.t_end < self.deadline.t_end):
+                self.deadline = dl
+            if tr.no_retry:
+                self.no_retry = True
 
     def total_ms(self) -> float:
         return (_mono() - self.t0) * 1e3
@@ -320,6 +341,12 @@ class TelemetryRegistry:
         "ldt_xla_compile_ms":
             "Dispatch wall time (ms) of first-execution (compiling) "
             "launches, per lane.",
+        "ldt_shed_total":
+            "Requests shed by admission control, by reason "
+            "(service/admission.py).",
+        "ldt_deadline_expired_total":
+            "Requests dropped at dequeue because their X-LDT-Deadline-Ms "
+            "budget had already passed.",
     }
 
     def __init__(self):
@@ -340,6 +367,23 @@ class TelemetryRegistry:
             with self._lock:
                 h = self._hists.setdefault(k, Histogram())
         return h
+
+    def histogram_peek(self, name: str, **labels) -> "Histogram | None":
+        """Read-only lookup: None instead of creating — load estimators
+        (admission.expected_flush_ms) poll stages that may never run on
+        this front, and each poll must not mint an empty series into
+        the exposition."""
+        return self._hists.get(self._key(name, labels))
+
+    def percentile_across(self, name: str, q: float):
+        """Max q-th percentile across every label set of a histogram
+        family (None when the family is empty) — the breaker's
+        compile-aware watchdog reads the worst lane."""
+        with self._lock:
+            hists = [h for (n, _), h in self._hists.items() if n == name]
+        vals = [p for p in (h.percentile(q) for h in hists)
+                if p is not None]
+        return max(vals) if vals else None
 
     def counter_inc(self, name: str, amount=1, **labels):
         k = self._key(name, labels)
@@ -475,6 +519,11 @@ def debug_vars(metrics=None) -> dict:
             d["languages"] = dict(metrics.languages)
         d["engine"] = dict(metrics.engine_stats() or {})
         d["cache"] = metrics.cache_stats()
+        adm_fn = getattr(metrics, "admission_stats", None)
+        if adm_fn is not None:
+            adm = adm_fn()
+            if adm:
+                d["admission"] = adm
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

@@ -1,0 +1,58 @@
+"""Scriptable stand-in worker for the supervisor tests
+(tests/test_supervisor.py). Behavior is driven by env vars so the
+supervisor can run it with its normal `python -m <module>` spawn:
+
+  FAKE_WORKER_EXIT       exit immediately with this code
+  FAKE_WORKER_RECYCLE    path to a marker file: first run (no marker)
+                         creates it and exits with RECYCLE_EXIT_CODE;
+                         the restarted run sees the marker and exits 0
+  FAKE_WORKER_SIGFILE    install a SIGTERM/SIGINT handler that writes
+                         the signal number to this path and exits 0;
+                         the worker then waits (bounded) to be signaled
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+from language_detector_tpu.service.recycle import RECYCLE_EXIT_CODE
+
+
+def main() -> int:
+    exit_code = os.environ.get("FAKE_WORKER_EXIT")
+    if exit_code is not None:
+        return int(exit_code)
+
+    marker = os.environ.get("FAKE_WORKER_RECYCLE")
+    if marker is not None:
+        if os.path.exists(marker):
+            return 0  # second generation: a clean exit ends the loop
+        with open(marker, "w") as f:
+            f.write("recycled")
+        return RECYCLE_EXIT_CODE
+
+    sigfile = os.environ.get("FAKE_WORKER_SIGFILE")
+    if sigfile is not None:
+        def on_signal(signum, frame):
+            with open(sigfile, "w") as f:
+                f.write(str(signum))
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, on_signal)
+        signal.signal(signal.SIGINT, on_signal)
+        # announce readiness so the test doesn't signal a worker that
+        # has not installed its handler yet
+        ready = sigfile + ".ready"
+        with open(ready, "w") as f:
+            f.write(str(os.getpid()))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            time.sleep(0.05)
+        return 3  # never signaled: fail loudly
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

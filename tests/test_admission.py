@@ -1,0 +1,564 @@
+"""Admission control & graceful degradation (service/admission.py).
+
+Unit coverage for the four pieces — bounded queues with cost
+accounting, deadline propagation, the brownout ladder's hysteresis,
+and the circuit breaker state machine — plus HTTP-level proof on BOTH
+fronts (sync threaded server and the asyncio server) that shed
+responses carry 429/503 + Retry-After, expired deadlines answer 504,
+priority traffic survives shed-all, and the new Prometheus series
+scrape. The controllers under test are injected with tiny bounds;
+the default (no LDT_* overrides) configuration is asserted to change
+nothing.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from language_detector_tpu import telemetry
+from language_detector_tpu.service.admission import (
+    AdmissionConfig, AdmissionController, BrownoutLadder, CircuitBreaker,
+    Deadline, DeadlineExceeded, request_cost, retry_after_sec)
+from language_detector_tpu.service.batcher import Batcher
+from language_detector_tpu.service.server import (DetectorService,
+                                                  make_server)
+
+EN = ("this is a simple english sentence with common words that "
+      "should be detected without any trouble at all")
+FR = ("Le gouvernement a annoncé de nouvelles mesures pour aider "
+      "les familles concernées")
+
+
+# -- cost accounting ---------------------------------------------------------
+
+
+def test_request_cost_monotone_in_bytes():
+    small = request_cost(["ab"])
+    big = request_cost(["ab" * 500])
+    assert 0 < small < big
+    # additive across documents
+    assert request_cost(["ab", "cd"]) == \
+        request_cost(["ab"]) + request_cost(["cd"])
+
+
+def test_retry_after_bounds():
+    assert 1 <= retry_after_sec(0) <= 30
+    assert retry_after_sec(10_000_000) == 30  # clamped at the cap
+
+
+# -- bounded queues ----------------------------------------------------------
+
+
+def test_queue_docs_bound_sheds_and_release_recovers():
+    ctrl = AdmissionController(AdmissionConfig(max_queue_docs=2))
+    a = ctrl.try_admit([EN, FR])
+    assert not a.shed and ctrl.queue_docs == 2 and ctrl.inflight == 1
+    b = ctrl.try_admit([EN])
+    assert b.shed and b.status == 429 and b.reason == "queue_docs"
+    assert 1 <= b.retry_after <= 30
+    ctrl.release(a)
+    assert ctrl.queue_docs == 0 and ctrl.inflight == 0
+    c = ctrl.try_admit([EN])
+    assert not c.shed
+    ctrl.release(c)
+
+
+def test_queue_bytes_and_inflight_bounds():
+    # bound just under one request's cost: occupancy stays ~1.0 so the
+    # brownout ladder (which sheds first) can't race ahead of the bound
+    ctrl = AdmissionController(
+        AdmissionConfig(max_queue_bytes=request_cost([EN]) - 1))
+    a = ctrl.try_admit([EN])
+    assert a.shed and a.status == 429 and a.reason == "queue_bytes"
+    ctrl = AdmissionController(AdmissionConfig(max_inflight=1))
+    a = ctrl.try_admit([EN])
+    b = ctrl.try_admit([FR])
+    assert not a.shed and b.shed and b.reason == "inflight"
+    ctrl.release(a)
+    assert not ctrl.try_admit([FR]).shed
+
+
+def test_shed_counters_exported():
+    ctrl = AdmissionController(AdmissionConfig(max_queue_docs=1))
+    ctrl.try_admit([EN, FR])  # 2 docs > 1: shed
+    s = ctrl.stats()
+    assert s["shed"]["queue_docs"] >= 1
+    assert s["limits"]["max_queue_docs"] == 1
+
+
+def test_default_config_admits_everything():
+    """No LDT_* overrides: every bound off, ladder stays healthy, no
+    degradation — the subsystem must be a no-op by default."""
+    ctrl = AdmissionController(AdmissionConfig())
+    a = ctrl.try_admit([EN] * 10_000)
+    assert not a.shed and a.level == 0 and not a.degrade
+    ctrl.release(a)
+    assert ctrl.deadline_from_header(None) is None
+
+
+# -- deadlines ---------------------------------------------------------------
+
+
+def test_deadline_parse_and_expiry():
+    ctrl = AdmissionController(AdmissionConfig())
+    assert ctrl.deadline_from_header(None) is None
+    dl = ctrl.deadline_from_header("5000")
+    assert dl is not None and not dl.expired() \
+        and 0 < dl.remaining_ms() <= 5000
+    assert ctrl.deadline_from_header(b"5000") is not None  # aio bytes
+    assert ctrl.deadline_from_header("garbage") is None
+    ctrl = AdmissionController(
+        AdmissionConfig(default_deadline_ms=1000.0))
+    assert ctrl.deadline_from_header(None) is not None      # default
+    assert ctrl.deadline_from_header("garbage") is not None  # fallback
+    assert Deadline(0).expired()
+    assert Deadline(-5).expired()
+
+
+def test_batcher_drops_expired_at_dequeue():
+    """An expired request fails with DeadlineExceeded at flush time
+    without burning detect work; a live neighbor in the same batch is
+    still served."""
+    seen = []
+
+    def detect(texts):
+        seen.extend(texts)
+        return ["en"] * len(texts)
+
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_deadline_expired_total")
+    b = Batcher(detect, max_delay_ms=30.0)
+    try:
+        tr_dead = telemetry.Trace()
+        tr_dead.deadline = Deadline(0)  # already expired
+        f_dead = b.submit(["expired doc"], trace=tr_dead)
+        f_live = b.submit(["live doc"])
+        assert f_live.result(timeout=10) == ["en"]
+        with pytest.raises(DeadlineExceeded):
+            f_dead.result(timeout=10)
+        assert "expired doc" not in seen and "live doc" in seen
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_deadline_expired_total") >= before + 1
+    finally:
+        b.close()
+
+
+def test_batcher_close_fails_queued_and_new_submits():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_detect(texts):
+        started.set()
+        release.wait(timeout=10)
+        return ["en"] * len(texts)
+
+    b = Batcher(slow_detect, max_delay_ms=1.0)
+    f1 = b.submit([EN])
+    started.wait(timeout=10)
+    release.set()
+    b.close()
+    assert f1.result(timeout=10) == ["en"]
+    # post-close submits fail fast instead of hanging to a timeout
+    f2 = b.submit([FR])
+    with pytest.raises(RuntimeError, match="batcher closed"):
+        f2.result(timeout=10)
+
+
+def test_engine_near_deadline_sets_no_retry():
+    """A trace whose remaining budget is under ~2 expected flushes makes
+    the engine scheduler skip the pipelined retry lane (trace.no_retry),
+    resolving any gate retries through the scalar oracle instead —
+    results stay exact either way."""
+    from language_detector_tpu import native
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    eng = NgramBatchEngine()
+    # >TINY_BATCH_C_PATH docs: the all-C shortcut (which has no retry
+    # lane to skip) must not swallow the batch
+    texts = [EN, FR,
+             "こんにちは世界、今日はとても良い天気ですね"] * 24
+    want = ["en", "fr", "ja"] * 24
+
+    tr = telemetry.Trace()
+    tr.deadline = Deadline(30_000)  # generous: retry lane stays on
+    assert eng.detect_codes(texts, trace=tr) == want
+    assert tr.no_retry is False
+
+    tr = telemetry.Trace()
+    tr.deadline = Deadline(1)  # ~expired: way under 2 expected flushes
+    assert eng.detect_codes(texts, trace=tr) == want
+    assert tr.no_retry is True
+    assert "retry_skipped_docs" in eng.stats
+
+
+# -- brownout ladder ---------------------------------------------------------
+
+
+def test_brownout_ladder_hysteresis():
+    lad = BrownoutLadder(enter=(0.5, 0.7, 0.9), exit=(0.3, 0.5, 0.7),
+                         alpha=1.0)  # alpha 1: ema == last sample
+    assert lad.observe(0.4) == 0
+    assert lad.observe(0.55) == 1      # crossed enter[0]
+    assert lad.observe(0.45) == 1      # between exit[0] and enter[1]: hold
+    assert lad.observe(0.95) == 3      # multi-step ascend
+    assert lad.observe(0.75) == 3      # above exit[2]: hold shed-all
+    assert lad.observe(0.65) == 2      # below exit[2]: one step down
+    assert lad.observe(0.2) == 0       # full recovery
+
+
+def test_brownout_ladder_ema_smoothing():
+    lad = BrownoutLadder(enter=(0.5, 0.7, 0.9), exit=(0.3, 0.5, 0.7),
+                         alpha=0.3)
+    assert lad.observe(1.0) == 0       # single spike: ema only 0.3
+    assert lad.observe(1.0) == 1       # persistent load climbs
+    for _ in range(20):
+        lad.observe(1.0)
+    assert lad.level == 3
+
+
+def test_brownout_ladder_validates_thresholds():
+    with pytest.raises(ValueError):
+        BrownoutLadder(enter=(0.5, 0.7, 0.9), exit=(0.5, 0.5, 0.7))
+    with pytest.raises(ValueError):
+        BrownoutLadder(enter=(0.5, 0.7), exit=(0.3, 0.5))
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trip_halfopen_recover():
+    clk = FakeClock()
+    br = CircuitBreaker(failures=2, cooldown_sec=10.0, clock=clk)
+    assert br.allow_device()
+    br.record_failure()
+    assert br.state == 0 and br.allow_device()  # below threshold
+    br.record_failure()
+    assert br.state == 2 and not br.allow_device()  # tripped open
+    clk.t += 5.0
+    assert not br.allow_device()                # cooldown not elapsed
+    clk.t += 6.0
+    assert br.allow_device()                    # half-open probe admitted
+    assert br.state == 1
+    assert not br.allow_device()                # only ONE probe at a time
+    br.record_success(elapsed_ms=50.0)
+    assert br.state == 0 and br.allow_device()  # recovered
+    assert br.stats()["trips"] == 1 and br.stats()["probes"] == 1
+
+
+def test_breaker_halfopen_failure_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker(failures=1, cooldown_sec=10.0, clock=clk)
+    br.record_failure()
+    assert br.state == 2
+    clk.t += 11.0
+    assert br.allow_device()          # probe
+    br.record_failure()               # probe failed
+    assert br.state == 2 and not br.allow_device()
+    assert br.stats()["trips"] == 2
+
+
+def test_breaker_stalled_success_counts_as_failure():
+    clk = FakeClock()
+    br = CircuitBreaker(failures=1, cooldown_sec=10.0,
+                        stall_min_ms=2000.0, clock=clk)
+    br.record_success(elapsed_ms=br.stall_ms() + 1.0)
+    assert br.state == 2              # a 30x-slow "success" is an outage
+    assert br.stats()["stalls_total"] == 1
+
+
+def test_breaker_routes_detect_to_scalar():
+    """The server seam, against an injected failing detect_fn: trips
+    open after N failures, serves scalar meanwhile, recovers through a
+    half-open probe once the device heals."""
+    clk = FakeClock()
+    br = CircuitBreaker(failures=2, cooldown_sec=10.0, clock=clk)
+    device_ok = {"v": False}
+    calls = {"device": 0, "scalar": 0}
+
+    def device_fn(texts):
+        calls["device"] += 1
+        if not device_ok["v"]:
+            raise RuntimeError("device wedged")
+        return ["dev"] * len(texts)
+
+    def scalar_fn(texts):
+        calls["scalar"] += 1
+        return ["sca"] * len(texts)
+
+    def detect(texts):  # mirrors DetectorService._make_detect wiring
+        if not br.allow_device():
+            return scalar_fn(texts)
+        try:
+            out = device_fn(texts)
+        except Exception:
+            br.record_failure()
+            return scalar_fn(texts)
+        br.record_success(1.0)
+        return out
+
+    assert detect(["x"]) == ["sca"]   # failure 1, answered via scalar
+    assert detect(["x"]) == ["sca"]   # failure 2: trips open
+    assert br.state == 2
+    assert detect(["x"]) == ["sca"]   # open: no device call at all
+    assert calls["device"] == 2
+    device_ok["v"] = True
+    clk.t += 11.0
+    assert detect(["x"]) == ["dev"]   # half-open probe succeeds
+    assert br.state == 0
+    assert detect(["x"]) == ["dev"]   # closed again
+
+
+# -- HTTP fronts -------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def front():
+    """Sync threaded server with an injected all-off controller whose
+    knobs the tests below flip per scenario (and restore)."""
+    ctrl = AdmissionController(AdmissionConfig())
+    svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                          admission=ctrl)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+           "metrics_url":
+               f"http://127.0.0.1:{metricsd.server_address[1]}",
+           "svc": svc, "ctrl": ctrl}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+
+
+@pytest.fixture()
+def adm(front):
+    """Yields the live controller; restores bounds/ladder after each
+    test so scenarios stay independent."""
+    ctrl = front["ctrl"]
+    yield ctrl
+    c = ctrl.config
+    c.max_queue_docs = c.max_queue_bytes = c.max_inflight = None
+    c.default_deadline_ms = None
+    ctrl.ladder.alpha = c.brownout_alpha
+    ctrl.ladder.ema = 0.0
+    ctrl.ladder.level = 0
+
+
+def _post(url, payload, headers=None, raw=None):
+    data = raw if raw is not None else json.dumps(payload).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers=h)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers), \
+            json.loads(body) if body else None
+
+
+def _pin_ladder(ctrl, level, ema):
+    ctrl.ladder.alpha = 0.0  # observe() keeps ema (and thus level) put
+    ctrl.ladder.ema = ema
+    ctrl.ladder.level = level
+
+
+def test_sync_queue_bound_429_with_retry_after(front, adm):
+    adm.config.max_queue_docs = 1
+    status, headers, body = _post(front["url"],
+                                  {"request": [{"text": EN},
+                                               {"text": FR}]})
+    assert status == 429
+    assert body == {"error": "server overloaded: document queue full"}
+    assert 1 <= int(headers["Retry-After"]) <= 30
+    adm.config.max_queue_docs = None
+    status, _, body = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 200  # recovery once the bound lifts
+
+
+def test_sync_brownout_shed_all_and_priority_survives(front, adm):
+    _pin_ladder(adm, level=3, ema=1.0)
+    status, headers, body = _post(front["url"],
+                                  {"request": [{"text": EN}]})
+    assert status == 503
+    assert "Retry-After" in headers
+    assert body == {"error":
+                    "server overloaded, shedding non-priority traffic"}
+    status, _, body = _post(front["url"], {"request": [{"text": EN}]},
+                            headers={"X-LDT-Priority": "1"})
+    assert status == 200
+    assert body["response"][0]["iso6391code"] == "en"
+
+
+def test_sync_brownout_degraded_bypasses_batcher(front, adm):
+    """Level 2: answers come from the cache+scalar path — the batcher
+    (and device) must not be touched."""
+    _pin_ladder(adm, level=2, ema=0.75)
+    svc = front["svc"]
+    real_submit = svc.batcher.submit
+
+    def boom(*a, **k):
+        raise AssertionError("degraded request reached the batcher")
+
+    svc.batcher.submit = boom
+    try:
+        status, _, body = _post(front["url"],
+                                {"request": [{"text": EN}]})
+    finally:
+        svc.batcher.submit = real_submit
+    assert status == 200
+    assert body["response"][0]["iso6391code"] == "en"
+
+
+def test_sync_expired_deadline_504(front, adm):
+    status, _, body = _post(front["url"], {"request": [{"text": EN}]},
+                            headers={"X-LDT-Deadline-Ms": "0"})
+    assert status == 504
+    assert body == {"error": "deadline expired before dispatch"}
+    # generous deadline: served normally
+    status, _, body = _post(front["url"], {"request": [{"text": EN}]},
+                            headers={"X-LDT-Deadline-Ms": "30000"})
+    assert status == 200
+
+
+def test_metrics_scrape_has_admission_series(front):
+    with urllib.request.urlopen(front["metrics_url"] +
+                                "/metrics") as resp:
+        text = resp.read().decode()
+    for series in ("ldt_admission_queue_docs",
+                   "ldt_admission_queue_bytes",
+                   "ldt_admission_inflight",
+                   "ldt_brownout_level", "ldt_breaker_state",
+                   'ldt_shed_total{reason="queue_docs"}',
+                   "ldt_deadline_expired_total"):
+        assert series in text, series
+
+
+def test_debug_vars_surfaces_admission(front):
+    with urllib.request.urlopen(front["metrics_url"] +
+                                "/debug/vars") as resp:
+        doc = json.loads(resp.read())
+    adm = doc["admission"]
+    assert adm["brownout_level"] == 0
+    assert adm["breaker"]["state_name"] == "closed"
+    assert set(adm["shed"]) == {"brownout", "queue_docs",
+                                "queue_bytes", "inflight"}
+    from language_detector_tpu.debug import format_admission
+    out = format_admission(doc)
+    assert "brownout" in out and "breaker" in out
+
+
+def test_sync_default_config_behavior_unchanged(front, adm):
+    """With every knob off the contract answers are identical to the
+    pre-admission service: plain 200/203s, no shed, no deadline."""
+    status, headers, body = _post(front["url"],
+                                  {"request": [{"text": EN},
+                                               {"text": FR}]})
+    assert status == 200
+    assert [r["iso6391code"] for r in body["response"]] == ["en", "fr"]
+    assert "Retry-After" not in headers
+    assert adm.stats()["queue_docs"] == 0  # fully released
+
+
+def test_aio_front_admission_contract():
+    """The asyncio front speaks the same shed/deadline/priority
+    contract: 429 + Retry-After past a bound, 503 at shed-all with
+    priority surviving, 504 on an expired deadline, series in
+    /metrics."""
+    import asyncio
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    ctrl = AdmissionController(AdmissionConfig())
+    ports_q: _q.Queue = _q.Queue()
+    loop_holder = {}
+
+    def run_loop():
+        async def main():
+            loop_holder["loop"] = asyncio.get_running_loop()
+            ready = asyncio.get_running_loop().create_future()
+            svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                                  start_batcher=False, admission=ctrl)
+            task = asyncio.get_running_loop().create_task(
+                serve(0, 0, svc=svc, ready=ready))
+            ports_q.put(await ready)
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass  # loop.stop() teardown ends the run mid-await
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    port, mport = ports_q.get(timeout=30)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        # queue bound: 429 + Retry-After, recovery after lifting it
+        ctrl.config.max_queue_docs = 1
+        status, headers, body = _post(url, {"request": [{"text": EN},
+                                                        {"text": FR}]})
+        assert status == 429
+        assert body == {"error":
+                        "server overloaded: document queue full"}
+        assert 1 <= int(headers["Retry-After"]) <= 30
+        ctrl.config.max_queue_docs = None
+        status, _, _ = _post(url, {"request": [{"text": EN}]})
+        assert status == 200
+
+        # shed-all: non-priority 503s, priority is served
+        _pin_ladder(ctrl, level=3, ema=1.0)
+        status, headers, body = _post(url, {"request": [{"text": EN}]})
+        assert status == 503 and "Retry-After" in headers
+        status, _, body = _post(url, {"request": [{"text": EN}]},
+                                headers={"X-LDT-Priority": "1"})
+        assert status == 200
+        assert body["response"][0]["iso6391code"] == "en"
+
+        # level 2: degraded path still answers correctly
+        _pin_ladder(ctrl, level=2, ema=0.75)
+        status, _, body = _post(url, {"request": [{"text": FR}]})
+        assert status == 200
+        assert body["response"][0]["iso6391code"] == "fr"
+        _pin_ladder(ctrl, level=0, ema=0.0)
+        ctrl.ladder.alpha = ctrl.config.brownout_alpha
+
+        # expired deadline: dropped at dequeue, 504
+        status, _, body = _post(url, {"request": [{"text": EN}]},
+                                headers={"X-LDT-Deadline-Ms": "0"})
+        assert status == 504
+        assert body == {"error": "deadline expired before dispatch"}
+
+        # new series scrape through the aio metrics port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics") as resp:
+            text = resp.read().decode()
+        for series in ("ldt_admission_queue_docs", "ldt_brownout_level",
+                       "ldt_breaker_state", "ldt_shed_total{reason=",
+                       "ldt_deadline_expired_total"):
+            assert series in text, series
+    finally:
+        loop = loop_holder.get("loop")
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)

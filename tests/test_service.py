@@ -138,12 +138,19 @@ def test_empty_request_list(server):
 
 
 def test_oversized_body_rejected(server):
-    # >1MB body is truncated before parsing -> invalid JSON -> 400
+    # >1MB body -> 413 without reading the payload, and the connection
+    # is closed (the unread body would otherwise poison keep-alive)
     big = b'{"request": [{"text": "' + b"a" * 1_100_000 + b'"}]}'
     status, body = _post(server["url"], None, raw=big)
-    assert status == 400
-    assert body == {"error":
-                    "Unable to parse request - invalid JSON detected"}
+    assert status == 413
+    assert body == {"error": "Request body exceeds 1MB limit"}
+    # regression: the server must still answer fresh requests after
+    # rejecting the oversized one
+    status, body = _post(server["url"], {"request": [
+        {"text": "this is a simple english sentence with common words "
+                 "that should be detected without any trouble at all"}]})
+    assert status == 200
+    assert body["response"][0]["iso6391code"] == "en"
 
 
 def test_metrics_endpoint(server):

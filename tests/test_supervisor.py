@@ -1,0 +1,79 @@
+"""service/supervisor.py: restart-on-recycle loop, exit-code
+propagation, and PID-1 signal forwarding, exercised against the
+scriptable tests/fake_worker.py child over real subprocesses."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from language_detector_tpu.service.recycle import RECYCLE_EXIT_CODE
+
+REPO = Path(__file__).resolve().parent.parent
+SUPERVISOR = [sys.executable, "-m",
+              "language_detector_tpu.service.supervisor",
+              "tests.fake_worker"]
+
+
+def _run(env_extra: dict, timeout: float = 30):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(SUPERVISOR, cwd=REPO, env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_child_exit_code_propagates():
+    r = _run({"FAKE_WORKER_EXIT": "5"})
+    assert r.returncode == 5
+    assert "propagating" in r.stdout
+
+
+def test_clean_exit_propagates_zero():
+    r = _run({"FAKE_WORKER_EXIT": "0"})
+    assert r.returncode == 0
+    assert "generation 1" in r.stdout
+    assert "generation 2" not in r.stdout
+
+
+def test_recycle_restarts_then_propagates(tmp_path):
+    marker = tmp_path / "recycled.marker"
+    r = _run({"FAKE_WORKER_RECYCLE": str(marker)})
+    # generation 1 exits RECYCLE_EXIT_CODE -> supervisor restarts;
+    # generation 2 sees the marker and exits 0, which propagates
+    assert r.returncode == 0
+    assert marker.exists()
+    assert "generation 1" in r.stdout and "generation 2" in r.stdout
+    assert "worker recycled" in r.stdout
+    assert str(RECYCLE_EXIT_CODE) not in str(r.returncode)
+
+
+@pytest.mark.parametrize("signum", [signal.SIGTERM, signal.SIGINT])
+def test_signal_forwarded_to_child(tmp_path, signum):
+    sigfile = tmp_path / "sig.txt"
+    env = dict(os.environ)
+    env["FAKE_WORKER_SIGFILE"] = str(sigfile)
+    proc = subprocess.Popen(SUPERVISOR, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        ready = sigfile.with_suffix(".txt.ready")
+        deadline = time.time() + 20
+        while not ready.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert ready.exists(), "worker never became ready"
+        proc.send_signal(signum)
+        rc = proc.wait(timeout=20)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # the worker received the forwarded signal, wrote it down, and
+    # exited 0 — which the supervisor propagates without restarting
+    assert sigfile.read_text() == str(int(signum))
+    assert rc == 0
