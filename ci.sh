@@ -15,8 +15,22 @@ fi
 echo "== artifact verify =="
 python3 tools/artifact_tool.py --verify
 
+echo "== static analysis =="
+# AST lint (docs/STATIC_ANALYSIS.md): trace safety, lock discipline,
+# knob registry, metric registry. Non-zero on any violation.
+python3 -m tools.lint
+
+if python3 -c "import mypy" 2>/dev/null; then
+    echo "== mypy =="
+    python3 -m mypy --config-file mypy.ini
+else
+    echo "== mypy SKIPPED (mypy not installed in this image) =="
+fi
+
 echo "== tests =="
-python3 -m pytest tests/ -q
+# the whole suite runs under the lock-order watchdog: any lock-order
+# inversion or self-deadlock reachable by a test raises immediately
+LDT_LOCK_DEBUG=1 python3 -m pytest tests/ -q
 
 echo "== graft entry =="
 python3 __graft_entry__.py

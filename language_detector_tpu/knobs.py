@@ -1,0 +1,260 @@
+"""Central registry of environment knobs — the only legal way to read
+env configuration inside the package.
+
+Every knob the serving stack honors is declared here once, with its
+name, type, default, and documentation. Call sites read through the
+typed accessors (get_int / get_float / get_str / get_bool /
+get_levels); `tools/lint`'s knob-registry analyzer bans direct
+`os.environ` / `os.getenv` reads anywhere else in
+`language_detector_tpu/`, and the docs table in docs/OBSERVABILITY.md
+is generated from this registry (`python -m tools.lint
+--write-knob-docs`), so code, checks, and docs cannot drift.
+
+Semantics (shared by every knob, formerly re-implemented per file):
+
+  - unset or blank -> the declared default (None for off-by-default
+    bounds);
+  - a mistyped value logs a loud warning and falls back to the default
+    instead of silently disabling the guard the operator thinks is
+    active (the rule service/recycle.py established);
+  - `bound=True` knobs treat non-positive values as "feature off"
+    (returns None), matching the admission/recycle bound contract.
+
+Values are read from the environment at every call (no import-time
+caching) so tests that monkeypatch a knob and re-init a component see
+the change.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+_log = logging.getLogger(__name__)
+
+_FALSE_WORDS = frozenset(("", "0", "false", "no", "off"))
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    ktype: str              # "int" | "float" | "str" | "bool" | "levels"
+    default: object         # typed default (None = off / not set)
+    doc: str
+    bound: bool = field(default=False)   # <= 0 means "off" -> None
+    external: bool = field(default=False)  # contract var owned by the
+    # platform (JAX/TPU launchers); declared for the docs table and the
+    # lint registry, defaults are never exported back
+
+
+def _k(name: str, ktype: str, default: object, doc: str,
+       bound: bool = False, external: bool = False) -> Knob:
+    return Knob(name, ktype, default, doc, bound, external)
+
+
+_DECLARATIONS: tuple[Knob, ...] = (
+    # -- telemetry (telemetry.py) -------------------------------------
+    _k("LDT_SLOW_TRACE_MS", "float", 0.0,
+       "Slow-request sampler threshold in ms; requests over it record "
+       "their full span tree into the /debug/slow ring. 0/unset = off."),
+    _k("LDT_SLOW_TRACE_RING", "int", 64,
+       "Capacity of the slow-trace ring (newest traces win)."),
+    # -- result cache (service/batcher.py wiring) ---------------------
+    _k("LDT_RESULT_CACHE_MB", "float", 0.0,
+       "Batcher result-cache budget in MB; 0/unset disables the cache."),
+    # -- worker self-recycle (service/recycle.py) ---------------------
+    _k("LDT_MAX_DISPATCHES", "int", None,
+       "Recycle the worker after this many device dispatches "
+       "(tunneled-backend RSS leak mitigation, docs/PERF.md).",
+       bound=True),
+    _k("LDT_MAX_RSS_MB", "float", None,
+       "Recycle the worker when process RSS exceeds this many MB.",
+       bound=True),
+    _k("LDT_RECYCLE_CHECK_SEC", "float", 5.0,
+       "Recycle-watcher poll period in seconds (floor 0.05)."),
+    _k("LDT_RECYCLE_DRAIN_SEC", "float", 5.0,
+       "Bounded window for in-flight handlers to finish their response "
+       "during a planned recycle before their sockets are aborted."),
+    # -- admission control (service/admission.py) ---------------------
+    _k("LDT_MAX_QUEUE_DOCS", "int", None,
+       "Admission bound: max documents admitted and not yet completed; "
+       "past it requests shed with 429.", bound=True),
+    _k("LDT_MAX_QUEUE_BYTES", "int", None,
+       "Admission bound: max byte-weighted cost (4 bytes per estimated "
+       "packer slot) held at once.", bound=True),
+    _k("LDT_MAX_INFLIGHT", "int", None,
+       "Admission bound: max HTTP requests in flight.", bound=True),
+    _k("LDT_DEFAULT_DEADLINE_MS", "float", None,
+       "Default request deadline when X-LDT-Deadline-Ms is absent; "
+       "expired work is dropped at dequeue (504).", bound=True),
+    _k("LDT_BROWNOUT_ALPHA", "float", 0.3,
+       "EWMA smoothing factor for the brownout ladder's load signal."),
+    _k("LDT_BROWNOUT_ENTER", "levels", (0.60, 0.80, 0.95),
+       "Comma-separated occupancy thresholds to ENTER brownout levels "
+       "1..3."),
+    _k("LDT_BROWNOUT_EXIT", "levels", (0.45, 0.65, 0.80),
+       "Comma-separated occupancy thresholds to EXIT brownout levels "
+       "1..3 (must sit below the enter thresholds: hysteresis)."),
+    _k("LDT_BROWNOUT_P95_MS", "float", None,
+       "Optional latency target: flush p95 over this feeds the "
+       "brownout load signal.", bound=True),
+    _k("LDT_BREAKER_FAILURES", "int", 5,
+       "Consecutive device-flush failures that trip the circuit "
+       "breaker open."),
+    _k("LDT_BREAKER_COOLDOWN_SEC", "float", 10.0,
+       "Seconds an open breaker waits before admitting a half-open "
+       "probe."),
+    _k("LDT_BREAKER_STALL_FACTOR", "float", 10.0,
+       "A flush slower than factor x compile-aware expected p95 counts "
+       "as a breaker failure (stall watchdog)."),
+    _k("LDT_BREAKER_STALL_MIN_MS", "float", 2000.0,
+       "Floor of the stall watchdog threshold in ms."),
+    # -- debug / CI ---------------------------------------------------
+    _k("LDT_LOCK_DEBUG", "bool", False,
+       "Build order-checking debug locks (language_detector_tpu/locks)"
+       ": records lock acquisition order and raises on inversion or "
+       "self-deadlock. CI runs the whole test suite with it on."),
+    # -- service ports (reference contract, main.go:91-116) -----------
+    _k("LISTEN_PORT", "int", 3000,
+       "HTTP service port for both fronts."),
+    _k("PROMETHEUS_PORT", "int", 30000,
+       "Metrics/debug HTTP port for both fronts."),
+    # -- multi-host launch contract (parallel/distributed.py) ---------
+    _k("JAX_COORDINATOR_ADDRESS", "str", None,
+       "jax.distributed coordinator address, as set by TPU pod "
+       "launchers.", external=True),
+    _k("JAX_NUM_PROCESSES", "int", None,
+       "Total process count for jax.distributed.", external=True),
+    _k("JAX_PROCESS_ID", "int", None,
+       "This process's index for jax.distributed.", external=True),
+    _k("TPU_WORKER_HOSTNAMES", "str", "",
+       "TPU runtime worker list; more than one entry implies a "
+       "multi-host slice.", external=True),
+)
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _DECLARATIONS}
+
+
+def raw(name: str) -> str | None:
+    """The registry's single environment touch: the raw string value of
+    a DECLARED knob, or None when unset. Reading an undeclared name is
+    a programming error (declare it above)."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError(f"undeclared env knob {name!r}; declare it in "
+                       "language_detector_tpu/knobs.py")
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    """True when the knob has a non-blank value in the environment."""
+    v = raw(name)
+    return v is not None and v != ""
+
+
+def _parse_scalar(knob: Knob, v: str) -> object:
+    if knob.ktype == "int":
+        # accept "8e3"-style floats the way the old per-file parsers
+        # accepted them for byte/MB counts
+        return int(v) if v.lstrip("+-").isdigit() else int(float(v))
+    if knob.ktype == "float":
+        return float(v)
+    return v
+
+
+def value(name: str) -> object:
+    """Typed value of a declared knob, applying the shared default /
+    mistype / bound semantics. Prefer the typed get_* accessors at call
+    sites."""
+    knob = KNOBS[name]
+    v = raw(name)
+    if knob.ktype == "bool":
+        if v is None:
+            return bool(knob.default)
+        return v.strip().lower() not in _FALSE_WORDS
+    if v in (None, ""):
+        return knob.default
+    if knob.ktype == "levels":
+        try:
+            parts = tuple(float(x) for x in v.split(","))
+        except ValueError:
+            parts = ()
+        if len(parts) != len(knob.default):  # type: ignore[arg-type]
+            _log.warning(
+                "%s=%r must be %d comma-separated numbers — using %r",
+                name, v, len(knob.default),  # type: ignore[arg-type]
+                knob.default)
+            return knob.default
+        return parts
+    if knob.ktype == "str":
+        return v
+    try:
+        n = _parse_scalar(knob, v)
+    except ValueError:
+        _log.warning("%s=%r is not a valid %s — using default %r",
+                     name, v, knob.ktype, knob.default)
+        return knob.default
+    if knob.bound and n <= 0:  # type: ignore[operator]
+        return None  # non-positive bound = feature off
+    return n
+
+
+def get_int(name: str) -> int | None:
+    knob = KNOBS[name]
+    assert knob.ktype == "int", f"{name} is {knob.ktype}, not int"
+    v = value(name)
+    return None if v is None else int(v)  # type: ignore[call-overload]
+
+
+def get_float(name: str) -> float | None:
+    knob = KNOBS[name]
+    assert knob.ktype == "float", f"{name} is {knob.ktype}, not float"
+    v = value(name)
+    return None if v is None else float(v)  # type: ignore[arg-type]
+
+
+def get_str(name: str) -> str | None:
+    knob = KNOBS[name]
+    assert knob.ktype == "str", f"{name} is {knob.ktype}, not str"
+    v = value(name)
+    return None if v is None else str(v)
+
+
+def get_bool(name: str) -> bool:
+    knob = KNOBS[name]
+    assert knob.ktype == "bool", f"{name} is {knob.ktype}, not bool"
+    return bool(value(name))
+
+
+def get_levels(name: str) -> tuple[float, ...]:
+    knob = KNOBS[name]
+    assert knob.ktype == "levels", f"{name} is {knob.ktype}, not levels"
+    v = value(name)
+    assert isinstance(v, tuple)
+    return v
+
+
+def doc_table() -> str:
+    """Markdown table of every declared knob, written into
+    docs/OBSERVABILITY.md between the ldt-knob-table markers by
+    `python -m tools.lint --write-knob-docs` and drift-checked by the
+    knob-registry analyzer."""
+    rows = ["| Knob | Type | Default | Meaning |",
+            "| --- | --- | --- | --- |"]
+    for knob in _DECLARATIONS:
+        if knob.default is None:
+            dflt = "off" if knob.bound else "unset"
+        elif knob.ktype == "levels":
+            dflt = ",".join(f"{x:g}" for x in knob.default)  # type: ignore[attr-defined]
+        elif knob.default == "":
+            dflt = "(empty)"
+        else:
+            dflt = f"{knob.default}"
+        doc = knob.doc
+        if knob.external:
+            doc += " (platform contract variable)"
+        rows.append(f"| `{knob.name}` | {knob.ktype} | {dflt} | "
+                    f"{doc} |")
+    return "\n".join(rows)

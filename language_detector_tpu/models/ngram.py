@@ -35,6 +35,7 @@ from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              FLAG_SQUEEZE, FLAG_TOP40,
                              ScalarResult, detect_scalar,
                              result_from_epilogue_row as _result_from_row)
+from ..locks import make_lock
 from ..ops.device_tables import DeviceTables
 from ..ops.score import score_chunks, unpack_chunks_out
 from ..registry import Registry, registry as default_registry
@@ -62,7 +63,7 @@ class NgramBatchEngine:
 
     # process-global interpreter-tuning state for _gc_paused (shared
     # across engines: the knobs it guards are process-global too)
-    _bulk_lock = __import__("threading").Lock()
+    _bulk_lock = make_lock("engine.bulk")
     _bulk_depth = 0
     _bulk_saved = (True, 0.005)
     # bulk calls completed since the last forced gc.collect(): under
@@ -126,9 +127,20 @@ class NgramBatchEngine:
                       # gate-failed docs resolved scalar because the
                       # flush was near its deadline or the brownout
                       # ladder disabled the retry lane (trace.no_retry)
-                      "retry_skipped_docs": 0}
-        import threading
-        self._stats_lock = threading.Lock()
+                      "retry_skipped_docs": 0,
+                      # docs answered on the all-C tiny-batch path.
+                      # Pre-seeded so the stats dict's key set is fixed
+                      # at init: snapshot copies and key insertion must
+                      # not race (stats_snapshot)
+                      "c_path_docs": 0}
+        self._stats_lock = make_lock("engine.stats")
+
+    def stats_snapshot(self) -> dict:
+        """Copy of the running stats under the stats lock — the only
+        safe way for another thread (the /metrics renderers) to read
+        them; iterating the live dict races flush-worker updates."""
+        with self._stats_lock:
+            return dict(self.stats)
 
     # -- device dispatch ----------------------------------------------------
 
@@ -557,12 +569,11 @@ class NgramBatchEngine:
         retry job on the SAME pending queue, so recursion rounds overlap
         main-lane scoring. Retry jobs carry FINISH so they can never
         defer again — the drain loop terminates."""
-        import threading
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
         from .. import native
 
-        retry_lock = threading.Lock()
+        retry_lock = make_lock("engine.retry")
         retry_bins = {False: [], True: []}  # squeezed -> [(gidx, text)]
 
         def run_main(lane, idxs, txts, cb):
@@ -878,8 +889,7 @@ class NgramBatchEngine:
                 # flush is tiny must not render as idle
                 with self._stats_lock:
                     self.stats["batches"] += 1
-                    self.stats["c_path_docs"] = \
-                        self.stats.get("c_path_docs", 0) + len(texts)
+                    self.stats["c_path_docs"] += len(texts)
                 return self.reg.lang_code[ids].tolist()
         with self._gc_paused():
             vals = self._detect_stream(

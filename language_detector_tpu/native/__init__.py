@@ -193,7 +193,7 @@ def _load_glue():
                     subprocess.run(
                         [str(_DIR / "build.sh"), "--glue-only"],
                         check=True, capture_output=True, timeout=120,
-                        env={**os.environ, "LDT_PYINC": incdir})
+                        env={**os.environ, "LDT_PYINC": incdir})  # ldt-lint: disable=knob-direct-env -- whole-environment passthrough to the build subprocess, not a config read
                     # re-verify freshness: build.sh exits 0 even when
                     # it could not compile, and loading the stale
                     # binary the check above just rejected would

@@ -25,7 +25,31 @@ topology from the runtime):
 """
 from __future__ import annotations
 
-import os
+from .. import knobs
+
+
+def distributed_is_initialized() -> bool:
+    """Version-compatible `jax.distributed.is_initialized()`.
+
+    The public helper only exists in newer jax releases; older jaxlibs
+    (including the pinned 0.4.x) expose just initialize/shutdown. Fall
+    back to probing the private global distributed state, and treat a
+    totally unprobeable build as "not initialized" — initialize() is
+    documented as safe to call twice, and jax.distributed.initialize
+    itself raises a clear error on a genuine double-init."""
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        try:
+            return bool(probe())
+        except Exception:  # noqa: BLE001 - fall through to the state probe
+            pass
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 - private layout changed
+        return False
 
 
 def initialize(coordinator_address: str | None = None,
@@ -42,20 +66,20 @@ def initialize(coordinator_address: str | None = None,
     import jax
 
     coordinator_address = coordinator_address or \
-        os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
-    if process_id is None and "JAX_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["JAX_PROCESS_ID"])
+        knobs.get_str("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = knobs.get_int("JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = knobs.get_int("JAX_PROCESS_ID")
 
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return True
     # Multi-host iff explicitly configured, or the TPU runtime lists more
     # than one worker. (Decided from env vars only — probing
     # jax.process_count() would initialize the XLA backend and break a
     # later initialize(); single-worker setups may still export
     # TPU_WORKER_HOSTNAMES=localhost.)
-    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    workers = knobs.get_str("TPU_WORKER_HOSTNAMES") or ""
     if coordinator_address is None and num_processes is None and \
             "," not in workers:
         return False

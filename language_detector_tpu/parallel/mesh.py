@@ -19,6 +19,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.score import score_chunks_impl
 
+# jax.shard_map graduated from jax.experimental in newer releases; the
+# pinned 0.4.x only ships the experimental entry point
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 BATCH_AXIS = "batch"
 
 
@@ -46,9 +53,9 @@ def sharded_score_chunks_fn(mesh: Mesh):
                       cnsl=P(BATCH_AXIS), cmeta=P(BATCH_AXIS),
                       cscript=P(BATCH_AXIS), cwhack=P(BATCH_AXIS),
                       hint_lp=P(), whack_tbl=P(), k_iota=P())
-    fn = jax.shard_map(score_chunks_impl, mesh=mesh,
-                       in_specs=(P(), wire_specs),
-                       out_specs=P(BATCH_AXIS))
+    fn = _shard_map(score_chunks_impl, mesh=mesh,
+                    in_specs=(P(), wire_specs),
+                    out_specs=P(BATCH_AXIS))
     return jax.jit(fn)
 
 

@@ -41,11 +41,10 @@ subsystem is a per-request constant-time no-op.
 from __future__ import annotations
 
 import math
-import os
-import threading
 import time
 
-from .. import telemetry
+from .. import knobs, telemetry
+from ..locks import make_lock
 from ..preprocess.pack import est_slot_demand
 
 _mono = time.monotonic
@@ -138,46 +137,6 @@ def retry_after_sec(queue_docs: int, flush_docs: int = 16384,
     return max(1, min(int(sec), cap_sec))
 
 
-def _env_num(name: str, cast, default):
-    """Parse an LDT_* numeric knob; <= 0 or unset means feature off
-    (None default) / default value. A mistyped value logs loudly instead
-    of silently disabling the guard (recycle.limits_from_env rule)."""
-    v = os.environ.get(name)
-    if v in (None, ""):
-        return default
-    try:
-        n = cast(v)
-    except ValueError:
-        import logging
-        logging.getLogger(__name__).warning(
-            "%s=%r is not a valid %s — using default %r",
-            name, v, cast.__name__, default)
-        return default
-    return n
-
-
-def _env_bound(name: str, cast):
-    n = _env_num(name, cast, None)
-    return None if n is None or n <= 0 else n
-
-
-def _env_levels(name: str, default: tuple) -> tuple:
-    v = os.environ.get(name)
-    if not v:
-        return default
-    try:
-        parts = tuple(float(x) for x in v.split(","))
-    except ValueError:
-        parts = ()
-    if len(parts) != len(BROWNOUT_LEVEL_NAMES) - 1:
-        import logging
-        logging.getLogger(__name__).warning(
-            "%s=%r must be %d comma-separated numbers — using %r",
-            name, v, len(BROWNOUT_LEVEL_NAMES) - 1, default)
-        return default
-    return parts
-
-
 class AdmissionConfig:
     """Env-derived knobs, all optional (docs/OBSERVABILITY.md table).
     Bounds are None when off; with everything off the controller admits
@@ -212,25 +171,26 @@ class AdmissionConfig:
 
     @classmethod
     def from_env(cls) -> "AdmissionConfig":
+        """All knobs through the central registry (knobs.py): bound
+        knobs answer None for unset/non-positive (feature off), scalar
+        knobs fall back to their declared defaults on mistype."""
         return cls(
-            max_queue_docs=_env_bound("LDT_MAX_QUEUE_DOCS", int),
-            max_queue_bytes=_env_bound("LDT_MAX_QUEUE_BYTES", int),
-            max_inflight=_env_bound("LDT_MAX_INFLIGHT", int),
-            default_deadline_ms=_env_bound("LDT_DEFAULT_DEADLINE_MS",
-                                           float),
-            brownout_alpha=_env_num("LDT_BROWNOUT_ALPHA", float, 0.3),
-            brownout_enter=_env_levels("LDT_BROWNOUT_ENTER",
-                                       (0.60, 0.80, 0.95)),
-            brownout_exit=_env_levels("LDT_BROWNOUT_EXIT",
-                                      (0.45, 0.65, 0.80)),
-            brownout_p95_ms=_env_bound("LDT_BROWNOUT_P95_MS", float),
-            breaker_failures=_env_num("LDT_BREAKER_FAILURES", int, 5),
-            breaker_cooldown_sec=_env_num("LDT_BREAKER_COOLDOWN_SEC",
-                                          float, 10.0),
-            breaker_stall_factor=_env_num("LDT_BREAKER_STALL_FACTOR",
-                                          float, 10.0),
-            breaker_stall_min_ms=_env_num("LDT_BREAKER_STALL_MIN_MS",
-                                          float, 2000.0),
+            max_queue_docs=knobs.get_int("LDT_MAX_QUEUE_DOCS"),
+            max_queue_bytes=knobs.get_int("LDT_MAX_QUEUE_BYTES"),
+            max_inflight=knobs.get_int("LDT_MAX_INFLIGHT"),
+            default_deadline_ms=knobs.get_float(
+                "LDT_DEFAULT_DEADLINE_MS"),
+            brownout_alpha=knobs.get_float("LDT_BROWNOUT_ALPHA"),
+            brownout_enter=knobs.get_levels("LDT_BROWNOUT_ENTER"),
+            brownout_exit=knobs.get_levels("LDT_BROWNOUT_EXIT"),
+            brownout_p95_ms=knobs.get_float("LDT_BROWNOUT_P95_MS"),
+            breaker_failures=knobs.get_int("LDT_BREAKER_FAILURES"),
+            breaker_cooldown_sec=knobs.get_float(
+                "LDT_BREAKER_COOLDOWN_SEC"),
+            breaker_stall_factor=knobs.get_float(
+                "LDT_BREAKER_STALL_FACTOR"),
+            breaker_stall_min_ms=knobs.get_float(
+                "LDT_BREAKER_STALL_MIN_MS"),
         )
 
 
@@ -257,7 +217,7 @@ class BrownoutLadder:
         self.alpha = alpha
         self.ema = 0.0
         self.level = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("admission.ladder")
 
     def observe(self, load: float) -> int:
         """Fold one load sample in and return the (possibly stepped)
@@ -273,6 +233,13 @@ class BrownoutLadder:
                     self.ema < self.exit[self.level - 1]:
                 self.level -= 1
             return self.level
+
+    def snapshot(self) -> tuple:
+        """(level, ema) read under the ladder's own lock — stats
+        reporters must not read the raw attributes (lock-discipline
+        analyzer ownership: BrownoutLadder._lock owns level/ema)."""
+        with self._lock:
+            return self.level, self.ema
 
 
 class CircuitBreaker:
@@ -297,7 +264,7 @@ class CircuitBreaker:
         self.stall_factor = stall_factor
         self.stall_min_ms = stall_min_ms
         self._clock = clock or _mono
-        self._lock = threading.Lock()
+        self._lock = make_lock("admission.breaker")
         self._state = BREAKER_CLOSED
         self._consec = 0
         self._opened_at = 0.0
@@ -424,7 +391,7 @@ class AdmissionController:
             cooldown_sec=c.breaker_cooldown_sec,
             stall_factor=c.breaker_stall_factor,
             stall_min_ms=c.breaker_stall_min_ms)
-        self._lock = threading.Lock()
+        self._lock = make_lock("admission.controller")
         self.queue_docs = 0
         self.queue_bytes = 0
         self.inflight = 0
@@ -535,8 +502,12 @@ class AdmissionController:
                  "queue_bytes": self.queue_bytes,
                  "inflight": self.inflight,
                  "shed": dict(self._shed)}
-        d["brownout_level"] = self.ladder.level
-        d["brownout_ema"] = round(self.ladder.ema, 4)
+        # snapshot() reads under the LADDER's lock: the raw level/ema
+        # attributes are owned by it, and an unlocked cross-object read
+        # here could see a torn (level, ema) pair mid-observe
+        level, ema = self.ladder.snapshot()
+        d["brownout_level"] = level
+        d["brownout_ema"] = round(ema, 4)
         d["breaker_state"] = self.breaker.state
         d["breaker"] = self.breaker.stats()
         d["deadline_expired"] = telemetry.REGISTRY.counter_value(

@@ -17,10 +17,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
-import os
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import telemetry
+from .. import knobs, telemetry
 from .admission import DeadlineExceeded, degraded_detect
 from .batcher import (_FLUSH_WORKERS, _MISS, Batcher, ResultCache,
                       _accepts_trace)
@@ -31,8 +30,7 @@ _MAX_HEADER_BYTES = 16384
 
 # planned recycle: bounded window for in-flight handlers to finish
 # their response before their sockets are aborted too
-_RECYCLE_DRAIN_SEC = float(os.environ.get("LDT_RECYCLE_DRAIN_SEC",
-                                          "5.0") or 5.0)
+_RECYCLE_DRAIN_SEC = knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0
 
 
 class AioBatcher:
@@ -564,8 +562,8 @@ def main():
     import sys
 
     from .recycle import RECYCLE_EXIT_CODE
-    port = int(os.environ.get("LISTEN_PORT", 3000))
-    metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
+    port = knobs.get_int("LISTEN_PORT") or 0
+    metrics_port = knobs.get_int("PROMETHEUS_PORT") or 0
     try:
         result = asyncio.run(serve(port, metrics_port))
     except KeyboardInterrupt:

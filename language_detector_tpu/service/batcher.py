@@ -31,6 +31,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from .. import telemetry
+from ..locks import make_lock
 from .admission import DeadlineExceeded, note_deadline_expired
 
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
@@ -71,7 +72,7 @@ class ResultCache:
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
         self._d: OrderedDict = OrderedDict()  # key -> (value, nbytes)
-        self._lock = threading.Lock()
+        self._lock = make_lock("batcher.result_cache")
         self.bytes = 0
         self.hits = 0
         self.misses = 0

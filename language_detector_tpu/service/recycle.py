@@ -10,26 +10,24 @@ past a dispatch-count or RSS bound, exit cleanly with RECYCLE_EXIT_CODE
 so the supervisor (service/supervisor.py, or a container restart policy)
 replaces the worker without dropping the listening story.
 
-Configuration (env, unset = feature off):
+Configuration (env, unset = feature off; declared in knobs.py):
   LDT_MAX_DISPATCHES  recycle after this many engine batch dispatches
   LDT_MAX_RSS_MB      recycle when process RSS exceeds this many MB
 """
 from __future__ import annotations
 
-import os
+from .. import knobs
 
 # Distinct from error exits so supervisors/restart policies can tell a
 # planned recycle from a crash (and bare `docker restart: on-failure`
 # still catches both).
 RECYCLE_EXIT_CODE = 77
 
+
 def check_interval_sec() -> float:
     """Watcher period (LDT_RECYCLE_CHECK_SEC env override, for tests)."""
-    try:
-        return max(float(os.environ.get("LDT_RECYCLE_CHECK_SEC", 5.0)),
-                   0.05)
-    except ValueError:
-        return 5.0
+    v = knobs.get_float("LDT_RECYCLE_CHECK_SEC")
+    return max(v if v is not None else 5.0, 0.05)
 
 
 def rss_mb() -> float:
@@ -45,23 +43,11 @@ def rss_mb() -> float:
 
 
 def limits_from_env() -> tuple[int | None, float | None]:
-    """(max_dispatches, max_rss_mb) from the environment; None = off."""
-    def _num(name, cast):
-        v = os.environ.get(name)
-        if not v:
-            return None
-        try:
-            n = cast(v)
-        except ValueError:
-            # a mis-typed bound must not silently disable the guard the
-            # operator thinks is active
-            import logging
-            logging.getLogger(__name__).warning(
-                "%s=%r is not a valid %s — recycle bound DISABLED",
-                name, v, cast.__name__)
-            return None
-        return n if n > 0 else None
-    return _num("LDT_MAX_DISPATCHES", int), _num("LDT_MAX_RSS_MB", float)
+    """(max_dispatches, max_rss_mb) from the environment; None = off.
+    Bound-knob semantics (knobs.py): unset, non-positive, or mistyped
+    (loud warning) all answer None."""
+    return (knobs.get_int("LDT_MAX_DISPATCHES"),
+            knobs.get_float("LDT_MAX_RSS_MB"))
 
 
 def should_recycle(dispatches: int,

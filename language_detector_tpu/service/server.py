@@ -26,13 +26,13 @@ Run: python -m language_detector_tpu.service.server
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
-from .. import telemetry
+from .. import knobs, telemetry
+from ..locks import make_lock
 from .admission import (AdmissionController, DeadlineExceeded,
                         degraded_detect)
 from .batcher import Batcher
@@ -79,7 +79,7 @@ class Metrics:
     backward compatibility, derived from the histogram's sum."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("server.metrics")
         self.counters = {
             "augmentation_requests_total": 0,
             "augmentation_invalid_requests_total": 0,
@@ -168,80 +168,59 @@ class Metrics:
             "ldt_request_latency_ms (histogram).",
             [("augmentation_request_duration_milliseconds", None,
               round(req_sum, 6))]))
-        # engine gauges, read live (the engine locks its own stats)
+        # ldt_* gauge/counter families below render through
+        # telemetry.metric_family, which looks TYPE and HELP up in the
+        # central telemetry.METRICS declaration — the metric-registry
+        # analyzer (tools/lint) keeps that declaration, this code, and
+        # docs/OBSERVABILITY.md in sync
+        fam = telemetry.metric_family
+
+        def one(name, value):
+            return fam(name, [(name, None, value)])
+
+        # engine gauges, read live (the engine locks its own stats);
+        # ldt_device_dispatches_total is what the recycle watcher meters
+        # against LDT_MAX_DISPATCHES (excludes all-C tiny flushes, which
+        # burn no recycle budget)
         es = self.engine_stats()
-        fams.append(("ldt_batch_flushes_total", "counter",
-                     "Engine batch flushes (all paths).",
-                     [("ldt_batch_flushes_total", None,
-                       es.get("batches", 0))]))
-        # what the recycle watcher meters against LDT_MAX_DISPATCHES
-        # (excludes all-C tiny flushes, which burn no recycle budget)
-        fams.append(("ldt_device_dispatches_total", "counter",
-                     "Device program launches (recycle-watcher meter).",
-                     [("ldt_device_dispatches_total", None,
-                       es.get("device_dispatches", 0))]))
-        fams.append(("ldt_fallback_documents_total", "counter",
-                     "Documents resolved off the device path "
-                     "(packer fallback + gate recursion).",
-                     [("ldt_fallback_documents_total", None,
-                       es.get("fallback_docs", 0) +
-                       es.get("scalar_recursion_docs", 0))]))
+        fams.append(one("ldt_batch_flushes_total",
+                        es.get("batches", 0)))
+        fams.append(one("ldt_device_dispatches_total",
+                        es.get("device_dispatches", 0)))
+        fams.append(one("ldt_fallback_documents_total",
+                        es.get("fallback_docs", 0) +
+                        es.get("scalar_recursion_docs", 0)))
         # bucketed-scheduler lanes (models/ngram.py _detect_stream)
-        fams.append(("ldt_tier_dispatches_total", "counter",
-                     "Dispatches per shape-tier lane.",
-                     [("ldt_tier_dispatches_total", {"tier": tier},
-                       es.get(f"tier_{tier}_dispatches", 0))
-                      for tier in ("short", "mid", "long", "mixed")]))
-        fams.append(("ldt_retry_lane_dispatches_total", "counter",
-                     "Overlapped retry-lane dispatches.",
-                     [("ldt_retry_lane_dispatches_total", None,
-                       es.get("retry_lane_dispatches", 0))]))
-        fams.append(("ldt_dedup_documents_total", "counter",
-                     "Documents answered by batch-internal dedup.",
-                     [("ldt_dedup_documents_total", None,
-                       es.get("dedup_docs", 0))]))
+        fams.append(fam("ldt_tier_dispatches_total",
+                        [("ldt_tier_dispatches_total", {"tier": tier},
+                          es.get(f"tier_{tier}_dispatches", 0))
+                         for tier in ("short", "mid", "long", "mixed")]))
+        fams.append(one("ldt_retry_lane_dispatches_total",
+                        es.get("retry_lane_dispatches", 0)))
+        fams.append(one("ldt_dedup_documents_total",
+                        es.get("dedup_docs", 0)))
         # result cache (service/batcher.py, LDT_RESULT_CACHE_MB)
         cs = self.cache_stats()
-        fams.append(("ldt_result_cache_hit_rate", "gauge",
-                     "Result-cache hit rate since start.",
-                     [("ldt_result_cache_hit_rate", None,
-                       cs["hit_rate"] if cs else 0.0)]))
-        fams.append(("ldt_result_cache_hits_total", "counter",
-                     "Result-cache hits.",
-                     [("ldt_result_cache_hits_total", None,
-                       cs["hits"] if cs else 0)]))
-        fams.append(("ldt_result_cache_bytes", "gauge",
-                     "Result-cache resident bytes.",
-                     [("ldt_result_cache_bytes", None,
-                       cs["bytes"] if cs else 0)]))
+        fams.append(one("ldt_result_cache_hit_rate",
+                        cs["hit_rate"] if cs else 0.0))
+        fams.append(one("ldt_result_cache_hits_total",
+                        cs["hits"] if cs else 0))
+        fams.append(one("ldt_result_cache_bytes",
+                        cs["bytes"] if cs else 0))
         # admission control / graceful degradation (service/admission.py;
         # ldt_shed_total and ldt_deadline_expired_total are registry
         # counters and render with the families below)
         ad = self.admission_stats() or {}
-        fams.append(("ldt_admission_queue_docs", "gauge",
-                     "Documents admitted and not yet completed.",
-                     [("ldt_admission_queue_docs", None,
-                       ad.get("queue_docs", 0))]))
-        fams.append(("ldt_admission_queue_bytes", "gauge",
-                     "Byte-weighted admission cost currently held "
-                     "(4 bytes per estimated packer slot).",
-                     [("ldt_admission_queue_bytes", None,
-                       ad.get("queue_bytes", 0))]))
-        fams.append(("ldt_admission_inflight", "gauge",
-                     "HTTP requests admitted and in flight.",
-                     [("ldt_admission_inflight", None,
-                       ad.get("inflight", 0))]))
-        fams.append(("ldt_brownout_level", "gauge",
-                     "Graceful-degradation level (0=healthy "
-                     "1=skip-retry-lane 2=cache+scalar-only "
-                     "3=shed-non-priority).",
-                     [("ldt_brownout_level", None,
-                       ad.get("brownout_level", 0))]))
-        fams.append(("ldt_breaker_state", "gauge",
-                     "Device-path circuit breaker (0=closed "
-                     "1=half-open 2=open).",
-                     [("ldt_breaker_state", None,
-                       ad.get("breaker_state", 0))]))
+        fams.append(one("ldt_admission_queue_docs",
+                        ad.get("queue_docs", 0)))
+        fams.append(one("ldt_admission_queue_bytes",
+                        ad.get("queue_bytes", 0)))
+        fams.append(one("ldt_admission_inflight",
+                        ad.get("inflight", 0)))
+        fams.append(one("ldt_brownout_level",
+                        ad.get("brownout_level", 0)))
+        fams.append(one("ldt_breaker_state",
+                        ad.get("breaker_state", 0)))
         # shared telemetry registry: stage/request histograms + compile
         # counters (both fronts render the same registry)
         fams.extend(telemetry.REGISTRY.families())
@@ -271,16 +250,15 @@ class DetectorService:
         # whole response body assembles by joining cached byte fragments
         # instead of building dicts + json.dumps per document)
         self._frag_cache: dict = {}
+        # throughput-window counters: handler threads race on the
+        # read-modify-write in log_processed, so they get their own lock
+        self._log_lock = make_lock("server.processed")
         self._num_processed = 0
         self._window_start = time.time()
         self._detect = self._make_detect(use_device)
         if cache_bytes is None:
-            try:
-                cache_bytes = int(float(
-                    os.environ.get("LDT_RESULT_CACHE_MB", "0") or 0)
-                    * 1e6)
-            except ValueError:
-                cache_bytes = 0
+            mb = knobs.get_float("LDT_RESULT_CACHE_MB")
+            cache_bytes = int((mb or 0) * 1e6)
         # resolved budget, for fronts that bring their own batching
         # layer (aioserver wires the same cache into its AioBatcher)
         self.cache_bytes = cache_bytes
@@ -303,10 +281,13 @@ class DetectorService:
                 metrics = self.metrics
                 breaker = self.admission.breaker
 
-                # engine TPU gauges (ldt_*) are read live from eng.stats
-                # at render time — per-flush before/after deltas would
-                # race now that flushes run concurrently on worker pools
-                metrics.engine_stats = lambda: dict(eng.stats)
+                # engine TPU gauges (ldt_*) are read live at render
+                # time — per-flush before/after deltas would race now
+                # that flushes run concurrently on worker pools. The
+                # snapshot copies UNDER the engine's stats lock: a bare
+                # dict(eng.stats) could race a concurrent key insertion
+                # (dict resize mid-copy raises RuntimeError)
+                metrics.engine_stats = eng.stats_snapshot
 
                 def detect(texts, trace=None):
                     # codes-only engine path: the handler needs just the
@@ -377,19 +358,24 @@ class DetectorService:
                                trace=trace)
 
     def log_processed(self, amount: int = 1):
-        """Throughput log every OBJECTS_PER_LOG objects (main.go:209)."""
-        self._num_processed += amount
-        if self._num_processed >= OBJECTS_PER_LOG:
+        """Throughput log every OBJECTS_PER_LOG objects (main.go:209).
+        Called from every handler thread, so the window counters live
+        under their own lock — the unlocked += was a lost-update race
+        (and could double-print a window)."""
+        with self._log_lock:
+            self._num_processed += amount
+            if self._num_processed < OBJECTS_PER_LOG:
+                return
             n = self._num_processed
             took = time.time() - self._window_start
-            rate = n / max(took, 1e-9)
-            print(json.dumps({
-                "msg": f"Processed {n} objects in "
-                       f"{took:.3f}s ({rate:.2f} per second)",
-                "took": f"{took:.3f}s",
-                "throughput": f"{rate:.2f}"}), flush=True)
             self._num_processed = 0
             self._window_start = time.time()
+        rate = n / max(took, 1e-9)
+        print(json.dumps({
+            "msg": f"Processed {n} objects in "
+                   f"{took:.3f}s ({rate:.2f} per second)",
+            "took": f"{took:.3f}s",
+            "throughput": f"{rate:.2f}"}), flush=True)
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -740,8 +726,8 @@ def main():
     import sys
 
     from .recycle import RECYCLE_EXIT_CODE
-    port = int(os.environ.get("LISTEN_PORT", 3000))
-    metrics_port = int(os.environ.get("PROMETHEUS_PORT", 30000))
+    port = knobs.get_int("LISTEN_PORT") or 0
+    metrics_port = knobs.get_int("PROMETHEUS_PORT") or 0
     httpd, metricsd, svc = make_server(port, metrics_port)
     _recycle_watch_thread(svc, httpd)
     threading.Thread(target=metricsd.serve_forever, daemon=True).start()

@@ -26,19 +26,101 @@ is assembled across all of them (handler spans + grafted flush spans),
 and /metrics on either front renders the same registry.
 
 Env knobs: LDT_SLOW_TRACE_MS (threshold, 0/unset = sampler off),
-LDT_SLOW_TRACE_RING (ring capacity, default 64).
+LDT_SLOW_TRACE_RING (ring capacity, default 64) — declared, like every
+knob, in language_detector_tpu/knobs.py.
 """
 from __future__ import annotations
 
 import os
-import threading
 import time
 from bisect import bisect_left
 from collections import deque
 
+from . import knobs
+from .locks import make_lock
+
 _mono = time.monotonic
 
 _PROCESS_START = time.time()
+
+# Central declaration of every ldt_* Prometheus series the package
+# emits: name -> (type, help). This is the single source the /metrics
+# renderers pull HELP/TYPE text from, and the contract `tools/lint`'s
+# metric-registry analyzer enforces: a series used in code but not
+# declared here, declared here but absent from docs/OBSERVABILITY.md
+# (or vice versa), or declared but never emitted, all fail the lint.
+METRICS: dict = {
+    "ldt_stage_latency_ms": (
+        "histogram",
+        "Per-stage wall time (ms) through the request pipeline."),
+    "ldt_request_latency_ms": (
+        "histogram",
+        "End-to-end HTTP request wall time (ms)."),
+    "ldt_xla_compiles_total": (
+        "counter",
+        "Jitted-scorer compilations: first execution of a new "
+        "padded wire shape, per dispatch lane."),
+    "ldt_xla_compile_ms": (
+        "histogram",
+        "Dispatch wall time (ms) of first-execution (compiling) "
+        "launches, per lane."),
+    "ldt_shed_total": (
+        "counter",
+        "Requests shed by admission control, by reason "
+        "(service/admission.py)."),
+    "ldt_deadline_expired_total": (
+        "counter",
+        "Requests dropped at dequeue because their X-LDT-Deadline-Ms "
+        "budget had already passed."),
+    "ldt_batch_flushes_total": (
+        "counter", "Engine batch flushes (all paths)."),
+    "ldt_device_dispatches_total": (
+        "counter",
+        "Device program launches (recycle-watcher meter)."),
+    "ldt_fallback_documents_total": (
+        "counter",
+        "Documents resolved off the device path "
+        "(packer fallback + gate recursion)."),
+    "ldt_tier_dispatches_total": (
+        "counter", "Dispatches per shape-tier lane."),
+    "ldt_retry_lane_dispatches_total": (
+        "counter", "Overlapped retry-lane dispatches."),
+    "ldt_dedup_documents_total": (
+        "counter", "Documents answered by batch-internal dedup."),
+    "ldt_result_cache_hit_rate": (
+        "gauge", "Result-cache hit rate since start."),
+    "ldt_result_cache_hits_total": (
+        "counter", "Result-cache hits."),
+    "ldt_result_cache_bytes": (
+        "gauge", "Result-cache resident bytes."),
+    "ldt_admission_queue_docs": (
+        "gauge", "Documents admitted and not yet completed."),
+    "ldt_admission_queue_bytes": (
+        "gauge",
+        "Byte-weighted admission cost currently held "
+        "(4 bytes per estimated packer slot)."),
+    "ldt_admission_inflight": (
+        "gauge", "HTTP requests admitted and in flight."),
+    "ldt_brownout_level": (
+        "gauge",
+        "Graceful-degradation level (0=healthy 1=skip-retry-lane "
+        "2=cache+scalar-only 3=shed-non-priority)."),
+    "ldt_breaker_state": (
+        "gauge",
+        "Device-path circuit breaker (0=closed 1=half-open 2=open)."),
+}
+
+
+def metric_help(name: str) -> str:
+    return METRICS[name][1] if name in METRICS else name
+
+
+def metric_family(name: str, samples: list) -> tuple:
+    """(name, type, help, samples) exposition family for a DECLARED
+    ldt_* series — the renderers build gauge/counter families through
+    this so HELP/TYPE text has exactly one source."""
+    mtype, help_text = METRICS[name]
+    return (name, mtype, help_text, samples)
 
 # Log-scaled (base-2) latency bucket upper bounds in milliseconds:
 # 0.05ms .. ~105s. One fixed ladder for every latency series keeps the
@@ -62,7 +144,7 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
         self.max = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.histogram")
 
     def observe(self, value_ms: float):
         i = bisect_left(self.edges, value_ms)
@@ -186,7 +268,7 @@ class CompileTracker:
 
     def __init__(self):
         self._seen: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.compiles")
 
     def first_seen(self, lane: str, key) -> bool:
         k = (lane, key)
@@ -215,22 +297,14 @@ class SlowTraceRing:
     def __init__(self, capacity: int | None = None,
                  threshold_ms: float | None = None):
         if capacity is None:
-            try:
-                capacity = int(os.environ.get("LDT_SLOW_TRACE_RING",
-                                              "64") or 64)
-            except ValueError:
-                capacity = 64
+            capacity = knobs.get_int("LDT_SLOW_TRACE_RING") or 64
         if threshold_ms is None:
-            try:
-                threshold_ms = float(os.environ.get("LDT_SLOW_TRACE_MS",
-                                                    "0") or 0)
-            except ValueError:
-                threshold_ms = 0.0
+            threshold_ms = knobs.get_float("LDT_SLOW_TRACE_MS") or 0.0
         self.capacity = max(capacity, 1)
         self.threshold_ms = threshold_ms
         self.recorded = 0  # total ever recorded (evictions included)
         self._ring: deque = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.slow_ring")
 
     def maybe_record(self, trace: Trace, total_ms: float,
                      meta: dict | None = None) -> bool:
@@ -330,27 +404,8 @@ class TelemetryRegistry:
     REGISTRY below); reset() clears in place so every holder of the
     reference sees the fresh state (tests)."""
 
-    _HELP = {
-        "ldt_stage_latency_ms":
-            "Per-stage wall time (ms) through the request pipeline.",
-        "ldt_request_latency_ms":
-            "End-to-end HTTP request wall time (ms).",
-        "ldt_xla_compiles_total":
-            "Jitted-scorer compilations: first execution of a new "
-            "padded wire shape, per dispatch lane.",
-        "ldt_xla_compile_ms":
-            "Dispatch wall time (ms) of first-execution (compiling) "
-            "launches, per lane.",
-        "ldt_shed_total":
-            "Requests shed by admission control, by reason "
-            "(service/admission.py).",
-        "ldt_deadline_expired_total":
-            "Requests dropped at dequeue because their X-LDT-Deadline-Ms "
-            "budget had already passed.",
-    }
-
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.registry")
         self._hists: dict = {}     # (name, label items) -> Histogram
         self._counters: dict = {}  # (name, label items) -> number
         self.compiles = CompileTracker()
@@ -362,6 +417,7 @@ class TelemetryRegistry:
 
     def histogram(self, name: str, **labels) -> Histogram:
         k = self._key(name, labels)
+        # ldt-lint: disable=lock-discipline -- benign racy fast path: dict.get on a grow-only map; a miss falls through to the locked setdefault below
         h = self._hists.get(k)
         if h is None:
             with self._lock:
@@ -373,7 +429,7 @@ class TelemetryRegistry:
         (admission.expected_flush_ms) poll stages that may never run on
         this front, and each poll must not mint an empty series into
         the exposition."""
-        return self._hists.get(self._key(name, labels))
+        return self._hists.get(self._key(name, labels))  # ldt-lint: disable=lock-discipline -- benign racy read of a grow-only map; a stale None only delays one estimator poll
 
     def percentile_across(self, name: str, q: float):
         """Max q-th percentile across every label set of a histogram
@@ -405,15 +461,14 @@ class TelemetryRegistry:
             by_name.setdefault(name, {})[litems] = h
         for name in sorted(by_name):
             fams.append(histogram_family(
-                name, self._HELP.get(name, name), by_name[name]))
+                name, metric_help(name), by_name[name]))
         cnt_by_name: dict = {}
         for (name, litems), v in counters.items():
             cnt_by_name.setdefault(name, []).append((litems, v))
         for name in sorted(cnt_by_name):
             samples = [(name, dict(litems) or None, v)
                        for litems, v in sorted(cnt_by_name[name])]
-            fams.append((name, "counter",
-                         self._HELP.get(name, name), samples))
+            fams.append((name, "counter", metric_help(name), samples))
         return fams
 
     def stage_percentiles(self) -> dict:

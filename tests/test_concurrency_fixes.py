@@ -1,0 +1,120 @@
+"""Regression tests for the unlocked shared-state races the static
+pass surfaced (and the lock-discipline analyzer now guards):
+
+  - DetectorService.log_processed: handler threads raced the
+    read-modify-write on the throughput-window counters (lost updates,
+    double-printed windows);
+  - NgramBatchEngine.stats_snapshot: /metrics renderers iterated the
+    live stats dict while flush workers mutated it;
+  - BrownoutLadder: stats reporters read level/ema as two unlocked
+    loads (torn read across a step) — snapshot() reads both under the
+    ladder's lock.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from language_detector_tpu.locks import make_lock
+from language_detector_tpu.service import server as server_mod
+from language_detector_tpu.service.admission import BrownoutLadder
+
+THREADS = 8
+PER_THREAD = 250
+
+
+def _hammer(fn):
+    errors: list = []
+
+    def body():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced via assert
+            errors.append(e)
+
+    ts = [threading.Thread(target=body) for _ in range(THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+
+
+def test_log_processed_no_lost_updates(monkeypatch, capsys):
+    monkeypatch.setattr(server_mod, "OBJECTS_PER_LOG", 100)
+    svc = object.__new__(server_mod.DetectorService)
+    svc._log_lock = make_lock("server.processed")
+    svc._num_processed = 0
+    svc._window_start = 0.0
+
+    _hammer(lambda: [svc.log_processed(1) for _ in range(PER_THREAD)])
+
+    printed = sum(
+        int(json.loads(line)["msg"].split()[1])
+        for line in capsys.readouterr().out.splitlines() if line)
+    # every increment lands in exactly one window: the sum of logged
+    # window counts plus the residual equals the true total
+    assert printed + svc._num_processed == THREADS * PER_THREAD
+    assert svc._num_processed < 100
+
+
+def test_stats_snapshot_survives_concurrent_mutation():
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+
+    eng = object.__new__(NgramBatchEngine)
+    eng.stats = {f"k{i}": 0 for i in range(64)}
+    eng._stats_lock = make_lock("engine.stats")
+    stop = threading.Event()
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            with eng._stats_lock:
+                # value churn plus key churn: the unlocked iteration
+                # this guards against died with "dictionary changed
+                # size during iteration"
+                eng.stats[f"k{i % 64}"] += 1
+                eng.stats[f"extra{i % 7}"] = i
+                eng.stats.pop(f"extra{(i + 3) % 7}", None)
+            i += 1
+
+    w = threading.Thread(target=mutate)
+    w.start()
+    try:
+        def read():
+            for _ in range(PER_THREAD):
+                snap = eng.stats_snapshot()
+                assert len(snap) >= 64
+                list(snap.items())  # safe: a private copy
+
+        _hammer(read)
+    finally:
+        stop.set()
+        w.join()
+
+
+def test_ladder_snapshot_is_atomic():
+    ladder = BrownoutLadder(alpha=1.0)  # no smoothing: level tracks
+    # the last sample exactly, so a torn read is detectable
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            ladder.observe(1.0)   # -> level 3
+            ladder.observe(0.0)   # -> level 0
+
+    w = threading.Thread(target=drive)
+    w.start()
+    try:
+        def read():
+            for _ in range(PER_THREAD):
+                level, ema = ladder.snapshot()
+                # with alpha=1 the pair is fully determined by the last
+                # sample; a torn read would pair level 3 with ema 0.0
+                # (or 0 with 1.0)
+                assert (level, ema) in ((3, 1.0), (0, 0.0))
+
+        _hammer(read)
+    finally:
+        stop.set()
+        w.join()

@@ -1,0 +1,178 @@
+"""Self-tests for the static-analysis suite (tools/lint).
+
+Each rule family is exercised against seeded-violation fixtures in
+tools/lint/fixtures/ (bad fixtures must trip, good fixtures must pass,
+suppressions must be honored and counted), and a meta-check asserts the
+live tree itself is clean — the same invariant ci.sh enforces by
+running `python -m tools.lint` before the test suite.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+from tools.lint import (knob_registry, lock_discipline, metric_registry,
+                        trace_safety)
+from tools.lint.__main__ import run
+from tools.lint.ownership import _cl
+
+REPO = Path(__file__).resolve().parent.parent
+FIX = "tools/lint/fixtures"
+
+
+def _rules(violations):
+    return Counter(v.rule for v in violations)
+
+
+# -- trace safety ------------------------------------------------------------
+
+
+def test_trace_bad_fixture_trips_every_rule():
+    v, _ = trace_safety.check(root=REPO, files=[f"{FIX}/trace_bad.py"])
+    rules = _rules(v)
+    assert rules["trace-host-sync"] == 3        # float(), .item(), np.asarray
+    assert rules["trace-python-branch"] == 1    # if n:
+    assert rules["jit-shape-source"] == 1       # score(dt, wire)
+    assert sum(rules.values()) == 5
+
+
+def test_trace_good_fixture_is_clean():
+    # the trace-time-static idioms the live scorer relies on: shape
+    # reads, range loops, literal-bool config flags, identity tests,
+    # packer-sourced wires
+    v, ns = trace_safety.check(root=REPO, files=[f"{FIX}/trace_good.py"])
+    assert v == []
+    assert ns == 0
+
+
+def test_trace_suppression_honored_and_reasonless_inert():
+    v, ns = trace_safety.check(root=REPO,
+                               files=[f"{FIX}/trace_suppressed.py"])
+    rules = _rules(v)
+    assert ns == 1                                   # reasoned comment
+    assert rules["trace-host-sync"] == 1             # reasonless: kept
+    assert rules["lint-suppression-missing-reason"] == 1
+
+
+# -- lock discipline ---------------------------------------------------------
+
+_LOCK_BAD_OWNERSHIP = {
+    f"{FIX}/lock_bad.py": {
+        "Counter": _cl(lock="_lock", attrs=("n",),
+                       aliases={"ladder": "Ladder"}),
+        "Ladder": _cl(lock="_lock", attrs=("level",)),
+    },
+}
+
+_LOCK_GOOD_OWNERSHIP = {
+    f"{FIX}/lock_good.py": {
+        "Gauge": _cl(lock="_lock", attrs=("v", "hint"),
+                     held=("_apply",),
+                     lockfree={"hint": "fixture: monotonic hint"}),
+    },
+}
+
+
+def test_lock_bad_fixture_trips():
+    v, _ = lock_discipline.check(root=REPO,
+                                 ownership=_LOCK_BAD_OWNERSHIP)
+    assert len(v) == 2
+    assert all(x.rule == "lock-discipline" for x in v)
+    texts = "\n".join(x.message for x in v)
+    assert "Counter.n" in texts          # owned attr outside the lock
+    assert "self.ladder.level" in texts  # torn read through the alias
+
+
+def test_lock_good_fixture_is_clean():
+    v, _ = lock_discipline.check(root=REPO,
+                                 ownership=_LOCK_GOOD_OWNERSHIP)
+    assert v == []
+
+
+def test_lock_stale_map_entry_fails():
+    stale = {
+        f"{FIX}/lock_good.py": {
+            "Gauge": _cl(lock="_lock", attrs=("v", "renamed_attr")),
+        },
+    }
+    v, _ = lock_discipline.check(root=REPO, ownership=stale)
+    assert any("stale map entry" in x.message for x in v)
+
+
+# -- knob registry -----------------------------------------------------------
+
+
+def test_knob_bad_fixture_trips():
+    v, _ = knob_registry.check(root=REPO, files=[f"{FIX}/knob_bad.py"])
+    rules = _rules(v)
+    assert rules["knob-direct-env"] == 3   # from-import, environ, getenv
+    assert rules["knob-undeclared"] == 1   # LDT_NOT_DECLARED
+    assert sum(rules.values()) == 4
+
+
+def test_knob_good_fixture_clean_with_suppression():
+    v, ns = knob_registry.check(root=REPO,
+                                files=[f"{FIX}/knob_good.py"])
+    assert v == []
+    assert ns == 1                         # env passthrough, reasoned
+
+
+def test_knob_table_generated_from_registry():
+    table = knob_registry.generated_table(REPO)
+    for name in ("LDT_LOCK_DEBUG", "LDT_MAX_QUEUE_DOCS",
+                 "LDT_SLOW_TRACE_MS"):
+        assert f"`{name}`" in table
+    # the docs carry exactly this table between the markers (drift in
+    # either direction is a knob-docs-drift violation on the live tree)
+    text = (REPO / knob_registry.DOCS_REL).read_text()
+    between = text.split(knob_registry.MARK_BEGIN, 1)[1] \
+        .split(knob_registry.MARK_END, 1)[0].strip()
+    assert between == table.strip()
+
+
+# -- metric registry ---------------------------------------------------------
+
+
+def test_metric_fixture_drift_both_directions():
+    v, _ = metric_registry.check(
+        root=REPO,
+        files=[f"{FIX}/metrics_use.py"],
+        telemetry_rel=f"{FIX}/metrics_mod.py",
+        docs_rel=f"{FIX}/metrics_docs.md")
+    rules = _rules(v)
+    assert rules["metric-undeclared"] == 1      # ldt_fix_rogue_total
+    assert rules["metric-unused"] == 1          # ldt_fix_unused_total
+    # declared-but-undocumented (unused_total, undoc_total) plus the
+    # stale doc token (ldt_fix_stale_total); the _count exposition
+    # suffix on the documented series does NOT count as drift
+    assert rules["metric-undocumented"] == 3
+    names = "\n".join(x.message for x in v)
+    assert "ldt_fix_stale_total" in names
+    assert "ldt_fix_used_total" not in names
+
+
+# -- whole-suite meta-checks -------------------------------------------------
+
+
+def test_live_tree_is_clean():
+    # the shipped package, docs, and ownership map pass their own lint
+    assert run(root=REPO) == 0
+
+
+def test_rule_filter_unknown_rule_exits_2():
+    assert run(rules="not-a-rule", root=REPO) == 2
+
+
+def test_rule_filter_single_family():
+    assert run(rules="knob-registry", root=REPO) == 0
+    assert run(rules="lock-discipline", root=REPO) == 0
+
+
+def test_cli_entrypoint_clean():
+    r = subprocess.run([sys.executable, "-m", "tools.lint"],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout

@@ -1,0 +1,115 @@
+"""Runtime lock-order watchdog (language_detector_tpu/locks.py).
+
+The static half of the concurrency contract is tools/lint/ownership.py
+(tested in test_lint.py); this file proves the runtime half: with
+LDT_LOCK_DEBUG=1 every make_lock() is order-checked and raises on
+inversion or self-deadlock, with it off make_lock() is a plain
+threading.Lock.
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from language_detector_tpu import locks
+from language_detector_tpu.locks import (DebugLock, LockOrderInversion,
+                                         _Watchdog, make_lock)
+
+
+@pytest.fixture
+def dog():
+    return _Watchdog()
+
+
+def _pair(dog, a="a", b="b"):
+    return DebugLock(a, dog), DebugLock(b, dog)
+
+
+def test_consistent_order_is_legal(dog):
+    a, b = _pair(dog)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert dog.edges() == {"a": {"b"}}
+
+
+def test_inversion_raises(dog):
+    a, b = _pair(dog)
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderInversion, match="inversion"):
+            a.acquire()
+
+
+def test_transitive_inversion_raises(dog):
+    # a->b and b->c recorded; c->a closes a cycle through b
+    a, b = _pair(dog)
+    c = DebugLock("c", dog)
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(LockOrderInversion):
+            a.acquire()
+
+
+def test_self_reacquire_raises(dog):
+    a = DebugLock("a", dog)
+    with a:
+        with pytest.raises(LockOrderInversion, match="self-deadlock"):
+            a.acquire()
+
+
+def test_same_name_instances_not_ordered(dog):
+    # two instances of one role (e.g. two Histograms) may nest — the
+    # graph orders ROLES, not instances
+    h1 = DebugLock("telemetry.histogram", dog)
+    h2 = DebugLock("telemetry.histogram", dog)
+    with h1:
+        with h2:
+            pass
+    assert dog.edges() == {}
+
+
+def test_release_out_of_order_tolerated(dog):
+    a, b = _pair(dog)
+    a.acquire()
+    b.acquire()
+    a.release()
+    b.release()
+    with a:
+        with b:
+            pass  # graph still consistent: no raise
+
+
+def test_order_is_process_wide_across_threads(dog):
+    a, b = _pair(dog)
+
+    def establish():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=establish)
+    t.start()
+    t.join()
+    # this thread now violates the order the other thread recorded
+    with b:
+        with pytest.raises(LockOrderInversion):
+            a.acquire()
+
+
+def test_make_lock_honors_knob(monkeypatch):
+    monkeypatch.delenv("LDT_LOCK_DEBUG", raising=False)
+    assert not isinstance(make_lock("x"), DebugLock)
+    monkeypatch.setenv("LDT_LOCK_DEBUG", "1")
+    lk = make_lock("x")
+    assert isinstance(lk, DebugLock)
+    assert lk._dog is locks.WATCHDOG

@@ -1,0 +1,18 @@
+"""ldt-lint: AST-based static analysis for the repo's own hazard
+classes (docs/STATIC_ANALYSIS.md).
+
+Four analyzers, each guarding an invariant the test suite cannot cheaply
+observe:
+
+  trace_safety     host syncs / Python control flow on traced values
+                   inside jit-reachable code, and jit call sites whose
+                   wire shapes bypass the bucket ladder
+  lock_discipline  declared lock-ownership map: owned attributes must be
+                   touched under their lock (ownership.py)
+  knob_registry    language_detector_tpu/knobs.py is the only legal
+                   env-config read; docs table drift
+  metric_registry  every ldt_* series declared once (telemetry.METRICS),
+                   documented (docs/OBSERVABILITY.md), and emitted
+
+Run: python -m tools.lint   (exits non-zero on any violation)
+"""

@@ -1,0 +1,97 @@
+"""CLI: python -m tools.lint [--rule r1,r2] [--knob-table]
+[--write-knob-docs]
+
+Default run executes all four analyzers over the live tree and exits
+non-zero on any violation — ci.sh runs exactly this before the test
+suite.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import knob_registry, lock_discipline, metric_registry, \
+    trace_safety
+from .base import RULE_IDS, repo_root
+
+# analyzer -> the rule ids it can emit (every analyzer can additionally
+# emit lint-suppression-missing-reason for its scanned files)
+ANALYZERS = (
+    ("trace-safety", trace_safety.check,
+     {"trace-host-sync", "trace-python-branch", "jit-shape-source"}),
+    ("lock-discipline", lock_discipline.check, {"lock-discipline"}),
+    ("knob-registry", knob_registry.check,
+     {"knob-direct-env", "knob-undeclared", "knob-docs-drift"}),
+    ("metric-registry", metric_registry.check,
+     {"metric-undeclared", "metric-undocumented", "metric-unused"}),
+)
+
+
+def run(rules=None, root=None) -> int:
+    root = root or repo_root()
+    want = None
+    if rules:
+        want = {r.strip() for r in rules.split(",") if r.strip()}
+        unknown = want - RULE_IDS - {a for a, _, _ in ANALYZERS}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"known: {', '.join(sorted(RULE_IDS))}",
+                  file=sys.stderr)
+            return 2
+    violations: list = []
+    n_suppressed = 0
+    for name, fn, emits in ANALYZERS:
+        if want is not None and not (want & emits) and name not in want:
+            continue
+        v, ns = fn(root=root)
+        if want is not None and name not in want:
+            v = [x for x in v if x.rule in want
+                 or x.rule == "lint-suppression-missing-reason"]
+        violations.extend(v)
+        n_suppressed += ns
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    for v in violations:
+        print(v)
+    if violations:
+        by_rule: dict = {}
+        for v in violations:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        summary = ", ".join(f"{r}: {n}"
+                            for r, n in sorted(by_rule.items()))
+        print(f"\nldt-lint: {len(violations)} violation(s) "
+              f"({summary}); {n_suppressed} suppressed",
+              file=sys.stderr)
+        return 1
+    print(f"ldt-lint: clean ({n_suppressed} suppressed)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="AST-based static analysis for this repo "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--rule", default=None,
+                    help="comma-separated rule ids or analyzer names "
+                         "to run (default: everything)")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the generated env-knob markdown table "
+                         "and exit")
+    ap.add_argument("--write-knob-docs", action="store_true",
+                    help="regenerate the knob table in "
+                         "docs/OBSERVABILITY.md and exit")
+    args = ap.parse_args(argv)
+    root = repo_root()
+    if args.knob_table:
+        print(knob_registry.generated_table(root))
+        return 0
+    if args.write_knob_docs:
+        changed = knob_registry.write_knob_docs(root)
+        print("docs/OBSERVABILITY.md "
+              + ("updated" if changed else "already current"))
+        return 0
+    return run(rules=args.rule, root=root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Shared lint infrastructure: violations, source loading, and the
+suppression contract.
+
+Suppression syntax (docs/STATIC_ANALYSIS.md):
+
+    x = os.environ["HOME"]  # ldt-lint: disable=knob-direct-env -- why
+
+The comment may ride the offending line or stand alone on the line
+directly above it. The ` -- reason` is MANDATORY: a suppression without
+a reason does not suppress anything and is itself reported
+(lint-suppression-missing-reason, which cannot be suppressed) — the
+reason is the review artifact, not the directive.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+# every rule id an analyzer can emit; `--rule` and disable= validate
+# against this so a typo'd rule name fails loudly instead of silently
+# matching nothing
+RULE_IDS = frozenset({
+    "trace-host-sync",
+    "trace-python-branch",
+    "jit-shape-source",
+    "lock-discipline",
+    "knob-direct-env",
+    "knob-undeclared",
+    "knob-docs-drift",
+    "metric-undeclared",
+    "metric-undocumented",
+    "metric-unused",
+    "lint-suppression-missing-reason",
+})
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str   # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ldt-lint:\s*disable=([A-Za-z0-9_,-]+)((?:\s*--\s*\S.*)?)\s*$")
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: Path
+    rel: str
+    text: str
+    tree: ast.Module
+    # line -> set of rule ids suppressed on that line
+    suppressed: dict
+    # lines carrying a reason-less (inert) suppression comment
+    missing_reason: list
+
+
+def load_source(path: Path, root: Path | None = None) -> SourceFile:
+    root = root or repo_root()
+    text = path.read_text()
+    tree = ast.parse(text, filename=str(path))
+    suppressed: dict = {}
+    missing_reason: list = []
+    lines = text.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not m.group(2).strip():
+            missing_reason.append(i)
+            continue  # inert: a suppression without a reason
+        # a standalone comment line covers the next line; a trailing
+        # comment covers its own line
+        target = i + 1 if line.lstrip().startswith("#") else i
+        suppressed.setdefault(target, set()).update(rules)
+    try:
+        rel = str(path.resolve().relative_to(root))
+    except ValueError:
+        rel = str(path)
+    return SourceFile(path=path, rel=rel, text=text, tree=tree,
+                      suppressed=suppressed,
+                      missing_reason=missing_reason)
+
+
+def apply_suppressions(sf: SourceFile, violations: list) -> tuple:
+    """Filter a file's violations through its suppression comments.
+    Returns (kept, n_suppressed); appends one unsuppressible violation
+    per reason-less suppression comment."""
+    kept: list = []
+    n_suppressed = 0
+    for v in violations:
+        if v.rule in sf.suppressed.get(v.line, ()):
+            n_suppressed += 1
+        else:
+            kept.append(v)
+    for line in sf.missing_reason:
+        kept.append(Violation(
+            "lint-suppression-missing-reason", sf.rel, line,
+            "suppression without a reason is inert; append "
+            "' -- <why this is safe>'"))
+    return kept, n_suppressed
+
+
+def iter_package_files(root: Path):
+    """Every .py of the shipped package, repo tools included —
+    tools/lint/fixtures (deliberately-bad inputs) excluded."""
+    pkg = root / "language_detector_tpu"
+    yield from sorted(pkg.rglob("*.py"))
+
+
+def first_str_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Trailing identifier of the called object: f() -> 'f',
+    a.b.f() -> 'f'."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None

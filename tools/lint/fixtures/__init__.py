@@ -1,0 +1,8 @@
+"""Seeded-violation fixtures for tests/test_lint.py.
+
+Each file here is analyzed by passing it explicitly to an analyzer's
+check(files=...) / check(ownership=...) — nothing in this directory is
+ever scanned as part of the live tree (the analyzers scope to
+language_detector_tpu/ and the declared ownership map). The files only
+need to parse; they are never imported or executed.
+"""

@@ -1,0 +1,11 @@
+"""Fixture: knob-registry violations — direct env reads plus an
+accessor naming an undeclared knob."""
+import os
+from os import environ
+
+
+def f():
+    a = os.environ.get("LDT_X")             # direct env access
+    b = os.getenv("LDT_Y")                  # direct env access
+    c = knobs.get_int("LDT_NOT_DECLARED")   # undeclared knob
+    return a, b, c, environ

@@ -1,0 +1,11 @@
+"""Fixture: legal knob usage — a declared knob through the registry,
+and a reasoned suppression for an environment passthrough."""
+import os
+
+
+def f():
+    return knobs.get_int("LDT_SLOW_TRACE_RING")
+
+
+def passthrough():
+    return {**os.environ}  # ldt-lint: disable=knob-direct-env -- fixture: whole-environment passthrough, not a config read

@@ -1,0 +1,30 @@
+"""Fixture: lock-discipline violations — an owned attribute touched
+outside its lock, and a cross-object read through an alias."""
+import threading
+
+
+class Ladder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.level = 0
+
+    def set(self, v):
+        with self._lock:
+            self.level = v
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        self.ladder = Ladder()
+
+    def bump(self):
+        self.n += 1                 # owned attr outside the lock
+
+    def read(self):
+        with self._lock:
+            return self.n           # fine
+
+    def peek_level(self):
+        return self.ladder.level    # torn read through the alias

@@ -1,0 +1,20 @@
+"""Fixture: the legal lock-discipline shapes — locked access, a
+declared held method, and a documented lock-free attribute."""
+import threading
+
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.v = 0
+        self.hint = 0
+
+    def set(self, x):
+        with self._lock:
+            self._apply(x)
+
+    def _apply(self, x):
+        self.v = x          # held method: caller holds the lock
+
+    def peek_hint(self):
+        return self.hint    # declared lock-free

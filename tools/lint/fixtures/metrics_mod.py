@@ -1,0 +1,8 @@
+"""Fixture: a stand-in telemetry module for the metric-registry
+analyzer (passed via telemetry_rel)."""
+
+METRICS: dict = {
+    "ldt_fix_used_total": ("counter", "emitted and documented"),
+    "ldt_fix_unused_total": ("counter", "declared, never emitted"),
+    "ldt_fix_undoc_total": ("counter", "emitted, absent from docs"),
+}

@@ -1,0 +1,7 @@
+"""Fixture: metric emission sites for the metric-registry analyzer."""
+
+
+def emit(metrics):
+    metrics.counter_inc("ldt_fix_used_total", 1)
+    metrics.counter_inc("ldt_fix_undoc_total", 1)
+    metrics.counter_inc("ldt_fix_rogue_total", 1)  # never declared

@@ -1,0 +1,21 @@
+"""Fixture: every trace-safety rule family tripped at least once."""
+import jax
+import numpy as np
+
+
+def scorer(dt, wire):
+    n = wire.sum()
+    if n:                       # trace-python-branch
+        pass
+    x = float(n)                # trace-host-sync (host cast)
+    y = n.item()                # trace-host-sync (.item)
+    z = np.asarray(wire)        # trace-host-sync (np materialize)
+    return x, y, z
+
+
+score = jax.jit(scorer)
+
+
+def launch(dt, texts):
+    wire = [np.zeros(4)]
+    return score(dt, wire)      # jit-shape-source (ad-hoc wire)

@@ -1,0 +1,31 @@
+"""Fixture: the trace-time-static patterns the live tree relies on —
+all of them must pass clean."""
+import jax
+import jax.numpy as jnp
+
+from somewhere import pack_chunks_native  # AST-only, never imported
+
+
+def scorer(dt, wire, full_out=False):
+    if wire.shape[-1] == 1:     # shape read: static at trace time
+        pass
+    for j in range(3):          # python loop over a constant range
+        wire = wire + j
+    if not full_out:            # literal-bool default: config flag
+        pass
+    g = None
+    if g is None:               # identity test: trace-static
+        g = wire
+    return jnp.where(wire > 0, wire, 0)
+
+
+score = jax.jit(scorer)
+
+
+def launch(dt, cb):
+    return score(dt, cb.wire)           # cb is a parameter: caller packs
+
+
+def launch_local(dt, texts):
+    cb = pack_chunks_native(texts)
+    return score(dt, cb.wire)           # cb from the native packer

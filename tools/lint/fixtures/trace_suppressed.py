@@ -1,0 +1,13 @@
+"""Fixture: suppression mechanics — a reasoned suppression silences,
+a reasonless one stays inert AND flags itself."""
+import jax
+import numpy as np
+
+
+def scorer(dt, wire):
+    a = np.asarray(wire)  # ldt-lint: disable=trace-host-sync -- fixture: documented exception
+    b = np.asarray(wire)  # ldt-lint: disable=trace-host-sync
+    return a, b
+
+
+score = jax.jit(scorer)

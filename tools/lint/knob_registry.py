@@ -1,0 +1,164 @@
+"""Knob-registry analyzer: knobs.py is the only legal env-config read.
+
+Three checks over language_detector_tpu/:
+
+  knob-direct-env   any os.environ / os.getenv / os.environb touch (or
+                    `from os import environ/getenv`) outside knobs.py.
+                    Before the registry existed the package had ~19
+                    direct reads across 7 files, each with its own
+                    unset/mistype semantics; the registry is only a
+                    single source of truth while new reads are banned
+  knob-undeclared   a knobs.get_*/raw/is_set/value call naming a knob
+                    that language_detector_tpu/knobs.py does not
+                    declare (would raise KeyError at runtime — caught
+                    at lint time instead)
+  knob-docs-drift   the generated table in docs/OBSERVABILITY.md
+                    (between the ldt-knob-table markers) no longer
+                    matches knobs.doc_table(); regenerate with
+                    `python -m tools.lint --write-knob-docs`
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import sys
+from pathlib import Path
+
+from .base import (Violation, apply_suppressions, first_str_arg,
+                   iter_package_files, load_source, repo_root)
+
+KNOBS_REL = "language_detector_tpu/knobs.py"
+DOCS_REL = "docs/OBSERVABILITY.md"
+MARK_BEGIN = "<!-- ldt-knob-table:begin -->"
+MARK_END = "<!-- ldt-knob-table:end -->"
+
+ACCESSORS = frozenset({"raw", "is_set", "value", "get_int", "get_float",
+                       "get_str", "get_bool", "get_levels"})
+
+
+def declared_knobs(root: Path) -> set:
+    """Knob names declared in knobs.py, by AST (no import needed)."""
+    sf = load_source(root / KNOBS_REL, root)
+    names: set = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("Knob", "_k"):
+            name = first_str_arg(node)
+            if name:
+                names.add(name)
+    return names
+
+
+def load_knobs_module(root: Path):
+    """Import knobs.py standalone (it only touches the stdlib), so the
+    doc-table check never drags the full package import in."""
+    spec = importlib.util.spec_from_file_location(
+        "_ldt_lint_knobs", root / KNOBS_REL)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves the module through sys.modules while
+    # processing the Knob class; register before exec
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod
+
+
+def generated_table(root: Path) -> str:
+    return load_knobs_module(root).doc_table()
+
+
+def _check_file(sf, declared: set, out: list):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            bad = [a.name for a in node.names
+                   if a.name in ("environ", "environb", "getenv")]
+            if bad:
+                out.append(Violation(
+                    "knob-direct-env", sf.rel, node.lineno,
+                    f"import of os.{'/'.join(bad)}: env configuration "
+                    f"must go through language_detector_tpu.knobs"))
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "os" and \
+                node.attr in ("environ", "environb", "getenv"):
+            out.append(Violation(
+                "knob-direct-env", sf.rel, node.lineno,
+                f"direct os.{node.attr} access: env configuration "
+                f"must go through language_detector_tpu.knobs"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "knobs" and \
+                node.func.attr in ACCESSORS:
+            name = first_str_arg(node)
+            if name is not None and name not in declared:
+                out.append(Violation(
+                    "knob-undeclared", sf.rel, node.lineno,
+                    f"knob {name!r} is not declared in "
+                    f"language_detector_tpu/knobs.py"))
+
+
+def _check_docs(root: Path, out: list):
+    docs = root / DOCS_REL
+    if not docs.exists():
+        out.append(Violation("knob-docs-drift", DOCS_REL, 1,
+                             "docs/OBSERVABILITY.md is missing"))
+        return
+    text = docs.read_text()
+    if MARK_BEGIN not in text or MARK_END not in text:
+        out.append(Violation(
+            "knob-docs-drift", DOCS_REL, 1,
+            f"knob-table markers ({MARK_BEGIN} / {MARK_END}) are "
+            f"missing; the env-knob table must be generated, not "
+            f"hand-maintained"))
+        return
+    current = text.split(MARK_BEGIN, 1)[1].split(MARK_END, 1)[0].strip()
+    want = generated_table(root).strip()
+    if current != want:
+        line = text[:text.index(MARK_BEGIN)].count("\n") + 1
+        out.append(Violation(
+            "knob-docs-drift", DOCS_REL, line,
+            "env-knob table is stale; run "
+            "`python -m tools.lint --write-knob-docs`"))
+
+
+def write_knob_docs(root: Path | None = None) -> bool:
+    """Regenerate the docs table in place. Returns True when the file
+    changed."""
+    root = root or repo_root()
+    docs = root / DOCS_REL
+    text = docs.read_text()
+    head, _, rest = text.partition(MARK_BEGIN)
+    _, _, tail = rest.partition(MARK_END)
+    new = (head + MARK_BEGIN + "\n" + generated_table(root).strip()
+           + "\n" + MARK_END + tail)
+    if new != text:
+        docs.write_text(new)
+        return True
+    return False
+
+
+def check(root: Path | None = None, files=None, check_docs=True):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    declared = declared_knobs(root)
+    violations: list = []
+    n_suppressed = 0
+    paths = list(iter_package_files(root)) if files is None else \
+        [root / f if not Path(f).is_absolute() else Path(f)
+         for f in files]
+    for path in paths:
+        sf = load_source(path, root)
+        if sf.rel == KNOBS_REL:
+            continue
+        file_violations: list = []
+        _check_file(sf, declared, file_violations)
+        kept, ns = apply_suppressions(sf, file_violations)
+        violations.extend(kept)
+        n_suppressed += ns
+    if check_docs and files is None:
+        _check_docs(root, violations)
+    return violations, n_suppressed

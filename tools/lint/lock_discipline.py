@@ -1,0 +1,175 @@
+"""Lock-discipline analyzer: owned attributes stay under their lock.
+
+Consumes the declared ownership map (ownership.py). For each mapped
+class, every `self.<attr>` touch of an owned attribute must happen
+lexically inside `with self.<lock>:` — except in `__init__` (no other
+thread can hold a reference yet), in declared held_methods (private
+helpers of locked sections), or for attributes documented lock-free.
+Cross-object reads through declared aliases (`self.ladder.level` from
+AdmissionController) are checked against the aliased class's map — that
+shape is exactly the torn-read bug class stats() used to have.
+
+The map itself is verified against the code: a declared lock, owned
+attribute, held method, alias, or lock-free entry that no longer exists
+in the class is a violation (stale documentation fails, both
+directions).
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+from .ownership import LOCK_OWNERSHIP
+
+RULE = "lock-discipline"
+
+
+def _is_self_attr(node, attr: str | None = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one method body tracking `with self.<lock>` nesting."""
+
+    def __init__(self, cls_name, spec, alias_specs, rel, out,
+                 assume_locked: bool):
+        self.cls = cls_name
+        self.spec = spec
+        self.alias_specs = alias_specs  # attr -> ClassLocks of aliased
+        self.rel = rel
+        self.out = out
+        self.depth = 1 if assume_locked else 0
+        self.seen_attrs: set = set()
+
+    def visit_With(self, node):
+        locked = any(
+            _is_self_attr(item.context_expr, self.spec.lock)
+            for item in node.items) if self.spec.lock else False
+        if locked:
+            self.depth += 1
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self.depth -= 1
+
+    def visit_Attribute(self, node):
+        if _is_self_attr(node):
+            self.seen_attrs.add(node.attr)
+            if node.attr in self.spec.attrs and self.depth == 0 \
+                    and node.attr not in self.spec.lockfree:
+                self.out.append(Violation(
+                    RULE, self.rel, node.lineno,
+                    f"{self.cls}.{node.attr} is owned by "
+                    f"{self.cls}.{self.spec.lock} but touched outside "
+                    f"`with self.{self.spec.lock}`"))
+        # cross-object: self.<alias>.<owned attr of aliased class>
+        if isinstance(node.value, ast.Attribute) \
+                and _is_self_attr(node.value) \
+                and node.value.attr in self.alias_specs:
+            other = self.alias_specs[node.value.attr]
+            if node.attr in other.attrs \
+                    and node.attr not in other.lockfree:
+                self.out.append(Violation(
+                    RULE, self.rel, node.lineno,
+                    f"self.{node.value.attr}.{node.attr} reads state "
+                    f"owned by the aliased object's own lock — use a "
+                    f"locked accessor (e.g. snapshot()) instead"))
+        self.generic_visit(node)
+
+
+def _check_class(cls: ast.ClassDef, spec, all_specs, rel,
+                 violations) -> None:
+    alias_specs = {attr: all_specs[cname]
+                   for attr, cname in spec.aliases.items()
+                   if cname in all_specs}
+    seen: set = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name == "__init__":
+            # collect attribute existence only; writes are exempt
+            for node in ast.walk(item):
+                if isinstance(node, ast.Attribute) \
+                        and _is_self_attr(node):
+                    seen.add(node.attr)
+            continue
+        mc = _MethodChecker(
+            cls.name, spec, alias_specs, rel, violations,
+            assume_locked=item.name in spec.held_methods)
+        for stmt in item.body:
+            mc.visit(stmt)
+        seen |= mc.seen_attrs
+    # stale-map detection: every declared name must still exist
+    line = cls.lineno
+    if spec.lock and spec.lock not in seen:
+        violations.append(Violation(
+            RULE, rel, line,
+            f"ownership map declares lock {cls.name}.{spec.lock} "
+            f"which the class never defines (stale map entry)"))
+    method_names = {i.name for i in cls.body
+                    if isinstance(i, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    for a in sorted(spec.attrs):
+        if a not in seen:
+            violations.append(Violation(
+                RULE, rel, line,
+                f"ownership map declares owned attribute "
+                f"{cls.name}.{a} which the class never touches "
+                f"(stale map entry)"))
+    for a in sorted(spec.lockfree):
+        if a not in seen:
+            violations.append(Violation(
+                RULE, rel, line,
+                f"ownership map documents lock-free attribute "
+                f"{cls.name}.{a} which the class never touches "
+                f"(stale map entry)"))
+    for m in sorted(spec.held_methods):
+        if m not in method_names:
+            violations.append(Violation(
+                RULE, rel, line,
+                f"ownership map declares held method {cls.name}.{m} "
+                f"which does not exist (stale map entry)"))
+    for a in sorted(spec.aliases):
+        if a not in seen:
+            violations.append(Violation(
+                RULE, rel, line,
+                f"ownership map declares alias {cls.name}.{a} "
+                f"which the class never touches (stale map entry)"))
+
+
+def check(root: Path | None = None, ownership: dict | None = None):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    ownership = LOCK_OWNERSHIP if ownership is None else ownership
+    violations: list = []
+    n_suppressed = 0
+    for rel, classes in sorted(ownership.items()):
+        path = root / rel
+        if not path.exists():
+            violations.append(Violation(
+                RULE, rel, 1, "ownership map names a file that does "
+                              "not exist (stale map entry)"))
+            continue
+        sf = load_source(path, root)
+        file_violations: list = []
+        found: set = set()
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name in classes:
+                found.add(node.name)
+                _check_class(node, classes[node.name], classes,
+                             sf.rel, file_violations)
+        for cname in sorted(set(classes) - found):
+            file_violations.append(Violation(
+                RULE, sf.rel, 1,
+                f"ownership map names class {cname} which does not "
+                f"exist in this file (stale map entry)"))
+        kept, ns = apply_suppressions(sf, file_violations)
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed

@@ -1,0 +1,146 @@
+"""Metric-registry analyzer: every ldt_* series declared, documented,
+and emitted.
+
+The declaration is telemetry.METRICS (name -> (type, help)); the docs
+contract is docs/OBSERVABILITY.md. Usage is extracted from the first
+string argument of the registry's emission/readback calls
+(counter_inc, counter_value, histogram, histogram_peek,
+percentile_across, metric_family — plus server.py's local one/fam
+wrappers around metric_family). Native symbol names like
+ldt_pack_flat_begin share the prefix but never appear as these calls'
+first argument, so the extraction is context-limited by construction.
+
+  metric-undeclared    emitted in code but missing from METRICS (no
+                       HELP/TYPE at scrape time)
+  metric-unused        declared in METRICS but never emitted (dead
+                       series rot in dashboards)
+  metric-undocumented  drift between METRICS and docs/OBSERVABILITY.md,
+                       either direction
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .base import (Violation, apply_suppressions, first_str_arg,
+                   iter_package_files, load_source, repo_root)
+
+TELEMETRY_REL = "language_detector_tpu/telemetry.py"
+DOCS_REL = "docs/OBSERVABILITY.md"
+
+EMIT_CALLS = frozenset({"counter_inc", "counter_value", "histogram",
+                        "histogram_peek", "percentile_across",
+                        "metric_family", "one", "fam"})
+
+# exposition-derived suffixes a doc may legally append to a series name
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_DOC_TOKEN_RE = re.compile(r"\bldt_[a-z0-9_]+\b")
+
+
+def declared_metrics(root: Path, telemetry_rel: str = TELEMETRY_REL):
+    """{name: line} of METRICS keys, by AST."""
+    sf = load_source(root / telemetry_rel, root)
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            is_metrics = any(isinstance(t, ast.Name)
+                             and t.id == "METRICS"
+                             for t in node.targets)
+        elif isinstance(node, ast.AnnAssign):
+            is_metrics = (isinstance(node.target, ast.Name)
+                          and node.target.id == "METRICS")
+        else:
+            continue
+        if is_metrics and isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+def used_metrics(sources):
+    """{name: (rel, line)} of ldt_* series used as the first argument
+    of an emission/readback call."""
+    used: dict = {}
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.attr \
+                if isinstance(node.func, ast.Attribute) \
+                else getattr(node.func, "id", None)
+            if fname not in EMIT_CALLS:
+                continue
+            name = first_str_arg(node)
+            if name and name.startswith("ldt_"):
+                used.setdefault(name, (sf.rel, node.lineno))
+    return used
+
+
+def doc_metrics(root: Path, docs_rel: str = DOCS_REL) -> set:
+    text = (root / docs_rel).read_text()
+    return set(_DOC_TOKEN_RE.findall(text))
+
+
+def _base_name(token: str, declared) -> str:
+    """Collapse an exposition token (ldt_foo_ms_bucket) onto its
+    declared family name, when one matches."""
+    if token in declared:
+        return token
+    for suf in _SUFFIXES:
+        if token.endswith(suf) and token[:-len(suf)] in declared:
+            return token[:-len(suf)]
+    return token
+
+
+def check(root: Path | None = None, files=None,
+          telemetry_rel: str = TELEMETRY_REL,
+          docs_rel: str = DOCS_REL):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    declared = declared_metrics(root, telemetry_rel)
+    paths = list(iter_package_files(root)) if files is None else \
+        [root / f if not Path(f).is_absolute() else Path(f)
+         for f in files]
+    sources = [load_source(p, root) for p in paths]
+    used = used_metrics(sources)
+    in_docs = doc_metrics(root, docs_rel) \
+        if (root / docs_rel).exists() else set()
+    doc_bases = {_base_name(t, declared) for t in in_docs}
+
+    per_file: dict = {sf.rel: [] for sf in sources}
+    extra: list = []
+
+    for name, (rel, line) in sorted(used.items()):
+        if name not in declared:
+            per_file.setdefault(rel, []).append(Violation(
+                "metric-undeclared", rel, line,
+                f"series {name} is emitted but not declared in "
+                f"telemetry.METRICS (no HELP/TYPE at scrape time)"))
+    for name, line in sorted(declared.items()):
+        if name not in used:
+            extra.append(Violation(
+                "metric-unused", telemetry_rel, line,
+                f"series {name} is declared in telemetry.METRICS but "
+                f"never emitted"))
+        if name not in doc_bases:
+            extra.append(Violation(
+                "metric-undocumented", telemetry_rel, line,
+                f"series {name} is declared but not documented in "
+                f"{docs_rel}"))
+    for token in sorted(in_docs):
+        if _base_name(token, declared) not in declared:
+            extra.append(Violation(
+                "metric-undocumented", docs_rel, 1,
+                f"{docs_rel} mentions {token}, which is not declared "
+                f"in telemetry.METRICS (stale docs)"))
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        kept, ns = apply_suppressions(sf, per_file.get(sf.rel, []))
+        violations.extend(kept)
+        n_suppressed += ns
+    violations.extend(extra)
+    return violations, n_suppressed

@@ -1,0 +1,123 @@
+"""Declared lock-ownership map for the serving stack.
+
+This file IS the concurrency design document the lock-discipline
+analyzer enforces: for every class that shares state across threads it
+names the owning lock, the attributes that lock owns, the methods that
+are only ever called with the lock already held, and — just as
+important — the deliberately lock-free state, each entry with the reason
+it is safe. An attribute touched outside its lock (and not documented
+lock-free) fails `python -m tools.lint`; a documented entry that no
+longer matches the code (renamed attribute, dropped lock) fails too, so
+the map cannot rot.
+
+Lock NAMES (the make_lock role strings) also feed the runtime
+lock-order watchdog (language_detector_tpu/locks.py, LDT_LOCK_DEBUG=1):
+the static map says who owns what, the watchdog proves at test time that
+the cross-lock acquisition graph stays acyclic.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassLocks:
+    # attribute holding the owning lock (None: class has no lock and
+    # only documents lock-free state)
+    lock: str | None = None
+    # instance attributes that must only be touched under `with <lock>`
+    attrs: frozenset = frozenset()
+    # methods whose callers already hold the lock (private helpers of
+    # locked sections); their bodies are treated as locked
+    held_methods: frozenset = frozenset()
+    # attribute -> reason it is intentionally lock-free; existence is
+    # verified so stale documentation fails the lint
+    lockfree: dict = dataclasses.field(default_factory=dict)
+    # attribute -> owned class name: cross-object reads like
+    # `self.ladder.level` are checked against the owned class's map
+    aliases: dict = dataclasses.field(default_factory=dict)
+
+
+def _cl(lock=None, attrs=(), held=(), lockfree=None, aliases=None):
+    return ClassLocks(lock=lock, attrs=frozenset(attrs),
+                      held_methods=frozenset(held),
+                      lockfree=dict(lockfree or {}),
+                      aliases=dict(aliases or {}))
+
+
+# {repo-relative path: {class name: ClassLocks}}
+LOCK_OWNERSHIP: dict = {
+    "language_detector_tpu/telemetry.py": {
+        "Histogram": _cl(
+            lock="_lock",
+            attrs=("counts", "sum", "count", "max")),
+        "CompileTracker": _cl(lock="_lock", attrs=("_seen",)),
+        "SlowTraceRing": _cl(
+            lock="_lock", attrs=("_ring",),
+            lockfree={
+                "recorded": "monotonic int written only under _lock; "
+                            "debug endpoints read it as a single "
+                            "GIL-atomic load and tolerate staleness",
+            }),
+        "TelemetryRegistry": _cl(
+            lock="_lock", attrs=("_hists", "_counters")),
+    },
+    "language_detector_tpu/service/admission.py": {
+        "BrownoutLadder": _cl(lock="_lock", attrs=("ema", "level")),
+        "CircuitBreaker": _cl(
+            lock="_lock",
+            attrs=("_state", "_consec", "_opened_at", "_probe_at",
+                   "trips", "probes", "failures_total",
+                   "stalls_total")),
+        "AdmissionController": _cl(
+            lock="_lock",
+            attrs=("queue_docs", "queue_bytes", "inflight", "_shed"),
+            held=("_occupancy", "_shed_out"),
+            aliases={"ladder": "BrownoutLadder",
+                     "breaker": "CircuitBreaker"}),
+    },
+    "language_detector_tpu/service/server.py": {
+        "Metrics": _cl(
+            lock="_lock",
+            attrs=("counters", "objects", "languages"),
+            lockfree={
+                "engine_stats": "callable reference, assigned once at "
+                                "service init before handler threads "
+                                "exist; the callee locks its own state",
+                "cache_stats": "callable reference, same single-"
+                               "assignment-at-init contract",
+                "admission_stats": "callable reference, same single-"
+                                   "assignment-at-init contract",
+            }),
+        "DetectorService": _cl(
+            lock="_log_lock",
+            attrs=("_num_processed", "_window_start"),
+            lockfree={
+                "_frag_cache": "per-code response fragments: value for "
+                               "a key is a pure function of the key, so "
+                               "a racing double-compute stores the same "
+                               "bytes; dict get/set are GIL-atomic",
+            }),
+    },
+    "language_detector_tpu/service/batcher.py": {
+        "ResultCache": _cl(
+            lock="_lock",
+            attrs=("_d", "bytes", "hits", "misses")),
+    },
+    "language_detector_tpu/service/aioserver.py": {
+        # the asyncio front deliberately holds no locks: every mutation
+        # below happens on the one event loop (or before it starts)
+        "AioService": _cl(lockfree={
+            "_writers": "event-loop confined: mutated only from handler "
+                        "coroutines and the recycle watcher, all on the "
+                        "same loop",
+            "_busy": "event-loop confined, same as _writers",
+            "recycling": "bool flag set by the recycle watcher and read "
+                         "by serve(), both on the event loop",
+        }),
+        "AioBatcher": _cl(lockfree={
+            "_cache": "ResultCache locks itself; flush workers and the "
+                      "collector share it through its own lock",
+        }),
+    },
+}

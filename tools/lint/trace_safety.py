@@ -1,0 +1,407 @@
+"""Trace-safety analyzer: no host syncs or Python control flow on
+traced values inside jit-reachable code, and no jit launches over
+hand-built wire shapes.
+
+Three rules over the device-path files (ops/, models/ngram.py,
+preprocess/pack.py, parallel/mesh.py):
+
+  trace-host-sync      .item()/.tolist(), float()/int()/bool() casts,
+                       or np.asarray()/np.array() applied to a traced
+                       value inside a function reachable from a
+                       jax.jit/pjit entry — each is a silent device
+                       sync that serializes the pipeline
+  trace-python-branch  `if`/`while`/`for`/ternary driven by a traced
+                       value's truthiness — a trace-time constant at
+                       best, a ConcretizationTypeError at worst
+  jit-shape-source     a call of a jitted scorer whose wire argument is
+                       not `<chunkbatch>.wire` from the native packer
+                       (the packer applies the bucket ladder; ad-hoc
+                       wires churn the XLA jit cache, the round-3
+                       regression class)
+
+The taint model is deliberately shape-aware: `.shape`/`.dtype`/`.ndim`
+reads, `is`/`is not` comparisons, and parameters with literal bool
+defaults (static config flags like full_out) are trace-time constants
+and legal to branch on — exactly the patterns ops/score.py relies on.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+
+SCAN_FILES = (
+    "language_detector_tpu/ops/score.py",
+    "language_detector_tpu/ops/device_tables.py",
+    "language_detector_tpu/models/ngram.py",
+    "language_detector_tpu/preprocess/pack.py",
+    "language_detector_tpu/parallel/mesh.py",
+)
+
+# attribute reads that are static at trace time (never tainted)
+STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+# builtins whose results are trace-time constants
+UNTAINT_FUNCS = frozenset({"range", "len", "enumerate", "isinstance"})
+HOST_SYNC_METHODS = frozenset({"item", "tolist"})
+HOST_CASTS = frozenset({"float", "int", "bool"})
+NP_SYNC_FUNCS = frozenset({"asarray", "array"})
+
+# instance attributes holding jitted callables (models/ngram.py wires
+# self._score_fn to score_chunks or the shard_map'd variant)
+ATTR_JITTED = frozenset({"_score_fn"})
+# calls that produce a bucket-padded ChunkBatch (native packer seam)
+ALLOWED_PACKERS = frozenset({"pack_chunks_native", "_pack",
+                             "_dispatch"})
+
+
+class _TaintChecker:
+    """One reachable function's body, forward taint propagation."""
+
+    def __init__(self, fn: ast.FunctionDef, rel: str, out: list):
+        self.rel = rel
+        self.out = out
+        self.tainted: set = set()
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) \
+            + list(args.defaults)
+        for a, d in zip(pos, defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, bool):
+                continue  # literal bool default: static config flag
+            self.tainted.add(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, bool):
+                continue
+            self.tainted.add(a.arg)
+
+    def _flag(self, rule: str, node, msg: str):
+        self.out.append(Violation(rule, self.rel, node.lineno, msg))
+
+    # -- expressions --------------------------------------------------------
+
+    def expr(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            inner = self.expr(node.value)
+            return False if node.attr in STATIC_ATTRS else inner
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value) | self.expr(node.slice)
+        if isinstance(node, ast.Slice):
+            return any(self.expr(x) for x in
+                       (node.lower, node.upper, node.step))
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) | self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any([self.expr(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            taints = [self.expr(node.left)] + \
+                [self.expr(c) for c in node.comparators]
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False  # identity tests are trace-static
+            return any(taints)
+        if isinstance(node, ast.IfExp):
+            if self.expr(node.test):
+                self._flag("trace-python-branch", node,
+                           "conditional expression on a traced value; "
+                           "use jnp.where")
+            return self.expr(node.body) | self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.expr(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            return any([self.expr(k) | self.expr(v)
+                        for k, v in zip(node.keys, node.values)])
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            t = False
+            for gen in node.generators:
+                if self.expr(gen.iter):
+                    self._flag("trace-python-branch", node,
+                               "Python iteration over a traced value")
+                    t = True
+            return t
+        return False
+
+    def _call(self, node: ast.Call) -> bool:
+        arg_taints = [self.expr(a) for a in node.args] + \
+            [self.expr(kw.value) for kw in node.keywords]
+        any_tainted = any(arg_taints)
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in HOST_CASTS and any_tainted:
+                self._flag("trace-host-sync", node,
+                           f"{f.id}() on a traced value forces a "
+                           f"device sync at trace time")
+                return False
+            if f.id in UNTAINT_FUNCS:
+                return False
+            return any_tainted
+        if isinstance(f, ast.Attribute):
+            recv_tainted = self.expr(f.value)
+            if f.attr in HOST_SYNC_METHODS and recv_tainted:
+                self._flag("trace-host-sync", node,
+                           f".{f.attr}() on a traced value forces a "
+                           f"device sync")
+                return False
+            if isinstance(f.value, ast.Name) and f.value.id == "np" \
+                    and f.attr in NP_SYNC_FUNCS and any_tainted:
+                self._flag("trace-host-sync", node,
+                           f"np.{f.attr}() materializes a traced value "
+                           f"on the host; use jnp")
+                return False
+            return any_tainted or recv_tainted
+        return any_tainted
+
+    # -- statements ---------------------------------------------------------
+
+    def _bind(self, target, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            self.expr(target)
+
+    def stmts(self, body):
+        for s in body:
+            self.stmt(s)
+
+    def stmt(self, node):
+        if isinstance(node, ast.Assign):
+            t = self.expr(node.value)
+            for tgt in node.targets:
+                self._bind(tgt, t)
+        elif isinstance(node, ast.AnnAssign):
+            t = self.expr(node.value)
+            self._bind(node.target, t)
+        elif isinstance(node, ast.AugAssign):
+            t = self.expr(node.value)
+            if isinstance(node.target, ast.Name):
+                if t:
+                    self.tainted.add(node.target.id)
+            else:
+                self.expr(node.target)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value)
+        elif isinstance(node, ast.Return):
+            self.expr(node.value)
+        elif isinstance(node, (ast.If, ast.While)):
+            if self.expr(node.test):
+                self._flag("trace-python-branch", node,
+                           "Python branch on a traced value's "
+                           "truthiness; use jnp.where or a shape test")
+            # two passes: taint introduced late in a loop body must
+            # propagate to its own top
+            self.stmts(node.body)
+            if isinstance(node, ast.While):
+                self.stmts(node.body)
+            self.stmts(node.orelse)
+        elif isinstance(node, ast.For):
+            if self.expr(node.iter):
+                self._flag("trace-python-branch", node,
+                           "Python iteration over a traced value")
+            self._bind(node.target, False)
+            self.stmts(node.body)
+            self.stmts(node.body)
+            self.stmts(node.orelse)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                self.expr(item.context_expr)
+            self.stmts(node.body)
+        elif isinstance(node, (ast.Try,)):
+            self.stmts(node.body)
+            for h in node.handlers:
+                self.stmts(h.body)
+            self.stmts(node.orelse)
+            self.stmts(node.finalbody)
+        # nested defs/classes: out of scope for the traced entry
+
+
+def _lambda_called_names(lam: ast.Lambda) -> set:
+    names = set()
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name):
+            names.add(node.func.id)
+    return names
+
+
+def _collect_entries_and_jitted(sources) -> tuple:
+    """(entry function names, jitted callable names).
+
+    Entries are the functions jax traces: direct jit(f) arguments,
+    functions called inside jit(lambda ...) bodies, and the first
+    argument of shard_map(f, ...) when the wrapped result is jitted.
+    Jitted names are module-level `X = jax.jit(...)` bindings — the
+    callables whose call sites the shape-source rule audits."""
+    entries: set = set()
+    jitted: set = set()
+    for sf in sources:
+        # local name -> the Call node it was assigned from, per scope
+        def scan(body, local_calls):
+            for node in body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_calls[tgt.id] = node.value
+                for child in ast.walk(node):
+                    if not isinstance(child, ast.Call):
+                        continue
+                    fname = child.func.attr \
+                        if isinstance(child.func, ast.Attribute) \
+                        else getattr(child.func, "id", None)
+                    if fname not in ("jit", "pjit") or not child.args:
+                        continue
+                    arg = child.args[0]
+                    if isinstance(arg, ast.Lambda):
+                        entries.update(_lambda_called_names(arg))
+                    elif isinstance(arg, ast.Name):
+                        src = local_calls.get(arg.id)
+                        sname = None
+                        if src is not None:
+                            sname = src.func.attr if isinstance(
+                                src.func, ast.Attribute) \
+                                else getattr(src.func, "id", None)
+                        if sname in ("shard_map", "_shard_map") \
+                                and src.args and \
+                                isinstance(src.args[0], ast.Name):
+                            entries.add(src.args[0].id)
+                        else:
+                            entries.add(arg.id)
+
+        # module-level jitted bindings
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                fname = node.value.func.attr \
+                    if isinstance(node.value.func, ast.Attribute) \
+                    else getattr(node.value.func, "id", None)
+                if fname in ("jit", "pjit"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jitted.add(tgt.id)
+        # jit calls anywhere (module level and inside functions)
+        scan(sf.tree.body, {})
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node.body, {})
+    return entries, jitted
+
+
+def _check_shape_sources(sf, jitted: set, out: list):
+    """Audit every call of a jitted callable: the wire argument must be
+    `<name>.wire` where <name> is a ChunkBatch — a parameter of the
+    enclosing function (callers own the packing) or a local assigned
+    from the native packer."""
+
+    def audit_scope(body, params: set):
+        local_sources: dict = {}
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    cname = node.value.func.attr if isinstance(
+                        node.value.func, ast.Attribute) \
+                        else getattr(node.value.func, "id", None)
+                    if cname in ALLOWED_PACKERS:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                local_sources[tgt.id] = cname
+                            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                                for e in tgt.elts:
+                                    if isinstance(e, ast.Name):
+                                        local_sources[e.id] = cname
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = node.func.id \
+                    if isinstance(node.func, ast.Name) else None
+                fattr = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else None
+                if fname not in jitted and fattr not in ATTR_JITTED:
+                    continue
+                if not node.args:
+                    continue
+                wire = node.args[-1]
+                ok = (isinstance(wire, ast.Attribute)
+                      and wire.attr == "wire"
+                      and isinstance(wire.value, ast.Name)
+                      and (wire.value.id in params
+                           or wire.value.id in local_sources))
+                if not ok:
+                    out.append(Violation(
+                        "jit-shape-source", sf.rel, node.lineno,
+                        "jitted scorer launched over a wire that is "
+                        "not a native-packer ChunkBatch: shapes must "
+                        "come from the bucket ladder "
+                        "(native.pack_chunks_native)"))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in node.args.posonlyargs
+                      + node.args.args + node.args.kwonlyargs}
+            audit_scope(node.body, params)
+
+
+def check(root: Path | None = None, files=None):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    rels = SCAN_FILES if files is None else files
+    sources = [load_source(root / rel if not Path(rel).is_absolute()
+                           else Path(rel), root)
+               for rel in rels
+               if (root / rel).exists() or Path(rel).is_absolute()]
+    entries, jitted = _collect_entries_and_jitted(sources)
+
+    # index of module-level functions across the scan set
+    index: dict = {}
+    for sf in sources:
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                index.setdefault(node.name, (sf, node))
+
+    # reachability: BFS through plain-name calls
+    reachable: list = []
+    seen: set = set()
+    frontier = [n for n in entries if n in index]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        sf, fn = index[name]
+        reachable.append((sf, fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in index and node.func.id not in seen:
+                frontier.append(node.func.id)
+
+    per_file: dict = {id(sf): [] for sf in sources}
+    for sf, fn in reachable:
+        tc = _TaintChecker(fn, sf.rel, per_file[id(sf)])
+        tc.stmts(fn.body)
+    for sf in sources:
+        _check_shape_sources(sf, jitted, per_file[id(sf)])
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        kept, ns = apply_suppressions(sf, per_file[id(sf)])
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed
