@@ -17,7 +17,7 @@ python3 tools/artifact_tool.py --verify
 
 echo "== static analysis =="
 # AST lint (docs/STATIC_ANALYSIS.md): trace safety, lock discipline,
-# knob registry, metric registry. Non-zero on any violation.
+# knob/metric/fault registries. Non-zero on any violation.
 python3 -m tools.lint
 
 if python3 -c "import mypy" 2>/dev/null; then
@@ -181,6 +181,158 @@ assert "ldt_admission_queue_docs" in metrics
 print("overload:", len(shed), "shed /", len(served), "served,",
       "retry_after", sorted({ra for _, ra in shed}))
 svc.batcher.close()
+EOF
+
+echo "== chaos smoke =="
+# a SUPERVISED asyncio front under the docs/ROBUSTNESS.md mixed chaos
+# profile (flaky device fetches + one slow compile) with a dispatch
+# bound that forces one mid-run recycle. The invariants: every request
+# resolves (a 200 or a typed 500 — never a hang), the breaker trips
+# and recovers through a half-open probe, generation 2 serves after
+# the recycle, the fault counter exports, and SIGINT exits 0.
+python3 - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+PORT, MPORT = 3177, 31771
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MPORT),
+    "LDT_FAULTS":
+        "device_flush:error:p=0.3:seed=7,compile:delay_ms=200:once",
+    "LDT_BREAKER_FAILURES": "1",       # any injected fetch error trips
+    "LDT_BREAKER_COOLDOWN_SEC": "0.3",
+    # a low dispatch bound forces a mid-run recycle: the counter
+    # climbs only on HEALTHY device flushes (faulted fetches and
+    # breaker-open scalar stretches don't count), about 1 per 7
+    # requests under this profile
+    "LDT_MAX_DISPATCHES": "3",
+    "LDT_RECYCLE_CHECK_SEC": "0.1",
+    "LDT_RESTART_ON_CRASH": "1",
+})
+log = open("/tmp/ldt_chaos_smoke.log", "w")
+# own session: on failure the cleanup kills the process GROUP, so a
+# dead supervisor never orphans a worker still holding the port
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+body = json.dumps({"request": [
+    {"text": f"the quick brown fox jumps over the lazy dog {i}"}
+    for i in range(80)  # > the 64-doc all-C shortcut: crosses the seams
+]}).encode()
+
+
+def post(timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{PORT}/", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except Exception:
+        return None  # connection-level (recycle window): retryable
+
+
+def get_json(path, port=MPORT):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        b = e.read()
+        return e.code, json.loads(b) if b else None
+    except Exception:
+        return None, None
+
+
+def metrics_text():
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{MPORT}/metrics", timeout=10) as r:
+            return r.read().decode()
+    except Exception:
+        return ""
+
+
+try:
+    deadline = time.time() + 180
+    while get_json("/readyz")[0] != 200:
+        assert time.time() < deadline, "worker never became ready"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+    statuses = []
+    breaker_seen = set()
+    generations = set()
+    for i in range(40):
+        attempt_deadline = time.time() + 180
+        status = post()
+        while status is None:  # riding out the recycle: retry, bounded
+            assert time.time() < attempt_deadline, \
+                f"request {i} never resolved"
+            time.sleep(0.3)
+            status = post()
+        assert status in (200, 500), f"request {i}: status {status}"
+        statuses.append(status)
+        _, dv = get_json("/debug/vars")
+        if dv:
+            breaker_seen.add(dv["admission"]["breaker"]["state_name"])
+        for line in metrics_text().splitlines():
+            if line.startswith("ldt_worker_generation "):
+                generations.add(float(line.split()[-1]))
+
+    assert statuses.count(200) > 0, f"nothing served: {statuses}"
+    assert "open" in breaker_seen or "half_open" in breaker_seen, \
+        f"breaker never tripped under the storm: {breaker_seen}"
+    assert 2.0 in generations, \
+        f"no post-recycle generation observed: {generations}"
+
+    # recovery: faults stay armed (p=0.3), but probes are 70% likely —
+    # drive traffic until the breaker closes and /readyz answers 200
+    deadline = time.time() + 120
+    while True:
+        st, ready = get_json("/readyz")
+        if st == 200 and ready["ok"]:
+            break
+        assert time.time() < deadline, f"never recovered: {ready}"
+        post()
+        time.sleep(0.1)
+
+    mtext = metrics_text()
+    assert 'ldt_fault_injected_total{point="device_flush"}' in mtext, \
+        "fault counter missing from /metrics"
+
+    sup.send_signal(signal.SIGINT)  # forwarded; aio front exits 0
+    rc = sup.wait(timeout=60)
+    assert rc == 0, f"supervisor exit {rc}"
+finally:
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+suplog = open("/tmp/ldt_chaos_smoke.log").read()
+assert "worker recycled" in suplog, "no recycle in supervisor log"
+served = sum(1 for s in statuses if s == 200)
+print("chaos:", served, "served /", statuses.count(500),
+      "typed 500s across", len(statuses), "requests,",
+      "breaker states", sorted(breaker_seen - {None}),
+      "| generations", sorted(g for g in generations if g))
 EOF
 
 echo "CI OK"

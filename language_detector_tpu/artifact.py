@@ -34,11 +34,21 @@ from pathlib import Path
 
 import numpy as np
 
+from . import faults
+
 MAGIC = 0x4154444C  # "LDTA"
 VERSION = 1
 ALIGN = 64
 _HDR = struct.Struct("<IIII QQ")
 _DESC = struct.Struct("<48s8sI 4Q QQ")
+
+
+class ArtifactError(ValueError):
+    """A corrupt, truncated, or wrong-version artifact file. Subclasses
+    ValueError so every pre-existing `except ValueError` load guard
+    still catches it; new code should catch ArtifactError and let the
+    message (which names the file, the failure, and the fix) reach the
+    operator — startup fails loud and /readyz stays false."""
 
 
 def write_artifact(arrays: dict, path: str | Path) -> None:
@@ -84,26 +94,40 @@ def load_artifact(path: str | Path) -> dict:
     """mmap the artifact and return name -> zero-copy ndarray views.
     The mapping stays alive as long as any view does (numpy holds the
     buffer reference)."""
+    if faults.ACTIVE is not None:
+        faults.hit("artifact_load")
     with open(path, "rb") as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     try:
         if len(mm) < _HDR.size:
-            raise ValueError(f"{path}: not an LDTA artifact (truncated)")
+            raise ArtifactError(
+                f"{path}: not an LDTA artifact (file shorter than the "
+                "header) — re-pack it with tools/artifact_tool.py --pack")
         magic, version, n, _, header_bytes, total = _HDR.unpack_from(mm, 0)
         if magic != MAGIC:
-            raise ValueError(f"{path}: bad magic {magic:#x}")
+            raise ArtifactError(
+                f"{path}: bad magic {magic:#x} (want {MAGIC:#x} 'LDTA') "
+                "— this is not a packed artifact; re-pack the npz with "
+                "tools/artifact_tool.py --pack")
         if version != VERSION:
-            raise ValueError(f"{path}: format version {version}, "
-                             f"expected {VERSION}")
+            raise ArtifactError(
+                f"{path}: format version {version}, this build reads "
+                f"version {VERSION} — re-pack with a matching "
+                "tools/artifact_tool.py --pack")
         if total != len(mm):
-            raise ValueError(f"{path}: size {len(mm)} != recorded {total} "
-                             "(truncated or corrupt)")
-        # a corrupted n_arrays/header_bytes must fail the ValueError
+            raise ArtifactError(
+                f"{path}: file is {len(mm)} bytes but the header "
+                f"records {total} (truncated or corrupt) — restore it "
+                "from source or re-pack with tools/artifact_tool.py "
+                "--pack")
+        # a corrupted n_arrays/header_bytes must fail the ArtifactError
         # contract, not crash struct.unpack past the mapping
         if header_bytes != _HDR.size + n * _DESC.size or \
                 header_bytes > total:
-            raise ValueError(f"{path}: header_bytes {header_bytes} "
-                             f"inconsistent with {n} descriptors (corrupt)")
+            raise ArtifactError(
+                f"{path}: header_bytes {header_bytes} inconsistent "
+                f"with {n} descriptors (corrupt header) — re-pack with "
+                "tools/artifact_tool.py --pack")
         data_start = -(-header_bytes // ALIGN) * ALIGN
         out: dict = {}
         buf = memoryview(mm)
@@ -114,20 +138,27 @@ def load_artifact(path: str | Path) -> dict:
             try:
                 dtype = np.dtype(dt_b.rstrip(b"\0").decode())
             except TypeError as e:
-                raise ValueError(f"{path}: {name} bad dtype ({e})") \
-                    from None
+                raise ArtifactError(
+                    f"{path}: array {name!r} has an unreadable dtype "
+                    f"({e}) — corrupt descriptor; re-pack with "
+                    "tools/artifact_tool.py --pack") from None
             shape = (s0, s1, s2, s3)[:ndim]
             # offsets must land in the data region: a corrupt descriptor
             # must not alias array views over the header/descriptor table
             if ndim > 4 or off < data_start or off + nb > total:
-                raise ValueError(f"{path}: {name} descriptor out of "
-                                 "bounds")
+                raise ArtifactError(
+                    f"{path}: array {name!r} descriptor out of bounds "
+                    "(corrupt) — re-pack with tools/artifact_tool.py "
+                    "--pack")
             count = 1
             for s in shape:
                 count *= s
             if nb != count * dtype.itemsize:
-                raise ValueError(f"{path}: {name} nbytes {nb} != shape "
-                                 f"{shape} x itemsize {dtype.itemsize}")
+                raise ArtifactError(
+                    f"{path}: array {name!r} records {nb} bytes but "
+                    f"shape {shape} x itemsize {dtype.itemsize} "
+                    "disagrees (corrupt descriptor) — re-pack with "
+                    "tools/artifact_tool.py --pack")
             a = np.frombuffer(buf[off:off + nb], dtype=dtype)
             out[name] = a.reshape(shape)
     except BaseException:

@@ -111,6 +111,35 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "as a breaker failure (stall watchdog)."),
     _k("LDT_BREAKER_STALL_MIN_MS", "float", 2000.0,
        "Floor of the stall watchdog threshold in ms."),
+    # -- fault injection & flush timeout (faults.py, both fronts) -----
+    _k("LDT_FAULTS", "str", None,
+       "Fault-injection spec, comma-separated "
+       "`point:action[:p=][:seed=][:once][:after=]` rules "
+       "(docs/ROBUSTNESS.md). Unset = injection disabled (seams cost "
+       "one attribute check)."),
+    _k("LDT_FLUSH_TIMEOUT_SEC", "float", 60.0,
+       "How long a handler waits on its flush future before answering "
+       "504 (both fronts; formerly a hardcoded 60 s)."),
+    # -- supervisor crash policy (service/supervisor.py) --------------
+    _k("LDT_RESTART_ON_CRASH", "bool", False,
+       "Supervisor restarts a crashed worker (any exit other than 0 / "
+       "recycle) with exponential backoff instead of propagating the "
+       "first crash."),
+    _k("LDT_CRASH_BACKOFF_BASE_SEC", "float", 0.5,
+       "First crash-restart backoff; doubles per consecutive crash "
+       "(x0.5-1.5 jitter)."),
+    _k("LDT_CRASH_BACKOFF_MAX_SEC", "float", 30.0,
+       "Ceiling of the crash-restart backoff."),
+    _k("LDT_CRASH_LOOP_WINDOW_SEC", "float", 60.0,
+       "Crash-loop window: this many seconds of crash history count "
+       "toward the loop detector."),
+    _k("LDT_CRASH_LOOP_MAX", "int", 5,
+       "Crashes inside the window that declare a crash loop: the "
+       "supervisor stops restarting and propagates the exit code."),
+    _k("LDT_WORKER_GENERATION", "int", 0,
+       "Set BY the supervisor on each spawned worker (1, 2, ...); "
+       "exported as the ldt_worker_generation gauge. 0 = running "
+       "unsupervised."),
     # -- debug / CI ---------------------------------------------------
     _k("LDT_LOCK_DEBUG", "bool", False,
        "Build order-checking debug locks (language_detector_tpu/locks)"

@@ -30,7 +30,7 @@ import time as _time
 
 import numpy as np
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              FLAG_SQUEEZE, FLAG_TOP40,
                              ScalarResult, detect_scalar,
@@ -152,11 +152,18 @@ class NgramBatchEngine:
         dispatch call, so the elapsed time of a fresh-shape launch IS
         the compile cost; warm launches return in microseconds and are
         not timed at all — the hot path stays one set lookup)."""
+        # fault seam BEFORE first_seen: an injected launch error must
+        # not consume the first-shape marker and mislabel the real
+        # retry's compile as warm
+        if faults.ACTIVE is not None:
+            faults.hit("scorer_launch")
         key = (self._mesh_size,
                tuple(sorted((k, tuple(np.shape(v)))
                             for k, v in cb.wire.items())))
         if not telemetry.REGISTRY.compiles.first_seen(lane, key):
             return self._score_fn(self.dt, cb.wire)
+        if faults.ACTIVE is not None:
+            faults.hit("compile")
         t0 = _time.monotonic()
         fut = self._score_fn(self.dt, cb.wire)
         telemetry.REGISTRY.counter_inc("ldt_xla_compiles_total",
@@ -778,6 +785,8 @@ class NgramBatchEngine:
         which is where a dispatch's time shows up under the depth-3
         pipeline (the launch itself is asynchronous)."""
         from .. import native
+        if faults.ACTIVE is not None:
+            faults.hit("device_flush")
         t0 = _time.monotonic()
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
         t0 = telemetry.observe_stage("dispatch", t0, trace=trace)

@@ -19,12 +19,13 @@ import contextlib
 import json
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import knobs, telemetry
+from .. import faults, knobs, telemetry
 from .admission import DeadlineExceeded, degraded_detect
 from .batcher import (_FLUSH_WORKERS, _MISS, Batcher, ResultCache,
                       _accepts_trace)
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
-                     parse_post_body, post_detect, pre_detect)
+                     health_response, parse_post_body, post_detect,
+                     pre_detect)
 
 _MAX_HEADER_BYTES = 16384
 
@@ -66,10 +67,15 @@ class AioBatcher:
         request grafts its engine stage spans into it (same contract as
         batcher.Batcher.submit)."""
         fut = asyncio.get_running_loop().create_future()
+        if faults.ACTIVE is not None:
+            # enqueue fault: raises before the future enters the queue,
+            # so the handler answers it and nothing is left half-armed
+            await faults.hit_async("queue_put")
         await self._q.put((texts, trace, fut))
-        # same 60s bound the sync path enforces via fut.result(60): a
+        # same bound the sync path enforces via fut.result(...): a
         # wedged flush must fail the request, not pin the connection
-        return await asyncio.wait_for(fut, timeout=60)
+        return await asyncio.wait_for(
+            fut, timeout=knobs.get_float("LDT_FLUSH_TIMEOUT_SEC") or 60.0)
 
     async def close(self):
         if self._task is not None:
@@ -95,6 +101,18 @@ class AioBatcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            if faults.ACTIVE is not None:
+                # dequeue fault: fail THIS batch's waiters with the
+                # typed error and keep collecting — the collector task
+                # must survive any chaos profile (a wait_for-cancelled
+                # future is done(); skip it)
+                try:
+                    await faults.hit_async("queue_get")
+                except faults.FaultInjected as e:
+                    for *_, fut in pending:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    continue
             # dequeue-time deadline check (shared with the sync
             # Batcher: (texts, trace, fut) has the same tail) — expired
             # requests fail with DeadlineExceeded before this flush
@@ -219,6 +237,15 @@ class AioService:
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
+        if faults.ACTIVE is not None:
+            # accept fault seam: drop the connection before any byte is
+            # read (the client sees a reset, never a torn response)
+            try:
+                await faults.hit_async("accept")
+            except faults.FaultInjected:
+                with contextlib.suppress(Exception):
+                    writer.close()
+                return
         self._writers.add(writer)
         try:
             sock = writer.get_extra_info("socket")
@@ -330,6 +357,9 @@ class AioService:
             if method == b"GET":
                 if path in ("/", ""):
                     return _http_response(200, self._usage)
+                if path in ("/healthz", "/readyz"):
+                    hstatus, hbody = health_response(svc, path)
+                    return _http_response(hstatus, hbody)
                 m.inc("augmentation_invalid_requests_total")
                 return _http_response(404, b'{"error":"Not found"}')
             if method != b"POST" or path not in ("/", ""):
@@ -395,13 +425,15 @@ class AioService:
                     504,
                     b'{"error":"deadline expired before dispatch"}')
             except (asyncio.TimeoutError, TimeoutError):
-                # wedged flush: fail THIS request with a response (the
-                # disconnect handler upstream must not eat it — on 3.12
-                # asyncio.TimeoutError IS builtins.TimeoutError)
+                # wedged flush (LDT_FLUSH_TIMEOUT_SEC): fail THIS
+                # request with a 504 — the backend stalled, the request
+                # was fine (the disconnect handler upstream must not eat
+                # it; on 3.12 asyncio.TimeoutError IS TimeoutError)
                 m.inc("augmentation_errors_logged_total")
-                meta["status"] = 500
+                meta["status"] = 504
+                meta["timeout"] = "flush"
                 return _http_response(
-                    500, b'{"error":"detection timed out"}')
+                    504, b'{"error":"detection timed out"}')
             finally:
                 if admit is not None:
                     adm.release(admit)
@@ -434,7 +466,10 @@ class AioService:
                     if len(parts) >= 2 else "/metrics"
                 self._busy.add(writer)
                 try:
-                    if path == "/debug/vars":
+                    if path in ("/healthz", "/readyz"):
+                        hstatus, hbody = health_response(self.svc, path)
+                        writer.write(_http_response(hstatus, hbody))
+                    elif path == "/debug/vars":
                         body = json.dumps(telemetry.debug_vars(
                             self.svc.metrics), indent=2).encode()
                         writer.write(_http_response(200, body))

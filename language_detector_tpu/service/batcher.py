@@ -30,7 +30,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..locks import make_lock
 from .admission import DeadlineExceeded, note_deadline_expired
 
@@ -149,6 +149,11 @@ class Batcher:
             # queue nobody drains until the caller's 60s result timeout
             fut.set_exception(RuntimeError("batcher closed"))
             return fut
+        if faults.ACTIVE is not None:
+            # an injected queue_put error raises out of submit: the
+            # handler answers it like any enqueue failure, and the
+            # future never enters the queue half-armed
+            faults.hit("queue_put")
         self._q.put((texts, hints_key, trace, fut))
         return fut
 
@@ -202,6 +207,15 @@ class Batcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            if faults.ACTIVE is not None:
+                # a dequeue fault fails THIS batch's waiters (typed
+                # error, not a hang) and the collector moves on — the
+                # collector thread itself must survive any chaos profile
+                try:
+                    faults.hit("queue_get")
+                except faults.FaultInjected as e:
+                    self._fail(pending, e)
+                    continue
             # wait for a flush slot, re-checking _stop so a wedged
             # device (every slot held by a stuck flush) cannot pin the
             # collector — and with it close()'s join — forever. A

@@ -28,12 +28,14 @@ from __future__ import annotations
 import json
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
-from .. import knobs, telemetry
+from .. import faults, knobs, telemetry
 from ..locks import make_lock
-from .admission import (AdmissionController, DeadlineExceeded,
+from .admission import (BREAKER_OPEN, BREAKER_STATE_NAMES,
+                        AdmissionController, DeadlineExceeded,
                         degraded_detect)
 from .batcher import Batcher
 
@@ -98,6 +100,10 @@ class Metrics:
         # live admission-control gauge source (set by DetectorService):
         # () -> admission.AdmissionController.stats() dict or None
         self.admission_stats = lambda: None
+        # live readiness source (set by DetectorService): () ->
+        # DetectorService.readiness() dict or None (the /readyz
+        # contract, exported as ldt_ready and /debug/vars "ready")
+        self.readiness = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -221,6 +227,14 @@ class Metrics:
                         ad.get("brownout_level", 0)))
         fams.append(one("ldt_breaker_state",
                         ad.get("breaker_state", 0)))
+        # readiness + supervision (docs/ROBUSTNESS.md): ldt_ready
+        # mirrors /readyz, the generation gauge is set by the
+        # supervisor through the child's environment
+        rd = self.readiness()
+        fams.append(one("ldt_ready",
+                        1 if rd is not None and rd.get("ok") else 0))
+        fams.append(one("ldt_worker_generation",
+                        knobs.get_int("LDT_WORKER_GENERATION") or 0))
         # shared telemetry registry: stage/request histograms + compile
         # counters (both fronts render the same registry)
         fams.extend(telemetry.REGISTRY.families())
@@ -255,7 +269,12 @@ class DetectorService:
         self._log_lock = make_lock("server.processed")
         self._num_processed = 0
         self._window_start = time.time()
+        # flipped true by _make_detect once the table artifact is
+        # actually loaded; /readyz reports false until then (and an
+        # ArtifactError propagates out of __init__ — startup fails loud)
+        self._artifact_loaded = False
         self._detect = self._make_detect(use_device)
+        self.metrics.readiness = self.readiness
         if cache_bytes is None:
             mb = knobs.get_float("LDT_RESULT_CACHE_MB")
             cache_bytes = int((mb or 0) * 1e6)
@@ -275,8 +294,14 @@ class DetectorService:
         self._tables = None
         if use_device:
             try:
+                # an ArtifactError (bad magic / truncated / version
+                # mismatch) is NOT swallowed into the scalar fallback:
+                # it propagates out of __init__ so startup fails with
+                # the actionable message instead of silently serving
+                # degraded
                 from ..models.ngram import NgramBatchEngine
                 eng = NgramBatchEngine()
+                self._artifact_loaded = True
                 self._engine = eng
                 metrics = self.metrics
                 breaker = self.admission.breaker
@@ -320,6 +345,7 @@ class DetectorService:
         from ..engine_scalar import detect_scalar
         from ..tables import load_tables
         tables = load_tables()
+        self._artifact_loaded = True
         self._engine = None
         self._tables = tables
 
@@ -345,9 +371,25 @@ class DetectorService:
         telemetry.observe_stage("scalar_detect", t0, trace=trace)
         return out
 
+    def readiness(self) -> dict:
+        """The /readyz contract (docs/ROBUSTNESS.md): ready means the
+        artifact is loaded, the device breaker is not open, and the
+        brownout ladder sits below the shed level. Liveness (/healthz)
+        is unconditional — a not-ready process is alive, just asking
+        the balancer to route around it."""
+        bstate = self.admission.breaker.stats()["state"]
+        level, _ = self.admission.ladder.snapshot()
+        ok = (self._artifact_loaded and bstate != BREAKER_OPEN and
+              level < 3)
+        return {"ok": ok,
+                "artifact_loaded": self._artifact_loaded,
+                "breaker": BREAKER_STATE_NAMES[bstate],
+                "brownout_level": level}
+
     def detect_codes(self, texts: list, trace=None) -> list:
         fut = self.batcher.submit(texts, trace=trace)
-        return fut.result(timeout=60)
+        return fut.result(
+            timeout=knobs.get_float("LDT_FLUSH_TIMEOUT_SEC") or 60.0)
 
     def detect_codes_degraded(self, texts: list, trace=None) -> list:
         """Brownout level-2 serving: result cache (when enabled) +
@@ -376,6 +418,19 @@ class DetectorService:
                    f"{took:.3f}s ({rate:.2f} per second)",
             "took": f"{took:.3f}s",
             "throughput": f"{rate:.2f}"}), flush=True)
+
+
+def health_response(svc: DetectorService, path: str):
+    """(status, body bytes) for /healthz and /readyz — one contract
+    shared by both fronts and both ports (docs/ROBUSTNESS.md).
+    /healthz is pure liveness: the process answers, so it is alive.
+    /readyz answers 200 only when readiness() says ok, 503 otherwise,
+    and the body carries the component breakdown either way so an
+    operator's curl explains itself."""
+    if path == "/healthz":
+        return 200, b'{"status":"ok"}'
+    r = svc.readiness()
+    return (200 if r["ok"] else 503), json.dumps(r).encode()
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -409,12 +464,27 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet access log
         pass
 
+    def handle(self):
+        # accept fault seam: an injected error here models a connection
+        # dropped before any byte is read — the client sees a reset,
+        # never a half-written response
+        if faults.ACTIVE is not None:
+            try:
+                faults.hit("accept")
+            except faults.FaultInjected:
+                self.close_connection = True
+                return
+        super().handle()
+
     # -- routes -------------------------------------------------------------
 
     def do_GET(self):
         t0 = time.time()
         if self.path in ("/", ""):
             self._send_json(200, json.dumps(USAGE).encode())
+        elif self.path in ("/healthz", "/readyz"):
+            status, body = health_response(self.service, self.path)
+            self._send_json(status, body)
         else:
             self.service.metrics.inc("augmentation_invalid_requests_total")
             self._send_json(404, b'{"error":"Not found"}')
@@ -530,6 +600,29 @@ class Handler(BaseHTTPRequestHandler):
             telemetry.finish_request(
                 trace, meta={"front": "sync", "docs": len(texts),
                              "status": 504})
+            return
+        except (TimeoutError, FuturesTimeout):
+            # flush future timed out (LDT_FLUSH_TIMEOUT_SEC): the
+            # device/batcher is wedged, not the request malformed — 504
+            # with the trace annotated, mirroring the aio front (on
+            # 3.10 concurrent.futures.TimeoutError is its own type;
+            # 3.11+ aliases it to the builtin)
+            svc.metrics.inc("augmentation_errors_logged_total")
+            self._send_json(504, b'{"error":"detection timed out"}')
+            telemetry.finish_request(
+                trace, meta={"front": "sync", "docs": len(texts),
+                             "status": 504, "timeout": "flush"})
+            return
+        except Exception as e:  # noqa: BLE001 - every doc resolves
+            # the chaos invariant: an injected (or real) batcher/engine
+            # error answers a typed 500, never a reset connection
+            print(json.dumps({"msg": "detect failed",
+                              "error": repr(e)}), flush=True)
+            svc.metrics.inc("augmentation_errors_logged_total")
+            self._send_json(500, b'{"error":"internal error"}')
+            telemetry.finish_request(
+                trace, meta={"front": "sync", "docs": len(texts),
+                             "status": 500})
             return
         finally:
             if admit is not None:
@@ -652,7 +745,11 @@ class MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = self.path.split("?", 1)[0]
-        if path == "/debug/vars":
+        status = 200
+        if path in ("/healthz", "/readyz"):
+            status, body = health_response(self.service, path)
+            ctype = "application/json; charset=utf-8"
+        elif path == "/debug/vars":
             body = json.dumps(
                 telemetry.debug_vars(self.service.metrics),
                 indent=2).encode()
@@ -668,7 +765,7 @@ class MetricsHandler(BaseHTTPRequestHandler):
         else:
             body = self.service.metrics.render().encode()
             ctype = "text/plain; version=0.0.4"
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

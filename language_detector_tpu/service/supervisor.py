@@ -1,11 +1,30 @@
-"""Worker supervisor: restart the serving process on planned recycles.
+"""Worker supervisor: restart the serving process on planned recycles,
+and (opt-in) on crashes — with backoff and crash-loop detection.
 
 The reference ships its restart story as a container policy
 (/root/reference/Dockerfile); this is the same story for bare-metal and
-for the repo's own Dockerfile CMD: run the HTTP front as a child, and
-while it exits with RECYCLE_EXIT_CODE (a planned self-recycle — see
-service/recycle.py), start a fresh one. Any other exit propagates, so
-crashes still surface to the outer restart policy / operator.
+for the repo's own Dockerfile CMD: run the HTTP front as a child and
+restart it per policy (docs/ROBUSTNESS.md):
+
+  - exit RECYCLE_EXIT_CODE (planned self-recycle, service/recycle.py):
+    restart immediately, always — a recycle is healthy behavior, it
+    resets the crash counter;
+  - exit 0, or a signal-initiated stop: propagate (done);
+  - any other exit ("crash"): propagate by default, so crashes surface
+    to the outer restart policy / operator. With LDT_RESTART_ON_CRASH
+    set, restart after an exponential backoff with jitter
+    (LDT_CRASH_BACKOFF_BASE_SEC doubling per consecutive crash up to
+    LDT_CRASH_BACKOFF_MAX_SEC, scaled x0.5-1.5) — unless
+    LDT_CRASH_LOOP_MAX crashes landed inside the trailing
+    LDT_CRASH_LOOP_WINDOW_SEC, which declares a crash loop: a worker
+    that cannot hold a generation up is broken, not unlucky, and
+    restarting it forever hides the outage. The loop propagates the
+    last exit code.
+
+Each spawned worker gets LDT_WORKER_GENERATION=<n> in its environment
+(1, 2, ...), which the fronts export as the ldt_worker_generation
+gauge, and every lifecycle event is one structured JSON log line with a
+"reason" field (recycle | crash | crash-loop | clean-exit | signal).
 
 Run: python -m language_detector_tpu.service.supervisor [module]
      (module defaults to language_detector_tpu.service.aioserver, the
@@ -15,18 +34,33 @@ Run: python -m language_detector_tpu.service.supervisor [module]
 from __future__ import annotations
 
 import json
+import os
+import random
 import signal
 import subprocess
 import sys
 import time
 
+from .. import knobs
 from .recycle import RECYCLE_EXIT_CODE
+
+
+def _log(msg: str, **fields):
+    print(json.dumps({"msg": msg, **fields}), flush=True)
 
 
 def main() -> int:
     module = sys.argv[1] if len(sys.argv) > 1 else \
         "language_detector_tpu.service.aioserver"
+    restart_on_crash = knobs.get_bool("LDT_RESTART_ON_CRASH")
+    backoff_base = knobs.get_float("LDT_CRASH_BACKOFF_BASE_SEC") or 0.5
+    backoff_max = knobs.get_float("LDT_CRASH_BACKOFF_MAX_SEC") or 30.0
+    loop_window = knobs.get_float("LDT_CRASH_LOOP_WINDOW_SEC") or 60.0
+    loop_max = knobs.get_int("LDT_CRASH_LOOP_MAX") or 5
+
     generation = 0
+    consec_crashes = 0
+    crash_times: list = []  # wall times of recent crashes (loop window)
     child: subprocess.Popen | None = None
     stopping = False
 
@@ -45,11 +79,14 @@ def main() -> int:
 
     while True:
         generation += 1
-        print(json.dumps({"msg": f"supervisor: starting {module} "
-                                 f"(generation {generation})"}),
-              flush=True)
+        _log(f"supervisor: starting {module} (generation {generation})",
+             generation=generation)
         t0 = time.time()
-        child = subprocess.Popen([sys.executable, "-m", module])
+        # the supervisor WRITES the child's env; its own reads above go
+        # through the registry
+        env = dict(os.environ)  # ldt-lint: disable=knob-direct-env -- building the child environment, not reading config
+        env["LDT_WORKER_GENERATION"] = str(generation)
+        child = subprocess.Popen([sys.executable, "-m", module], env=env)
         if stopping:  # signal raced the spawn: stop the new worker too
             child.send_signal(signal.SIGTERM)
         while True:
@@ -58,15 +95,58 @@ def main() -> int:
                 break
             except KeyboardInterrupt:  # Ctrl+C raced the handler
                 continue
-        if stopping or rc != RECYCLE_EXIT_CODE:
-            print(json.dumps({"msg": f"supervisor: worker exited rc={rc} "
-                                     f"after {time.time() - t0:.1f}s — "
-                                     "propagating"}), flush=True)
+        uptime = round(time.time() - t0, 3)
+        if stopping:
+            _log("supervisor: worker stopped by signal — propagating",
+                 reason="signal", rc=rc, generation=generation,
+                 uptime_sec=uptime)
             return rc
-        print(json.dumps({"msg": "supervisor: worker recycled after "
-                                 f"{time.time() - t0:.1f}s"}), flush=True)
-        if stopping:  # SIGTERM landed in the reap/restart gap
+        if rc == RECYCLE_EXIT_CODE:
+            # planned recycle: healthy; restart now and forget crashes
+            consec_crashes = 0
+            _log("supervisor: worker recycled", reason="recycle",
+                 rc=rc, generation=generation, uptime_sec=uptime)
+            continue
+        if rc == 0:
+            _log("supervisor: worker exited cleanly — propagating",
+                 reason="clean-exit", rc=rc, generation=generation,
+                 uptime_sec=uptime)
             return rc
+        # crash
+        if not restart_on_crash:
+            _log("supervisor: worker crashed — propagating "
+                 "(LDT_RESTART_ON_CRASH not set)", reason="crash",
+                 rc=rc, generation=generation, uptime_sec=uptime)
+            return rc
+        now = time.time()
+        crash_times = [t for t in crash_times if now - t <= loop_window]
+        crash_times.append(now)
+        if len(crash_times) >= loop_max:
+            _log(f"supervisor: crash-loop — {len(crash_times)} crashes "
+                 f"in {loop_window:g}s, propagating",
+                 reason="crash-loop", rc=rc, generation=generation,
+                 uptime_sec=uptime)
+            return rc
+        consec_crashes += 1
+        backoff = min(backoff_base * (2 ** (consec_crashes - 1)),
+                      backoff_max)
+        backoff *= 0.5 + random.random()  # jitter: x0.5 - x1.5
+        _log("supervisor: worker crashed — restarting after backoff",
+             reason="crash", rc=rc, generation=generation,
+             uptime_sec=uptime, backoff_sec=round(backoff, 3),
+             consecutive_crashes=consec_crashes)
+        # interruptible backoff: a SIGTERM during the wait must end the
+        # supervisor, not spawn one more doomed generation
+        deadline = time.time() + backoff
+        while time.time() < deadline:
+            if stopping:
+                _log("supervisor: stopped during backoff — propagating",
+                     reason="signal", rc=rc, generation=generation)
+                return rc
+            try:
+                time.sleep(min(0.1, max(deadline - time.time(), 0)))
+            except KeyboardInterrupt:
+                continue
 
 
 if __name__ == "__main__":

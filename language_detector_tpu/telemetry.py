@@ -108,6 +108,18 @@ METRICS: dict = {
     "ldt_breaker_state": (
         "gauge",
         "Device-path circuit breaker (0=closed 1=half-open 2=open)."),
+    "ldt_fault_injected_total": (
+        "counter",
+        "Injected faults that actually fired, by fault point "
+        "(language_detector_tpu/faults.py, LDT_FAULTS spec)."),
+    "ldt_ready": (
+        "gauge",
+        "Readiness: 1 when the artifact is loaded, the breaker is not "
+        "open, and brownout is below shed — the /readyz contract."),
+    "ldt_worker_generation": (
+        "gauge",
+        "Worker generation under the supervisor (LDT_WORKER_GENERATION"
+        "; 0 = unsupervised)."),
 }
 
 
@@ -579,6 +591,11 @@ def debug_vars(metrics=None) -> dict:
             adm = adm_fn()
             if adm:
                 d["admission"] = adm
+        ready_fn = getattr(metrics, "readiness", None)
+        if ready_fn is not None:
+            r = ready_fn()
+            if r is not None:
+                d["ready"] = r
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

@@ -6,12 +6,20 @@ supervisor can run it with its normal `python -m <module>` spawn:
   FAKE_WORKER_RECYCLE    path to a marker file: first run (no marker)
                          creates it and exits with RECYCLE_EXIT_CODE;
                          the restarted run sees the marker and exits 0
+  FAKE_WORKER_CRASH_UNTIL  "path:N" — a run counter lives at path; each
+                         run increments it and crashes (exit 9) until N
+                         runs have crashed, then exits 0. Exercises the
+                         supervisor's restart-on-crash backoff path.
   FAKE_WORKER_SIGFILE    install a SIGTERM/SIGINT handler that writes
                          the signal number to this path and exits 0;
                          the worker then waits (bounded) to be signaled
+
+Every run prints one JSON line with the LDT_WORKER_GENERATION it was
+handed, so tests can assert the supervisor numbers its children.
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import sys
@@ -21,9 +29,26 @@ from language_detector_tpu.service.recycle import RECYCLE_EXIT_CODE
 
 
 def main() -> int:
+    print(json.dumps({
+        "fake_worker_generation":
+            os.environ.get("LDT_WORKER_GENERATION", "unset"),
+    }), flush=True)
+
     exit_code = os.environ.get("FAKE_WORKER_EXIT")
     if exit_code is not None:
         return int(exit_code)
+
+    crash_until = os.environ.get("FAKE_WORKER_CRASH_UNTIL")
+    if crash_until is not None:
+        path, _, n = crash_until.rpartition(":")
+        runs = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                runs = int(f.read() or "0")
+        runs += 1
+        with open(path, "w") as f:
+            f.write(str(runs))
+        return 9 if runs <= int(n) else 0
 
     marker = os.environ.get("FAKE_WORKER_RECYCLE")
     if marker is not None:

@@ -1,0 +1,651 @@
+"""Chaos suite for the fault-injection framework (faults.py) and the
+recovery invariants in docs/ROBUSTNESS.md.
+
+Layers covered:
+  - the LDT_FAULTS spec parser and deterministic schedules (p/seed,
+    once, after, delay accumulation, loud rejection of typos);
+  - artifact corruption regressions: every corruption mode raises a
+    typed ArtifactError (a ValueError) with an actionable message;
+  - the engine seams (scorer_launch / compile / device_flush) against
+    a real NgramBatchEngine;
+  - HTTP-level chaos on BOTH fronts: every submitted document resolves
+    (a result or a typed 500/504, never a hang), the breaker opens
+    under an injected device-error storm and recovers through a
+    half-open probe, flush timeouts answer 504, queue faults fail that
+    request only, accept faults drop the connection pre-read;
+  - the /healthz + /readyz contract (service and metrics ports, the
+    `ldt_ready` gauge, `"ready"` in /debug/vars).
+"""
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from language_detector_tpu import artifact, faults, native, telemetry
+from language_detector_tpu.service.admission import (AdmissionConfig,
+                                                     AdmissionController)
+from language_detector_tpu.service.batcher import Batcher
+from language_detector_tpu.service.server import (DetectorService,
+                                                  health_response,
+                                                  make_server)
+
+EN = ("this is a simple english sentence with common words that "
+      "should be detected without any trouble at all")
+FR = ("Le gouvernement a annoncé de nouvelles mesures pour aider "
+      "les familles concernées")
+# > TINY_BATCH_C_PATH (64) docs so device-front requests actually cross
+# the launch/flush seams instead of the all-C shortcut
+STORM_DOCS = [EN, FR] * 40
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves injection disarmed, whatever it armed."""
+    yield
+    faults.configure(None)
+
+
+def _post(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else None
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- spec parser & schedules -------------------------------------------------
+
+
+def test_spec_rejected_loud():
+    for bad in ("device_flush",                   # no action
+                "not_a_point:error",              # undeclared point
+                "device_flush:explode",           # unknown action
+                "device_flush:error:bogus=1"):    # unknown option
+        with pytest.raises(ValueError):
+            faults.configure(bad)
+    # the unknown-point message names the declared points
+    with pytest.raises(ValueError, match="device_flush"):
+        faults.configure("not_a_point:error")
+
+
+def test_blank_spec_disarms():
+    faults.configure("device_flush:error")
+    assert faults.ACTIVE is not None
+    faults.configure(None)
+    assert faults.ACTIVE is None
+    faults.configure("")
+    assert faults.ACTIVE is None
+    assert faults.evaluate("device_flush") == (0.0, False)
+
+
+def test_undeclared_point_is_a_programming_error():
+    with pytest.raises(KeyError):
+        faults.evaluate("nope_not_declared")
+    with pytest.raises(KeyError):
+        faults.hit("nope_not_declared")
+
+
+def test_probability_schedule_is_deterministic():
+    spec = "device_flush:error:p=0.5:seed=7"
+    faults.configure(spec)
+    first = [faults.evaluate("device_flush")[1] for _ in range(12)]
+    faults.configure(spec)  # re-arm: same seed, same schedule
+    again = [faults.evaluate("device_flush")[1] for _ in range(12)]
+    assert first == again
+    assert True in first and False in first  # actually probabilistic
+
+
+def test_once_and_after_semantics():
+    faults.configure("compile:delay_ms=100:once")
+    assert faults.evaluate("compile") == (0.1, False)
+    assert faults.evaluate("compile") == (0.0, False)  # disarmed
+
+    faults.configure("queue_put:error:after=2")
+    assert faults.evaluate("queue_put") == (0.0, False)
+    assert faults.evaluate("queue_put") == (0.0, False)
+    assert faults.evaluate("queue_put") == (0.0, True)  # from arrival 3
+    assert faults.evaluate("queue_put") == (0.0, True)
+
+
+def test_multiple_rules_accumulate():
+    faults.configure("device_flush:delay_ms=10,"
+                     "device_flush:delay_ms=5,device_flush:error")
+    delay, err = faults.evaluate("device_flush")
+    assert err is True
+    assert delay == pytest.approx(0.015)
+
+
+def test_fired_faults_counted():
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_fault_injected_total", point="queue_get")
+    faults.configure("queue_get:error")
+    with pytest.raises(faults.FaultInjected):
+        faults.hit("queue_get")
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_fault_injected_total", point="queue_get") == before + 1
+
+
+def test_hit_async_same_contract():
+    faults.configure("queue_put:delay_ms=1,queue_put:error")
+
+    async def drive():
+        with pytest.raises(faults.FaultInjected):
+            await faults.hit_async("queue_put")
+
+    asyncio.run(drive())
+
+
+# -- artifact corruption regressions -----------------------------------------
+
+
+@pytest.fixture()
+def packed(tmp_path):
+    path = tmp_path / "model.ldta"
+    artifact.write_artifact(
+        {"a": np.arange(16, dtype=np.int32),
+         "b": np.ones((2, 3), dtype=np.float32)}, path)
+    return path
+
+
+def _corrupt(path, offset, blob):
+    raw = bytearray(path.read_bytes())
+    raw[offset:offset + len(blob)] = blob
+    path.write_bytes(bytes(raw))
+
+
+def test_artifact_round_trip(packed):
+    out = artifact.load_artifact(packed)
+    assert list(out["a"]) == list(range(16))
+    assert out["b"].shape == (2, 3)
+
+
+@pytest.mark.parametrize("mode", ["truncated_header", "bad_magic",
+                                  "bad_version", "size_mismatch",
+                                  "bad_header_bytes"])
+def test_artifact_corruption_modes_fail_loud(packed, mode):
+    if mode == "truncated_header":
+        packed.write_bytes(packed.read_bytes()[:8])
+        expect = "shorter than the header"
+    elif mode == "bad_magic":
+        _corrupt(packed, 0, b"\xde\xad\xbe\xef")
+        expect = "bad magic"
+    elif mode == "bad_version":
+        _corrupt(packed, 4, struct.pack("<I", 99))
+        expect = "format version 99"
+    elif mode == "size_mismatch":
+        packed.write_bytes(packed.read_bytes()[:-7])
+        expect = "truncated or corrupt"
+    else:  # bad_header_bytes: n_arrays inconsistent with header_bytes
+        _corrupt(packed, 8, struct.pack("<I", 1000))
+        expect = "corrupt header"
+    with pytest.raises(artifact.ArtifactError) as ei:
+        artifact.load_artifact(packed)
+    assert expect in str(ei.value)
+    # actionable: the message names the file and the fix
+    assert str(packed) in str(ei.value)
+    assert "artifact_tool.py" in str(ei.value)
+    # pre-existing `except ValueError` load guards still catch it
+    assert isinstance(ei.value, ValueError)
+
+
+def test_artifact_load_fault_point(packed):
+    faults.configure("artifact_load:error")
+    with pytest.raises(faults.FaultInjected):
+        artifact.load_artifact(packed)
+    faults.configure(None)
+    assert "a" in artifact.load_artifact(packed)
+
+
+# -- batcher seams (no HTTP) -------------------------------------------------
+
+
+def test_queue_put_fault_raises_in_submit_nothing_enqueued():
+    b = Batcher(lambda texts: ["en"] * len(texts), max_delay_ms=1.0)
+    try:
+        faults.configure("queue_put:error")
+        with pytest.raises(faults.FaultInjected):
+            b.submit([EN])
+        faults.configure(None)
+        assert b.submit([EN]).result(timeout=10) == ["en"]
+    finally:
+        b.close()
+
+
+def test_queue_get_fault_fails_batch_collector_survives():
+    b = Batcher(lambda texts: ["en"] * len(texts), max_delay_ms=1.0)
+    try:
+        faults.configure("queue_get:error:once")
+        fut = b.submit([EN])
+        with pytest.raises(faults.FaultInjected):
+            fut.result(timeout=10)
+        # the collector survived the injected dequeue error
+        assert b.submit([EN]).result(timeout=10) == ["en"]
+    finally:
+        b.close()
+
+
+# -- engine seams ------------------------------------------------------------
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native packer unavailable")
+
+
+@pytest.fixture(scope="module")
+def engine():
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine()
+
+
+@needs_native
+def test_engine_seam_faults_raise_and_heal(engine):
+    want = ["en", "fr"] * 40
+    assert engine.detect_codes(STORM_DOCS) == want  # warm (compiles)
+
+    for point in ("scorer_launch", "device_flush"):
+        faults.configure(f"{point}:error")
+        with pytest.raises(faults.FaultInjected):
+            engine.detect_codes(STORM_DOCS)
+        faults.configure(None)
+        # the failure left no wedged state behind
+        assert engine.detect_codes(STORM_DOCS) == want
+
+
+@needs_native
+def test_compile_delay_does_not_corrupt_results(engine):
+    # delay-only rule on the compile seam: results stay exact
+    faults.configure("compile:delay_ms=1")
+    assert engine.detect_codes(STORM_DOCS) == ["en", "fr"] * 40
+
+
+# -- sync front under chaos --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def front():
+    """Scalar-engine sync front for queue/accept/timeout chaos (the
+    batcher seams are engine-independent)."""
+    ctrl = AdmissionController(AdmissionConfig())
+    svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                          admission=ctrl)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+           "metrics_url":
+               f"http://127.0.0.1:{metricsd.server_address[1]}",
+           "svc": svc, "ctrl": ctrl}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+
+
+def test_sync_queue_put_fault_is_typed_500(front):
+    faults.configure("queue_put:error")
+    status, body = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 500
+    assert body == {"error": "internal error"}
+    faults.configure(None)
+    status, body = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 200
+    assert body["response"][0]["iso6391code"] == "en"
+
+
+def test_sync_queue_get_fault_resolves_not_hangs(front):
+    faults.configure("queue_get:error:once")
+    status, body = _post(front["url"], {"request": [{"text": EN}]},
+                         timeout=15)
+    assert status == 500 and body == {"error": "internal error"}
+    faults.configure(None)
+    status, _ = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 200
+
+
+def test_sync_flush_timeout_is_504(front, monkeypatch):
+    monkeypatch.setenv("LDT_FLUSH_TIMEOUT_SEC", "0.1")
+    faults.configure("queue_get:delay_ms=700:once")
+    status, body = _post(front["url"], {"request": [{"text": EN}]},
+                         timeout=15)
+    assert status == 504
+    assert body == {"error": "detection timed out"}
+    monkeypatch.delenv("LDT_FLUSH_TIMEOUT_SEC")
+    faults.configure(None)
+    time.sleep(0.8)  # let the delayed collector pass drain
+    status, _ = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 200
+
+
+def test_sync_expired_work_504_under_queue_delay(front):
+    # the injected dequeue delay pushes the request past its deadline:
+    # dropped at dequeue (504), no detect work burned
+    faults.configure("queue_get:delay_ms=300:once")
+    status, body = _post(front["url"], {"request": [{"text": EN}]},
+                         headers={"X-LDT-Deadline-Ms": "50"},
+                         timeout=15)
+    assert status == 504
+    assert body == {"error": "deadline expired before dispatch"}
+
+
+def test_sync_accept_fault_drops_connection(front):
+    faults.configure("accept:error")
+    with pytest.raises((urllib.error.URLError, ConnectionError,
+                        http.client.HTTPException)):
+        _post(front["url"], {"request": [{"text": EN}]}, timeout=10)
+    faults.configure(None)
+    status, _ = _post(front["url"], {"request": [{"text": EN}]})
+    assert status == 200
+
+
+def test_health_and_ready_endpoints(front):
+    for base in (front["url"], front["metrics_url"]):
+        status, body = _get(base + "/healthz")
+        assert (status, json.loads(body)) == (200, {"status": "ok"})
+        status, body = _get(base + "/readyz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["ok"] is True and doc["artifact_loaded"] is True
+        assert doc["breaker"] == "closed" and doc["brownout_level"] == 0
+
+
+def test_readyz_flips_on_brownout_and_artifact(front):
+    ctrl = front["ctrl"]
+    svc = front["svc"]
+    ctrl.ladder.alpha = 0.0
+    ctrl.ladder.ema = 1.0
+    ctrl.ladder.level = 3
+    try:
+        status, body = _get(front["url"] + "/readyz")
+        assert status == 503
+        assert json.loads(body)["brownout_level"] == 3
+    finally:
+        ctrl.ladder.alpha = ctrl.config.brownout_alpha
+        ctrl.ladder.ema = 0.0
+        ctrl.ladder.level = 0
+    svc._artifact_loaded = False
+    try:
+        status, body = _get(front["url"] + "/readyz")
+        assert status == 503
+        assert json.loads(body)["artifact_loaded"] is False
+    finally:
+        svc._artifact_loaded = True
+    # healthz stays 200 through all of it: liveness is unconditional
+    status, _ = _get(front["url"] + "/healthz")
+    assert status == 200
+
+
+def test_ready_in_metrics_and_debug_vars(front):
+    _, body = _get(front["metrics_url"] + "/metrics")
+    text = body.decode()
+    assert "ldt_ready 1" in text
+    assert "ldt_worker_generation" in text
+    _, body = _get(front["metrics_url"] + "/debug/vars")
+    doc = json.loads(body)
+    assert doc["ready"]["ok"] is True
+    assert set(doc["ready"]) == {"ok", "artifact_loaded", "breaker",
+                                 "brownout_level"}
+
+
+def test_health_response_contract_unit(front):
+    svc = front["svc"]
+    assert health_response(svc, "/healthz") == (200, b'{"status":"ok"}')
+    status, body = health_response(svc, "/readyz")
+    assert status == 200 and json.loads(body)["ok"] is True
+
+
+# -- breaker storm + half-open recovery, sync front --------------------------
+
+
+@pytest.fixture(scope="module")
+def device_front():
+    """Engine-backed sync front with a tight injected breaker so the
+    storm tests trip and recover in test time."""
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    ctrl = AdmissionController(AdmissionConfig(breaker_failures=2,
+                                               breaker_cooldown_sec=0.2))
+    svc = DetectorService(use_device=True, max_delay_ms=1.0,
+                          admission=ctrl)
+    if svc._engine is None:
+        pytest.skip("device engine unavailable")
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+           "metrics_url":
+               f"http://127.0.0.1:{metricsd.server_address[1]}",
+           "svc": svc, "ctrl": ctrl}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+
+
+def test_sync_breaker_storm_opens_then_halfopen_recovers(device_front):
+    url = device_front["url"]
+    br = device_front["ctrl"].breaker
+    payload = {"request": [{"text": t} for t in STORM_DOCS]}
+
+    # warm: the jit compile happens on a healthy flush, not the probe
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    assert [r["iso6391code"] for r in body["response"][:2]] == \
+        ["en", "fr"]
+    trips0 = br.stats()["trips"]
+
+    # storm: every device fetch dies; each request resolves as a typed
+    # 500 until the breaker opens, then scalar serves exact 200s
+    faults.configure("device_flush:error:p=1")
+    statuses = []
+    while br.stats()["state"] != 2 and len(statuses) < 10:
+        status, body = _post(url, payload, timeout=60)
+        statuses.append(status)
+        assert status in (200, 500)  # resolved, never hung
+    assert br.stats()["state"] == 2  # open
+    assert br.stats()["trips"] == trips0 + 1
+    assert 500 in statuses
+
+    # open: served via scalar, exact answers, readyz says route-around
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    assert [r["iso6391code"] for r in body["response"][:2]] == \
+        ["en", "fr"]
+    status, body = _get(url + "/readyz")
+    assert status == 503 and json.loads(body)["breaker"] == "open"
+    status, _ = _get(url + "/healthz")
+    assert status == 200
+
+    # heal the device, wait out the cooldown: the next request is the
+    # half-open probe; success closes the breaker
+    faults.configure(None)
+    probes0 = br.stats()["probes"]
+    time.sleep(0.25)
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    assert br.stats()["state"] == 0  # closed again
+    assert br.stats()["probes"] == probes0 + 1
+    status, body = _get(url + "/readyz")
+    assert status == 200 and json.loads(body)["breaker"] == "closed"
+
+    # the storm was counted
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_fault_injected_total", point="device_flush") >= 2
+
+
+def test_sync_probabilistic_storm_every_doc_resolves(device_front):
+    """The headline chaos invariant: under a 50% device-error storm,
+    every request resolves with a result or a typed error — no hangs,
+    no torn connections — and the stack recovers afterwards."""
+    url = device_front["url"]
+    br = device_front["ctrl"].breaker
+    payload = {"request": [{"text": t} for t in STORM_DOCS]}
+    faults.configure("device_flush:error:p=0.5:seed=3")
+    statuses = []
+    for _ in range(8):
+        status, body = _post(url, payload, timeout=120)
+        statuses.append(status)
+        assert status in (200, 500)
+        if status == 200:
+            assert len(body["response"]) == len(STORM_DOCS)
+    faults.configure(None)
+    time.sleep(0.25)  # cooldown, in case the storm tripped it
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    deadline = time.time() + 5
+    while br.stats()["state"] != 0 and time.time() < deadline:
+        _post(url, payload, timeout=120)
+        time.sleep(0.05)
+    assert br.stats()["state"] == 0
+
+
+# -- asyncio front under chaos -----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def aio_front():
+    """Engine-backed asyncio front (same breaker wiring via
+    svc._detect) driven from a side thread, as in test_admission."""
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    ctrl = AdmissionController(AdmissionConfig(breaker_failures=2,
+                                               breaker_cooldown_sec=0.2))
+    svc = DetectorService(use_device=True, max_delay_ms=1.0,
+                          start_batcher=False, admission=ctrl)
+    if svc._engine is None:
+        pytest.skip("device engine unavailable")
+    ports_q: _q.Queue = _q.Queue()
+    loop_holder = {}
+
+    def run_loop():
+        async def main():
+            loop_holder["loop"] = asyncio.get_running_loop()
+            ready = asyncio.get_running_loop().create_future()
+            task = asyncio.get_running_loop().create_task(
+                serve(0, 0, svc=svc, ready=ready))
+            ports_q.put(await ready)
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass  # loop.stop() teardown ends the run mid-await
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    port, mport = ports_q.get(timeout=30)
+    yield {"url": f"http://127.0.0.1:{port}",
+           "metrics_url": f"http://127.0.0.1:{mport}",
+           "svc": svc, "ctrl": ctrl}
+    loop = loop_holder.get("loop")
+    if loop is not None:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_aio_breaker_storm_opens_then_halfopen_recovers(aio_front):
+    url = aio_front["url"]
+    br = aio_front["ctrl"].breaker
+    payload = {"request": [{"text": t} for t in STORM_DOCS]}
+
+    status, body = _post(url, payload, timeout=120)  # warm compile
+    assert status == 200
+    trips0 = br.stats()["trips"]
+
+    faults.configure("device_flush:error:p=1")
+    statuses = []
+    while br.stats()["state"] != 2 and len(statuses) < 10:
+        status, _ = _post(url, payload, timeout=60)
+        statuses.append(status)
+        assert status in (200, 500)
+    assert br.stats()["state"] == 2
+    assert br.stats()["trips"] == trips0 + 1
+
+    # open: exact scalar answers; readyz 503 on service AND metrics port
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    assert [r["iso6391code"] for r in body["response"][:2]] == \
+        ["en", "fr"]
+    for base in (url, aio_front["metrics_url"]):
+        status, body = _get(base + "/readyz")
+        assert status == 503 and json.loads(body)["breaker"] == "open"
+        status, _ = _get(base + "/healthz")
+        assert status == 200
+
+    faults.configure(None)
+    probes0 = br.stats()["probes"]
+    time.sleep(0.25)
+    status, _ = _post(url, payload, timeout=120)
+    assert status == 200
+    assert br.stats()["state"] == 0
+    assert br.stats()["probes"] == probes0 + 1
+    status, body = _get(url + "/readyz")
+    assert status == 200 and json.loads(body)["ok"] is True
+
+
+def test_aio_queue_and_timeout_chaos(aio_front, monkeypatch):
+    url = aio_front["url"]
+    one = {"request": [{"text": EN}]}
+
+    # queue_put: typed 500 raised before anything is enqueued
+    faults.configure("queue_put:error")
+    status, body = _post(url, one, timeout=15)
+    assert status == 500 and body == {"error": "internal error"}
+
+    # queue_get: that batch's futures fail, the collector survives
+    faults.configure("queue_get:error:once")
+    status, body = _post(url, one, timeout=15)
+    assert status == 500 and body == {"error": "internal error"}
+    faults.configure(None)
+    status, _ = _post(url, one)
+    assert status == 200
+
+    # flush timeout: 504 with the timeout body, then recovery
+    monkeypatch.setenv("LDT_FLUSH_TIMEOUT_SEC", "0.1")
+    faults.configure("queue_get:delay_ms=700:once")
+    status, body = _post(url, one, timeout=15)
+    assert status == 504 and body == {"error": "detection timed out"}
+    monkeypatch.delenv("LDT_FLUSH_TIMEOUT_SEC")
+    faults.configure(None)
+    time.sleep(0.8)
+    status, _ = _post(url, one)
+    assert status == 200
+
+
+def test_aio_accept_fault_drops_connection(aio_front):
+    faults.configure("accept:error")
+    with pytest.raises((urllib.error.URLError, ConnectionError,
+                        http.client.HTTPException)):
+        _post(aio_front["url"], {"request": [{"text": EN}]}, timeout=10)
+    faults.configure(None)
+    status, _ = _post(aio_front["url"], {"request": [{"text": EN}]})
+    assert status == 200

@@ -13,8 +13,8 @@ import sys
 from collections import Counter
 from pathlib import Path
 
-from tools.lint import (knob_registry, lock_discipline, metric_registry,
-                        trace_safety)
+from tools.lint import (faults_registry, knob_registry, lock_discipline,
+                        metric_registry, trace_safety)
 from tools.lint.__main__ import run
 from tools.lint.ownership import _cl
 
@@ -151,6 +151,33 @@ def test_metric_fixture_drift_both_directions():
     names = "\n".join(x.message for x in v)
     assert "ldt_fix_stale_total" in names
     assert "ldt_fix_used_total" not in names
+
+
+# -- fault registry ----------------------------------------------------------
+
+
+def test_fault_fixture_drift_both_directions():
+    v, _ = faults_registry.check(
+        root=REPO,
+        files=[f"{FIX}/faults_use.py"],
+        faults_rel=f"{FIX}/faults_mod.py",
+        docs_rel=f"{FIX}/faults_docs.md")
+    rules = _rules(v)
+    assert rules["fault-undeclared"] == 1       # fix_rogue
+    assert rules["fault-unused"] == 1           # fix_unused
+    # declared-but-undocumented (fix_unused, fix_undoc) plus the stale
+    # docs row (fix_stale); the token outside the markers doesn't count
+    assert rules["fault-undocumented"] == 3
+    names = "\n".join(x.message for x in v)
+    assert "fix_stale" in names
+    assert "fix_not_a_seam" not in names        # not rooted at `faults`
+    assert "fix_used" not in names
+
+
+def test_fault_live_points_all_hit():
+    # the shipped seams cover every declared point, no rogue hits
+    v, _ = faults_registry.check(root=REPO)
+    assert [x for x in v if x.rule != "fault-undocumented"] == []
 
 
 # -- whole-suite meta-checks -------------------------------------------------

@@ -3,6 +3,7 @@ propagation, and PID-1 signal forwarding, exercised against the
 scriptable tests/fake_worker.py child over real subprocesses."""
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -51,6 +52,55 @@ def test_recycle_restarts_then_propagates(tmp_path):
     assert "generation 1" in r.stdout and "generation 2" in r.stdout
     assert "worker recycled" in r.stdout
     assert str(RECYCLE_EXIT_CODE) not in str(r.returncode)
+
+
+def test_restart_on_crash_recovers(tmp_path):
+    counter = tmp_path / "crashes.count"
+    r = _run({"FAKE_WORKER_CRASH_UNTIL": f"{counter}:2",
+              "LDT_RESTART_ON_CRASH": "1",
+              "LDT_CRASH_BACKOFF_BASE_SEC": "0.01",
+              "LDT_CRASH_BACKOFF_MAX_SEC": "0.05"})
+    # generations 1 and 2 crash (exit 9), generation 3 exits 0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert counter.read_text() == "3"
+    assert "restarting after backoff" in r.stdout
+    for gen in (1, 2, 3):
+        assert f"generation {gen}" in r.stdout
+    assert "generation 4" not in r.stdout
+
+
+def test_crash_without_optin_propagates(tmp_path):
+    counter = tmp_path / "crashes.count"
+    r = _run({"FAKE_WORKER_CRASH_UNTIL": f"{counter}:2"})
+    # no LDT_RESTART_ON_CRASH: the first crash propagates immediately
+    assert r.returncode == 9
+    assert counter.read_text() == "1"
+    assert "LDT_RESTART_ON_CRASH" in r.stdout
+
+
+def test_crash_loop_detected(tmp_path):
+    counter = tmp_path / "crashes.count"
+    # the worker would need 10 crashes to heal, but the loop detector
+    # gives up after 3 inside the window and propagates the exit code
+    r = _run({"FAKE_WORKER_CRASH_UNTIL": f"{counter}:10",
+              "LDT_RESTART_ON_CRASH": "1",
+              "LDT_CRASH_BACKOFF_BASE_SEC": "0.01",
+              "LDT_CRASH_BACKOFF_MAX_SEC": "0.05",
+              "LDT_CRASH_LOOP_MAX": "3",
+              "LDT_CRASH_LOOP_WINDOW_SEC": "60"})
+    assert r.returncode == 9
+    assert "crash-loop" in r.stdout
+    assert counter.read_text() == "3"
+
+
+def test_generation_env_handed_to_children(tmp_path):
+    marker = tmp_path / "recycled.marker"
+    r = _run({"FAKE_WORKER_RECYCLE": str(marker)})
+    assert r.returncode == 0
+    gens = [json.loads(line)["fake_worker_generation"]
+            for line in r.stdout.splitlines()
+            if "fake_worker_generation" in line]
+    assert gens == ["1", "2"]
 
 
 @pytest.mark.parametrize("signum", [signal.SIGTERM, signal.SIGINT])

@@ -1,7 +1,7 @@
 """CLI: python -m tools.lint [--rule r1,r2] [--knob-table]
 [--write-knob-docs]
 
-Default run executes all four analyzers over the live tree and exits
+Default run executes all five analyzers over the live tree and exits
 non-zero on any violation — ci.sh runs exactly this before the test
 suite.
 """
@@ -10,8 +10,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from . import knob_registry, lock_discipline, metric_registry, \
-    trace_safety
+from . import faults_registry, knob_registry, lock_discipline, \
+    metric_registry, trace_safety
 from .base import RULE_IDS, repo_root
 
 # analyzer -> the rule ids it can emit (every analyzer can additionally
@@ -24,6 +24,8 @@ ANALYZERS = (
      {"knob-direct-env", "knob-undeclared", "knob-docs-drift"}),
     ("metric-registry", metric_registry.check,
      {"metric-undeclared", "metric-undocumented", "metric-unused"}),
+    ("fault-registry", faults_registry.check,
+     {"fault-undeclared", "fault-undocumented", "fault-unused"}),
 )
 
 

@@ -32,6 +32,9 @@ RULE_IDS = frozenset({
     "metric-undeclared",
     "metric-undocumented",
     "metric-unused",
+    "fault-undeclared",
+    "fault-undocumented",
+    "fault-unused",
     "lint-suppression-missing-reason",
 })
 

@@ -1,0 +1,147 @@
+"""Fault-registry analyzer: every fault point declared, hit, and
+documented.
+
+The declaration is language_detector_tpu/faults.py's FAULT_POINTS
+(name -> where the seam lives); the docs contract is the fault-point
+table in docs/ROBUSTNESS.md between the ldt-fault-table markers (first
+backticked token of each table row). Usage is extracted from the first
+string argument of faults.hit / faults.hit_async / faults.evaluate
+calls — the same first-literal-argument discipline the metric-registry
+analyzer uses, so a seam wired through a variable name is invisible to
+the operator docs and the analyzer alike (don't do that).
+
+  fault-undeclared    a seam hits a point missing from FAULT_POINTS
+                      (KeyError at the first armed run — catch it here)
+  fault-unused        a point is declared but no seam hits it (a chaos
+                      profile naming it silently injects nothing)
+  fault-undocumented  drift between FAULT_POINTS and the
+                      docs/ROBUSTNESS.md table, either direction
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .base import (Violation, apply_suppressions, first_str_arg,
+                   iter_package_files, load_source, repo_root)
+
+FAULTS_REL = "language_detector_tpu/faults.py"
+DOCS_REL = "docs/ROBUSTNESS.md"
+
+HIT_CALLS = frozenset({"hit", "hit_async", "evaluate"})
+
+MARK_BEGIN = "<!-- ldt-fault-table:begin -->"
+MARK_END = "<!-- ldt-fault-table:end -->"
+
+# first backticked token of a markdown table row: | `point` | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def declared_points(root: Path, faults_rel: str = FAULTS_REL):
+    """{name: line} of FAULT_POINTS keys, by AST."""
+    sf = load_source(root / faults_rel, root)
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            is_points = any(isinstance(t, ast.Name)
+                            and t.id == "FAULT_POINTS"
+                            for t in node.targets)
+        elif isinstance(node, ast.AnnAssign):
+            is_points = (isinstance(node.target, ast.Name)
+                         and node.target.id == "FAULT_POINTS")
+        else:
+            continue
+        if is_points and isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+def used_points(sources):
+    """{name: (rel, line)} of points passed as the literal first
+    argument of a faults.hit / hit_async / evaluate call."""
+    used: dict = {}
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # only attribute calls rooted at a `faults` name count:
+            # an unrelated object's .hit() must not register a seam
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in HIT_CALLS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "faults"):
+                continue
+            name = first_str_arg(node)
+            if name:
+                used.setdefault(name, (sf.rel, node.lineno))
+    return used
+
+
+def doc_points(root: Path, docs_rel: str = DOCS_REL) -> set:
+    """Backticked first-column tokens of the fault table between the
+    markers; empty when the docs or markers are missing (reported as
+    undocumented-declared drift by check)."""
+    path = root / docs_rel
+    if not path.exists():
+        return set()
+    text = path.read_text()
+    if MARK_BEGIN not in text or MARK_END not in text:
+        return set()
+    between = text.split(MARK_BEGIN, 1)[1].split(MARK_END, 1)[0]
+    return set(_DOC_ROW_RE.findall(between))
+
+
+def check(root: Path | None = None, files=None,
+          faults_rel: str = FAULTS_REL, docs_rel: str = DOCS_REL):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    declared = declared_points(root, faults_rel)
+    paths = list(iter_package_files(root)) if files is None else \
+        [root / f if not Path(f).is_absolute() else Path(f)
+         for f in files]
+    # faults.py's own evaluate() calls take a variable, never a
+    # literal; skip it so the registry module can't vouch for itself
+    paths = [p for p in paths
+             if str(p.resolve()) != str((root / faults_rel).resolve())]
+    sources = [load_source(p, root) for p in paths]
+    used = used_points(sources)
+    in_docs = doc_points(root, docs_rel)
+
+    per_file: dict = {sf.rel: [] for sf in sources}
+    extra: list = []
+
+    for name, (rel, line) in sorted(used.items()):
+        if name not in declared:
+            per_file.setdefault(rel, []).append(Violation(
+                "fault-undeclared", rel, line,
+                f"fault point {name} is hit but not declared in "
+                f"faults.FAULT_POINTS (KeyError the first armed run)"))
+    for name, line in sorted(declared.items()):
+        if name not in used:
+            extra.append(Violation(
+                "fault-unused", faults_rel, line,
+                f"fault point {name} is declared but no seam hits it "
+                f"(an LDT_FAULTS rule naming it injects nothing)"))
+        if name not in in_docs:
+            extra.append(Violation(
+                "fault-undocumented", faults_rel, line,
+                f"fault point {name} is declared but missing from the "
+                f"{docs_rel} fault table"))
+    for name in sorted(in_docs):
+        if name not in declared:
+            extra.append(Violation(
+                "fault-undocumented", docs_rel, 1,
+                f"{docs_rel} fault table lists {name}, which is not "
+                f"declared in faults.FAULT_POINTS (stale docs)"))
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        kept, ns = apply_suppressions(sf, per_file.get(sf.rel, []))
+        violations.extend(kept)
+        n_suppressed += ns
+    violations.extend(extra)
+    return violations, n_suppressed

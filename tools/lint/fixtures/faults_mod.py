@@ -1,0 +1,8 @@
+"""Fixture: a stand-in faults module for the fault-registry analyzer
+(passed via faults_rel)."""
+
+FAULT_POINTS: dict = {
+    "fix_used": "hit and documented",
+    "fix_unused": "declared, never hit",
+    "fix_undoc": "declared, hit, absent from the docs table",
+}

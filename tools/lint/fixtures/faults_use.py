@@ -1,0 +1,9 @@
+"""Fixture: fault seam call sites for the fault-registry analyzer."""
+
+
+def seams(faults, other):
+    if faults.ACTIVE is not None:
+        faults.hit("fix_used")
+        faults.hit("fix_rogue")  # never declared
+    faults.evaluate("fix_undoc")
+    other.hit("fix_not_a_seam")  # not rooted at `faults`: ignored

@@ -88,6 +88,8 @@ LOCK_OWNERSHIP: dict = {
                                "assignment-at-init contract",
                 "admission_stats": "callable reference, same single-"
                                    "assignment-at-init contract",
+                "readiness": "callable reference, same single-"
+                             "assignment-at-init contract",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
@@ -97,6 +99,10 @@ LOCK_OWNERSHIP: dict = {
                                "a key is a pure function of the key, so "
                                "a racing double-compute stores the same "
                                "bytes; dict get/set are GIL-atomic",
+                "_artifact_loaded": "bool written only during __init__ "
+                                    "(before handler threads exist), "
+                                    "read-only afterwards by "
+                                    "readiness()",
             }),
     },
     "language_detector_tpu/service/batcher.py": {
