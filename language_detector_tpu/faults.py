@@ -58,6 +58,13 @@ FAULT_POINTS: dict = {
                  "collector)",
     "accept": "both HTTP fronts, per accepted connection (an error "
               "drops the connection before any read)",
+    "swap_cutover": "service/swap.swap_artifact, after the fresh mmap "
+                    "loads but before the engine reference rebinds (an "
+                    "error aborts the swap; the old tables keep "
+                    "serving)",
+    "standby_spawn": "service/supervisor swap drill, before the "
+                     "standby generation is spawned (an error aborts "
+                     "the drill; the old generation keeps serving)",
 }
 
 

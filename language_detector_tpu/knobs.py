@@ -140,6 +140,61 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Set BY the supervisor on each spawned worker (1, 2, ...); "
        "exported as the ldt_worker_generation gauge. 0 = running "
        "unsupervised."),
+    # -- artifact & hot swap (supervisor + service/swap.py) -----------
+    _k("LDT_ARTIFACT_PATH", "str", None,
+       "Path to the .ldta scoring artifact to serve. Unset -> the "
+       "packaged data/model.ldta. The supervisor rewrites it on each "
+       "standby spawn during a swap drill."),
+    _k("LDT_ARTIFACT_POINTER", "str", None,
+       "Path to a one-line text file naming the current artifact. The "
+       "supervisor re-reads it on every SIGHUP swap drill, so an "
+       "operator retargets a deployment by rewriting the pointer and "
+       "signaling."),
+    _k("LDT_SWAP_TIMEOUT_SEC", "float", 30.0,
+       "How long the supervisor holds a standby worker waiting for its "
+       "ready file before aborting the swap and keeping the old "
+       "generation serving."),
+    _k("LDT_READY_FILE", "str", None,
+       "Set BY the supervisor on a standby worker: the front writes "
+       "this file (JSON: generation/pid/ports/warmup_ms) once /readyz "
+       "is true, signaling the supervisor to cut traffic over."),
+    _k("LDT_SWAPPED", "bool", False,
+       "Set BY the supervisor on a standby worker spawned for a swap "
+       "drill; the front counts ldt_swap_total{result=ok} once it "
+       "becomes ready, so the drill is visible on the new generation's "
+       "/metrics."),
+    _k("LDT_REUSEPORT", "bool", False,
+       "Bind both fronts' listeners with SO_REUSEPORT so an old and a "
+       "standby generation can overlap on the same port during a "
+       "blue/green swap. Required (on the supervisor env) for "
+       "zero-downtime SIGHUP drills on a fixed port."),
+    # -- startup warmup & compile cache (server.py, models/ngram.py) --
+    _k("LDT_WARMUP", "bool", False,
+       "Pre-compile the bucket ladder's jitted shapes at startup and "
+       "gate /readyz on that warmup finishing; the duration lands in "
+       "the ldt_warmup_ms gauge."),
+    _k("LDT_COMPILE_CACHE_DIR", "str", None,
+       "Directory for JAX's persistent compilation cache "
+       "(jax_compilation_cache_dir), set at engine init so restarted "
+       "or standby worker generations start warm."),
+    # -- per-tenant isolation (service/admission.py) ------------------
+    _k("LDT_TENANT_QUOTA_DOCS", "int", None,
+       "Per-tenant cap on queued documents (X-LDT-Tenant header; "
+       "absent header = tenant \"default\"); over it the tenant sheds "
+       "429 tenant_docs while other tenants keep admitting.",
+       bound=True),
+    _k("LDT_TENANT_QUOTA_BYTES", "int", None,
+       "Per-tenant cap on queued byte-weighted cost (same accounting "
+       "as LDT_MAX_QUEUE_BYTES); over it the tenant sheds 429 "
+       "tenant_bytes.", bound=True),
+    _k("LDT_TENANT_WEIGHTS", "str", None,
+       "Deficit-weighted fair queueing weights as "
+       "\"tenantA=4,tenantB=1\" (unlisted tenants weigh 1). Setting it "
+       "turns on DRR dequeue in both fronts' batchers; unset keeps "
+       "strict FIFO."),
+    _k("LDT_WFQ_QUANTUM_BYTES", "int", 65536,
+       "DRR quantum: bytes of queued cost a weight-1 tenant may "
+       "dequeue per scheduler round."),
     # -- debug / CI ---------------------------------------------------
     _k("LDT_LOCK_DEBUG", "bool", False,
        "Build order-checking debug locks (language_detector_tpu/locks)"

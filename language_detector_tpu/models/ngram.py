@@ -30,7 +30,7 @@ import time as _time
 
 import numpy as np
 
-from .. import faults, telemetry
+from .. import faults, knobs, telemetry
 from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              FLAG_SQUEEZE, FLAG_TOP40,
                              ScalarResult, detect_scalar,
@@ -87,6 +87,19 @@ class NgramBatchEngine:
         self.flags = flags
         self.max_slots = max_slots
         self.max_chunks = max_chunks
+        # persistent XLA compile cache: with LDT_COMPILE_CACHE_DIR set,
+        # a fresh process (a recycled worker, the blue/green standby)
+        # warms its bucket ladder from disk instead of recompiling —
+        # the dominant cost of standby readiness. Best-effort: an old
+        # jax without the option just compiles as before.
+        cache_dir = knobs.get_str("LDT_COMPILE_CACHE_DIR")
+        if cache_dir:
+            try:
+                import jax
+                jax.config.update("jax_compilation_cache_dir",
+                                  cache_dir)
+            except Exception:
+                pass
         self.dt = DeviceTables.from_host(self.tables, self.reg)
         self.mesh = mesh
         if mesh is not None:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import math
 import time
+from collections import deque
 
 from .. import knobs, telemetry
 from ..locks import make_lock
@@ -51,7 +52,12 @@ _mono = time.monotonic
 
 # shed reasons, in the order they are checked; pre-touched as counter
 # label values so every ldt_shed_total series renders from scrape one
-SHED_REASONS = ("brownout", "queue_docs", "queue_bytes", "inflight")
+SHED_REASONS = ("brownout", "tenant_docs", "tenant_bytes",
+                "queue_docs", "queue_bytes", "inflight")
+
+# tenant attributed to requests that carry no X-LDT-Tenant header; the
+# per-tenant quotas and the WFQ scheduler treat it as a normal tenant
+DEFAULT_TENANT = "default"
 
 BROWNOUT_LEVEL_NAMES = ("healthy", "skip_retry", "degraded", "shed")
 
@@ -154,10 +160,14 @@ class AdmissionConfig:
                  breaker_failures: int = 5,
                  breaker_cooldown_sec: float = 10.0,
                  breaker_stall_factor: float = 10.0,
-                 breaker_stall_min_ms: float = 2000.0):
+                 breaker_stall_min_ms: float = 2000.0,
+                 tenant_quota_docs: int | None = None,
+                 tenant_quota_bytes: int | None = None):
         self.max_queue_docs = max_queue_docs
         self.max_queue_bytes = max_queue_bytes
         self.max_inflight = max_inflight
+        self.tenant_quota_docs = tenant_quota_docs
+        self.tenant_quota_bytes = tenant_quota_bytes
         self.default_deadline_ms = default_deadline_ms
         self.flush_docs = flush_docs
         self.brownout_alpha = brownout_alpha
@@ -191,6 +201,8 @@ class AdmissionConfig:
                 "LDT_BREAKER_STALL_FACTOR"),
             breaker_stall_min_ms=knobs.get_float(
                 "LDT_BREAKER_STALL_MIN_MS"),
+            tenant_quota_docs=knobs.get_int("LDT_TENANT_QUOTA_DOCS"),
+            tenant_quota_bytes=knobs.get_int("LDT_TENANT_QUOTA_BYTES"),
         )
 
 
@@ -351,10 +363,11 @@ class Admit:
     front should send."""
 
     __slots__ = ("shed", "status", "reason", "message", "retry_after",
-                 "level", "degrade", "docs", "cost")
+                 "level", "degrade", "docs", "cost", "tenant")
 
     def __init__(self, shed, status, reason, message, retry_after,
-                 level, degrade, docs, cost):
+                 level, degrade, docs, cost,
+                 tenant: str = DEFAULT_TENANT):
         self.shed = shed
         self.status = status
         self.reason = reason
@@ -364,10 +377,13 @@ class Admit:
         self.degrade = degrade
         self.docs = docs
         self.cost = cost
+        self.tenant = tenant
 
 
 _SHED_MESSAGES = {
     "brownout": "server overloaded, shedding non-priority traffic",
+    "tenant_docs": "tenant over quota: document quota exhausted",
+    "tenant_bytes": "tenant over quota: byte quota exhausted",
     "queue_docs": "server overloaded: document queue full",
     "queue_bytes": "server overloaded: byte queue full",
     "inflight": "server overloaded: too many requests in flight",
@@ -395,6 +411,10 @@ class AdmissionController:
         self.queue_docs = 0
         self.queue_bytes = 0
         self.inflight = 0
+        # tenant -> [queued docs, queued byte cost]; entries drop when
+        # a tenant fully drains, so the dict stays bounded by the set
+        # of tenants with live work
+        self.tenants: dict = {}
         self._shed = dict.fromkeys(SHED_REASONS, 0)
         # pre-touch the counter series so a scrape shows them at 0
         # before the first shed/expiry, not only after trouble starts
@@ -426,44 +446,60 @@ class AdmissionController:
         return occ
 
     def _shed_out(self, reason: str, status: int, level: int,
-                  docs: int, cost: int) -> Admit:
+                  docs: int, cost: int, tenant: str) -> Admit:
         self._shed[reason] += 1
         telemetry.REGISTRY.counter_inc("ldt_shed_total", reason=reason)
+        telemetry.REGISTRY.counter_inc("ldt_tenant_shed_total",
+                                       tenant=tenant, reason=reason)
         ra = retry_after_sec(self.queue_docs, self.config.flush_docs)
         return Admit(True, status, reason, _SHED_MESSAGES[reason], ra,
-                     level, False, docs, cost)
+                     level, False, docs, cost, tenant)
 
-    def try_admit(self, texts: list, priority: bool = False) -> Admit:
+    def try_admit(self, texts: list, priority: bool = False,
+                  tenant: str | None = None) -> Admit:
         """Admit or shed one request. Order: the brownout ladder sheds
         non-priority traffic first (503 — the service is degrading by
-        policy), then the hard bounds shed anything over capacity (429
-        — priority included; a bound is a bound)."""
+        policy), then the caller's per-tenant quota (429 — a hot tenant
+        sheds on its own budget before it can fill the global queue),
+        then the hard bounds shed anything over capacity (429 —
+        priority included; a bound is a bound)."""
         docs = len(texts)
         cost = request_cost(texts)
+        tenant = tenant or DEFAULT_TENANT
         c = self.config
         with self._lock:
             level = self.ladder.observe(
                 self._occupancy(docs, cost, 1))
             if level >= 3 and not priority:
                 return self._shed_out("brownout", 503, level, docs,
-                                      cost)
+                                      cost, tenant)
+            t_docs, t_bytes = self.tenants.get(tenant, (0, 0))
+            if c.tenant_quota_docs is not None and \
+                    t_docs + docs > c.tenant_quota_docs:
+                return self._shed_out("tenant_docs", 429, level, docs,
+                                      cost, tenant)
+            if c.tenant_quota_bytes is not None and \
+                    t_bytes + cost > c.tenant_quota_bytes:
+                return self._shed_out("tenant_bytes", 429, level, docs,
+                                      cost, tenant)
             if c.max_queue_docs is not None and \
                     self.queue_docs + docs > c.max_queue_docs:
                 return self._shed_out("queue_docs", 429, level, docs,
-                                      cost)
+                                      cost, tenant)
             if c.max_queue_bytes is not None and \
                     self.queue_bytes + cost > c.max_queue_bytes:
                 return self._shed_out("queue_bytes", 429, level, docs,
-                                      cost)
+                                      cost, tenant)
             if c.max_inflight is not None and \
                     self.inflight + 1 > c.max_inflight:
                 return self._shed_out("inflight", 429, level, docs,
-                                      cost)
+                                      cost, tenant)
             self.queue_docs += docs
             self.queue_bytes += cost
             self.inflight += 1
+            self.tenants[tenant] = [t_docs + docs, t_bytes + cost]
             return Admit(False, 200, None, None, 0, level,
-                         level >= 2, docs, cost)
+                         level >= 2, docs, cost, tenant)
 
     def release(self, admit: Admit):
         """Return an admitted request's cost (fronts call from a
@@ -475,6 +511,12 @@ class AdmissionController:
             self.queue_docs = max(self.queue_docs - admit.docs, 0)
             self.queue_bytes = max(self.queue_bytes - admit.cost, 0)
             self.inflight = max(self.inflight - 1, 0)
+            entry = self.tenants.get(admit.tenant)
+            if entry is not None:
+                entry[0] = max(entry[0] - admit.docs, 0)
+                entry[1] = max(entry[1] - admit.cost, 0)
+                if entry[0] == 0 and entry[1] == 0:
+                    del self.tenants[admit.tenant]
             self.ladder.observe(self._occupancy())
 
     def deadline_from_header(self, value) -> Deadline | None:
@@ -501,7 +543,10 @@ class AdmissionController:
             d = {"queue_docs": self.queue_docs,
                  "queue_bytes": self.queue_bytes,
                  "inflight": self.inflight,
-                 "shed": dict(self._shed)}
+                 "shed": dict(self._shed),
+                 "tenants": {t: {"queue_docs": v[0],
+                                 "queue_bytes": v[1]}
+                             for t, v in self.tenants.items()}}
         # snapshot() reads under the LADDER's lock: the raw level/ema
         # attributes are owned by it, and an unlocked cross-object read
         # here could see a torn (level, ema) pair mid-observe
@@ -515,7 +560,9 @@ class AdmissionController:
         d["limits"] = {"max_queue_docs": c.max_queue_docs,
                        "max_queue_bytes": c.max_queue_bytes,
                        "max_inflight": c.max_inflight,
-                       "default_deadline_ms": c.default_deadline_ms}
+                       "default_deadline_ms": c.default_deadline_ms,
+                       "tenant_quota_docs": c.tenant_quota_docs,
+                       "tenant_quota_bytes": c.tenant_quota_bytes}
         return d
 
 
@@ -538,3 +585,114 @@ def degraded_detect(texts: list, scalar_fn, cache=None, hints_key=None,
             vals[i] = v
             cache.put((hints_key, texts[i]), v, texts[i])
     return vals
+
+
+def parse_tenant_weights(spec: str | None) -> dict:
+    """LDT_TENANT_WEIGHTS "tenantA=4,tenantB=1" -> {tenant: weight}.
+    Malformed or non-positive entries are dropped with a loud warning
+    (the knobs.py mistype rule); unlisted tenants weigh 1."""
+    import logging
+    out: dict = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition("=")
+        try:
+            w = float(val) if sep else 1.0
+        except ValueError:
+            w = -1.0
+        if not name.strip() or w <= 0:
+            logging.getLogger(__name__).warning(
+                "ignoring malformed LDT_TENANT_WEIGHTS entry %r", part)
+            continue
+        out[name.strip()] = w
+    return out
+
+
+class FairScheduler:
+    """Deficit-weighted round robin over per-tenant FIFO lanes.
+
+    The transport queue (queue.Queue / asyncio.Queue) stays the
+    cross-task handoff; this is a dequeue-side stash owned by exactly
+    one batcher collector (thread or task), so it needs no lock. Each
+    scheduler round credits a tenant `quantum * weight` bytes of
+    deficit; items pop while their byte cost fits, so when the backlog
+    exceeds one flush a saturating tenant waits its turn instead of
+    starving everyone else. Work within a lane stays FIFO."""
+
+    def __init__(self, weights: dict, quantum: int = 65536):
+        self.weights = dict(weights)
+        self.quantum = max(int(quantum), 1)
+        self._lanes: dict = {}          # tenant -> deque of items
+        self._ring: deque = deque()     # active tenants, visit order
+        self._deficit: dict = {}        # tenant -> accumulated bytes
+        self.backlog = 0                # stashed docs across all lanes
+
+    @classmethod
+    def from_env(cls) -> "FairScheduler | None":
+        """A scheduler when LDT_TENANT_WEIGHTS is set, else None (both
+        batchers keep their strict-FIFO dequeue)."""
+        weights = parse_tenant_weights(
+            knobs.get_str("LDT_TENANT_WEIGHTS"))
+        if not weights:
+            return None
+        return cls(weights,
+                   knobs.get_int("LDT_WFQ_QUANTUM_BYTES") or 65536)
+
+    @staticmethod
+    def _tenant(item) -> str:
+        # both batchers' items end (..., trace, future)
+        return getattr(item[-2], "tenant", None) or DEFAULT_TENANT
+
+    @staticmethod
+    def _cost(item) -> int:
+        return sum(len(t) for t in item[0]) + 1
+
+    def push(self, item):
+        tenant = self._tenant(item)
+        lane = self._lanes.get(tenant)
+        if lane is None:
+            lane = self._lanes[tenant] = deque()
+            self._ring.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+        lane.append(item)
+        self.backlog += len(item[0])
+
+    def pop_batch(self, max_docs: int) -> list:
+        """Dequeue up to max_docs documents' worth of items in DRR
+        order. Always makes progress when lanes are non-empty: each
+        ring visit adds a quantum, so any head item eventually fits."""
+        out: list = []
+        docs = 0
+        while self._ring and docs < max_docs:
+            tenant = self._ring[0]
+            lane = self._lanes[tenant]
+            self._deficit[tenant] += \
+                self.quantum * self.weights.get(tenant, 1.0)
+            while lane and docs < max_docs:
+                cost = self._cost(lane[0])
+                if cost > self._deficit[tenant] and out:
+                    break
+                item = lane.popleft()
+                self._deficit[tenant] -= cost
+                out.append(item)
+                docs += len(item[0])
+                self.backlog -= len(item[0])
+            if not lane:
+                del self._lanes[tenant]
+                del self._deficit[tenant]
+                self._ring.popleft()
+            else:
+                self._ring.rotate(-1)
+        return out
+
+    def drain_all(self) -> list:
+        """Every stashed item, in lane order — close() uses this to
+        fail stranded work instead of leaking its futures."""
+        items = [it for lane in self._lanes.values() for it in lane]
+        self._lanes.clear()
+        self._ring.clear()
+        self._deficit.clear()
+        self.backlog = 0
+        return items

@@ -20,7 +20,8 @@ import json
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import faults, knobs, telemetry
-from .admission import DeadlineExceeded, degraded_detect
+from .admission import (DeadlineExceeded, FairScheduler,
+                        degraded_detect)
 from .batcher import (_FLUSH_WORKERS, _MISS, Batcher, ResultCache,
                       _accepts_trace)
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
@@ -29,9 +30,13 @@ from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
 
 _MAX_HEADER_BYTES = 16384
 
-# planned recycle: bounded window for in-flight handlers to finish
-# their response before their sockets are aborted too
-_RECYCLE_DRAIN_SEC = knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0
+
+def _drain_sec() -> float:
+    """Bounded window for in-flight handlers to finish their response
+    before their sockets are aborted (recycle and SIGTERM drains). Read
+    at drain time, not import time, so a supervisor/test that sets
+    LDT_RECYCLE_DRAIN_SEC after this module imports is still honored."""
+    return knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0
 
 
 class AioBatcher:
@@ -47,6 +52,9 @@ class AioBatcher:
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
         self._q: asyncio.Queue = asyncio.Queue()
+        # deficit-weighted fair queueing at dequeue (LDT_TENANT_WEIGHTS;
+        # None = strict FIFO). Owned by the collector task alone.
+        self._sched = FairScheduler.from_env()
         self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
                                         thread_name_prefix="ldt-aioflush")
         self._task: asyncio.Task | None = None
@@ -80,6 +88,12 @@ class AioBatcher:
     async def close(self):
         if self._task is not None:
             self._task.cancel()
+        if self._sched is not None:
+            # the stash is collector-owned; with the collector
+            # cancelled nothing else will ever resolve these futures
+            for *_, fut in self._sched.drain_all():
+                if not fut.done():
+                    fut.set_exception(RuntimeError("batcher closed"))
         self._pool.shutdown(wait=False)
 
     async def _collector(self):
@@ -88,10 +102,21 @@ class AioBatcher:
         # unboundedly when the device falls behind)
         slots = asyncio.Semaphore(_FLUSH_WORKERS + 1)
         while True:
-            pending = [await self._q.get()]
-            n = len(pending[0][0])
+            sched = self._sched
+            if sched is not None and sched.backlog:
+                # stashed backlog exists: don't block on an empty
+                # queue, just sweep in whatever already arrived
+                try:
+                    first = await asyncio.wait_for(self._q.get(),
+                                                   self.max_delay)
+                except asyncio.TimeoutError:
+                    first = None
+            else:
+                first = await self._q.get()
+            pending = [first] if first is not None else []
+            n = len(first[0]) if first is not None else 0
             deadline = loop.time() + self.max_delay
-            while n < self.max_batch:
+            while n < self.max_batch and first is not None:
                 timeout = deadline - loop.time()
                 if timeout <= 0:
                     break
@@ -101,6 +126,15 @@ class AioBatcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            if sched is not None:
+                # fair queueing at dequeue: stash the sweep, pop the
+                # next batch in deficit-round-robin order; whatever a
+                # saturating tenant over-queued waits in its lane
+                for it in pending:
+                    sched.push(it)
+                pending = sched.pop_batch(self.max_batch)
+                if not pending:
+                    continue
             if faults.ACTIVE is not None:
                 # dequeue fault: fail THIS batch's waiters with the
                 # typed error and keep collecting — the collector task
@@ -225,6 +259,8 @@ class AioService:
             self.svc.metrics.cache_stats = self.batcher.cache_stats
         self._usage = json.dumps(USAGE).encode()
         self.recycling = False  # set by _recycle_watch; read by serve()
+        self.draining = False   # set by the SIGTERM handler (swap
+        # cutover / docker stop): same teardown, exit code 0
         # open client connections: the recycle path must force-close
         # idle keep-alive connections (a Prometheus scraper's persistent
         # socket would otherwise pin Server.wait_closed() forever on
@@ -386,9 +422,12 @@ class AioService:
             adm = svc.admission
             admit = None
             if texts:
+                tenant_h = headers.get(b"x-ldt-tenant")
                 admit = adm.try_admit(
                     texts,
-                    priority=headers.get(b"x-ldt-priority") is not None)
+                    priority=headers.get(b"x-ldt-priority") is not None,
+                    tenant=tenant_h.decode("latin-1")
+                    if tenant_h else None)
                 if admit.shed:
                     m.inc("augmentation_errors_logged_total")
                     meta["status"] = admit.status
@@ -401,6 +440,7 @@ class AioService:
                                         .encode()),))
                 trace.deadline = adm.deadline_from_header(
                     headers.get(b"x-ldt-deadline-ms"))
+                trace.tenant = admit.tenant
                 if admit.level >= 1:
                     trace.no_retry = True
             try:
@@ -461,12 +501,39 @@ class AioService:
                 except (asyncio.IncompleteReadError, ConnectionError,
                         asyncio.LimitOverrunError):
                     break
-                parts = head.partition(b"\r\n")[0].split()
+                line, _, rest = head.partition(b"\r\n")
+                parts = line.split()
+                method = parts[0] if parts else b"GET"
                 path = parts[1].decode("latin-1").split("?", 1)[0] \
                     if len(parts) >= 2 else "/metrics"
+                mheaders = {}
+                for h in rest.split(b"\r\n"):
+                    k, sep, v = h.partition(b":")
+                    if sep:
+                        mheaders[k.strip().lower()] = v.strip()
+                try:
+                    length = int(mheaders.get(b"content-length", 0)
+                                 or 0)
+                except ValueError:
+                    length = 0
+                if length > _MAX_HEADER_BYTES:
+                    writer.write(_http_response(
+                        413, b'{"error":"body too large"}',
+                        extra_headers=((b"Connection", b"close"),)))
+                    with contextlib.suppress(Exception):
+                        await writer.drain()
+                    break
+                try:
+                    body = await reader.readexactly(length) if length \
+                        else b""
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
                 self._busy.add(writer)
                 try:
-                    if path in ("/healthz", "/readyz"):
+                    if method == b"POST" and path == "/swap":
+                        status, sbody = await self._swap(body)
+                        writer.write(_http_response(status, sbody))
+                    elif path in ("/healthz", "/readyz"):
                         hstatus, hbody = health_response(self.svc, path)
                         writer.write(_http_response(hstatus, hbody))
                     elif path == "/debug/vars":
@@ -495,6 +562,29 @@ class AioService:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+
+    async def _swap(self, body: bytes) -> tuple:
+        """POST /swap on the metrics port: in-process artifact hot swap
+        (service/swap.py). Body {"path": ...}, falling back to
+        LDT_ARTIFACT_PATH. The mmap + device-table build run on the
+        default executor so the event loop keeps serving."""
+        from . import swap as swap_mod
+        try:
+            doc = json.loads(body.decode("utf-8") or "{}")
+        except (ValueError, UnicodeDecodeError):
+            return 400, b'{"error":"invalid JSON body"}'
+        path = (doc.get("path") if isinstance(doc, dict) else None) \
+            or knobs.get_str("LDT_ARTIFACT_PATH")
+        if not path:
+            return 400, (b'{"error":"no artifact path: POST '
+                         b'{\\"path\\":...} or set LDT_ARTIFACT_PATH"}')
+        loop = asyncio.get_running_loop()
+        try:
+            info = await loop.run_in_executor(
+                None, swap_mod.swap_artifact, self.svc, path)
+        except swap_mod.SwapError as e:
+            return 409, json.dumps({"error": str(e)}).encode()
+        return 200, json.dumps(info).encode()
 
 
 async def _recycle_watch(aio: "AioService", server, mserver):
@@ -527,34 +617,57 @@ async def _recycle_watch(aio: "AioService", server, mserver):
             # DURING exception propagation — before any except clause —
             # and on 3.12.1+ that waits for every accepted connection,
             # so an idle keep-alive socket would pin the recycle forever
-            # unless aborted first. IDLE sockets (not inside a request;
-            # a Prometheus scraper between scrapes, a pooled client
-            # between calls) abort immediately — there is no response to
-            # lose. Sockets with an IN-FLIGHT request get a bounded
-            # window to finish writing their response instead of the old
-            # fixed 0.5s guillotine, then any stragglers abort too.
+            # unless aborted by the drain's final sweep. Idle sockets
+            # are spared through the settle window (a connection
+            # accepted just before the listener closed may not have
+            # surfaced in the busy set yet — aborting it would reset a
+            # request already on the wire), in-flight requests get a
+            # bounded window to finish writing their response, then any
+            # stragglers abort.
             aio.recycling = True
-            server.close()
-            mserver.close()
+            await _teardown(aio, server, mserver, spare_idle=True)
+            return
 
-            def _abort(w):
-                try:
-                    w.transport.abort()
-                except Exception:  # noqa: BLE001 - already gone
-                    pass
 
-            for w in list(aio._writers):
-                if w not in aio._busy:
-                    _abort(w)
-            loop = asyncio.get_running_loop()
-            deadline = loop.time() + _RECYCLE_DRAIN_SEC
+def _abort(w):
+    try:
+        w.transport.abort()
+    except Exception:  # noqa: BLE001 - already gone
+        pass
+
+
+async def _teardown(aio: "AioService", server, mserver,
+                    spare_idle: bool = False):
+    """Shared drain for recycle and SIGTERM (swap cutover): stop
+    accepting, give in-flight requests a bounded window, then abort
+    whatever is left so wait_closed() cannot hang on a survivor.
+    spare_idle: leave idle keep-alive sockets alone until the busy set
+    settles — a connection accepted just before the listener closed may
+    still be delivering its request (not yet in the busy set), and
+    neither a cutover nor a recycle handoff may reset it. The final
+    sweep still aborts true idlers, so wait_closed() never hangs."""
+    server.close()
+    mserver.close()
+    if not spare_idle:
+        for w in list(aio._writers):
+            if w not in aio._busy:
+                _abort(w)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + _drain_sec()
+    while aio._busy and loop.time() < deadline:
+        await asyncio.sleep(0.05)
+    if spare_idle:
+        # settle window: requests racing the listener close surface in
+        # _busy a beat after the accept; drain those too
+        settle = loop.time() + 0.25
+        while loop.time() < min(settle, deadline):
+            await asyncio.sleep(0.05)
             while aio._busy and loop.time() < deadline:
                 await asyncio.sleep(0.05)
-            # stragglers past the bound + connections that went idle
-            # (and may have picked up a new request) since the sweep
-            for w in list(aio._writers):
-                _abort(w)
-            return
+    # stragglers past the bound + connections that went idle
+    # (and may have picked up a new request) since the sweep
+    for w in list(aio._writers):
+        _abort(w)
 
 
 async def serve(port: int = 3000, metrics_port: int = 30000,
@@ -568,10 +681,14 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
     # Bind IPv4 explicitly: host "" dual-stack-binds v4 AND v6, and with
     # port=0 each family gets a DIFFERENT ephemeral port (sockets[0]'s
     # family is unordered — callers would connect to the wrong one).
+    # SO_REUSEPORT (LDT_REUSEPORT): an old and a standby generation
+    # overlap on the same port during a blue/green swap drill
+    kw = {"reuse_port": True} if knobs.get_bool("LDT_REUSEPORT") else {}
     server = await asyncio.start_server(aio.handle, "0.0.0.0", port,
-                                        limit=BODY_LIMIT_BYTES + 65536)
+                                        limit=BODY_LIMIT_BYTES + 65536,
+                                        **kw)
     mserver = await asyncio.start_server(aio.handle_metrics, "0.0.0.0",
-                                         metrics_port)
+                                         metrics_port, **kw)
     ports = (server.sockets[0].getsockname()[1],
              mserver.sockets[0].getsockname()[1])
     print(json.dumps({"msg": f"language-detector (asyncio) listening on "
@@ -579,18 +696,46 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
           flush=True)
     if ready is not None and not ready.done():
         ready.set_result(ports)
-    watch = asyncio.get_running_loop().create_task(
-        _recycle_watch(aio, server, mserver))
+    loop = asyncio.get_running_loop()
+    # warmup (LDT_WARMUP) + readiness handshake (LDT_READY_FILE /
+    # LDT_SWAPPED) off the loop: the standby contract with the
+    # supervisor's swap drill
+    from .swap import startup_ready_task
+    loop.run_in_executor(None, startup_ready_task, aio.svc, ports)
+
+    def _on_term():
+        # graceful drain (the supervisor's swap cutover, docker stop):
+        # stop accepting, flush in-flight, then exit 0
+        if aio.recycling or aio.draining:
+            return
+        aio.draining = True
+        print(json.dumps({"msg": "draining worker: SIGTERM"}),
+              flush=True)
+        loop.create_task(_teardown(aio, server, mserver,
+                                   spare_idle=True))
+
+    try:
+        import signal as _signal
+        loop.add_signal_handler(_signal.SIGTERM, _on_term)
+    except (ValueError, RuntimeError, NotImplementedError):
+        pass  # embedded in a non-main thread (tests) or no signals
+    watch = loop.create_task(_recycle_watch(aio, server, mserver))
     try:
         async with server, mserver:
             await asyncio.gather(server.serve_forever(),
                                  mserver.serve_forever())
     except asyncio.CancelledError:
-        if not aio.recycling:
+        if not (aio.recycling or aio.draining):
             raise  # external cancellation (tests, embedding callers)
     finally:
         watch.cancel()
-    return "recycle" if aio.recycling else None
+        with contextlib.suppress(ValueError, RuntimeError,
+                                 NotImplementedError):
+            import signal as _signal
+            loop.remove_signal_handler(_signal.SIGTERM)
+    if aio.recycling:
+        return "recycle"
+    return "drain" if aio.draining else None
 
 
 def main():

@@ -32,7 +32,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from .. import faults, telemetry
 from ..locks import make_lock
-from .admission import DeadlineExceeded, note_deadline_expired
+from .admission import (DeadlineExceeded, FairScheduler,
+                        note_deadline_expired)
 
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
 # ceiling (models/ngram.py's scheduler pool uses the same depth)
@@ -125,6 +126,9 @@ class Batcher:
         self._cache = ResultCache(cache_bytes) if cache_bytes > 0 \
             else None
         self._q: queue.Queue = queue.Queue()
+        # deficit-weighted fair queueing at dequeue (LDT_TENANT_WEIGHTS;
+        # None = strict FIFO). Owned by the collector thread alone.
+        self._sched = FairScheduler.from_env()
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
                                         thread_name_prefix="ldt-flush")
@@ -182,20 +186,35 @@ class Batcher:
                 break
             if item is not None:
                 self._fail([item], RuntimeError("batcher closed"))
+        # the WFQ stash is collector-owned; with the collector joined
+        # (or abandoned after its timeout) nothing else drains it
+        if self._sched is not None:
+            stranded = self._sched.drain_all()
+            if stranded:
+                self._fail(stranded, RuntimeError("batcher closed"))
 
     # -- collector -----------------------------------------------------------
 
     def _run(self):
         while not self._stop.is_set():
-            item = self._q.get()
-            if item is None:
+            sched = self._sched
+            if sched is not None and sched.backlog:
+                # stashed backlog exists: don't block on an empty
+                # queue, just sweep in whatever already arrived
+                try:
+                    item = self._q.get(timeout=self.max_delay)
+                except queue.Empty:
+                    item = None
+            else:
+                item = self._q.get()
+            if item is None and (sched is None or not sched.backlog):
                 continue
-            pending = [item]
-            n = len(item[0])
+            pending = [item] if item is not None else []
+            n = len(item[0]) if item is not None else 0
             # accumulate until deadline or size cap
             import time
             deadline = time.monotonic() + self.max_delay
-            while n < self.max_batch:
+            while n < self.max_batch and item is not None:
                 timeout = deadline - time.monotonic()
                 if timeout <= 0:
                     break
@@ -207,6 +226,15 @@ class Batcher:
                     break
                 pending.append(nxt)
                 n += len(nxt[0])
+            if sched is not None:
+                # fair queueing at dequeue: stash the sweep, pop the
+                # next batch in deficit-round-robin order; whatever a
+                # saturating tenant over-queued waits in its lane
+                for it in pending:
+                    sched.push(it)
+                pending = sched.pop_batch(self.max_batch)
+                if not pending:
+                    continue
             if faults.ACTIVE is not None:
                 # a dequeue fault fails THIS batch's waiters (typed
                 # error, not a hang) and the collector moves on — the

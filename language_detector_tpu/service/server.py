@@ -227,12 +227,21 @@ class Metrics:
                         ad.get("brownout_level", 0)))
         fams.append(one("ldt_breaker_state",
                         ad.get("breaker_state", 0)))
+        # per-tenant queue occupancy (X-LDT-Tenant quotas); tenants
+        # with no live work carry no sample — the family still renders
+        fams.append(fam("ldt_tenant_queue_bytes",
+                        [("ldt_tenant_queue_bytes", {"tenant": t},
+                          v.get("queue_bytes", 0))
+                         for t, v in sorted(
+                             (ad.get("tenants") or {}).items())]))
         # readiness + supervision (docs/ROBUSTNESS.md): ldt_ready
         # mirrors /readyz, the generation gauge is set by the
         # supervisor through the child's environment
         rd = self.readiness()
         fams.append(one("ldt_ready",
                         1 if rd is not None and rd.get("ok") else 0))
+        fams.append(one("ldt_warmup_ms",
+                        rd.get("warmup_ms", 0) if rd else 0))
         fams.append(one("ldt_worker_generation",
                         knobs.get_int("LDT_WORKER_GENERATION") or 0))
         # shared telemetry registry: stage/request histograms + compile
@@ -273,8 +282,28 @@ class DetectorService:
         # actually loaded; /readyz reports false until then (and an
         # ArtifactError propagates out of __init__ — startup fails loud)
         self._artifact_loaded = False
+        # which artifact is serving (LDT_ARTIFACT_PATH or the packaged
+        # default); service/swap.py rebinds it on a hot swap
+        self._artifact_path = knobs.get_str("LDT_ARTIFACT_PATH")
+        # serializes in-process hot swaps (service/swap.swap_artifact);
+        # detect closures never take it — they read the engine/tables
+        # reference once per call, and a swap is one atomic rebind
+        self._swap_lock = make_lock("server.swap")
+        self._swap_count = 0
+        # startup warmup (LDT_WARMUP): /readyz holds false until warm()
+        # pre-compiles the bucket ladder; off -> born warm
+        self._warmed = not knobs.get_bool("LDT_WARMUP")
+        self._warmup_ms = 0.0
+        # in-flight HTTP requests on the threaded front (main()'s
+        # graceful drain waits on it; shares the _log_lock)
+        self._inflight_http = 0
         self._detect = self._make_detect(use_device)
         self.metrics.readiness = self.readiness
+        # pre-touch both swap outcomes so a scrape shows the series at
+        # 0 before any drill (mirrors the admission shed pre-touch)
+        for result in ("ok", "error"):
+            telemetry.REGISTRY.counter_inc("ldt_swap_total", 0,
+                                           result=result)
         if cache_bytes is None:
             mb = knobs.get_float("LDT_RESULT_CACHE_MB")
             cache_bytes = int((mb or 0) * 1e6)
@@ -288,6 +317,16 @@ class DetectorService:
         if self.batcher is not None and self.batcher._cache is not None:
             self.metrics.cache_stats = self.batcher.cache_stats
 
+    def _load_tables(self):
+        """Initial table load honoring LDT_ARTIFACT_PATH. An explicit
+        path loads its own mmap (bypassing tables.py's per-path cache —
+        the same loader the hot swap uses); unset keeps the packaged
+        default."""
+        from ..tables import ScoringTables, load_tables
+        if self._artifact_path:
+            return ScoringTables.load_mmap(Path(self._artifact_path))
+        return load_tables()
+
     def _make_detect(self, use_device: bool):
         from ..registry import registry
         self._registry = registry
@@ -300,7 +339,9 @@ class DetectorService:
                 # the actionable message instead of silently serving
                 # degraded
                 from ..models.ngram import NgramBatchEngine
-                eng = NgramBatchEngine()
+                eng = NgramBatchEngine(
+                    tables=self._load_tables()
+                    if self._artifact_path else None)
                 self._artifact_loaded = True
                 self._engine = eng
                 metrics = self.metrics
@@ -311,8 +352,11 @@ class DetectorService:
                 # that flushes run concurrently on worker pools. The
                 # snapshot copies UNDER the engine's stats lock: a bare
                 # dict(eng.stats) could race a concurrent key insertion
-                # (dict resize mid-copy raises RuntimeError)
-                metrics.engine_stats = eng.stats_snapshot
+                # (dict resize mid-copy raises RuntimeError). Reading
+                # through self._engine (not a captured engine) keeps
+                # the gauges live across hot swaps
+                metrics.engine_stats = \
+                    lambda: self._engine.stats_snapshot()
 
                 def detect(texts, trace=None):
                     # codes-only engine path: the handler needs just the
@@ -326,13 +370,19 @@ class DetectorService:
                     # The circuit breaker wraps exactly this seam: a
                     # tripped device routes flushes to the scalar
                     # engine (identical answers, no device dispatch)
-                    # until a half-open probe succeeds
+                    # until a half-open probe succeeds. The engine
+                    # reference is read once per call: a hot swap
+                    # (service/swap.py) rebinds self._engine between
+                    # flushes and in-flight calls finish on the engine
+                    # they started with
+                    engine = self._engine
                     if not breaker.allow_device():
                         return self.scalar_codes(texts, trace=trace)
                     t0 = time.monotonic()
                     try:
-                        out = eng.detect_codes(texts, batch_size=8192,
-                                               trace=trace)
+                        out = engine.detect_codes(texts,
+                                                  batch_size=8192,
+                                                  trace=trace)
                     except Exception:
                         breaker.record_failure()
                         raise
@@ -343,13 +393,15 @@ class DetectorService:
             except (ImportError, RuntimeError):
                 pass
         from ..engine_scalar import detect_scalar
-        from ..tables import load_tables
-        tables = load_tables()
+        tables = self._load_tables()
         self._artifact_loaded = True
         self._engine = None
         self._tables = tables
 
         def detect(texts, trace=None):
+            # same per-call reference read as the device closure: a
+            # hot swap rebinds self._tables atomically
+            tables = self._tables
             t0 = time.monotonic()
             out = [registry.code(
                 detect_scalar(t, tables, registry).summary_lang)
@@ -371,18 +423,55 @@ class DetectorService:
         telemetry.observe_stage("scalar_detect", t0, trace=trace)
         return out
 
+    def warm(self) -> float:
+        """Pre-compile the bucket ladder's jitted shapes so the first
+        real request doesn't pay XLA compilation (LDT_WARMUP gates
+        /readyz on this). The batch deliberately exceeds the tiny-batch
+        all-C threshold (TINY_BATCH_C_PATH=64 docs) with mixed lengths
+        so the short/mid tier lanes actually launch; returns (and
+        records) the wall duration in ms."""
+        t0 = time.monotonic()
+        base = ("the quick brown fox jumps over the lazy dog ",
+                "el veloz murcielago hindu comia feliz cardillo ",
+                "portez ce vieux whisky au juge blond qui fume ")
+        texts = [base[i % 3] * (1 + (i % 4) * 8) + str(i)
+                 for i in range(96)]
+        try:
+            self._detect(texts)
+        finally:
+            self._warmup_ms = (time.monotonic() - t0) * 1e3
+            self._warmed = True
+        return self._warmup_ms
+
+    def http_inflight(self) -> int:
+        """Threaded-front in-flight request count (main()'s graceful
+        drain polls it after serve_forever returns)."""
+        with self._log_lock:
+            return self._inflight_http
+
+    def _http_enter(self):
+        with self._log_lock:
+            self._inflight_http += 1
+
+    def _http_exit(self):
+        with self._log_lock:
+            self._inflight_http -= 1
+
     def readiness(self) -> dict:
         """The /readyz contract (docs/ROBUSTNESS.md): ready means the
-        artifact is loaded, the device breaker is not open, and the
-        brownout ladder sits below the shed level. Liveness (/healthz)
-        is unconditional — a not-ready process is alive, just asking
-        the balancer to route around it."""
+        artifact is loaded, startup warmup finished (when LDT_WARMUP
+        is on), the device breaker is not open, and the brownout ladder
+        sits below the shed level. Liveness (/healthz) is unconditional
+        — a not-ready process is alive, just asking the balancer to
+        route around it."""
         bstate = self.admission.breaker.stats()["state"]
         level, _ = self.admission.ladder.snapshot()
-        ok = (self._artifact_loaded and bstate != BREAKER_OPEN and
-              level < 3)
+        ok = (self._artifact_loaded and self._warmed and
+              bstate != BREAKER_OPEN and level < 3)
         return {"ok": ok,
                 "artifact_loaded": self._artifact_loaded,
+                "warmed": self._warmed,
+                "warmup_ms": round(self._warmup_ms, 3),
                 "breaker": BREAKER_STATE_NAMES[bstate],
                 "brownout_level": level}
 
@@ -491,20 +580,28 @@ class Handler(BaseHTTPRequestHandler):
         self._finish_metrics(t0)
 
     def do_POST(self):
-        t0 = time.time()
-        body = self._consume_body()
-        if body is None:  # oversize: 413 sent, connection closing
-            self._finish_metrics(t0)
-            return
-        if self.path not in ("/", ""):
-            self.service.metrics.inc("augmentation_invalid_requests_total")
-            self._send_json(404, b'{"error":"Not found"}')
-            self._finish_metrics(t0)
-            return
-        self._detector(body)
-        # the detector path observed its own (traced) duration via
-        # telemetry.finish_request — only count the request here
-        self._finish_metrics(t0, traced=True)
+        # in-flight accounting: main()'s graceful drain (recycle /
+        # SIGTERM cutover) waits for this count to hit zero after the
+        # accept loop stops, so a full-size flush mid-request survives
+        self.service._http_enter()
+        try:
+            t0 = time.time()
+            body = self._consume_body()
+            if body is None:  # oversize: 413 sent, connection closing
+                self._finish_metrics(t0)
+                return
+            if self.path not in ("/", ""):
+                self.service.metrics.inc(
+                    "augmentation_invalid_requests_total")
+                self._send_json(404, b'{"error":"Not found"}')
+                self._finish_metrics(t0)
+                return
+            self._detector(body)
+            # the detector path observed its own (traced) duration via
+            # telemetry.finish_request — only count the request here
+            self._finish_metrics(t0, traced=True)
+        finally:
+            self.service._http_exit()
 
     def _finish_metrics(self, t0: float, traced: bool = False):
         m = self.service.metrics
@@ -571,7 +668,8 @@ class Handler(BaseHTTPRequestHandler):
         if texts:
             admit = adm.try_admit(
                 texts,
-                priority=self.headers.get("X-LDT-Priority") is not None)
+                priority=self.headers.get("X-LDT-Priority") is not None,
+                tenant=self.headers.get("X-LDT-Tenant"))
             if admit.shed:
                 svc.metrics.inc("augmentation_errors_logged_total")
                 self._send_json(
@@ -585,6 +683,7 @@ class Handler(BaseHTTPRequestHandler):
                 return
             trace.deadline = adm.deadline_from_header(
                 self.headers.get("X-LDT-Deadline-Ms"))
+            trace.tenant = admit.tenant
             if admit.level >= 1:
                 trace.no_retry = True
         try:
@@ -771,6 +870,67 @@ class MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):
+        """POST /swap: in-process artifact hot swap (service/swap.py).
+        Body {"path": ...}, falling back to LDT_ARTIFACT_PATH. Lives on
+        the metrics port — an operator action, not client traffic."""
+        path = self.path.split("?", 1)[0]
+        if path != "/swap":
+            self._answer(404, b'{"error":"Not found"}')
+            return
+        from . import swap as swap_mod
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = 0
+        body = self.rfile.read(max(min(length, 65536), 0))
+        try:
+            doc = json.loads(body.decode("utf-8") or "{}")
+        except (ValueError, UnicodeDecodeError):
+            self._answer(400, b'{"error":"invalid JSON body"}')
+            return
+        apath = (doc.get("path") if isinstance(doc, dict) else None) \
+            or knobs.get_str("LDT_ARTIFACT_PATH")
+        if not apath:
+            self._answer(400, b'{"error":"no artifact path: POST '
+                              b'{\\"path\\":...} or set '
+                              b'LDT_ARTIFACT_PATH"}')
+            return
+        try:
+            info = swap_mod.swap_artifact(self.service, apath)
+        except swap_mod.SwapError as e:
+            self._answer(409, json.dumps({"error": str(e)}).encode())
+            return
+        self._answer(200, json.dumps(info).encode())
+
+    def _answer(self, status: int, body: bytes):
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _make_http_server(addr: tuple, handler) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer, optionally bound with SO_REUSEPORT
+    (LDT_REUSEPORT) so an old and a standby worker generation can
+    overlap on the same port during a blue/green swap."""
+    if not knobs.get_bool("LDT_REUSEPORT"):
+        return ThreadingHTTPServer(addr, handler)
+    import socket
+    httpd = ThreadingHTTPServer(addr, handler,
+                                bind_and_activate=False)
+    try:
+        httpd.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT,
+                                1)
+        httpd.server_bind()
+        httpd.server_activate()
+    except OSError:
+        httpd.server_close()
+        raise
+    return httpd
+
 
 def make_server(port: int = 0, metrics_port: int = 0,
                 service: DetectorService | None = None):
@@ -778,10 +938,10 @@ def make_server(port: int = 0, metrics_port: int = 0,
     ephemeral ports (tests)."""
     svc = service or DetectorService()
     handler = type("BoundHandler", (Handler,), {"service": svc})
-    httpd = ThreadingHTTPServer(("", port), handler)
+    httpd = _make_http_server(("", port), handler)
     mhandler = type("BoundMetricsHandler", (MetricsHandler,),
                     {"service": svc})
-    metricsd = ThreadingHTTPServer(("", metrics_port), mhandler)
+    metricsd = _make_http_server(("", metrics_port), mhandler)
     return httpd, metricsd, svc
 
 
@@ -820,6 +980,7 @@ def _recycle_watch_thread(svc: DetectorService, httpd):
 
 
 def main():
+    import signal
     import sys
 
     from .recycle import RECYCLE_EXIT_CODE
@@ -834,16 +995,47 @@ def main():
                              f":{httpd.server_address[1]}, metrics on "
                              f":{metricsd.server_address[1]}"}),
           flush=True)
+    # warmup (LDT_WARMUP) + readiness handshake (LDT_READY_FILE /
+    # LDT_SWAPPED): the standby contract with the supervisor's swap
+    # drill, off the serving threads
+    from .swap import startup_ready_task
+    threading.Thread(target=startup_ready_task,
+                     args=(svc, (httpd.server_address[1],
+                                 metricsd.server_address[1])),
+                     daemon=True, name="ldt-warmup").start()
+
+    def _on_term(signum, frame):
+        # graceful drain (the supervisor's swap cutover, docker stop):
+        # stop accepting, flush in-flight, exit 0. shutdown() blocks
+        # until serve_forever returns, and this handler RUNS inside
+        # serve_forever's thread — a direct call would deadlock
+        if not getattr(httpd, "_ldt_drain", False):
+            httpd._ldt_drain = True
+            print(json.dumps({"msg": "draining worker: SIGTERM"}),
+                  flush=True)
+            threading.Thread(target=httpd.shutdown,
+                             daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # embedded in a non-main thread (tests)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        if getattr(httpd, "_ldt_recycle", False):
-            # shutdown() only stops the accept loop; give in-flight
-            # handler threads a moment to finish writing before the
-            # batcher closes under them (the aio front drains the same)
-            time.sleep(0.5)
+        if getattr(httpd, "_ldt_recycle", False) or \
+                getattr(httpd, "_ldt_drain", False):
+            # shutdown() only stops the accept loop: wait for in-flight
+            # handler threads (a full-size flush mid-request must
+            # survive a planned recycle / swap cutover) up to the drain
+            # bound before the batcher closes under them
+            deadline = time.monotonic() + \
+                (knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0)
+            while svc.http_inflight() > 0 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
         svc.batcher.close()
     if getattr(httpd, "_ldt_recycle", False):
         sys.exit(RECYCLE_EXIT_CODE)

@@ -24,7 +24,19 @@ restart it per policy (docs/ROBUSTNESS.md):
 Each spawned worker gets LDT_WORKER_GENERATION=<n> in its environment
 (1, 2, ...), which the fronts export as the ldt_worker_generation
 gauge, and every lifecycle event is one structured JSON log line with a
-"reason" field (recycle | crash | crash-loop | clean-exit | signal).
+"reason" field (recycle | crash | crash-loop | clean-exit | signal |
+swap | swap-abort).
+
+SIGHUP runs the blue/green swap drill (docs/ROBUSTNESS.md): spawn a
+STANDBY generation (LDT_SWAPPED=1, optionally pointed at a new
+artifact via the LDT_ARTIFACT_POINTER file), hold it until its
+LDT_READY_FILE handshake lands (readiness open: warmup done, bucket
+ladder pre-compiled — service/swap.startup_ready_task), then cut over
+by SIGTERM-draining the old generation. Zero dropped requests when the
+operator sets LDT_REUSEPORT in the supervisor's env (both generations
+then share the listening port while the old one drains); any abort —
+standby dies, readiness times out, pointer unreadable, injected
+``standby_spawn`` fault — leaves the old generation serving untouched.
 
 Run: python -m language_detector_tpu.service.supervisor [module]
      (module defaults to language_detector_tpu.service.aioserver, the
@@ -39,9 +51,10 @@ import random
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
-from .. import knobs
+from .. import faults, knobs
 from .recycle import RECYCLE_EXIT_CODE
 
 
@@ -63,19 +76,118 @@ def main() -> int:
     crash_times: list = []  # wall times of recent crashes (loop window)
     child: subprocess.Popen | None = None
     stopping = False
+    swap_requested = False
+    signaled: subprocess.Popen | None = None  # child already SIGTERMed
+    t0 = time.time()
 
     # PID-1 duty (the Dockerfile CMD): forward SIGTERM/SIGINT to the
     # worker so `docker stop` gives it a graceful shutdown instead of
     # the namespace teardown SIGKILLing it mid-request; then stop
     # restarting and exit with the worker's code.
     def _forward(signum, frame):
-        nonlocal stopping
+        nonlocal stopping, signaled
         stopping = True
         if child is not None and child.poll() is None:
             child.send_signal(signum)
+            signaled = child
 
     signal.signal(signal.SIGTERM, _forward)
     signal.signal(signal.SIGINT, _forward)
+
+    # SIGHUP = "roll to a new generation without dropping traffic";
+    # the flag is drained by the wait loop below, never the handler
+    def _request_swap(signum, frame):
+        nonlocal swap_requested
+        swap_requested = True
+
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, _request_swap)
+
+    def _swap_drill():
+        nonlocal child, generation, t0
+        old = child
+        gen = generation + 1
+        _log("supervisor: swap drill starting", reason="swap",
+             generation=generation, standby_generation=gen)
+        artifact = None
+        pointer = knobs.get_str("LDT_ARTIFACT_POINTER")
+        if pointer:
+            try:
+                with open(pointer) as f:
+                    artifact = f.read().strip()
+            except OSError as e:
+                _log("supervisor: swap aborted — artifact pointer "
+                     "unreadable", reason="swap-abort",
+                     pointer=pointer, error=repr(e))
+                return
+        try:
+            if faults.ACTIVE is not None:
+                faults.hit("standby_spawn")
+        except faults.FaultInjected as e:
+            _log("supervisor: swap aborted — injected fault",
+                 reason="swap-abort", error=repr(e))
+            return
+        ready_file = os.path.join(
+            tempfile.gettempdir(),
+            f"ldt-ready-{os.getpid()}-{gen}.json")
+        try:
+            os.remove(ready_file)
+        except OSError:
+            pass
+        env = dict(os.environ)  # ldt-lint: disable=knob-direct-env -- building the child environment, not reading config
+        env["LDT_WORKER_GENERATION"] = str(gen)
+        env["LDT_SWAPPED"] = "1"
+        env["LDT_READY_FILE"] = ready_file
+        if artifact:
+            env["LDT_ARTIFACT_PATH"] = artifact
+        standby = subprocess.Popen([sys.executable, "-m", module],
+                                   env=env)
+        st0 = time.time()
+        timeout = knobs.get_float("LDT_SWAP_TIMEOUT_SEC") or 30.0
+        deadline = st0 + timeout
+        ready = False
+        while time.time() < deadline and not stopping:
+            if standby.poll() is not None:
+                # a standby that dies before ready (corrupt artifact,
+                # port clash) aborts the drill; old keeps serving
+                _log("supervisor: swap aborted — standby died before "
+                     "ready", reason="swap-abort",
+                     rc=standby.returncode, standby_generation=gen)
+                return
+            if os.path.exists(ready_file):
+                ready = True
+                break
+            time.sleep(0.05)
+        if not ready:
+            standby.kill()
+            standby.wait()
+            _log("supervisor: swap aborted — standby not ready "
+                 "in time", reason="swap-abort",
+                 standby_generation=gen, timeout_sec=timeout)
+            return
+        # cutover: standby is warmed and listening (share the port via
+        # LDT_REUSEPORT for zero-drop) — drain the old generation
+        # gracefully (SIGTERM: stop accepting, flush in-flight, exit 0)
+        _log("supervisor: swap cutover — draining old generation",
+             reason="swap", generation=generation,
+             standby_generation=gen)
+        if old.poll() is None:
+            old.send_signal(signal.SIGTERM)
+        try:
+            old.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            old.kill()
+            old.wait()
+        try:
+            os.remove(ready_file)
+        except OSError:
+            pass
+        child = standby
+        generation = gen
+        t0 = st0
+        _log("supervisor: swap complete", reason="swap",
+             generation=gen,
+             standby_ready_sec=round(time.time() - st0, 3))
 
     while True:
         generation += 1
@@ -91,8 +203,26 @@ def main() -> int:
             child.send_signal(signal.SIGTERM)
         while True:
             try:
-                rc = child.wait()
+                # short-poll wait so a SIGHUP swap request is noticed
+                # while the worker is healthy (the only time a drill
+                # makes sense)
+                rc = child.wait(timeout=0.2)
                 break
+            except subprocess.TimeoutExpired:
+                if stopping:
+                    # a stop that raced a swap drill forwarded the
+                    # signal to the OLD child; make sure whichever
+                    # generation is current hears it — exactly once
+                    # (a repeat can land mid-shutdown, after the
+                    # worker's handler is gone, and turn a clean drain
+                    # into a SIGTERM death)
+                    if child is not signaled and child.poll() is None:
+                        child.send_signal(signal.SIGTERM)
+                        signaled = child
+                elif swap_requested:
+                    swap_requested = False
+                    _swap_drill()
+                continue
             except KeyboardInterrupt:  # Ctrl+C raced the handler
                 continue
         uptime = round(time.time() - t0, 3)

@@ -120,6 +120,23 @@ METRICS: dict = {
         "gauge",
         "Worker generation under the supervisor (LDT_WORKER_GENERATION"
         "; 0 = unsupervised)."),
+    "ldt_swap_total": (
+        "counter",
+        "Artifact hot swaps by result: ok (new tables serving — "
+        "counted by a standby generation once ready, or by "
+        "service/swap.py after an in-process rebind) or error "
+        "(aborted; the old tables keep serving)."),
+    "ldt_warmup_ms": (
+        "gauge",
+        "Startup bucket-ladder warmup duration (LDT_WARMUP); 0 until "
+        "warmup completes / when warmup is off."),
+    "ldt_tenant_shed_total": (
+        "counter",
+        "Requests shed by admission control, by tenant and reason "
+        "(X-LDT-Tenant header; absent = \"default\")."),
+    "ldt_tenant_queue_bytes": (
+        "gauge",
+        "Byte-weighted admission cost currently held, per tenant."),
 }
 
 
@@ -208,18 +225,21 @@ class Trace:
     tree is reconstructed at render time, never maintained on the hot
     path."""
 
-    __slots__ = ("t0", "t_wall", "spans", "deadline", "no_retry")
+    __slots__ = ("t0", "t_wall", "spans", "deadline", "no_retry",
+                 "tenant")
 
     def __init__(self):
         self.t0 = _mono()
         self.t_wall = time.time()
         self.spans: list = []
         # admission-control freight riding the existing trace plumbing
-        # (service/admission.py): the request's Deadline, and whether
+        # (service/admission.py): the request's Deadline, whether
         # the engine should resolve gate failures scalar instead of
-        # running the pipelined retry lane (brownout / near-deadline)
+        # running the pipelined retry lane (brownout / near-deadline),
+        # and the tenant identity for fair-queueing at dequeue
         self.deadline = None
         self.no_retry = False
+        self.tenant = None
 
     def add(self, name: str, t0: float, t1: float, depth: int = 0):
         self.spans.append((name, depth, t0, t1))

@@ -13,6 +13,15 @@ supervisor can run it with its normal `python -m <module>` spawn:
   FAKE_WORKER_SIGFILE    install a SIGTERM/SIGINT handler that writes
                          the signal number to this path and exits 0;
                          the worker then waits (bounded) to be signaled
+  FAKE_WORKER_SERVE      path to a directory: drop gen-<N>.up there at
+                         start, honor the LDT_READY_FILE handshake the
+                         supervisor's swap drill polls for (write the
+                         ready JSON once "serving"), then wait for
+                         SIGTERM/SIGINT and exit 0 — a scriptable
+                         generation for the blue/green drill tests
+  FAKE_WORKER_STANDBY_CRASH  with FAKE_WORKER_SERVE: if this run is the
+                         standby (LDT_SWAPPED set), exit 9 before the
+                         ready file — exercises the drill's abort path
 
 Every run prints one JSON line with the LDT_WORKER_GENERATION it was
 handed, so tests can assert the supervisor numbers its children.
@@ -57,6 +66,34 @@ def main() -> int:
         with open(marker, "w") as f:
             f.write("recycled")
         return RECYCLE_EXIT_CODE
+
+    serve_dir = os.environ.get("FAKE_WORKER_SERVE")
+    if serve_dir is not None:
+        gen = os.environ.get("LDT_WORKER_GENERATION", "0")
+        stop = []
+
+        def on_stop(signum, frame):
+            stop.append(signum)
+
+        # handlers BEFORE the .up marker: tests treat the marker as
+        # "safe to signal", so the install must already be done
+        signal.signal(signal.SIGTERM, on_stop)
+        signal.signal(signal.SIGINT, on_stop)
+        with open(os.path.join(serve_dir, f"gen-{gen}.up"), "w") as f:
+            f.write(str(os.getpid()))
+        ready_file = os.environ.get("LDT_READY_FILE")
+        if ready_file:
+            if os.environ.get("FAKE_WORKER_STANDBY_CRASH") and \
+                    os.environ.get("LDT_SWAPPED"):
+                return 9  # standby dies before ready: drill must abort
+            with open(ready_file, "w") as f:
+                json.dump({"generation": int(gen), "pid": os.getpid(),
+                           "port": 0, "metrics_port": 0,
+                           "warmup_ms": 0.0}, f)
+        deadline = time.time() + 60
+        while time.time() < deadline and not stop:
+            time.sleep(0.05)
+        return 0 if stop else 3
 
     sigfile = os.environ.get("FAKE_WORKER_SIGFILE")
     if sigfile is not None:

@@ -21,8 +21,9 @@ import pytest
 
 from language_detector_tpu import telemetry
 from language_detector_tpu.service.admission import (
-    AdmissionConfig, AdmissionController, BrownoutLadder, CircuitBreaker,
-    Deadline, DeadlineExceeded, request_cost, retry_after_sec)
+    DEFAULT_TENANT, AdmissionConfig, AdmissionController, BrownoutLadder,
+    CircuitBreaker, Deadline, DeadlineExceeded, FairScheduler,
+    parse_tenant_weights, request_cost, retry_after_sec)
 from language_detector_tpu.service.batcher import Batcher
 from language_detector_tpu.service.server import (DetectorService,
                                                   make_server)
@@ -98,6 +99,105 @@ def test_default_config_admits_everything():
     assert not a.shed and a.level == 0 and not a.degrade
     ctrl.release(a)
     assert ctrl.deadline_from_header(None) is None
+
+
+# -- per-tenant isolation ----------------------------------------------------
+
+
+def test_tenant_quota_docs_sheds_only_that_tenant():
+    ctrl = AdmissionController(AdmissionConfig(tenant_quota_docs=2))
+    a = ctrl.try_admit([EN, FR], tenant="hot")
+    assert not a.shed and a.tenant == "hot"
+    b = ctrl.try_admit([EN], tenant="hot")
+    assert b.shed and b.status == 429 and b.reason == "tenant_docs"
+    assert 1 <= b.retry_after <= 30
+    # a different tenant (and the default one) is untouched
+    c = ctrl.try_admit([EN], tenant="cold")
+    d = ctrl.try_admit([EN])
+    assert not c.shed and not d.shed
+    assert d.tenant == DEFAULT_TENANT
+    # release frees the hot tenant's quota and drops its entry
+    ctrl.release(a)
+    assert "hot" not in ctrl.tenants
+    assert not ctrl.try_admit([EN], tenant="hot").shed
+    ctrl.release(c)
+    ctrl.release(d)
+
+
+def test_tenant_quota_bytes_sheds():
+    ctrl = AdmissionController(
+        AdmissionConfig(tenant_quota_bytes=request_cost([EN]) - 1))
+    a = ctrl.try_admit([EN], tenant="hot")
+    assert a.shed and a.reason == "tenant_bytes" and a.status == 429
+    assert not ctrl.try_admit(["hi"], tenant="hot").shed
+
+
+def test_tenant_stats_and_shed_counter():
+    ctrl = AdmissionController(AdmissionConfig(tenant_quota_docs=1))
+    a = ctrl.try_admit([EN], tenant="t1")
+    ctrl.try_admit([EN], tenant="t1")  # shed
+    s = ctrl.stats()
+    assert s["shed"]["tenant_docs"] >= 1
+    assert s["tenants"]["t1"]["queue_docs"] == 1
+    assert s["limits"]["tenant_quota_docs"] == 1
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_tenant_shed_total", tenant="t1", reason="tenant_docs") >= 1
+    ctrl.release(a)
+    assert ctrl.stats()["tenants"] == {}
+
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("a=4, b=1.5,c") == \
+        {"a": 4.0, "b": 1.5, "c": 1.0}
+    # malformed / non-positive entries drop; blank spec means disabled
+    assert parse_tenant_weights("a=-1,=3,b=x") == {}
+    assert parse_tenant_weights(None) == {}
+    assert parse_tenant_weights("") == {}
+
+
+def _wfq_item(tenant, nbytes=40):
+    class _T:
+        pass
+    t = _T()
+    t.tenant = tenant
+    from concurrent.futures import Future
+    return (["x" * nbytes], None, t, Future())
+
+
+def test_fair_scheduler_weighted_interleave():
+    sched = FairScheduler({"a": 4, "b": 1}, quantum=64)
+    for _ in range(10):
+        sched.push(_wfq_item("a"))
+        sched.push(_wfq_item("b"))
+    assert sched.backlog == 20
+    drained = []
+    while sched.backlog:
+        drained.append([FairScheduler._tenant(i)
+                        for i in sched.pop_batch(4)])
+    flat = [t for row in drained for t in row]
+    assert sorted(flat) == ["a"] * 10 + ["b"] * 10  # nothing lost
+    # the weighted tenant drains ~4x faster up front
+    head = [t for row in drained[:3] for t in row]
+    assert head.count("a") > head.count("b")
+
+
+def test_fair_scheduler_always_makes_progress():
+    # one item costing far more than a quantum must still pop (the
+    # ring visit re-credits until the head fits; out-empty pops force
+    # progress) — a fat document cannot wedge the collector
+    sched = FairScheduler({}, quantum=8)
+    sched.push(_wfq_item("big", nbytes=10_000))
+    batch = sched.pop_batch(4)
+    assert len(batch) == 1 and sched.backlog == 0
+
+
+def test_fair_scheduler_drain_all():
+    sched = FairScheduler({}, quantum=64)
+    for t in ("a", "b", "a"):
+        sched.push(_wfq_item(t))
+    items = sched.drain_all()
+    assert len(items) == 3 and sched.backlog == 0
+    assert sched.pop_batch(4) == []
 
 
 # -- deadlines ---------------------------------------------------------------
@@ -353,6 +453,7 @@ def adm(front):
     yield ctrl
     c = ctrl.config
     c.max_queue_docs = c.max_queue_bytes = c.max_inflight = None
+    c.tenant_quota_docs = c.tenant_quota_bytes = None
     c.default_deadline_ms = None
     ctrl.ladder.alpha = c.brownout_alpha
     ctrl.ladder.ema = 0.0
@@ -459,7 +560,8 @@ def test_debug_vars_surfaces_admission(front):
     adm = doc["admission"]
     assert adm["brownout_level"] == 0
     assert adm["breaker"]["state_name"] == "closed"
-    assert set(adm["shed"]) == {"brownout", "queue_docs",
+    assert set(adm["shed"]) == {"brownout", "tenant_docs",
+                                "tenant_bytes", "queue_docs",
                                 "queue_bytes", "inflight"}
     from language_detector_tpu.debug import format_admission
     out = format_admission(doc)
@@ -476,6 +578,38 @@ def test_sync_default_config_behavior_unchanged(front, adm):
     assert [r["iso6391code"] for r in body["response"]] == ["en", "fr"]
     assert "Retry-After" not in headers
     assert adm.stats()["queue_docs"] == 0  # fully released
+
+
+def test_sync_two_tenant_saturation(front, adm):
+    """A tenant saturating its quota 429s with a tenant_* reason while
+    another tenant (and headerless default traffic) keeps being
+    served at 200 — the isolation contract through the sync front."""
+    adm.config.tenant_quota_docs = 1
+
+    # a 2-doc request exceeds the 1-doc tenant quota outright
+    status, headers, body = _post(
+        front["url"], {"request": [{"text": EN}, {"text": FR}]},
+        headers={"X-LDT-Tenant": "hot"})
+    assert status == 429
+    assert "quota" in body["error"]
+    assert 1 <= int(headers["Retry-After"]) <= 30
+    # cold tenant and headerless traffic: unaffected
+    for extra in ({"X-LDT-Tenant": "cold"}, {}):
+        status, _, body = _post(front["url"],
+                                {"request": [{"text": EN}]},
+                                headers=extra)
+        assert status == 200
+        assert body["response"][0]["iso6391code"] == "en"
+    # single-doc request fits the quota once nothing is queued
+    status, _, _ = _post(front["url"], {"request": [{"text": EN}]},
+                         headers={"X-LDT-Tenant": "hot"})
+    assert status == 200
+    # shed counter carries the tenant label through the scrape
+    with urllib.request.urlopen(front["metrics_url"] +
+                                "/metrics") as resp:
+        text = resp.read().decode()
+    assert 'ldt_tenant_shed_total{' in text
+    assert 'tenant="hot"' in text
 
 
 def test_aio_front_admission_contract():
@@ -550,14 +684,31 @@ def test_aio_front_admission_contract():
         assert status == 504
         assert body == {"error": "deadline expired before dispatch"}
 
+        # two-tenant saturation: hot tenant over quota 429s, the cold
+        # tenant and headerless default traffic stay at 200
+        ctrl.config.tenant_quota_docs = 1
+        status, headers, body = _post(
+            url, {"request": [{"text": EN}, {"text": FR}]},
+            headers={"X-LDT-Tenant": "hot-aio"})
+        assert status == 429 and "quota" in body["error"]
+        assert 1 <= int(headers["Retry-After"]) <= 30
+        for extra in ({"X-LDT-Tenant": "cold-aio"}, {}):
+            status, _, body = _post(url, {"request": [{"text": EN}]},
+                                    headers=extra)
+            assert status == 200
+            assert body["response"][0]["iso6391code"] == "en"
+        ctrl.config.tenant_quota_docs = None
+
         # new series scrape through the aio metrics port
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{mport}/metrics") as resp:
             text = resp.read().decode()
         for series in ("ldt_admission_queue_docs", "ldt_brownout_level",
                        "ldt_breaker_state", "ldt_shed_total{reason=",
-                       "ldt_deadline_expired_total"):
+                       "ldt_deadline_expired_total",
+                       'ldt_tenant_shed_total{'):
             assert series in text, series
+        assert 'tenant="hot-aio"' in text
     finally:
         loop = loop_holder.get("loop")
         if loop is not None:

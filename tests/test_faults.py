@@ -407,7 +407,8 @@ def test_ready_in_metrics_and_debug_vars(front):
     doc = json.loads(body)
     assert doc["ready"]["ok"] is True
     assert set(doc["ready"]) == {"ok", "artifact_loaded", "breaker",
-                                 "brownout_level"}
+                                 "brownout_level", "warmed",
+                                 "warmup_ms"}
 
 
 def test_health_response_contract_unit(front):

@@ -118,6 +118,108 @@ def test_threaded_server_recycles_end_to_end():
             p.kill()
 
 
+def _spawn_front(module: str, env_extra: dict = None):
+    env = {**os.environ, "LISTEN_PORT": "0", "PROMETHEUS_PORT": "0",
+           "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
+    env.update(env_extra or {})
+    p = subprocess.Popen(
+        [sys.executable, "-m", f"language_detector_tpu.service.{module}"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    port = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if "listening on" in line:
+            msg = json.loads(line)["msg"]
+            port = int(msg.split(":")[1].split(",")[0])
+            break
+    assert port, f"{module} never reported its port"
+    return p, port
+
+
+def _post_docs(port: int, n: int, results: list, tag: str):
+    docs = [{"text": f"bonjour le monde numero {i}"} for i in range(n)]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"request": docs}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        body = urllib.request.urlopen(req, timeout=90).read()
+        results.append((tag, body.count(b"iso6391code")))
+    except Exception as e:  # noqa: BLE001 - recorded for the assert
+        results.append((tag, repr(e)))
+
+
+def _assert_inflight_survives_recycle(module: str):
+    """Regression for the recycle handoff gap: a full-size flush still
+    in flight when the dispatch watcher trips must complete (drained,
+    not guillotined) before the worker exits RECYCLE_EXIT_CODE."""
+    import threading
+    p, port = _spawn_front(module, {"LDT_MAX_DISPATCHES": "1",
+                                    "LDT_RECYCLE_CHECK_SEC": "0.05"})
+    try:
+        results: list = []
+        # first request trips the watcher; the second lands while the
+        # first flush is mid-device so it rides a LATER flush that is
+        # in flight when shutdown starts
+        t1 = threading.Thread(target=_post_docs,
+                              args=(port, 100, results, "a"))
+        t2 = threading.Thread(target=_post_docs,
+                              args=(port, 100, results, "b"))
+        t1.start()
+        time.sleep(0.05)
+        t2.start()
+        t1.join(timeout=120)
+        t2.join(timeout=120)
+        assert sorted(results) == [("a", 100), ("b", 100)], results
+        try:
+            rc = p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate(timeout=10)
+            raise AssertionError(
+                f"worker did not recycle; stdout={out[-400:]!r} "
+                f"stderr={err[-400:]!r}")
+        assert rc == RECYCLE_EXIT_CODE, (rc, p.stderr.read()[-500:])
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_threaded_server_inflight_flush_survives_recycle():
+    _assert_inflight_survives_recycle("server")
+
+
+def test_aioserver_inflight_flush_survives_recycle():
+    _assert_inflight_survives_recycle("aioserver")
+
+
+def test_threaded_server_sigterm_drains_and_exits_zero():
+    """The swap cutover's drain contract on the sync front: SIGTERM
+    stops the accept loop, in-flight requests finish, exit code 0 (the
+    supervisor propagates it instead of restarting)."""
+    import signal
+    import threading
+    p, port = _spawn_front("server")
+    try:
+        results: list = []
+        t = threading.Thread(target=_post_docs,
+                             args=(port, 100, results, "a"))
+        t.start()
+        time.sleep(0.1)  # request in flight
+        p.send_signal(signal.SIGTERM)
+        t.join(timeout=120)
+        assert results == [("a", 100)], results
+        rc = p.wait(timeout=30)
+        assert rc == 0, (rc, p.stderr.read()[-500:])
+        out = p.stdout.read()
+        assert "draining worker" in out
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
 def test_supervisor_forwards_sigterm(tmp_path):
     """PID-1 duty (the Dockerfile CMD): SIGTERM to the supervisor is
     forwarded to the worker, whose graceful exit code propagates —

@@ -103,6 +103,129 @@ def test_generation_env_handed_to_children(tmp_path):
     assert gens == ["1", "2"]
 
 
+# -- blue/green swap drill (SIGHUP) ------------------------------------------
+
+
+def _start_serving_supervisor(tmp_path, env_extra=None):
+    env = dict(os.environ)
+    env["FAKE_WORKER_SERVE"] = str(tmp_path)
+    env["LDT_SWAP_TIMEOUT_SEC"] = "20"
+    env.update(env_extra or {})
+    return subprocess.Popen(SUPERVISOR, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _wait_for(path: Path, timeout: float = 20) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if path.exists():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _stop(proc) -> str:
+    """SIGTERM the supervisor and return its full stdout."""
+    try:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate(timeout=10)
+    return out
+
+
+def test_sighup_swap_drill_promotes_standby(tmp_path):
+    proc = _start_serving_supervisor(tmp_path)
+    try:
+        assert _wait_for(tmp_path / "gen-1.up"), "gen 1 never served"
+        proc.send_signal(signal.SIGHUP)
+        # the drill spawns generation 2 with the ready-file handshake;
+        # once it lands the old generation is drained and gen 2 serves
+        assert _wait_for(tmp_path / "gen-2.up"), "standby never spawned"
+    finally:
+        out = _stop(proc)
+    assert proc.returncode == 0, out
+    assert "swap drill starting" in out
+    assert "swap cutover" in out
+    assert "swap complete" in out
+    assert "swap-abort" not in out
+    # the promoted standby carried the swap env contract
+    gens = [json.loads(line)["fake_worker_generation"]
+            for line in out.splitlines()
+            if "fake_worker_generation" in line]
+    assert gens == ["1", "2"]
+
+
+def test_sighup_swap_aborts_when_standby_dies(tmp_path):
+    proc = _start_serving_supervisor(
+        tmp_path, {"FAKE_WORKER_STANDBY_CRASH": "1"})
+    try:
+        assert _wait_for(tmp_path / "gen-1.up")
+        proc.send_signal(signal.SIGHUP)
+        # the standby starts (drops gen-2.up) then dies before its
+        # ready file; give the drill a beat to notice and abort
+        assert _wait_for(tmp_path / "gen-2.up")
+        time.sleep(1.0)
+    finally:
+        out = _stop(proc)
+    # the old generation kept serving until our SIGTERM — clean exit
+    assert proc.returncode == 0, out
+    assert "standby died before ready" in out
+    assert "swap complete" not in out
+
+
+def test_sighup_swap_aborts_on_injected_fault(tmp_path):
+    proc = _start_serving_supervisor(
+        tmp_path, {"LDT_FAULTS": "standby_spawn:error"})
+    try:
+        assert _wait_for(tmp_path / "gen-1.up")
+        proc.send_signal(signal.SIGHUP)
+        time.sleep(1.0)  # give the drill a beat to abort
+        assert not (tmp_path / "gen-2.up").exists()
+    finally:
+        out = _stop(proc)
+    assert proc.returncode == 0, out
+    assert "injected fault" in out
+    assert "swap complete" not in out
+
+
+def test_sighup_swap_artifact_pointer(tmp_path):
+    """LDT_ARTIFACT_POINTER names a file whose contents become the
+    standby's LDT_ARTIFACT_PATH — the operator flips the pointer, then
+    HUPs. An unreadable pointer aborts before any spawn."""
+    pointer = tmp_path / "current.txt"
+    pointer.write_text(str(tmp_path / "model-v2.ldta"))
+    proc = _start_serving_supervisor(
+        tmp_path, {"LDT_ARTIFACT_POINTER": str(pointer)})
+    try:
+        assert _wait_for(tmp_path / "gen-1.up")
+        proc.send_signal(signal.SIGHUP)
+        assert _wait_for(tmp_path / "gen-2.up")
+    finally:
+        out = _stop(proc)
+    assert proc.returncode == 0, out
+    assert "swap complete" in out
+
+    # unreadable pointer: drill aborts, no standby
+    missing_dir = tmp_path / "second"
+    missing_dir.mkdir()
+    proc = _start_serving_supervisor(
+        missing_dir,
+        {"LDT_ARTIFACT_POINTER": str(tmp_path / "missing.txt")})
+    try:
+        assert _wait_for(missing_dir / "gen-1.up")
+        proc.send_signal(signal.SIGHUP)
+        time.sleep(1.0)
+        assert not (missing_dir / "gen-2.up").exists()
+    finally:
+        out = _stop(proc)
+    assert proc.returncode == 0, out
+    assert "artifact pointer" in out and "swap-abort" in out
+
+
 @pytest.mark.parametrize("signum", [signal.SIGTERM, signal.SIGINT])
 def test_signal_forwarded_to_child(tmp_path, signum):
     sigfile = tmp_path / "sig.txt"

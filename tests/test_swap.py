@@ -1,0 +1,326 @@
+"""Artifact hot-swap contract (service/swap.py): in-process rebind on
+both scorer paths, abort safety (corrupt artifact, injected fault,
+open breaker), the POST /swap operator endpoint on both fronts, the
+warmup readiness gate, and the compile-cache knob.
+
+The blue/green generation swap (supervisor SIGHUP drill) is covered in
+tests/test_supervisor.py; ci.sh runs the full live drill as a smoke.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from language_detector_tpu import faults, native, telemetry
+from language_detector_tpu.service.admission import (AdmissionConfig,
+                                                     AdmissionController)
+from language_detector_tpu.service.server import (DetectorService,
+                                                  make_server)
+from language_detector_tpu.service.swap import (SwapError, swap_artifact,
+                                                startup_ready_task)
+
+ARTIFACT = os.path.join(os.path.dirname(__file__), "..",
+                        "language_detector_tpu", "data", "model.ldta")
+
+EN = ("this is a simple english sentence with common words that "
+      "should be detected without any trouble at all")
+
+
+def _detect(svc, texts):
+    return svc.batcher.submit(texts).result(30)
+
+
+@pytest.fixture()
+def scalar_svc():
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    yield svc
+    svc.batcher.close()
+
+
+@pytest.fixture()
+def artifact_copy(tmp_path):
+    return str(shutil.copy(ARTIFACT, tmp_path / "new.ldta"))
+
+
+# -- in-process swap ---------------------------------------------------------
+
+
+def test_scalar_swap_rebinds_tables(scalar_svc, artifact_copy):
+    svc = scalar_svc
+    assert _detect(svc, [EN]) == ["en"]
+    old_tables = svc._tables
+    ok0 = telemetry.REGISTRY.counter_value("ldt_swap_total",
+                                           result="ok")
+    info = swap_artifact(svc, artifact_copy)
+    assert info["swapped"] and info["swap_count"] == 1
+    assert not info["engine"]
+    assert svc._tables is not old_tables  # FRESH mmap, not the cache
+    assert svc._artifact_path == artifact_copy
+    assert _detect(svc, [EN]) == ["en"]  # still serving, new tables
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_swap_total", result="ok") == ok0 + 1
+
+
+def test_swap_corrupt_artifact_aborts(scalar_svc, tmp_path):
+    svc = scalar_svc
+    bad = tmp_path / "bad.ldta"
+    bad.write_bytes(b"not an artifact")
+    old_tables = svc._tables
+    err0 = telemetry.REGISTRY.counter_value("ldt_swap_total",
+                                            result="error")
+    with pytest.raises(SwapError):
+        swap_artifact(svc, bad)
+    # the old artifact keeps serving, untouched
+    assert svc._tables is old_tables and svc._swap_count == 0
+    assert _detect(svc, [EN]) == ["en"]
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_swap_total", result="error") == err0 + 1
+
+
+def test_swap_cutover_fault_aborts(scalar_svc, artifact_copy):
+    svc = scalar_svc
+    old_tables = svc._tables
+    faults.configure("swap_cutover:error")
+    try:
+        with pytest.raises(SwapError):
+            swap_artifact(svc, artifact_copy)
+    finally:
+        faults.configure(None)
+    assert svc._tables is old_tables
+    assert _detect(svc, [EN]) == ["en"]
+    # a re-run with the fault disarmed succeeds
+    assert swap_artifact(svc, artifact_copy)["swapped"]
+
+
+# -- device-engine swap + breaker guard --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def device_svc():
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    ctrl = AdmissionController(AdmissionConfig(breaker_failures=2,
+                                               breaker_cooldown_sec=0.1))
+    svc = DetectorService(use_device=True, max_delay_ms=1.0,
+                          admission=ctrl)
+    if svc._engine is None:
+        pytest.skip("device engine unavailable")
+    yield svc
+    svc.batcher.close()
+
+
+def test_engine_swap_preserves_stats(device_svc, artifact_copy):
+    svc = device_svc
+    assert _detect(svc, [EN]) == ["en"]
+    old_eng = svc._engine
+    before = old_eng.stats_snapshot()
+    assert before["batches"] >= 1
+    info = swap_artifact(svc, artifact_copy)
+    assert info["engine"]
+    assert svc._engine is not old_eng
+    # counters carried over: monotonic across the swap
+    after = svc._engine.stats_snapshot()
+    assert after["batches"] >= before["batches"]
+    assert _detect(svc, [EN]) == ["en"]
+
+
+def test_swap_refused_while_breaker_open(device_svc, artifact_copy):
+    svc = device_svc
+    br = svc.admission.breaker
+    br.record_failure()
+    br.record_failure()  # trips open (breaker_failures=2)
+    assert br.state == 2
+    count0 = svc._swap_count
+    with pytest.raises(SwapError, match="breaker"):
+        swap_artifact(svc, artifact_copy)
+    assert svc._swap_count == count0
+    # recover: cooldown, half-open probe, success closes it — swap ok
+    import time
+    time.sleep(0.15)
+    assert br.allow_device()
+    br.record_success(1.0)
+    assert br.state == 0
+    assert swap_artifact(svc, artifact_copy)["swapped"]
+
+
+# -- POST /swap, sync front --------------------------------------------------
+
+
+def _post_raw(url, data):
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else None
+
+
+@pytest.fixture(scope="module")
+def sync_front():
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+           "metrics_url":
+               f"http://127.0.0.1:{metricsd.server_address[1]}",
+           "svc": svc}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+
+
+def test_sync_post_swap(sync_front, artifact_copy, tmp_path):
+    murl = sync_front["metrics_url"]
+    status, body = _post_raw(
+        murl + "/swap", json.dumps({"path": artifact_copy}).encode())
+    assert status == 200 and body["swapped"]
+    # serving straight through the swap
+    status, body = _post_raw(
+        sync_front["url"],
+        json.dumps({"request": [{"text": EN}]}).encode())
+    assert status == 200
+    assert body["response"][0]["iso6391code"] == "en"
+    # contract errors: bad JSON 400, no path 400, corrupt artifact 409
+    status, body = _post_raw(murl + "/swap", b"{nope")
+    assert status == 400
+    status, body = _post_raw(murl + "/swap", b"{}")
+    assert status == 400 and "path" in body["error"]
+    bad = tmp_path / "bad.ldta"
+    bad.write_bytes(b"garbage")
+    status, body = _post_raw(
+        murl + "/swap", json.dumps({"path": str(bad)}).encode())
+    assert status == 409
+    # swap counters exported on the scrape
+    with urllib.request.urlopen(murl + "/metrics") as resp:
+        text = resp.read().decode()
+    assert 'ldt_swap_total{result="ok"}' in text
+    assert 'ldt_swap_total{result="error"}' in text
+
+
+def test_sync_post_swap_unknown_path_404(sync_front):
+    status, _ = _post_raw(sync_front["metrics_url"] + "/nope", b"{}")
+    assert status == 404
+
+
+# -- POST /swap, aio front ---------------------------------------------------
+
+
+def test_aio_post_swap(artifact_copy):
+    import asyncio
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    ports_q: _q.Queue = _q.Queue()
+    loop_holder = {}
+
+    def run_loop():
+        async def main():
+            loop_holder["loop"] = asyncio.get_running_loop()
+            ready = asyncio.get_running_loop().create_future()
+            svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                                  start_batcher=False)
+            task = asyncio.get_running_loop().create_task(
+                serve(0, 0, svc=svc, ready=ready))
+            ports_q.put(await ready)
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    port, mport = ports_q.get(timeout=30)
+    try:
+        status, body = _post_raw(
+            f"http://127.0.0.1:{mport}/swap",
+            json.dumps({"path": artifact_copy}).encode())
+        assert status == 200 and body["swapped"]
+        status, body = _post_raw(
+            f"http://127.0.0.1:{port}",
+            json.dumps({"request": [{"text": EN}]}).encode())
+        assert status == 200
+        assert body["response"][0]["iso6391code"] == "en"
+        status, body = _post_raw(
+            f"http://127.0.0.1:{mport}/swap", b"{}")
+        assert status == 400
+    finally:
+        loop = loop_holder.get("loop")
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)
+
+
+# -- warmup readiness gate + ready-file handshake ----------------------------
+
+
+def test_warmup_gates_readiness(monkeypatch):
+    monkeypatch.setenv("LDT_WARMUP", "1")
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    try:
+        rd = svc.readiness()
+        assert not rd["ok"] and not rd["warmed"]
+        ms = svc.warm()
+        assert ms > 0
+        rd = svc.readiness()
+        assert rd["ok"] and rd["warmed"] and rd["warmup_ms"] > 0
+    finally:
+        svc.batcher.close()
+
+
+def test_warmup_off_by_default():
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    try:
+        rd = svc.readiness()
+        assert rd["ok"] and rd["warmed"] and rd["warmup_ms"] == 0
+    finally:
+        svc.batcher.close()
+
+
+def test_startup_ready_task_writes_ready_file(monkeypatch, tmp_path):
+    ready = tmp_path / "ready.json"
+    monkeypatch.setenv("LDT_READY_FILE", str(ready))
+    monkeypatch.setenv("LDT_WARMUP", "1")
+    monkeypatch.setenv("LDT_WORKER_GENERATION", "7")
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    try:
+        startup_ready_task(svc, (1234, 5678))
+        doc = json.loads(ready.read_text())
+        assert doc["generation"] == 7 and doc["port"] == 1234
+        assert doc["metrics_port"] == 5678
+        assert doc["warmup_ms"] > 0
+        assert svc.readiness()["ok"]
+    finally:
+        svc.batcher.close()
+
+
+# -- compile-cache knob ------------------------------------------------------
+
+
+def test_compile_cache_dir_knob(monkeypatch, tmp_path):
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    import jax
+    old = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("LDT_COMPILE_CACHE_DIR", str(tmp_path))
+    try:
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        NgramBatchEngine()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)

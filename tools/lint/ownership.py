@@ -71,10 +71,15 @@ LOCK_OWNERSHIP: dict = {
                    "stalls_total")),
         "AdmissionController": _cl(
             lock="_lock",
-            attrs=("queue_docs", "queue_bytes", "inflight", "_shed"),
+            attrs=("queue_docs", "queue_bytes", "inflight", "_shed",
+                   "tenants"),
             held=("_occupancy", "_shed_out"),
             aliases={"ladder": "BrownoutLadder",
                      "breaker": "CircuitBreaker"}),
+        # FairScheduler is deliberately lock-free by OWNERSHIP, not by
+        # documentation per attribute: it is confined to the single
+        # batcher collector (thread or task) that created it — push and
+        # pop_batch never run concurrently
     },
     "language_detector_tpu/service/server.py": {
         "Metrics": _cl(
@@ -93,7 +98,8 @@ LOCK_OWNERSHIP: dict = {
             }),
         "DetectorService": _cl(
             lock="_log_lock",
-            attrs=("_num_processed", "_window_start"),
+            attrs=("_num_processed", "_window_start",
+                   "_inflight_http"),
             lockfree={
                 "_frag_cache": "per-code response fragments: value for "
                                "a key is a pure function of the key, so "
@@ -103,6 +109,25 @@ LOCK_OWNERSHIP: dict = {
                                     "(before handler threads exist), "
                                     "read-only afterwards by "
                                     "readiness()",
+                "_engine": "rebound atomically by swap_artifact under "
+                           "_swap_lock; every reader (detect closure, "
+                           "scalar fallback) takes ONE GIL-atomic "
+                           "reference per call, so in-flight flushes "
+                           "finish on the engine they captured",
+                "_tables": "same swap contract as _engine: one rebind "
+                           "under _swap_lock, one-reference-per-call "
+                           "readers",
+                "_artifact_path": "str rebound under _swap_lock; "
+                                  "readers tolerate either value",
+                "_swap_count": "int written only under _swap_lock; "
+                               "read as a single GIL-atomic load by "
+                               "stats surfaces",
+                "_warmed": "bool flips False->True exactly once by the "
+                           "warmup thread; readiness readers tolerate "
+                           "a stale False (fail-closed)",
+                "_warmup_ms": "float written once by the warmup "
+                              "thread before _warmed flips; readers "
+                              "see it only after the flip",
             }),
     },
     "language_detector_tpu/service/batcher.py": {
@@ -120,6 +145,9 @@ LOCK_OWNERSHIP: dict = {
             "_busy": "event-loop confined, same as _writers",
             "recycling": "bool flag set by the recycle watcher and read "
                          "by serve(), both on the event loop",
+            "draining": "bool flag set by the SIGTERM handler (runs on "
+                        "the loop via add_signal_handler) and read by "
+                        "serve(), both on the event loop",
         }),
         "AioBatcher": _cl(lockfree={
             "_cache": "ResultCache locks itself; flush workers and the "
