@@ -294,11 +294,126 @@ def bench(batch_size: int = 16384, n_batches: int = 6,
 
 
 
+def bench_multichip_child(n_devices: int) -> dict:
+    """Pooled multi-lane throughput over an n-device mesh (runs inside
+    the re-exec'd child: JAX_PLATFORMS/XLA_FLAGS/LDT_POOL_LANES are
+    already set). Lanes partition the mesh into sub-meshes; concurrent
+    submitters (one per lane) drive the pool the way the batcher's
+    widened flush workers do in the service."""
+    import threading
+
+    import jax
+
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    from language_detector_tpu.parallel.mesh import batch_mesh
+
+    mesh = batch_mesh(n_devices)
+    eng = NgramBatchEngine(mesh=mesh)
+    if eng.pool is None:
+        raise RuntimeError("pool off — LDT_POOL_LANES not handed down")
+    n_lanes = len(eng.pool.lanes)
+
+    batch = 4096
+    n_rounds = 3
+    # one distinct stream per submitter per round: the engine's
+    # batch-internal dedup would collapse repeated blocks
+    corpus = make_corpus(batch * n_lanes * n_rounds)
+    streams = [corpus[i * batch * n_rounds:(i + 1) * batch * n_rounds]
+               for i in range(n_lanes)]
+
+    # warm every lane's program: round-robin rotation covers the pool
+    for _ in range(n_lanes):
+        eng.detect_codes(corpus[:batch], batch_size=batch)
+
+    def run_once() -> float:
+        errors: list = []
+
+        def body(stream):
+            try:
+                eng.detect_codes(stream, batch_size=batch)
+            except BaseException as e:  # noqa: BLE001 - join surfaces it
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(s,)) for s in streams]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            raise errors[0]
+        return len(corpus) / (time.time() - t0)
+
+    runs = sorted(run_once() for _ in range(3))
+    docs_sec = runs[-1]
+    stats = eng.pool.stats()
+    return dict(
+        metric="multichip_pool_throughput",
+        value=round(docs_sec, 1),
+        unit="docs/sec",
+        vs_baseline=round(docs_sec / (PER_CHIP_TARGET * n_devices), 4),
+        detail=dict(
+            n_devices=n_devices,
+            n_lanes=n_lanes,
+            lane_mesh_size=stats["lane_mesh_size"],
+            lanes_active=stats["lanes_active"],
+            batch_size=batch,
+            rounds=n_rounds,
+            docs_total=len(corpus),
+            docs_sec_median=round(runs[len(runs) // 2], 1),
+            docs_sec_runs=[round(r, 1) for r in runs],
+            per_lane_dispatches={str(ln["lane"]): ln["dispatches"]
+                                 for ln in stats["lanes"]},
+            per_lane_ewma_ms={str(ln["lane"]): round(ln["ewma_ms"], 1)
+                              for ln in stats["lanes"]},
+            simulated=jax.devices()[0].platform == "cpu",
+        ),
+    )
+
+
+def run_multichip(n_devices: int) -> dict:
+    """Re-exec bench_multichip_child with an n-device virtual mesh and
+    the pool on (env must land before jax first imports), then write
+    MULTICHIP_r06.json at the repo root."""
+    import os
+    import subprocess
+    env = os.environ.copy()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={n_devices}")
+    env["LDT_POOL_LANES"] = str(max(2, n_devices // 2))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"),
+         "--multichip-child", str(n_devices)],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=900)
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("{"):
+            out = json.loads(line)
+            break
+    else:
+        raise RuntimeError(
+            f"multichip child produced no result (rc={r.returncode}): "
+            f"{r.stderr[-2000:]}")
+    with open(REPO / "MULTICHIP_r06.json", "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
 if __name__ == "__main__":
     # --profile DIR: wrap the run in a jax.profiler trace (open DIR with
     # tensorboard / xprof to see the device timeline per op)
     # --smoke: small fast configuration (CI sanity, not a benchmark)
-    if len(sys.argv) > 1 and sys.argv[1] == "--profile":
+    # --multichip [N]: pooled throughput over an N-device virtual mesh
+    if len(sys.argv) > 1 and sys.argv[1] == "--multichip":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        print(json.dumps(run_multichip(n)))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--multichip-child":
+        print(json.dumps(bench_multichip_child(int(sys.argv[2]))))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--profile":
         if len(sys.argv) < 3:
             sys.exit("usage: bench.py [--profile TRACE_DIR | --smoke]")
         import jax

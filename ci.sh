@@ -335,6 +335,166 @@ print("chaos:", served, "served /", statuses.count(500),
       "| generations", sorted(g for g in generations if g))
 EOF
 
+echo "== pool chaos smoke =="
+# the device-pool scheduler (parallel/pool.py) under lane chaos: a
+# SUPERVISED asyncio front with two simulated lanes and lost-batch +
+# stall injection armed on the lane seams. The invariants: every
+# request is a 2xx (lost-batch failover absorbs every injected loss —
+# no 5xx, no hang), at least one lane eviction exports, the evicted
+# lane re-admits through a half-open probe (lanes_active recovers to
+# 2 with the faults still armed), and SIGTERM exits 0.
+python3 - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+PORT, MPORT = 3181, 31811
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MPORT),
+    "LDT_POOL_LANES": "2",
+    "LDT_POOL_EVICT_FAILURES": "1",    # any injected loss evicts
+    "LDT_POOL_PROBE_COOLDOWN_SEC": "1",
+    "LDT_FAULTS": "lane_lost:error:p=0.2:seed=5,"
+                  "lane_stall:delay_ms=150:p=0.1:seed=6",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_pool_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+
+def post(tag, timeout=120):
+    # 80 DISTINCT docs per request: above the 64-doc all-C shortcut,
+    # unique across the run so batch dedup can't collapse the dispatch
+    body = json.dumps({"request": [
+        {"text": f"the quick brown fox jumps over the lazy dog "
+                 f"burst {tag} document {i}"} for i in range(80)
+    ]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{PORT}/", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except Exception:
+        return None
+
+
+def scrape():
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{MPORT}/metrics", timeout=10) as r:
+            return r.read().decode()
+    except Exception:
+        return ""
+
+
+def series_sum(text, prefix):
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
+
+
+try:
+    deadline = time.time() + 180
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{MPORT}/readyz", timeout=10) as r:
+                if r.status == 200:
+                    break
+        except Exception:
+            pass
+        assert time.time() < deadline, "worker never became ready"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+    statuses = []
+    lock = threading.Lock()
+
+    def burst(worker):
+        for i in range(8):
+            attempt_deadline = time.time() + 180
+            s = post(f"w{worker}r{i}")
+            while s is None:
+                assert time.time() < attempt_deadline, "request hung"
+                time.sleep(0.2)
+                s = post(f"w{worker}r{i}retry")
+            with lock:
+                statuses.append(s)
+
+    threads = [threading.Thread(target=burst, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "burst hung"
+
+    bad = [s for s in statuses if not 200 <= s < 300]
+    assert not bad, \
+        f"non-2xx under lane chaos (failover must absorb): {sorted(set(bad))}"
+
+    mtext = scrape()
+    evicted = series_sum(mtext, "ldt_pool_lane_evicted_total")
+    lost = series_sum(
+        mtext, 'ldt_fault_injected_total{point="lane_lost"}')
+    failovers = series_sum(mtext, "ldt_pool_failover_total")
+    assert lost and lost > 0, "lane_lost fault never fired"
+    assert failovers and failovers > 0, "no lost-batch failovers counted"
+    assert evicted and evicted > 0, \
+        f"no lane eviction under p=0.2 loss with evict_failures=1"
+
+    # recovery with the faults STILL ARMED: probes re-admit the evicted
+    # lane on healthy completions — drive traffic until both lanes are
+    # active again
+    deadline = time.time() + 120
+    i = 0
+    while True:
+        active = series_sum(scrape(), "ldt_pool_lanes_active")
+        if active == 2.0:
+            break
+        assert time.time() < deadline, \
+            f"evicted lane never re-admitted: lanes_active={active}"
+        post(f"recover{i}")
+        i += 1
+        time.sleep(0.1)
+    readmitted = series_sum(scrape(), "ldt_pool_lane_readmitted_total")
+
+    sup.send_signal(signal.SIGTERM)
+    rc = sup.wait(timeout=60)
+    assert rc == 0, f"supervisor exit {rc}"
+finally:
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+print("pool chaos:", len(statuses), "requests all 2xx,",
+      int(lost), "injected losses,", int(failovers), "failovers,",
+      int(evicted), "evictions,", int(readmitted or 0),
+      "re-admissions — lanes_active recovered to 2")
+EOF
+
 echo "== swap-drill smoke =="
 # blue/green hot swap under live traffic (docs/ROBUSTNESS.md): a
 # SUPERVISED asyncio front with LDT_REUSEPORT + warmup-gated readiness,

@@ -65,6 +65,14 @@ FAULT_POINTS: dict = {
     "standby_spawn": "service/supervisor swap drill, before the "
                      "standby generation is spawned (an error aborts "
                      "the drill; the old generation keeps serving)",
+    "lane_dispatch": "parallel/pool DevicePool.launch, before a batch "
+                     "dispatches on its chosen lane (an error fails "
+                     "over to the next lane in rotation)",
+    "lane_lost": "parallel/pool fetch path, the in-flight result fetch "
+                 "(an error loses the batch on that lane; the pool "
+                 "re-dispatches it on a surviving lane)",
+    "lane_stall": "parallel/pool fetch path, before the fetch (a delay "
+                  "models a straggler lane and triggers hedging)",
 }
 
 

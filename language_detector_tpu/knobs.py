@@ -177,6 +177,30 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Directory for JAX's persistent compilation cache "
        "(jax_compilation_cache_dir), set at engine init so restarted "
        "or standby worker generations start warm."),
+    # -- device-pool scheduler (parallel/pool.py) ---------------------
+    _k("LDT_POOL_LANES", "int", None,
+       "Dispatch-lane count for the fault-tolerant device pool. On a "
+       "mesh the devices partition into this many sub-meshes (one lane "
+       "each); on CPU the lanes share the single scorer (simulated "
+       "lanes for chaos tests). Unset/0 = no pool: dispatch takes "
+       "exactly the direct single-lane path.", bound=True),
+    _k("LDT_POOL_HEDGE_FACTOR", "float", 4.0,
+       "Straggler hedge threshold: a fetch slower than factor x the "
+       "lane's observed p95 latency re-dispatches the batch on another "
+       "healthy lane (first result wins). 0 disables hedging."),
+    _k("LDT_POOL_HEDGE_MIN_MS", "float", 500.0,
+       "Floor of the hedge threshold in ms, so cold lanes with "
+       "microsecond p95s don't hedge every warm launch."),
+    _k("LDT_POOL_EVICT_FAILURES", "int", 3,
+       "Consecutive fetch/dispatch failures that evict a lane from "
+       "rotation (per-lane circuit breaker)."),
+    _k("LDT_POOL_PROBE_COOLDOWN_SEC", "float", 5.0,
+       "Seconds an evicted lane waits before it may carry a half-open "
+       "probe batch; a successful probe re-admits the lane."),
+    _k("LDT_POOL_MAX_REDISPATCH", "int", 8,
+       "Failover budget per batch: how many lane attempts (initial + "
+       "re-dispatches) before the error surfaces to the batch's "
+       "futures."),
     # -- per-tenant isolation (service/admission.py) ------------------
     _k("LDT_TENANT_QUOTA_DOCS", "int", None,
        "Per-tenant cap on queued documents (X-LDT-Tenant header; "

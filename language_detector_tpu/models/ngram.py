@@ -98,6 +98,16 @@ class NgramBatchEngine:
                 import jax
                 jax.config.update("jax_compilation_cache_dir",
                                   cache_dir)
+                try:
+                    # the default min-compile-time floor skips caching
+                    # sub-second compiles — on the CPU simulator (and
+                    # for the smaller bucket-ladder programs) that is
+                    # ALL of them, which would leave a recycled worker
+                    # cold despite the cache dir
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 0)
+                except Exception:
+                    pass
             except Exception:
                 pass
         self.dt = DeviceTables.from_host(self.tables, self.reg)
@@ -111,6 +121,16 @@ class NgramBatchEngine:
         else:
             self._score_fn = score_chunks
             self._mesh_size = 1
+        # fault-tolerant dispatch pool (parallel/pool.py): built only
+        # when LDT_POOL_LANES is set; None = the direct single-lane
+        # launch path, byte-identical to the pool-less engine
+        from ..parallel import pool as pool_mod
+        self.pool = pool_mod.build_from_env(self._score_fn, mesh)
+        if self.pool is not None and mesh is not None:
+            # lanes score over SUB-meshes: pad/pack to the lane size,
+            # and point direct _score_fn users at lane 0's program
+            self._score_fn = self.pool.lanes[0].score_fn
+            self._mesh_size = self.pool.lane_mesh_size
         from .. import native
         if not native.available():
             raise RuntimeError(
@@ -157,33 +177,52 @@ class NgramBatchEngine:
 
     # -- device dispatch ----------------------------------------------------
 
-    def _launch(self, cb, lane: str = "main"):
-        """Launch the jitted scorer over a packed wire, metering compile
+    def _launch_raw(self, cb, lane: str = "main", score_fn=None):
+        """Launch a jitted scorer over a packed wire, metering compile
         events: the first execution of a new padded wire shape on a lane
         increments ldt_xla_compiles_total{lane=} and records the launch
         wall time (jit traces + compiles synchronously inside the
         dispatch call, so the elapsed time of a fresh-shape launch IS
         the compile cost; warm launches return in microseconds and are
-        not timed at all — the hot path stays one set lookup)."""
+        not timed at all — the hot path stays one set lookup).
+        score_fn: the pool passes each lane's own program; the compile
+        key carries its identity so per-lane first compiles meter as
+        compiles instead of hiding behind another lane's warm mark."""
+        if score_fn is None:
+            score_fn = self._score_fn
         # fault seam BEFORE first_seen: an injected launch error must
         # not consume the first-shape marker and mislabel the real
         # retry's compile as warm
         if faults.ACTIVE is not None:
             faults.hit("scorer_launch")
-        key = (self._mesh_size,
+        key = (self._mesh_size, id(score_fn),
                tuple(sorted((k, tuple(np.shape(v)))
                             for k, v in cb.wire.items())))
         if not telemetry.REGISTRY.compiles.first_seen(lane, key):
-            return self._score_fn(self.dt, cb.wire)
+            return score_fn(self.dt, cb.wire)
         if faults.ACTIVE is not None:
             faults.hit("compile")
         t0 = _time.monotonic()
-        fut = self._score_fn(self.dt, cb.wire)
+        fut = score_fn(self.dt, cb.wire)
         telemetry.REGISTRY.counter_inc("ldt_xla_compiles_total",
                                        lane=lane)
         telemetry.REGISTRY.histogram("ldt_xla_compile_ms", lane=lane) \
             .observe((_time.monotonic() - t0) * 1e3)
         return fut
+
+    def _launch(self, cb, lane: str = "main", trace=None):
+        """Dispatch a packed wire: the direct jitted launch when the
+        device pool is off (LDT_POOL_LANES unset — byte-identical to
+        the pool-less engine), else a pool-supervised launch whose
+        returned future carries straggler hedging and lost-batch
+        failover (parallel/pool.py). Every fetch site already uses
+        np.asarray(fut), which is exactly the pool future's supervised
+        entry point."""
+        if self.pool is None:
+            return self._launch_raw(cb, lane)
+        return self.pool.launch(
+            lambda pl: self._launch_raw(cb, lane, pl.score_fn),
+            trace=trace)
 
     def score_chunk_batch(self, cb) -> np.ndarray:
         """Run the jitted device program over a ChunkBatch; returns the
@@ -561,7 +600,8 @@ class NgramBatchEngine:
             cb = self._pack(txts)
             telemetry.observe_stage("pack", t0, trace=trace)
             d: list = []
-            vals = finish_fn(txts, cb, self._launch(cb, name),
+            vals = finish_fn(txts, cb, self._launch(cb, name,
+                                                    trace=trace),
                              deferred=d, trace=trace)
             for g, v in zip(idxs, vals):
                 out[g] = v
@@ -597,7 +637,7 @@ class NgramBatchEngine:
         retry_bins = {False: [], True: []}  # squeezed -> [(gidx, text)]
 
         def run_main(lane, idxs, txts, cb):
-            fut = self._launch(cb, lane)
+            fut = self._launch(cb, lane, trace=trace)
             d: list = []
             vals = finish_fn(txts, cb, fut, deferred=d, trace=trace)
             if d:
@@ -611,7 +651,7 @@ class NgramBatchEngine:
         def run_retry(idxs, txts, cb, flags):
             t0 = _time.monotonic()
             rows = unpack_chunks_out(
-                np.asarray(self._launch(cb, "retry")),
+                np.asarray(self._launch(cb, "retry", trace=trace)),
                 cb.wire["cmeta"])
             with self._stats_lock:
                 self.stats["device_dispatches"] += 1
@@ -802,14 +842,20 @@ class NgramBatchEngine:
             faults.hit("device_flush")
         t0 = _time.monotonic()
         rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
-        t0 = telemetry.observe_stage("dispatch", t0, trace=trace)
+        t1 = _time.monotonic()
         B = len(texts)
+        ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
+        t2 = _time.monotonic()
+        # stats and trace spans record only AFTER every fallible step
+        # (the device fetch and the native epilogue): when a pool
+        # failover or the batcher's failure path retries this dispatch,
+        # counters and spans must come out exactly once
+        telemetry.observe_stage("dispatch", t0, t1, trace=trace)
+        telemetry.observe_stage("epilogue", t1, t2, trace=trace)
         with self._stats_lock:
             self.stats["batches"] += 1
             self.stats["device_dispatches"] += 1
             self.stats["fallback_docs"] += int(cb.fallback[:B].sum())
-        ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
-        telemetry.observe_stage("epilogue", t0, trace=trace)
         patches: dict[int, ScalarResult] = {}
         need = np.flatnonzero(ep[:B, 12])
         if not need.size:

@@ -97,6 +97,21 @@ def initialize(coordinator_address: str | None = None,
     return True
 
 
+def addressable_pool_devices() -> list:
+    """Devices the fault-tolerant device pool (parallel/pool.py) may
+    form dispatch lanes over on THIS process. Lanes launch and fetch
+    independently per process — a lane spanning another host's chips
+    could never be dispatched from here — so on a multi-host cluster
+    the pool partitions the process's ADDRESSABLE devices, while the
+    single-host case (and the CPU simulator) uses them all. Pass the
+    result to mesh.batch_mesh(devices=...) before building the engine
+    when running pooled lanes under jax.distributed."""
+    import jax
+    if distributed_is_initialized():
+        return jax.local_devices()
+    return jax.devices()
+
+
 def local_batch_slice(global_batch: int) -> tuple[int, int]:
     """(start, size) of this process's document slice of a global batch:
     contiguous shares in process order, matching the contiguous shard

@@ -62,3 +62,20 @@ def sharded_score_chunks_fn(mesh: Mesh):
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for host->device transfer of packed batch arrays."""
     return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def lane_meshes(mesh: Mesh, n_lanes: int) -> list:
+    """Partition a batch mesh's devices into n_lanes equal contiguous
+    sub-meshes — the device pool's lanes (parallel/pool.py). Each lane
+    is an independent 1-D batch mesh over its share, so a lane failure
+    never touches the others' programs and the pool is mesh-count
+    agnostic: 8 devices serve 2 lanes of 4 or 4 lanes of 2 with the
+    same scoring program per lane. Devices beyond an even split are
+    dropped (a ragged lane would compile a second program set)."""
+    devs = list(mesh.devices.flat)
+    per = len(devs) // n_lanes
+    if per < 1:
+        raise ValueError(
+            f"cannot split {len(devs)} devices into {n_lanes} lanes")
+    return [Mesh(devs[i * per:(i + 1) * per], (BATCH_AXIS,))
+            for i in range(n_lanes)]

@@ -1,0 +1,502 @@
+"""Fault-tolerant device-pool scheduler: health-tracked dispatch lanes.
+
+ROADMAP item 1's prerequisite for real multi-chip serving: a pool of N
+dispatch lanes (one per mesh sub-group, or N simulated lanes sharing
+the single CPU scorer) that sits between the batchers' flush workers
+and the engine's jitted-scorer launches and makes the dispatch seam
+fault-tolerant end to end:
+
+  health tracking   each lane keeps an EWMA of fetch latency, a bounded
+                    sample ring for on-demand p95, a consecutive-failure
+                    count, and a last-completion timestamp
+  lane breaker      LDT_POOL_EVICT_FAILURES consecutive failures evict
+                    the lane from rotation; after
+                    LDT_POOL_PROBE_COOLDOWN_SEC it re-enters half-open
+                    (PROBING) and carries exactly one probe batch — a
+                    healthy probe re-admits it, a failed one re-evicts
+  straggler hedge   a fetch exceeding max(LDT_POOL_HEDGE_MIN_MS,
+                    LDT_POOL_HEDGE_FACTOR x lane p95) re-dispatches the
+                    batch on another healthy lane; the first result
+                    wins, the loser is cancelled and counted
+                    (ldt_pool_hedges_total{result=won|lost}), and the
+                    caller sees exactly one resolution
+  lost-batch path   a device/runtime error at dispatch or fetch fails
+                    the batch over to the next lane in rotation
+                    (ldt_pool_failover_total), bounded by
+                    LDT_POOL_MAX_REDISPATCH attempts and the trace's
+                    no_retry/deadline contract, before any error
+                    surfaces to the batch's futures
+
+The pool is OFF unless LDT_POOL_LANES is set: build_from_env returns
+None and models/ngram.py's `_launch` takes exactly the direct path —
+byte-identical single-lane behavior. When on, `_launch` returns a
+_PoolFuture whose `__array__` performs the supervised fetch, so every
+existing `np.asarray(fut)` fetch site (epilogue, retry lane, hinted
+detect) rides the recovery machinery without changing shape.
+
+jax is imported lazily (build_from_env, mesh lanes only): the module
+itself is importable anywhere in the service layer without touching
+the device runtime.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
+                                wait)
+
+import numpy as np
+
+from .. import faults, knobs, telemetry
+from ..locks import make_lock
+
+# lane states: ACTIVE lanes are in rotation; EVICTED lanes sit out
+# until their probe cooldown elapses; PROBING lanes carry exactly one
+# half-open probe batch whose outcome decides re-admission
+LANE_ACTIVE = 0
+LANE_EVICTED = 1
+LANE_PROBING = 2
+LANE_STATE_NAMES = ("active", "evicted", "probing")
+
+# minimum completed fetches before a lane's p95 is trusted enough to
+# hedge against (a cold lane's first samples are compile-dominated)
+HEDGE_MIN_SAMPLES = 5
+
+# bounded latency sample ring per lane (p95 on demand over the ring)
+LANE_SAMPLE_RING = 64
+
+
+class PoolExhausted(RuntimeError):
+    """Every failover attempt for a batch failed (or the trace's
+    no_retry/deadline contract forbade another attempt). Carries the
+    last lane error as __cause__; batch futures resolve with this —
+    a typed error, never a hang."""
+
+
+class Lane:
+    """One dispatch lane: a jitted scorer bound to a device sub-group
+    (or the shared CPU scorer) plus its health state. Mutable health
+    fields are owned by self._lock; the pool never holds two lane
+    locks at once."""
+
+    def __init__(self, idx: int, score_fn, mesh=None):
+        self.idx = idx
+        self.name = f"lane{idx}"
+        self.score_fn = score_fn
+        self.mesh = mesh
+        self._lock = make_lock("pool.lane")
+        self._state = LANE_ACTIVE
+        self._ewma_ms = 0.0
+        self._samples: list = []   # bounded ring of fetch latencies (ms)
+        self._sample_pos = 0
+        self._consecutive = 0
+        self._dispatches = 0
+        self._failures = 0
+        self._last_completion = 0.0
+        self._evicted_at = 0.0
+
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def record_success(self, elapsed_ms: float, now: float) -> bool:
+        """Fold one completed fetch into the health state. Returns True
+        when this success re-admitted a probing lane to rotation."""
+        with self._lock:
+            self._dispatches += 1
+            self._consecutive = 0
+            self._last_completion = now
+            self._ewma_ms = elapsed_ms if self._ewma_ms == 0.0 \
+                else 0.8 * self._ewma_ms + 0.2 * elapsed_ms
+            if len(self._samples) < LANE_SAMPLE_RING:
+                self._samples.append(elapsed_ms)
+            else:
+                self._samples[self._sample_pos] = elapsed_ms
+                self._sample_pos = (self._sample_pos + 1) \
+                    % LANE_SAMPLE_RING
+            readmitted = self._state == LANE_PROBING
+            if readmitted:
+                self._state = LANE_ACTIVE
+            return readmitted
+
+    def record_failure(self, now: float, evict_after: int) -> bool:
+        """Fold one failed dispatch/fetch in. Returns True when this
+        failure newly evicted the lane (a failed PROBE re-evicts but
+        does not re-count as an eviction)."""
+        with self._lock:
+            self._failures += 1
+            self._consecutive += 1
+            if self._state == LANE_PROBING:
+                self._state = LANE_EVICTED
+                self._evicted_at = now
+                return False
+            if self._state == LANE_ACTIVE \
+                    and self._consecutive >= max(evict_after, 1):
+                self._state = LANE_EVICTED
+                self._evicted_at = now
+                return True
+            return False
+
+    def probe_due(self, now: float, cooldown_sec: float) -> bool:
+        """Non-mutating peek: True when this lane is EVICTED with its
+        cooldown elapsed, i.e. the next dispatch through _pick_lane
+        would admit it as a half-open probe."""
+        with self._lock:
+            return self._state == LANE_EVICTED and \
+                now - self._evicted_at >= cooldown_sec
+
+    def try_begin_probe(self, now: float, cooldown_sec: float) -> bool:
+        """EVICTED -> PROBING when the cooldown elapsed; the caller owns
+        the single admitted probe batch."""
+        with self._lock:
+            if self._state != LANE_EVICTED:
+                return False
+            if now - self._evicted_at < cooldown_sec:
+                return False
+            self._state = LANE_PROBING
+            return True
+
+    def p95_ms(self):
+        """On-demand p95 over the sample ring; None below the hedge
+        sample floor."""
+        with self._lock:
+            n = len(self._samples)
+            if n < HEDGE_MIN_SAMPLES:
+                return None
+            s = sorted(self._samples)
+            return s[min(int(n * 0.95), n - 1)]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "lane": self.name,
+                "state": LANE_STATE_NAMES[self._state],
+                "ewma_ms": round(self._ewma_ms, 3),
+                "dispatches": self._dispatches,
+                "failures": self._failures,
+                "consecutive_failures": self._consecutive,
+                "last_completion": self._last_completion,
+            }
+
+
+class _PoolFuture:
+    """Handle for a pool-supervised dispatch. `__array__` runs the
+    supervised fetch (hedge + failover), so every np.asarray(fut) site
+    in the engine resolves through the pool; the result is memoized so
+    a double fetch can never re-dispatch (never double-resolved)."""
+
+    __slots__ = ("_pool", "lane", "raw", "launch_fn", "trace",
+                 "_result")
+
+    def __init__(self, pool, lane, raw, launch_fn, trace):
+        self._pool = pool
+        self.lane = lane
+        self.raw = raw
+        self.launch_fn = launch_fn
+        self.trace = trace
+        self._result = None
+
+    def __array__(self, dtype=None):
+        if self._result is None:
+            self._result = self._pool._fetch(self)
+        out = self._result
+        return out if dtype is None else np.asarray(out, dtype=dtype)
+
+
+class DevicePool:
+    """N health-tracked dispatch lanes with rotation, eviction,
+    half-open probing, straggler hedging, and lost-batch failover.
+
+    Thread-safety: the pool lock owns rotation state (the round-robin
+    cursor); each Lane owns its own health under its lane lock. Fetches
+    block on a private executor (sized to keep every lane's fetch plus
+    a hedge in flight) so a stalled device never wedges the caller past
+    the hedge threshold."""
+
+    def __init__(self, lanes: list, lane_mesh_size: int = 1,
+                 hedge_factor: float | None = None,
+                 hedge_min_ms: float | None = None,
+                 evict_failures: int | None = None,
+                 probe_cooldown_sec: float | None = None,
+                 max_redispatch: int | None = None,
+                 clock=None):
+        if not lanes:
+            raise ValueError("DevicePool needs at least one lane")
+        self.lanes = lanes
+        self.lane_mesh_size = lane_mesh_size
+        self.hedge_factor = knobs.get_float("LDT_POOL_HEDGE_FACTOR") \
+            if hedge_factor is None else hedge_factor
+        self.hedge_min_ms = knobs.get_float("LDT_POOL_HEDGE_MIN_MS") \
+            if hedge_min_ms is None else hedge_min_ms
+        self.evict_failures = knobs.get_int("LDT_POOL_EVICT_FAILURES") \
+            if evict_failures is None else evict_failures
+        self.probe_cooldown_sec = \
+            knobs.get_float("LDT_POOL_PROBE_COOLDOWN_SEC") \
+            if probe_cooldown_sec is None else probe_cooldown_sec
+        self.max_redispatch = knobs.get_int("LDT_POOL_MAX_REDISPATCH") \
+            if max_redispatch is None else max_redispatch
+        self._now = clock or time.monotonic
+        self._lock = make_lock("pool.rotation")
+        self._rr = 0
+        self._exec = ThreadPoolExecutor(
+            max(2 * len(lanes) + 2, 4),
+            thread_name_prefix="ldt-pool")
+
+    def close(self):
+        self._exec.shutdown(wait=False)
+
+    # -- lane selection -----------------------------------------------------
+
+    def _pick_lane(self, exclude=None):
+        """Next lane in rotation: ACTIVE lanes round-robin; an EVICTED
+        lane whose cooldown elapsed is admitted as a half-open probe.
+        When every lane is out of rotation the least-recently-evicted
+        lane is drafted anyway — work must go SOMEWHERE, and a fully
+        evicted pool behaves like the breaker-open path (errors surface
+        typed, the ladder sheds load upstream)."""
+        now = self._now()
+        with self._lock:
+            n = len(self.lanes)
+            for _ in range(n):
+                lane = self.lanes[self._rr % n]
+                self._rr += 1
+                if lane is exclude and n > 1:
+                    continue
+                if lane.state() == LANE_ACTIVE:
+                    return lane
+                if lane.try_begin_probe(now, self.probe_cooldown_sec):
+                    return lane
+            lane = self.lanes[self._rr % n]
+            self._rr += 1
+            if lane is exclude and n > 1:
+                lane = self.lanes[self._rr % n]
+                self._rr += 1
+            return lane
+
+    def _lane_failed(self, lane):
+        if lane.record_failure(self._now(), self.evict_failures):
+            telemetry.REGISTRY.counter_inc(
+                "ldt_pool_lane_evicted_total", lane=lane.name)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def launch(self, launch_fn, trace=None) -> _PoolFuture:
+        """Dispatch a batch on the pool: launch_fn(lane) must start the
+        device program on that lane and return its raw future. A launch
+        error (device lost, OOM at dispatch) counts against the lane
+        and fails over to the next in rotation. Returns a _PoolFuture;
+        the fetch side (np.asarray) carries hedging and lost-batch
+        recovery."""
+        last_err = None
+        lane = None
+        for _ in range(max(self.max_redispatch, 1)):
+            lane = self._pick_lane(exclude=lane)
+            try:
+                raw = self._launch_on(lane, launch_fn)
+            except Exception as e:  # noqa: BLE001 - any launch error fails over
+                self._lane_failed(lane)
+                last_err = e
+                continue
+            return _PoolFuture(self, lane, raw, launch_fn, trace)
+        raise PoolExhausted(
+            f"no lane accepted the dispatch after "
+            f"{max(self.max_redispatch, 1)} attempts") from last_err
+
+    def _launch_on(self, lane, launch_fn):
+        if faults.ACTIVE is not None:
+            faults.hit("lane_dispatch")
+        return launch_fn(lane)
+
+    # -- fetch: hedge + failover --------------------------------------------
+
+    def _fetch_on(self, lane, raw) -> np.ndarray:
+        """Blocking fetch of one raw future on one lane (executor
+        thread). Success and latency fold into the lane's health; a
+        probing lane's success re-admits it."""
+        if faults.ACTIVE is not None:
+            faults.hit("lane_stall")
+            faults.hit("lane_lost")
+        t0 = self._now()
+        out = np.asarray(raw)
+        if lane.record_success((self._now() - t0) * 1e3, self._now()):
+            telemetry.REGISTRY.counter_inc(
+                "ldt_pool_lane_readmitted_total", lane=lane.name)
+        return out
+
+    def _hedge_threshold_sec(self, lane, trace):
+        """Seconds to wait before hedging this lane's fetch, or None
+        when hedging is off (factor 0, no_retry flush, single lane, or
+        the lane lacks a trusted p95)."""
+        if not self.hedge_factor or self.hedge_factor <= 0:
+            return None
+        if len(self.lanes) < 2:
+            return None
+        if trace is not None and getattr(trace, "no_retry", False):
+            return None
+        p95 = lane.p95_ms()
+        if p95 is None:
+            return None
+        return max(self.hedge_min_ms, self.hedge_factor * p95) / 1e3
+
+    def _may_failover(self, trace) -> bool:
+        """The existing no_retry/deadline contract: a near-deadline or
+        brownout flush must not queue another device round — its error
+        surfaces immediately and the epilogue resolves scalar."""
+        if trace is None:
+            return True
+        if getattr(trace, "no_retry", False):
+            return False
+        dl = getattr(trace, "deadline", None)
+        if dl is not None and dl.expired():
+            return False
+        return True
+
+    def _await_result(self, lane, raw, pf) -> np.ndarray:
+        """One supervised wait on one lane's fetch, hedging onto a
+        second lane past the straggler threshold. Exactly one result
+        is returned; the losing future is cancelled and counted, and a
+        loser that still completes only updates lane health."""
+        fut = self._exec.submit(self._fetch_on, lane, raw)
+        thresh = self._hedge_threshold_sec(lane, pf.trace)
+        if thresh is None:
+            return fut.result()
+        done, _ = wait([fut], timeout=thresh)
+        if fut in done:
+            return fut.result()
+        hlane = self._pick_lane(exclude=lane)
+        if hlane is lane or hlane.state() != LANE_ACTIVE:
+            return fut.result()
+        try:
+            hraw = self._launch_on(hlane, pf.launch_fn)
+        except Exception:  # noqa: BLE001 - hedge launch failure falls back
+            self._lane_failed(hlane)
+            return fut.result()
+        hfut = self._exec.submit(self._fetch_on, hlane, hraw)
+        done, _ = wait([fut, hfut], return_when=FIRST_COMPLETED)
+        winner = fut if fut in done else hfut
+        loser = hfut if winner is fut else fut
+        # prefer a finished SUCCESS over a finished failure: when the
+        # straggler finally errored while the hedge succeeded (or the
+        # reverse), the caller gets the good result and the failure
+        # only feeds lane health
+        if winner.exception() is not None and loser.done() \
+                and loser.exception() is None:
+            winner, loser = loser, winner
+        loser.cancel()
+        if loser.done() and not loser.cancelled() \
+                and loser.exception() is not None:
+            self._lane_failed(hlane if loser is hfut else lane)
+        telemetry.REGISTRY.counter_inc(
+            "ldt_pool_hedges_total",
+            result="won" if winner is hfut else "lost")
+        return winner.result()
+
+    def _fetch(self, pf) -> np.ndarray:
+        """Supervised fetch for a _PoolFuture: hedge stragglers, catch
+        lane errors, and fail the batch over to surviving lanes until
+        the redispatch budget or the no_retry/deadline contract stops
+        it. Every error path raises (typed) — futures upstream always
+        resolve."""
+        lane, raw = pf.lane, pf.raw
+        budget = max(self.max_redispatch, 1)
+        attempts = 0
+        last_err = None
+        while True:
+            attempts += 1
+            try:
+                return self._await_result(lane, raw, pf)
+            except Exception as e:  # noqa: BLE001 - any fetch error is a lost batch
+                self._lane_failed(lane)
+                last_err = e
+                if not self._may_failover(pf.trace):
+                    raise
+            # lost batch: re-dispatch on the next lane in rotation
+            # (failed relaunches spend the same attempt budget)
+            relaunched = False
+            while attempts < budget:
+                telemetry.REGISTRY.counter_inc("ldt_pool_failover_total")
+                lane = self._pick_lane(exclude=lane)
+                try:
+                    raw = self._launch_on(lane, pf.launch_fn)
+                except Exception as e:  # noqa: BLE001 - relaunch error, next lane
+                    self._lane_failed(lane)
+                    last_err = e
+                    attempts += 1
+                    continue
+                relaunched = True
+                break
+            if not relaunched:
+                break
+        raise PoolExhausted(
+            f"batch lost after {attempts} lane attempts "
+            f"(budget {budget})") from last_err
+
+    # -- capacity & stats ---------------------------------------------------
+
+    def capacity(self) -> tuple:
+        """(lanes in rotation, lanes total); PROBING counts as in
+        rotation — it is carrying work."""
+        active = sum(1 for ln in self.lanes
+                     if ln.state() != LANE_EVICTED)
+        return active, len(self.lanes)
+
+    def capacity_load(self) -> float:
+        """Pool-capacity loss as an occupancy-scale load signal for the
+        brownout ladder (service/admission.py): 0.0 fully healthy, 0.6
+        at half the lanes evicted (ladder level 1), 1.2 fully evicted
+        (level 3 — shed, like a breaker-open worker)."""
+        active, total = self.capacity()
+        if total == 0:
+            return 0.0
+        return 1.2 * (total - active) / total
+
+    def wants_probe(self) -> bool:
+        """True when some evicted lane's cooldown has elapsed and no
+        probe is already in flight. Half-open probes are traffic-driven
+        (_pick_lane only re-admits on a dispatch), so upstream load
+        shedding must let ONE request through a full-shed brownout as
+        the probe vehicle — shedding everything would turn a fully
+        evicted pool into a self-sustaining outage (the ladder sheds
+        because the pool is down, and the pool stays down because
+        everything sheds)."""
+        now = self._now()
+        due = False
+        for lane in self.lanes:
+            state = lane.state()
+            if state == LANE_PROBING:
+                return False
+            if state == LANE_EVICTED and \
+                    lane.probe_due(now, self.probe_cooldown_sec):
+                due = True
+        return due
+
+    def stats(self) -> dict:
+        active, total = self.capacity()
+        return {
+            "lanes_total": total,
+            "lanes_active": active,
+            "lane_mesh_size": self.lane_mesh_size,
+            "lanes": [ln.snapshot() for ln in self.lanes],
+        }
+
+
+def build_from_env(default_score_fn, mesh=None):
+    """Build the pool the LDT_POOL_* knobs describe, or None when
+    LDT_POOL_LANES is unset/0 (pool off: the engine dispatches exactly
+    as before). With a mesh, devices partition into one sub-mesh per
+    lane (parallel/mesh.lane_meshes) and each lane gets its own
+    shard_map'd scorer; without one, N simulated lanes share
+    default_score_fn — same scheduler, same chaos seams, single
+    device."""
+    n = knobs.get_int("LDT_POOL_LANES")
+    if not n:
+        return None
+    if mesh is not None:
+        from .mesh import lane_meshes, sharded_score_chunks_fn
+        meshes = lane_meshes(mesh, n)
+        lanes = [Lane(i, sharded_score_chunks_fn(m), mesh=m)
+                 for i, m in enumerate(meshes)]
+        lane_mesh_size = len(list(meshes[0].devices.flat))
+    else:
+        lanes = [Lane(i, default_score_fn) for i in range(n)]
+        lane_mesh_size = 1
+    return DevicePool(lanes, lane_mesh_size=lane_mesh_size)

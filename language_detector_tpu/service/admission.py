@@ -363,11 +363,11 @@ class Admit:
     front should send."""
 
     __slots__ = ("shed", "status", "reason", "message", "retry_after",
-                 "level", "degrade", "docs", "cost", "tenant")
+                 "level", "degrade", "docs", "cost", "tenant", "probe")
 
     def __init__(self, shed, status, reason, message, retry_after,
                  level, degrade, docs, cost,
-                 tenant: str = DEFAULT_TENANT):
+                 tenant: str = DEFAULT_TENANT, probe: bool = False):
         self.shed = shed
         self.status = status
         self.reason = reason
@@ -378,6 +378,10 @@ class Admit:
         self.docs = docs
         self.cost = cost
         self.tenant = tenant
+        # probe vehicle (pool half-open probe through a full-shed
+        # brownout): the fronts must serve it on the FULL device path —
+        # degraded mode and no_retry would defeat the probe
+        self.probe = probe
 
 
 _SHED_MESSAGES = {
@@ -408,6 +412,10 @@ class AdmissionController:
             stall_factor=c.breaker_stall_factor,
             stall_min_ms=c.breaker_stall_min_ms)
         self._lock = make_lock("admission.controller")
+        # zero-arg provider returning the engine's DevicePool (or None);
+        # a provider (not the pool itself) so a zero-downtime artifact
+        # swap that rebuilds the engine is picked up automatically
+        self.pool = None
         self.queue_docs = 0
         self.queue_bytes = 0
         self.inflight = 0
@@ -422,10 +430,19 @@ class AdmissionController:
             telemetry.REGISTRY.counter_inc("ldt_shed_total", 0,
                                            reason=reason)
         telemetry.REGISTRY.counter_inc("ldt_deadline_expired_total", 0)
+        telemetry.REGISTRY.counter_inc("ldt_pool_probe_admits_total", 0)
 
     @classmethod
     def from_env(cls) -> "AdmissionController":
         return cls(AdmissionConfig.from_env())
+
+    def attach_pool(self, provider) -> None:
+        """Wire the device pool's capacity into the brownout ladder.
+        provider: zero-arg callable returning the current DevicePool or
+        None (pool disabled / scalar engine). Called once at service
+        build; reads happen inside _occupancy under the controller
+        lock."""
+        self.pool = provider
 
     def _occupancy(self, docs: int = 0, nbytes: int = 0,
                    inflight: int = 0) -> float:
@@ -443,7 +460,23 @@ class AdmissionController:
             occ = max(occ, (self.inflight + inflight) / c.max_inflight)
         if c.brownout_p95_ms:
             occ = max(occ, expected_flush_ms() / c.brownout_p95_ms)
+        if self.pool is not None:
+            pool = self.pool()
+            if pool is not None:
+                # lost dispatch capacity IS load: half the lanes
+                # evicted reads as 0.6 (brownout level 1), a fully
+                # evicted pool as 1.2 (level 3) — the ladder sheds
+                # what the surviving lanes cannot carry
+                occ = max(occ, pool.capacity_load())
         return occ
+
+    def _pool_probe_due(self) -> bool:
+        """Caller holds self._lock (same discipline as _occupancy's
+        pool read)."""
+        if self.pool is None:
+            return False
+        pool = self.pool()
+        return pool is not None and pool.wants_probe()
 
     def _shed_out(self, reason: str, status: int, level: int,
                   docs: int, cost: int, tenant: str) -> Admit:
@@ -467,12 +500,24 @@ class AdmissionController:
         cost = request_cost(texts)
         tenant = tenant or DEFAULT_TENANT
         c = self.config
+        probe_vehicle = False
         with self._lock:
             level = self.ladder.observe(
                 self._occupancy(docs, cost, 1))
             if level >= 3 and not priority:
-                return self._shed_out("brownout", 503, level, docs,
-                                      cost, tenant)
+                # full-shed exception: when the device pool owes a
+                # half-open probe, this request is admitted as the
+                # probe vehicle — probes are traffic-driven, so a
+                # blanket shed would leave a fully evicted pool (load
+                # 1.2 -> level 3) down forever (parallel/pool.py
+                # wants_probe)
+                if self._pool_probe_due():
+                    probe_vehicle = True
+                    telemetry.REGISTRY.counter_inc(
+                        "ldt_pool_probe_admits_total")
+                else:
+                    return self._shed_out("brownout", 503, level, docs,
+                                          cost, tenant)
             t_docs, t_bytes = self.tenants.get(tenant, (0, 0))
             if c.tenant_quota_docs is not None and \
                     t_docs + docs > c.tenant_quota_docs:
@@ -499,7 +544,8 @@ class AdmissionController:
             self.inflight += 1
             self.tenants[tenant] = [t_docs + docs, t_bytes + cost]
             return Admit(False, 200, None, None, 0, level,
-                         level >= 2, docs, cost, tenant)
+                         level >= 2 and not probe_vehicle, docs, cost,
+                         tenant, probe=probe_vehicle)
 
     def release(self, admit: Admit):
         """Return an admitted request's cost (fronts call from a

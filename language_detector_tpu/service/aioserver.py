@@ -22,8 +22,8 @@ from concurrent.futures import ThreadPoolExecutor
 from .. import faults, knobs, telemetry
 from .admission import (DeadlineExceeded, FairScheduler,
                         degraded_detect)
-from .batcher import (_FLUSH_WORKERS, _MISS, Batcher, ResultCache,
-                      _accepts_trace)
+from .batcher import (_MISS, Batcher, ResultCache, _accepts_trace,
+                      flush_workers)
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
                      health_response, parse_post_body, post_detect,
                      pre_detect)
@@ -55,7 +55,9 @@ class AioBatcher:
         # deficit-weighted fair queueing at dequeue (LDT_TENANT_WEIGHTS;
         # None = strict FIFO). Owned by the collector task alone.
         self._sched = FairScheduler.from_env()
-        self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
+        # widened with the device pool's lane count (batcher.py)
+        self._n_flush = flush_workers()
+        self._pool = ThreadPoolExecutor(self._n_flush,
                                         thread_name_prefix="ldt-aioflush")
         self._task: asyncio.Task | None = None
         # same LRU result cache as the sync Batcher (this front has no
@@ -100,7 +102,7 @@ class AioBatcher:
         loop = asyncio.get_running_loop()
         # bound in-flight flushes (executor queue would otherwise grow
         # unboundedly when the device falls behind)
-        slots = asyncio.Semaphore(_FLUSH_WORKERS + 1)
+        slots = asyncio.Semaphore(self._n_flush + 1)
         while True:
             sched = self._sched
             if sched is not None and sched.backlog:
@@ -441,7 +443,9 @@ class AioService:
                 trace.deadline = adm.deadline_from_header(
                     headers.get(b"x-ldt-deadline-ms"))
                 trace.tenant = admit.tenant
-                if admit.level >= 1:
+                if admit.level >= 1 and not admit.probe:
+                    # pool probe vehicles keep retry rights: a lost
+                    # probe batch must fail over, not 500
                     trace.no_retry = True
             try:
                 if admit is not None and admit.degrade:

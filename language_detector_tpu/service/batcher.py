@@ -30,7 +30,7 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
-from .. import faults, telemetry
+from .. import faults, knobs, telemetry
 from ..locks import make_lock
 from .admission import (DeadlineExceeded, FairScheduler,
                         note_deadline_expired)
@@ -38,6 +38,15 @@ from .admission import (DeadlineExceeded, FairScheduler,
 # concurrent flushes: >= 3 reaches the TPU tunnel's dispatch-overlap
 # ceiling (models/ngram.py's scheduler pool uses the same depth)
 _FLUSH_WORKERS = 3
+
+
+def flush_workers() -> int:
+    """Flush-worker count for both batchers: the fixed overlap depth,
+    widened when the device pool runs more lanes — N lanes can carry N
+    concurrent flushes (plus one accumulating), and a narrower worker
+    pool would idle healthy lanes exactly when a sick lane is being
+    covered for."""
+    return max(_FLUSH_WORKERS, (knobs.get_int("LDT_POOL_LANES") or 0) + 1)
 
 _MISS = object()  # cache sentinel: any real result (even None) differs
 
@@ -130,11 +139,12 @@ class Batcher:
         # None = strict FIFO). Owned by the collector thread alone.
         self._sched = FairScheduler.from_env()
         self._stop = threading.Event()
-        self._pool = ThreadPoolExecutor(_FLUSH_WORKERS,
+        nw = flush_workers()
+        self._pool = ThreadPoolExecutor(nw,
                                         thread_name_prefix="ldt-flush")
         # bound in-flight flushes so a backed-up device cannot pile
         # unbounded batches in memory
-        self._slots = threading.Semaphore(_FLUSH_WORKERS + 1)
+        self._slots = threading.Semaphore(nw + 1)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ldt-batcher")
         self._thread.start()

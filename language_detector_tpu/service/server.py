@@ -104,6 +104,10 @@ class Metrics:
         # DetectorService.readiness() dict or None (the /readyz
         # contract, exported as ldt_ready and /debug/vars "ready")
         self.readiness = lambda: None
+        # live device-pool gauge source (set when the engine runs a
+        # DevicePool): () -> parallel.pool.DevicePool.stats() dict or
+        # None (pool disabled — the gauges render 0)
+        self.pool_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -234,6 +238,14 @@ class Metrics:
                           v.get("queue_bytes", 0))
                          for t, v in sorted(
                              (ad.get("tenants") or {}).items())]))
+        # device-pool lane rotation (parallel/pool.py; the eviction /
+        # re-admission / failover / hedge counters are registry
+        # counters and render with the families below)
+        ps = self.pool_stats() or {}
+        fams.append(one("ldt_pool_lanes_total",
+                        ps.get("lanes_total", 0)))
+        fams.append(one("ldt_pool_lanes_active",
+                        ps.get("lanes_active", 0)))
         # readiness + supervision (docs/ROBUSTNESS.md): ldt_ready
         # mirrors /readyz, the generation gauge is set by the
         # supervisor through the child's environment
@@ -357,6 +369,20 @@ class DetectorService:
                 # the gauges live across hot swaps
                 metrics.engine_stats = \
                     lambda: self._engine.stats_snapshot()
+                # device-pool wiring (read through self._engine so a
+                # hot swap's rebuilt engine is picked up): lane gauges
+                # for /metrics, and lost lane capacity feeding the
+                # brownout ladder's load signal
+
+                def pool_of():
+                    return getattr(self._engine, "pool", None)
+
+                def pool_stats():
+                    p = pool_of()
+                    return p.stats() if p is not None else None
+
+                metrics.pool_stats = pool_stats
+                self.admission.attach_pool(pool_of)
 
                 def detect(texts, trace=None):
                     # codes-only engine path: the handler needs just the
@@ -684,7 +710,9 @@ class Handler(BaseHTTPRequestHandler):
             trace.deadline = adm.deadline_from_header(
                 self.headers.get("X-LDT-Deadline-Ms"))
             trace.tenant = admit.tenant
-            if admit.level >= 1:
+            if admit.level >= 1 and not admit.probe:
+                # pool probe vehicles keep retry rights: a lost probe
+                # batch must fail over, not 500 (admission.Admit.probe)
                 trace.no_retry = True
         try:
             if admit is not None and admit.degrade:

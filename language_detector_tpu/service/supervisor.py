@@ -23,9 +23,12 @@ restart it per policy (docs/ROBUSTNESS.md):
 
 Each spawned worker gets LDT_WORKER_GENERATION=<n> in its environment
 (1, 2, ...), which the fronts export as the ldt_worker_generation
-gauge, and every lifecycle event is one structured JSON log line with a
-"reason" field (recycle | crash | crash-loop | clean-exit | signal |
-swap | swap-abort).
+gauge, plus a shared LDT_COMPILE_CACHE_DIR (operator-set or a
+per-supervisor tempdir) so generation 2+ warms its bucket ladder from
+generation 1's persisted XLA compiles instead of recompiling cold.
+Every lifecycle event is one structured JSON log line with a "reason"
+field (recycle | crash | crash-loop | clean-exit | signal | swap |
+swap-abort).
 
 SIGHUP runs the blue/green swap drill (docs/ROBUSTNESS.md): spawn a
 STANDBY generation (LDT_SWAPPED=1, optionally pointed at a new
@@ -70,6 +73,23 @@ def main() -> int:
     backoff_max = knobs.get_float("LDT_CRASH_BACKOFF_MAX_SEC") or 30.0
     loop_window = knobs.get_float("LDT_CRASH_LOOP_WINDOW_SEC") or 60.0
     loop_max = knobs.get_int("LDT_CRASH_LOOP_MAX") or 5
+
+    # persistent-XLA-cache continuity across generations: every spawned
+    # generation (restart, recycle, blue/green standby) shares one
+    # compile-cache dir, so generation 2+ pre-compiles its bucket
+    # ladder from generation 1's persisted programs instead of paying
+    # the full cold-start compile (the dominant cost of readiness).
+    # An operator-set LDT_COMPILE_CACHE_DIR is honored as-is; otherwise
+    # a per-supervisor dir under tempdir keeps concurrent supervisors
+    # (tests, canaries) from sharing entries
+    cache_dir = knobs.get_str("LDT_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        cache_dir = os.path.join(
+            tempfile.gettempdir(), f"ldt-compile-cache-{os.getpid()}")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = None
 
     generation = 0
     consec_crashes = 0
@@ -138,6 +158,8 @@ def main() -> int:
         env["LDT_WORKER_GENERATION"] = str(gen)
         env["LDT_SWAPPED"] = "1"
         env["LDT_READY_FILE"] = ready_file
+        if cache_dir:
+            env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         if artifact:
             env["LDT_ARTIFACT_PATH"] = artifact
         standby = subprocess.Popen([sys.executable, "-m", module],
@@ -146,7 +168,7 @@ def main() -> int:
         timeout = knobs.get_float("LDT_SWAP_TIMEOUT_SEC") or 30.0
         deadline = st0 + timeout
         ready = False
-        while time.time() < deadline and not stopping:
+        while time.time() < deadline:
             if standby.poll() is not None:
                 # a standby that dies before ready (corrupt artifact,
                 # port clash) aborts the drill; old keeps serving
@@ -156,6 +178,12 @@ def main() -> int:
                 return
             if os.path.exists(ready_file):
                 ready = True
+                break
+            # the ready check comes FIRST: a SIGTERM racing the
+            # handshake must not abort a standby that already landed
+            # its ready file — the cutover completes and the main loop
+            # forwards the stop to the promoted generation
+            if stopping:
                 break
             time.sleep(0.05)
         if not ready:
@@ -198,6 +226,8 @@ def main() -> int:
         # through the registry
         env = dict(os.environ)  # ldt-lint: disable=knob-direct-env -- building the child environment, not reading config
         env["LDT_WORKER_GENERATION"] = str(generation)
+        if cache_dir:
+            env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         child = subprocess.Popen([sys.executable, "-m", module], env=env)
         if stopping:  # signal raced the spawn: stop the new worker too
             child.send_signal(signal.SIGTERM)

@@ -137,6 +137,32 @@ METRICS: dict = {
     "ldt_tenant_queue_bytes": (
         "gauge",
         "Byte-weighted admission cost currently held, per tenant."),
+    "ldt_pool_lane_evicted_total": (
+        "counter",
+        "Device-pool lanes evicted from rotation after consecutive "
+        "failures (parallel/pool.py), per lane."),
+    "ldt_pool_lane_readmitted_total": (
+        "counter",
+        "Evicted lanes re-admitted to rotation after a successful "
+        "half-open probe, per lane."),
+    "ldt_pool_failover_total": (
+        "counter",
+        "Batches re-dispatched on a surviving lane after a lost-batch "
+        "error on their original lane."),
+    "ldt_pool_hedges_total": (
+        "counter",
+        "Straggler hedges by outcome: result=won (the hedge answered "
+        "first) or result=lost (the original dispatch finished first)."),
+    "ldt_pool_probe_admits_total": (
+        "counter",
+        "Requests admitted through a full-shed brownout as the pool's "
+        "half-open probe vehicle (probes are traffic-driven; a blanket "
+        "shed would leave a fully evicted pool down forever)."),
+    "ldt_pool_lanes_active": (
+        "gauge",
+        "Device-pool lanes currently in rotation (active + probing)."),
+    "ldt_pool_lanes_total": (
+        "gauge", "Device-pool lane count (0 = pool disabled)."),
 }
 
 
@@ -616,6 +642,11 @@ def debug_vars(metrics=None) -> dict:
             r = ready_fn()
             if r is not None:
                 d["ready"] = r
+        pool_fn = getattr(metrics, "pool_stats", None)
+        if pool_fn is not None:
+            p = pool_fn()
+            if p:
+                d["pool"] = p
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

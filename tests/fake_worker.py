@@ -41,6 +41,8 @@ def main() -> int:
     print(json.dumps({
         "fake_worker_generation":
             os.environ.get("LDT_WORKER_GENERATION", "unset"),
+        "fake_worker_cache_dir":
+            os.environ.get("LDT_COMPILE_CACHE_DIR", "unset"),
     }), flush=True)
 
     exit_code = os.environ.get("FAKE_WORKER_EXIT")

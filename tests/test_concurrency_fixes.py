@@ -8,13 +8,21 @@ pass surfaced (and the lock-discipline analyzer now guards):
     live stats dict while flush workers mutated it;
   - BrownoutLadder: stats reporters read level/ema as two unlocked
     loads (torn read across a step) — snapshot() reads both under the
-    ladder's lock.
+    ladder's lock;
+  - NgramBatchEngine._epilogue: stats counters and trace spans recorded
+    BEFORE the fallible fetch/epilogue steps double-counted when the
+    pool's lost-batch failover (or the batcher's failure path) retried
+    the dispatch — everything now records after the last fallible step,
+    exactly once per successful epilogue.
 """
 from __future__ import annotations
 
 import json
 import threading
 
+import pytest
+
+from language_detector_tpu import native, telemetry
 from language_detector_tpu.locks import make_lock
 from language_detector_tpu.service import server as server_mod
 from language_detector_tpu.service.admission import BrownoutLadder
@@ -91,6 +99,52 @@ def test_stats_snapshot_survives_concurrent_mutation():
     finally:
         stop.set()
         w.join()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native packer unavailable")
+def test_epilogue_stats_and_spans_exactly_once(monkeypatch):
+    """A failed epilogue (device fetch error, native epilogue error —
+    exactly what a pool failover retries) must record NO stats and NO
+    trace spans; the successful retry of the same dispatch records each
+    exactly once."""
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+
+    eng = NgramBatchEngine()
+    texts = [f"plain english words for the exactly once epilogue "
+             f"regression number {i}" for i in range(8)]
+    cb, fut = eng._dispatch(texts)
+
+    real = native.epilogue_flat_native
+    state = {"fail": True}
+
+    def flaky(*a, **kw):
+        if state["fail"]:
+            state["fail"] = False
+            raise RuntimeError("injected epilogue failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(native, "epilogue_flat_native", flaky)
+    tr = telemetry.Trace()
+    before = eng.stats_snapshot()
+    with pytest.raises(RuntimeError, match="injected epilogue"):
+        eng._epilogue(texts, cb, fut, trace=tr)
+    mid = eng.stats_snapshot()
+    assert mid["batches"] == before["batches"]
+    assert mid["device_dispatches"] == before["device_dispatches"]
+    names = [s[0] for s in tr.spans]
+    assert "dispatch" not in names and "epilogue" not in names
+
+    # the retry of the SAME (cb, fut): counted exactly once
+    ep, _patches = eng._epilogue(texts, cb, fut, trace=tr)
+    assert ep.shape[0] >= len(texts)
+    after = eng.stats_snapshot()
+    assert after["batches"] == before["batches"] + 1
+    assert after["device_dispatches"] == \
+        before["device_dispatches"] + 1
+    names = [s[0] for s in tr.spans]
+    assert names.count("dispatch") == 1
+    assert names.count("epilogue") == 1
 
 
 def test_ladder_snapshot_is_atomic():

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
+import os
 import struct
 import threading
 import time
@@ -31,6 +32,7 @@ import numpy as np
 import pytest
 
 from language_detector_tpu import artifact, faults, native, telemetry
+from language_detector_tpu.parallel import pool as pool_mod
 from language_detector_tpu.service.admission import (AdmissionConfig,
                                                      AdmissionController)
 from language_detector_tpu.service.batcher import Batcher
@@ -650,3 +652,382 @@ def test_aio_accept_fault_drops_connection(aio_front):
     faults.configure(None)
     status, _ = _post(aio_front["url"], {"request": [{"text": EN}]})
     assert status == 200
+
+
+# -- device-pool scheduler chaos (parallel/pool.py) --------------------------
+#
+# The pool fixtures run 2 SIMULATED lanes sharing the one CPU scorer:
+# same rotation / eviction / failover scheduler the mesh lanes get,
+# exercised through the real fronts. Pool requests need more unique
+# docs than the all-C shortcut (TINY_BATCH_C_PATH=64) AND distinct
+# texts per request, so every request genuinely crosses the lane seams
+# instead of resolving via dedup or the result cache.
+
+
+def _pool_docs(tag: str) -> list:
+    return [f"the quick brown fox jumps over the lazy dog in burst "
+            f"{tag} document number {i}" for i in range(80)]
+
+
+_POOL_ENV = {"LDT_POOL_LANES": "2",
+             "LDT_POOL_HEDGE_FACTOR": "0",      # failover only: no
+             "LDT_POOL_EVICT_FAILURES": "5",    # hedge/evict noise in
+             "LDT_POOL_PROBE_COOLDOWN_SEC": "0.2",  # the storm stats
+             "LDT_POOL_MAX_REDISPATCH": "8"}
+
+
+def _set_pool_env():
+    saved = {k: os.environ.get(k) for k in _POOL_ENV}
+    os.environ.update(_POOL_ENV)
+    return saved
+
+
+def _restore_pool_env(saved):
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def pool_front():
+    """Threaded front over a 2-lane pooled engine. Env (not
+    monkeypatch): the knobs must be set before engine construction and
+    outlive every test of the module."""
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    saved = _set_pool_env()
+    try:
+        ctrl = AdmissionController(AdmissionConfig())
+        svc = DetectorService(use_device=True, max_delay_ms=1.0,
+                              admission=ctrl)
+        if svc._engine is None or svc._engine.pool is None:
+            pytest.skip("pooled device engine unavailable")
+        httpd, metricsd, svc = make_server(0, 0, service=svc)
+        threads = [threading.Thread(target=s.serve_forever, daemon=True)
+                   for s in (httpd, metricsd)]
+        for t in threads:
+            t.start()
+        yield {"url": f"http://127.0.0.1:{httpd.server_address[1]}",
+               "svc": svc, "ctrl": ctrl}
+        httpd.shutdown()
+        metricsd.shutdown()
+        svc.batcher.close()
+        svc._engine.pool.close()
+    finally:
+        _restore_pool_env(saved)
+
+
+@pytest.fixture(scope="module")
+def pool_aio_front():
+    """Asyncio front over its own 2-lane pooled engine (same side-
+    thread loop scaffolding as aio_front)."""
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    saved = _set_pool_env()
+    try:
+        ctrl = AdmissionController(AdmissionConfig())
+        svc = DetectorService(use_device=True, max_delay_ms=1.0,
+                              start_batcher=False, admission=ctrl)
+        if svc._engine is None or svc._engine.pool is None:
+            pytest.skip("pooled device engine unavailable")
+        ports_q: _q.Queue = _q.Queue()
+        loop_holder = {}
+
+        def run_loop():
+            async def main():
+                loop_holder["loop"] = asyncio.get_running_loop()
+                ready = asyncio.get_running_loop().create_future()
+                task = asyncio.get_running_loop().create_task(
+                    serve(0, 0, svc=svc, ready=ready))
+                ports_q.put(await ready)
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            try:
+                asyncio.run(main())
+            except RuntimeError:
+                pass  # loop.stop() teardown ends the run mid-await
+
+        t = threading.Thread(target=run_loop, daemon=True)
+        t.start()
+        port, _mport = ports_q.get(timeout=30)
+        yield {"url": f"http://127.0.0.1:{port}", "svc": svc}
+        loop = loop_holder.get("loop")
+        if loop is not None:
+            loop.call_soon_threadsafe(loop.stop)
+        svc._engine.pool.close()
+    finally:
+        _restore_pool_env(saved)
+
+
+def _pool_burst(url, tag_prefix, n_requests=5):
+    """Fire n concurrent pooled requests (distinct corpora) and return
+    their (status, body) results. A request that never resolves fails
+    the join timeout — the zero-lost-futures invariant."""
+    results: list = []
+    lock = threading.Lock()
+
+    def worker(w):
+        docs = _pool_docs(f"{tag_prefix}-{w}")
+        got = _post(url, {"request": [{"text": t} for t in docs]},
+                    timeout=120)
+        with lock:
+            results.append(got)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "request hung"
+    return results
+
+
+def test_pool_lane_lost_recovers_sync_front(pool_front):
+    """A deterministic lost batch mid-request fails over to the other
+    lane: the request still answers 200 with every doc resolved."""
+    url = pool_front["url"]
+    docs = _pool_docs("warm-sync")
+    payload = {"request": [{"text": t} for t in docs]}
+    status, body = _post(url, payload, timeout=120)  # warm compile
+    assert status == 200 and len(body["response"]) == len(docs)
+
+    fo0 = telemetry.REGISTRY.counter_value("ldt_pool_failover_total")
+    inj0 = telemetry.REGISTRY.counter_value(
+        "ldt_fault_injected_total", point="lane_lost")
+    faults.configure("lane_lost:error:once")
+    status, body = _post(url, payload, timeout=120)
+    assert status == 200
+    assert len(body["response"]) == len(docs)
+    assert body["response"][0]["iso6391code"] == "en"
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_fault_injected_total", point="lane_lost") == inj0 + 1
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_pool_failover_total") >= fo0 + 1
+
+
+def test_pool_lane_lost_midburst_sync_front(pool_front):
+    """Probabilistic lane_lost + lane_stall storm under a concurrent
+    burst: every request resolves 200 with a full result set (failover
+    absorbs the losses; nothing hangs, nothing is dropped)."""
+    url = pool_front["url"]
+    faults.configure("lane_lost:error:p=0.25:seed=9,"
+                     "lane_stall:delay_ms=20:p=0.2:seed=4")
+    results = _pool_burst(url, "storm-sync")
+    faults.configure(None)
+    assert len(results) == 5
+    for status, body in results:
+        assert status == 200
+        assert len(body["response"]) == 80
+        assert body["response"][0]["iso6391code"] == "en"
+    # recovery: a clean request after the storm
+    status, body = _post(
+        url, {"request": [{"text": t}
+                          for t in _pool_docs("post-sync")]},
+        timeout=120)
+    assert status == 200 and len(body["response"]) == 80
+
+
+def test_pool_lane_lost_midburst_aio_front(pool_aio_front):
+    """The same mid-burst invariant through the asyncio front: its
+    flush workers ride the identical pool seam."""
+    url = pool_aio_front["url"]
+    docs = _pool_docs("warm-aio")
+    status, body = _post(url, {"request": [{"text": t} for t in docs]},
+                         timeout=120)  # warm compile
+    assert status == 200 and len(body["response"]) == len(docs)
+
+    faults.configure("lane_lost:error:p=0.25:seed=11,"
+                     "lane_stall:delay_ms=20:p=0.2:seed=6")
+    results = _pool_burst(url, "storm-aio")
+    faults.configure(None)
+    assert len(results) == 5
+    for status, body in results:
+        assert status == 200
+        assert len(body["response"]) == 80
+        assert body["response"][0]["iso6391code"] == "en"
+    status, body = _post(
+        url, {"request": [{"text": t}
+                          for t in _pool_docs("post-aio")]},
+        timeout=120)
+    assert status == 200 and len(body["response"]) == 80
+
+
+# -- pool scheduler invariants (stub lanes, no HTTP) --------------------------
+
+
+class _Raw:
+    """Stub device future: __array__ delegates to a callable, exactly
+    the shape of a jax async result the pool fetches."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __array__(self, dtype=None):
+        out = np.asarray(self._fn())
+        return out if dtype is None else out.astype(dtype)
+
+
+def test_pool_straggler_hedge_wins_exactly_once():
+    """A fetch past the hedge threshold re-dispatches on the other
+    lane; the hedge's result wins, is counted once, and a second fetch
+    of the future cannot re-dispatch or re-resolve."""
+    lanes = [pool_mod.Lane(0, None), pool_mod.Lane(1, None)]
+    pool = pool_mod.DevicePool(lanes, hedge_factor=1.0, hedge_min_ms=1.0,
+                               evict_failures=5,
+                               probe_cooldown_sec=60.0,
+                               max_redispatch=2)
+    try:
+        for ln in lanes:  # trusted p95 (5ms) so the hedge arms
+            for _ in range(pool_mod.HEDGE_MIN_SAMPLES + 1):
+                ln.record_success(5.0, 0.0)
+        release = threading.Event()
+
+        def slow():
+            release.wait(10)
+            return np.array([1.0])
+
+        calls: list = []
+
+        def launch_fn(lane):
+            calls.append(lane.name)
+            return _Raw(slow) if len(calls) == 1 \
+                else _Raw(lambda: np.array([2.0]))
+
+        won0 = telemetry.REGISTRY.counter_value(
+            "ldt_pool_hedges_total", result="won")
+        pf = pool.launch(launch_fn)
+        out = np.asarray(pf)
+        assert out.tolist() == [2.0]  # the hedge's result won
+        assert len(calls) == 2 and calls[0] != calls[1]
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_pool_hedges_total", result="won") == won0 + 1
+        # memoized resolution: no re-dispatch, no double-resolve
+        assert np.asarray(pf).tolist() == [2.0]
+        assert len(calls) == 2
+    finally:
+        release.set()
+        pool.close()
+
+
+def test_pool_evicted_lane_readmits_via_probe():
+    """lane_lost chaos evicts both lanes (counted once each); after the
+    cooldown each lane carries a half-open probe whose success re-admits
+    it to rotation — capacity returns to full."""
+    clk = [0.0]
+    lanes = [pool_mod.Lane(0, None), pool_mod.Lane(1, None)]
+    pool = pool_mod.DevicePool(lanes, hedge_factor=0,
+                               evict_failures=2,
+                               probe_cooldown_sec=5.0,
+                               max_redispatch=2,
+                               clock=lambda: clk[0])
+    try:
+        ok = _Raw(lambda: np.arange(3))
+        ev0 = {ln.name: telemetry.REGISTRY.counter_value(
+            "ldt_pool_lane_evicted_total", lane=ln.name)
+            for ln in lanes}
+        re0 = {ln.name: telemetry.REGISTRY.counter_value(
+            "ldt_pool_lane_readmitted_total", lane=ln.name)
+            for ln in lanes}
+        faults.configure("lane_lost:error")
+        for _ in range(2):  # 2 failures per lane -> both evicted
+            with pytest.raises(pool_mod.PoolExhausted):
+                np.asarray(pool.launch(lambda lane: ok))
+        assert [ln.state() for ln in lanes] == \
+            [pool_mod.LANE_EVICTED] * 2
+        assert pool.capacity() == (0, 2)
+        assert pool.capacity_load() == pytest.approx(1.2)
+        for ln in lanes:
+            assert telemetry.REGISTRY.counter_value(
+                "ldt_pool_lane_evicted_total",
+                lane=ln.name) == ev0[ln.name] + 1
+
+        # heal the device, pass the cooldown: each lane's next launch
+        # is its half-open probe; the successful fetch re-admits it
+        faults.configure(None)
+        clk[0] = 6.0
+        for _ in range(4):
+            assert np.asarray(
+                pool.launch(lambda lane: ok)).tolist() == [0, 1, 2]
+            if pool.capacity() == (2, 2):
+                break
+        assert [ln.state() for ln in lanes] == \
+            [pool_mod.LANE_ACTIVE] * 2
+        assert pool.capacity() == (2, 2)
+        assert pool.capacity_load() == 0.0
+        for ln in lanes:
+            assert telemetry.REGISTRY.counter_value(
+                "ldt_pool_lane_readmitted_total",
+                lane=ln.name) == re0[ln.name] + 1
+    finally:
+        pool.close()
+
+
+def test_pool_brownout_rises_when_half_lanes_evicted():
+    """Pool-capacity loss feeds the admission brownout ladder: half the
+    lanes evicted lifts the load signal to 0.6 (level 1); a fully
+    evicted pool reads 1.2 and sheds like a breaker-open worker."""
+    clk = [0.0]
+    lanes = [pool_mod.Lane(0, None), pool_mod.Lane(1, None)]
+    pool = pool_mod.DevicePool(lanes, hedge_factor=0, evict_failures=1,
+                               probe_cooldown_sec=600.0,
+                               max_redispatch=1,
+                               clock=lambda: clk[0])
+    try:
+        ctrl = AdmissionController(AdmissionConfig(brownout_alpha=1.0))
+        ctrl.attach_pool(lambda: pool)
+
+        admit = ctrl.try_admit([EN])
+        assert not admit.shed
+        ctrl.release(admit)
+        assert ctrl.stats()["brownout_level"] == 0
+
+        lanes[0].record_failure(0.0, 1)  # evict half the pool
+        admit = ctrl.try_admit([EN])
+        assert not admit.shed
+        ctrl.release(admit)
+        assert ctrl.stats()["brownout_level"] == 1
+
+        lanes[1].record_failure(0.0, 1)  # pool fully evicted
+        admit = ctrl.try_admit([EN])
+        if not admit.shed:  # the shed decision uses the NEW level
+            ctrl.release(admit)
+        admit = ctrl.try_admit([EN])
+        assert admit.shed and admit.status == 503
+        assert ctrl.stats()["brownout_level"] == 3
+        # priority traffic still lands through a full brownout
+        admit = ctrl.try_admit([EN], priority=True)
+        assert not admit.shed
+        ctrl.release(admit)
+
+        # probe trickle: once an evicted lane's cooldown elapses, the
+        # full shed must admit a plain request as the probe vehicle —
+        # probes are traffic-driven, so a blanket 503 would leave the
+        # pool down forever
+        clk[0] = 601.0
+        before = telemetry.REGISTRY.counter_value(
+            "ldt_pool_probe_admits_total")
+        admit = ctrl.try_admit([EN])
+        assert not admit.shed
+        ctrl.release(admit)
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_pool_probe_admits_total") == before + 1
+        # once a probe is in flight (lane PROBING) the trickle closes —
+        # no second vehicle — and the probing lane counts as carrying
+        # work again, so the ladder steps down from full shed
+        assert lanes[0].try_begin_probe(clk[0], 600.0)
+        assert not pool.wants_probe()
+        admit = ctrl.try_admit([EN])
+        assert not admit.shed
+        ctrl.release(admit)
+        assert ctrl.stats()["brownout_level"] < 3
+    finally:
+        pool.close()

@@ -1,0 +1,319 @@
+"""Unit suite for the fault-tolerant device-pool scheduler
+(parallel/pool.py): rotation, lost-batch failover, exactly-once
+resolution, the no_retry/deadline contract, typed exhaustion, lane
+health (EWMA/p95/eviction), knob-driven construction, the batcher's
+flush-worker widening, and pool-on/pool-off engine equivalence.
+
+Scheduler tests drive DevicePool directly with stub lanes and stub
+device futures (constructor-injected config + clock, no env), so every
+state transition is deterministic; the HTTP-level chaos lives in
+test_faults.py."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from language_detector_tpu import native, telemetry
+from language_detector_tpu.parallel import pool as pool_mod
+from language_detector_tpu.parallel.pool import (DevicePool, Lane,
+                                                 PoolExhausted)
+from language_detector_tpu.service import batcher as batcher_mod
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native packer unavailable")
+
+
+class _Raw:
+    """Stub device future: __array__ delegates to a callable (the
+    shape of a jax async result)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __array__(self, dtype=None):
+        out = np.asarray(self._fn())
+        return out if dtype is None else out.astype(dtype)
+
+
+def _pool(n_lanes=2, **kw):
+    kw.setdefault("hedge_factor", 0)
+    kw.setdefault("evict_failures", 3)
+    kw.setdefault("probe_cooldown_sec", 60.0)
+    kw.setdefault("max_redispatch", 4)
+    return DevicePool([Lane(i, None) for i in range(n_lanes)], **kw)
+
+
+def _counter(name, **labels):
+    return telemetry.REGISTRY.counter_value(name, **labels)
+
+
+# -- rotation & dispatch ------------------------------------------------------
+
+
+def test_round_robin_rotation():
+    pool = _pool(3)
+    try:
+        used: list = []
+        for _ in range(6):
+            pf = pool.launch(lambda lane: _Raw(lambda: [0]))
+            used.append(pf.lane.idx)
+        assert used == [0, 1, 2, 0, 1, 2]
+    finally:
+        pool.close()
+
+
+def test_launch_error_fails_over_to_next_lane():
+    pool = _pool(2)
+    try:
+        calls: list = []
+
+        def launch_fn(lane):
+            calls.append(lane.idx)
+            if lane.idx == 0:
+                raise RuntimeError("device lost at dispatch")
+            return _Raw(lambda: np.array([7]))
+
+        pf = pool.launch(launch_fn)
+        assert calls == [0, 1]
+        assert pf.lane.idx == 1
+        assert np.asarray(pf).tolist() == [7]
+        # the failed dispatch fed lane 0's health
+        assert pool.lanes[0].snapshot()["consecutive_failures"] == 1
+        assert pool.lanes[1].snapshot()["consecutive_failures"] == 0
+    finally:
+        pool.close()
+
+
+def test_fetch_error_fails_over_and_counts():
+    pool = _pool(2)
+    try:
+        boom = _Raw(lambda: (_ for _ in ()).throw(
+            RuntimeError("fetch died")))
+        good = _Raw(lambda: np.array([1, 2]))
+        raws = {0: boom, 1: good}
+        fo0 = _counter("ldt_pool_failover_total")
+        pf = pool.launch(lambda lane: raws[lane.idx])
+        assert np.asarray(pf).tolist() == [1, 2]
+        assert _counter("ldt_pool_failover_total") == fo0 + 1
+        assert pool.lanes[0].snapshot()["consecutive_failures"] == 1
+    finally:
+        pool.close()
+
+
+def test_result_memoized_fetch_runs_exactly_once():
+    pool = _pool(2)
+    try:
+        fetches = [0]
+
+        def fn():
+            fetches[0] += 1
+            return np.array([3.0])
+
+        pf = pool.launch(lambda lane: _Raw(fn))
+        a = np.asarray(pf)
+        b = np.asarray(pf)
+        assert a.tolist() == b.tolist() == [3.0]
+        assert fetches[0] == 1  # never re-fetched, never re-dispatched
+    finally:
+        pool.close()
+
+
+# -- the no_retry / deadline contract -----------------------------------------
+
+
+class _Deadline:
+    def __init__(self, expired):
+        self._expired = expired
+
+    def expired(self):
+        return self._expired
+
+
+def test_no_retry_trace_blocks_failover():
+    pool = _pool(2)
+    try:
+        tr = telemetry.Trace()
+        tr.no_retry = True
+        boom = _Raw(lambda: (_ for _ in ()).throw(
+            RuntimeError("fetch died")))
+        fo0 = _counter("ldt_pool_failover_total")
+        pf = pool.launch(lambda lane: boom, trace=tr)
+        with pytest.raises(RuntimeError, match="fetch died"):
+            np.asarray(pf)
+        assert _counter("ldt_pool_failover_total") == fo0
+    finally:
+        pool.close()
+
+
+def test_expired_deadline_blocks_failover():
+    pool = _pool(2)
+    try:
+        tr = telemetry.Trace()
+        tr.deadline = _Deadline(expired=True)
+        boom = _Raw(lambda: (_ for _ in ()).throw(
+            RuntimeError("fetch died")))
+        fo0 = _counter("ldt_pool_failover_total")
+        pf = pool.launch(lambda lane: boom, trace=tr)
+        with pytest.raises(RuntimeError, match="fetch died"):
+            np.asarray(pf)
+        assert _counter("ldt_pool_failover_total") == fo0
+        # a live deadline keeps the failover path open
+        tr2 = telemetry.Trace()
+        tr2.deadline = _Deadline(expired=False)
+        raws = {0: boom, 1: _Raw(lambda: np.array([5]))}
+        pf = pool.launch(lambda lane: raws[lane.idx], trace=tr2)
+        assert np.asarray(pf).tolist() == [5]
+    finally:
+        pool.close()
+
+
+def test_exhausted_budget_raises_typed_with_cause():
+    pool = _pool(2, max_redispatch=3)
+    try:
+        boom = _Raw(lambda: (_ for _ in ()).throw(
+            RuntimeError("every lane dead")))
+        pf = pool.launch(lambda lane: boom)
+        with pytest.raises(PoolExhausted) as ei:
+            np.asarray(pf)
+        assert "budget 3" in str(ei.value)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+    finally:
+        pool.close()
+
+
+# -- lane health --------------------------------------------------------------
+
+
+def test_lane_ewma_and_p95():
+    lane = Lane(0, None)
+    assert lane.p95_ms() is None  # below the trust floor
+    for ms in (10.0, 10.0, 10.0, 10.0, 50.0):
+        lane.record_success(ms, 0.0)
+    assert lane.p95_ms() == 50.0
+    snap = lane.snapshot()
+    assert snap["dispatches"] == 5
+    assert 10.0 < snap["ewma_ms"] < 50.0
+
+
+def test_lane_eviction_probe_cycle_with_fake_clock():
+    lane = Lane(0, None)
+    assert lane.record_failure(0.0, evict_after=2) is False
+    assert lane.record_failure(0.0, evict_after=2) is True  # evicted
+    assert lane.state() == pool_mod.LANE_EVICTED
+    # cooldown not elapsed: no probe yet
+    assert lane.try_begin_probe(3.0, cooldown_sec=5.0) is False
+    assert lane.try_begin_probe(6.0, cooldown_sec=5.0) is True
+    assert lane.state() == pool_mod.LANE_PROBING
+    # a failed probe re-evicts WITHOUT recounting the eviction
+    assert lane.record_failure(6.0, evict_after=2) is False
+    assert lane.state() == pool_mod.LANE_EVICTED
+    # ...and a successful probe re-admits
+    assert lane.try_begin_probe(12.0, cooldown_sec=5.0) is True
+    assert lane.record_success(4.0, 12.0) is True
+    assert lane.state() == pool_mod.LANE_ACTIVE
+
+
+def test_capacity_load_scale():
+    pool = _pool(4, evict_failures=1)
+    try:
+        assert pool.capacity() == (4, 4)
+        assert pool.capacity_load() == 0.0
+        pool.lanes[0].record_failure(0.0, 1)
+        pool.lanes[1].record_failure(0.0, 1)
+        assert pool.capacity() == (2, 4)
+        assert pool.capacity_load() == pytest.approx(0.6)
+        pool.lanes[2].record_failure(0.0, 1)
+        pool.lanes[3].record_failure(0.0, 1)
+        assert pool.capacity_load() == pytest.approx(1.2)
+    finally:
+        pool.close()
+
+
+def test_fully_evicted_pool_still_dispatches():
+    """All lanes out of rotation: work is drafted onto an evicted lane
+    anyway (errors must surface typed upstream, not queue forever)."""
+    pool = _pool(2, evict_failures=1, probe_cooldown_sec=600.0)
+    try:
+        for ln in pool.lanes:
+            ln.record_failure(0.0, 1)
+        pf = pool.launch(lambda lane: _Raw(lambda: np.array([9])))
+        assert np.asarray(pf).tolist() == [9]
+    finally:
+        pool.close()
+
+
+def test_stats_shape():
+    pool = _pool(2)
+    try:
+        s = pool.stats()
+        assert s["lanes_total"] == 2 and s["lanes_active"] == 2
+        assert s["lane_mesh_size"] == 1
+        assert [ln["lane"] for ln in s["lanes"]] == ["lane0", "lane1"]
+        assert all(ln["state"] == "active" for ln in s["lanes"])
+    finally:
+        pool.close()
+
+
+# -- knob-driven construction & service wiring --------------------------------
+
+
+def test_build_from_env_off_by_default(monkeypatch):
+    monkeypatch.delenv("LDT_POOL_LANES", raising=False)
+    assert pool_mod.build_from_env(lambda *a: None) is None
+    monkeypatch.setenv("LDT_POOL_LANES", "0")
+    assert pool_mod.build_from_env(lambda *a: None) is None
+
+
+def test_build_from_env_simulated_lanes(monkeypatch):
+    monkeypatch.setenv("LDT_POOL_LANES", "3")
+    monkeypatch.setenv("LDT_POOL_MAX_REDISPATCH", "5")
+
+    def score(dt, wire):
+        return None
+
+    pool = pool_mod.build_from_env(score)
+    try:
+        assert pool is not None
+        assert len(pool.lanes) == 3
+        assert all(ln.score_fn is score for ln in pool.lanes)
+        assert pool.lane_mesh_size == 1
+        assert pool.max_redispatch == 5
+    finally:
+        pool.close()
+
+
+def test_flush_workers_widen_with_pool(monkeypatch):
+    monkeypatch.delenv("LDT_POOL_LANES", raising=False)
+    base = batcher_mod.flush_workers()
+    assert base == batcher_mod._FLUSH_WORKERS
+    monkeypatch.setenv("LDT_POOL_LANES", "8")
+    # enough flush workers to keep every lane fed plus one spare
+    assert batcher_mod.flush_workers() == max(base, 9)
+
+
+# -- engine equivalence (pool on == pool off) ---------------------------------
+
+
+@needs_native
+def test_engine_pool_answers_identical(monkeypatch):
+    """The acceptance invariant behind the default: a pooled engine
+    (simulated lanes, no faults) answers byte-identically to the
+    pool-off engine, and the pool-off engine has pool=None."""
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    docs = [f"the quick brown fox jumps over the lazy dog equivalence "
+            f"check number {i}" for i in range(80)]
+
+    monkeypatch.delenv("LDT_POOL_LANES", raising=False)
+    plain = NgramBatchEngine()
+    assert plain.pool is None
+    want = plain.detect_codes(docs)
+
+    monkeypatch.setenv("LDT_POOL_LANES", "2")
+    pooled = NgramBatchEngine()
+    try:
+        assert pooled.pool is not None
+        assert len(pooled.pool.lanes) == 2
+        assert pooled.detect_codes(docs) == want
+        assert pooled.pool.stats()["lanes_active"] == 2
+    finally:
+        pooled.pool.close()

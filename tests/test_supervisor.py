@@ -103,6 +103,42 @@ def test_generation_env_handed_to_children(tmp_path):
     assert gens == ["1", "2"]
 
 
+def test_compile_cache_dir_shared_across_generations(tmp_path):
+    """Every spawned generation gets the SAME LDT_COMPILE_CACHE_DIR in
+    its env (operator-set here), so generation 2+ warms its bucket
+    ladder from generation 1's persisted XLA compiles instead of
+    starting cold."""
+    marker = tmp_path / "recycled.marker"
+    cache = tmp_path / "xla-cache"
+    r = _run({"FAKE_WORKER_RECYCLE": str(marker),
+              "LDT_COMPILE_CACHE_DIR": str(cache)})
+    assert r.returncode == 0
+    dirs = [json.loads(line)["fake_worker_cache_dir"]
+            for line in r.stdout.splitlines()
+            if "fake_worker_cache_dir" in line]
+    assert dirs == [str(cache), str(cache)]
+    assert cache.is_dir()  # the supervisor created it up front
+
+
+def test_compile_cache_dir_defaults_per_supervisor(tmp_path):
+    """Without the operator knob the supervisor still hands every
+    generation one shared per-supervisor cache dir (continuity is the
+    default, not an opt-in)."""
+    marker = tmp_path / "recycled.marker"
+    env = dict(os.environ)
+    env.pop("LDT_COMPILE_CACHE_DIR", None)
+    env["FAKE_WORKER_RECYCLE"] = str(marker)
+    r = subprocess.run(SUPERVISOR, cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0
+    dirs = [json.loads(line)["fake_worker_cache_dir"]
+            for line in r.stdout.splitlines()
+            if "fake_worker_cache_dir" in line]
+    assert len(dirs) == 2
+    assert dirs[0] == dirs[1] != "unset"
+    assert "ldt-compile-cache" in dirs[0]
+
+
 # -- blue/green swap drill (SIGHUP) ------------------------------------------
 
 
@@ -224,6 +260,52 @@ def test_sighup_swap_artifact_pointer(tmp_path):
         out = _stop(proc)
     assert proc.returncode == 0, out
     assert "artifact pointer" in out and "swap-abort" in out
+
+
+# -- restart cold-start: shared persistent compile cache ---------------------
+
+
+# The exact warmup the fronts run under LDT_WARMUP (DetectorService
+# .warm()'s corpus), timed in a worker-like subprocess: generation 1
+# populates LDT_COMPILE_CACHE_DIR, generation 2 must start warm from it.
+_WARM_SNIPPET = r"""
+import json, time
+from language_detector_tpu.models.ngram import NgramBatchEngine
+eng = NgramBatchEngine()
+base = ("the quick brown fox jumps over the lazy dog ",
+        "el veloz murcielago hindu comia feliz cardillo ",
+        "portez ce vieux whisky au juge blond qui fume ")
+texts = [base[i % 3] * (1 + (i % 4) * 8) + str(i) for i in range(96)]
+t0 = time.monotonic()
+eng.detect_codes(texts)
+print(json.dumps({"warmup_ms": (time.monotonic() - t0) * 1e3}))
+"""
+
+
+def test_generation2_warmup_substantially_below_generation1(tmp_path):
+    """The restart cold-start fix end to end: two fresh processes (the
+    supervisor's generation 1 and 2) sharing one LDT_COMPILE_CACHE_DIR;
+    the second's warmup must come in far under the first's, because its
+    bucket-ladder programs deserialize from the persistent XLA cache
+    instead of recompiling."""
+    from language_detector_tpu import native
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LDT_COMPILE_CACHE_DIR"] = str(tmp_path / "xla-cache")
+    env.pop("LDT_POOL_LANES", None)
+
+    def generation() -> float:
+        r = subprocess.run([sys.executable, "-c", _WARM_SNIPPET],
+                           cwd=REPO, env=env, capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        return json.loads(r.stdout.splitlines()[-1])["warmup_ms"]
+
+    first = generation()
+    second = generation()
+    assert second < 0.6 * first, (first, second)
 
 
 @pytest.mark.parametrize("signum", [signal.SIGTERM, signal.SIGINT])

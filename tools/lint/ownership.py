@@ -74,6 +74,12 @@ LOCK_OWNERSHIP: dict = {
             attrs=("queue_docs", "queue_bytes", "inflight", "_shed",
                    "tenants"),
             held=("_occupancy", "_shed_out"),
+            lockfree={
+                "pool": "provider callable assigned at init / by "
+                        "attach_pool during service build (before "
+                        "traffic); the DevicePool it returns locks "
+                        "its own lane state",
+            },
             aliases={"ladder": "BrownoutLadder",
                      "breaker": "CircuitBreaker"}),
         # FairScheduler is deliberately lock-free by OWNERSHIP, not by
@@ -95,6 +101,8 @@ LOCK_OWNERSHIP: dict = {
                                    "assignment-at-init contract",
                 "readiness": "callable reference, same single-"
                              "assignment-at-init contract",
+                "pool_stats": "callable reference, same single-"
+                              "assignment-at-init contract",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
@@ -128,6 +136,43 @@ LOCK_OWNERSHIP: dict = {
                 "_warmup_ms": "float written once by the warmup "
                               "thread before _warmed flips; readers "
                               "see it only after the flip",
+            }),
+    },
+    "language_detector_tpu/parallel/pool.py": {
+        "DevicePool": _cl(
+            lock="_lock",
+            attrs=("_rr",),
+            lockfree={
+                "lanes": "list assigned once at init and never "
+                         "rebound; each Lane locks its own health "
+                         "state",
+                "lane_mesh_size": "int assigned once at init, "
+                                  "read-only afterwards",
+                "hedge_factor": "config scalar, init-assigned "
+                                "read-only",
+                "hedge_min_ms": "config scalar, init-assigned "
+                                "read-only",
+                "evict_failures": "config scalar, init-assigned "
+                                  "read-only",
+                "probe_cooldown_sec": "config scalar, init-assigned "
+                                      "read-only",
+                "max_redispatch": "config scalar, init-assigned "
+                                  "read-only",
+                "_exec": "ThreadPoolExecutor locks itself; submit is "
+                         "thread-safe",
+                "_now": "clock callable, init-assigned read-only",
+            }),
+        "Lane": _cl(
+            lock="_lock",
+            attrs=("_state", "_ewma_ms", "_samples", "_sample_pos",
+                   "_consecutive", "_dispatches", "_failures",
+                   "_last_completion", "_evicted_at"),
+            lockfree={
+                "idx": "int assigned once at init, read-only",
+                "name": "str assigned once at init, read-only",
+                "score_fn": "jitted callable, init-assigned read-only "
+                            "(jax jit dispatch is thread-safe)",
+                "mesh": "Mesh reference, init-assigned read-only",
             }),
     },
     "language_detector_tpu/service/batcher.py": {

@@ -50,6 +50,10 @@ NP_SYNC_FUNCS = frozenset({"asarray", "array"})
 # instance attributes holding jitted callables (models/ngram.py wires
 # self._score_fn to score_chunks or the shard_map'd variant)
 ATTR_JITTED = frozenset({"_score_fn"})
+# parameter names that carry a jitted scorer into a launch helper
+# (models/ngram._launch_raw receives the pool lane's program); any
+# plain-name call of one of these is audited like a jitted call
+PARAM_JITTED = frozenset({"score_fn"})
 # calls that produce a bucket-padded ChunkBatch (native packer seam)
 ALLOWED_PACKERS = frozenset({"pack_chunks_native", "_pack",
                              "_dispatch"})
@@ -332,7 +336,8 @@ def _check_shape_sources(sf, jitted: set, out: list):
                     if isinstance(node.func, ast.Name) else None
                 fattr = node.func.attr \
                     if isinstance(node.func, ast.Attribute) else None
-                if fname not in jitted and fattr not in ATTR_JITTED:
+                if fname not in jitted and fname not in PARAM_JITTED \
+                        and fattr not in ATTR_JITTED:
                     continue
                 if not node.args:
                     continue
