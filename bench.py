@@ -16,6 +16,14 @@ sys.path.insert(0, str(REPO))
 
 PER_CHIP_TARGET = 1_000_000 / 8  # docs/sec (BASELINE.json north star, v5e-8)
 
+# Budget for one full `python -m tools.lint` run (all analyzers, whole
+# tree, including the bounded model checker). ci.sh runs the suite on
+# every pass, so --smoke measures it and fails when it stops being
+# cheap; the live run is ~1.5s, so 30s absorbs a loaded CI host
+# without hiding a real regression (an accidental state-space blowup
+# in the model checker lands well past this).
+LINT_BUDGET_MS = 30_000
+
 # Self-contained corpus: service-sized snippets in several scripts; padded
 # with index salt so quad repeat filters see realistic variety.
 _SEEDS = [
@@ -420,7 +428,26 @@ if __name__ == "__main__":
         with jax.profiler.trace(sys.argv[2]):
             print(json.dumps(bench(http_bench=False)))
     elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
-        print(json.dumps(bench(batch_size=2048, n_batches=2,
-                               http_bench=False)))
+        # time the full static-analysis suite first (subprocess: its
+        # imports and the model checker's exploration must not warm or
+        # pollute this process) and hold it to LINT_BUDGET_MS — the
+        # suite runs on every CI pass, so "lint got slow" is a
+        # regression the smoke catches, not a vibe
+        import subprocess
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.lint"], cwd=str(REPO),
+            capture_output=True, text=True,
+            timeout=10 * LINT_BUDGET_MS / 1e3)
+        lint_ms = round((time.time() - t0) * 1e3, 1)
+        if r.returncode != 0:
+            sys.exit(f"bench --smoke: lint violations:\n"
+                     f"{r.stdout}{r.stderr}")
+        if lint_ms > LINT_BUDGET_MS:
+            sys.exit(f"bench --smoke: lint suite took {lint_ms:.0f}ms "
+                     f"(budget {LINT_BUDGET_MS}ms)")
+        out = bench(batch_size=2048, n_batches=2, http_bench=False)
+        out["detail"]["lint_ms"] = lint_ms
+        print(json.dumps(out))
     else:
         print(json.dumps(bench()))

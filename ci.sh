@@ -16,8 +16,13 @@ echo "== artifact verify =="
 python3 tools/artifact_tool.py --verify
 
 echo "== static analysis =="
-# AST lint (docs/STATIC_ANALYSIS.md): trace safety, lock discipline,
-# knob/metric/fault registries. Non-zero on any violation.
+# AST lint (docs/STATIC_ANALYSIS.md): trace safety, jit contracts,
+# lock discipline, knob/metric/fault registries, FSM conformance,
+# bounded model checking, future resolution. Non-zero on any
+# violation. CI always runs the FULL suite; `python3 -m tools.lint
+# --changed` is the git-diff-scoped variant for the local edit loop
+# (it can skip analyzers, never weaken them — registry or tools/lint
+# changes fall back to a full run).
 python3 -m tools.lint
 
 if python3 -c "import mypy" 2>/dev/null; then
@@ -53,7 +58,8 @@ print("bucketed scheduler:",
       "cache_hit_rate", d["cache_hit_rate"],
       "| tier_dispatches", d["tier_dispatches"],
       "| dedup_docs", d["mixed_dedup_docs"],
-      "| retry_lane_dispatches", d["mixed_retry_lane_dispatches"])
+      "| retry_lane_dispatches", d["mixed_retry_lane_dispatches"],
+      "| lint_ms", d["lint_ms"])
 EOF
 
 echo "== telemetry smoke =="

@@ -78,7 +78,7 @@ class Lane:
     fields are owned by self._lock; the pool never holds two lane
     locks at once."""
 
-    def __init__(self, idx: int, score_fn, mesh=None):
+    def __init__(self, idx: int, score_fn, mesh=None) -> None:
         self.idx = idx
         self.name = f"lane{idx}"
         self.score_fn = score_fn
@@ -155,7 +155,7 @@ class Lane:
             self._state = LANE_PROBING
             return True
 
-    def p95_ms(self):
+    def p95_ms(self) -> float | None:
         """On-demand p95 over the sample ring; None below the hedge
         sample floor."""
         with self._lock:
@@ -187,7 +187,8 @@ class _PoolFuture:
     __slots__ = ("_pool", "lane", "raw", "launch_fn", "trace",
                  "_result")
 
-    def __init__(self, pool, lane, raw, launch_fn, trace):
+    def __init__(self, pool: "DevicePool", lane: Lane, raw,
+                 launch_fn, trace) -> None:
         self._pool = pool
         self.lane = lane
         self.raw = raw
@@ -195,7 +196,7 @@ class _PoolFuture:
         self.trace = trace
         self._result = None
 
-    def __array__(self, dtype=None):
+    def __array__(self, dtype=None) -> np.ndarray:
         if self._result is None:
             self._result = self._pool._fetch(self)
         out = self._result
@@ -218,7 +219,7 @@ class DevicePool:
                  evict_failures: int | None = None,
                  probe_cooldown_sec: float | None = None,
                  max_redispatch: int | None = None,
-                 clock=None):
+                 clock=None) -> None:
         if not lanes:
             raise ValueError("DevicePool needs at least one lane")
         self.lanes = lanes
@@ -241,12 +242,12 @@ class DevicePool:
             max(2 * len(lanes) + 2, 4),
             thread_name_prefix="ldt-pool")
 
-    def close(self):
+    def close(self) -> None:
         self._exec.shutdown(wait=False)
 
     # -- lane selection -----------------------------------------------------
 
-    def _pick_lane(self, exclude=None):
+    def _pick_lane(self, exclude: Lane | None = None) -> Lane:
         """Next lane in rotation: ACTIVE lanes round-robin; an EVICTED
         lane whose cooldown elapsed is admitted as a half-open probe.
         When every lane is out of rotation the least-recently-evicted
@@ -272,7 +273,7 @@ class DevicePool:
                 self._rr += 1
             return lane
 
-    def _lane_failed(self, lane):
+    def _lane_failed(self, lane: Lane) -> None:
         if lane.record_failure(self._now(), self.evict_failures):
             telemetry.REGISTRY.counter_inc(
                 "ldt_pool_lane_evicted_total", lane=lane.name)
@@ -286,8 +287,8 @@ class DevicePool:
         and fails over to the next in rotation. Returns a _PoolFuture;
         the fetch side (np.asarray) carries hedging and lost-batch
         recovery."""
-        last_err = None
-        lane = None
+        last_err: Exception | None = None
+        lane: Lane | None = None
         for _ in range(max(self.max_redispatch, 1)):
             lane = self._pick_lane(exclude=lane)
             try:
@@ -301,14 +302,14 @@ class DevicePool:
             f"no lane accepted the dispatch after "
             f"{max(self.max_redispatch, 1)} attempts") from last_err
 
-    def _launch_on(self, lane, launch_fn):
+    def _launch_on(self, lane: Lane, launch_fn):
         if faults.ACTIVE is not None:
             faults.hit("lane_dispatch")
         return launch_fn(lane)
 
     # -- fetch: hedge + failover --------------------------------------------
 
-    def _fetch_on(self, lane, raw) -> np.ndarray:
+    def _fetch_on(self, lane: Lane, raw) -> np.ndarray:
         """Blocking fetch of one raw future on one lane (executor
         thread). Success and latency fold into the lane's health; a
         probing lane's success re-admits it."""
@@ -322,7 +323,7 @@ class DevicePool:
                 "ldt_pool_lane_readmitted_total", lane=lane.name)
         return out
 
-    def _hedge_threshold_sec(self, lane, trace):
+    def _hedge_threshold_sec(self, lane: Lane, trace) -> float | None:
         """Seconds to wait before hedging this lane's fetch, or None
         when hedging is off (factor 0, no_retry flush, single lane, or
         the lane lacks a trusted p95)."""
@@ -399,7 +400,7 @@ class DevicePool:
         lane, raw = pf.lane, pf.raw
         budget = max(self.max_redispatch, 1)
         attempts = 0
-        last_err = None
+        last_err: Exception | None = None
         while True:
             attempts += 1
             try:
@@ -417,6 +418,7 @@ class DevicePool:
                 lane = self._pick_lane(exclude=lane)
                 try:
                     raw = self._launch_on(lane, pf.launch_fn)
+                # ldt-lint: disable=future-consumer-guard -- handler re-enters the relaunch loop; every _fetch exit raises typed
                 except Exception as e:  # noqa: BLE001 - relaunch error, next lane
                     self._lane_failed(lane)
                     last_err = e
@@ -432,7 +434,7 @@ class DevicePool:
 
     # -- capacity & stats ---------------------------------------------------
 
-    def capacity(self) -> tuple:
+    def capacity(self) -> tuple[int, int]:
         """(lanes in rotation, lanes total); PROBING counts as in
         rotation — it is carrying work."""
         active = sum(1 for ln in self.lanes
@@ -479,7 +481,7 @@ class DevicePool:
         }
 
 
-def build_from_env(default_score_fn, mesh=None):
+def build_from_env(default_score_fn, mesh=None) -> "DevicePool | None":
     """Build the pool the LDT_POOL_* knobs describe, or None when
     LDT_POOL_LANES is unset/0 (pool off: the engine dispatches exactly
     as before). With a mesh, devices partition into one sub-mesh per

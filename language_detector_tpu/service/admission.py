@@ -327,6 +327,11 @@ class CircuitBreaker:
             self.record_failure(stalled=True)
             return
         with self._lock:
+            if self._state == BREAKER_OPEN:
+                # a straggler success from a flush dispatched before the
+                # trip must not close the breaker: OPEN only recovers
+                # through the cooldown -> half-open probe path
+                return
             self._consec = 0
             self._state = BREAKER_CLOSED
             self._probe_at = None

@@ -90,6 +90,23 @@ class AioBatcher:
     async def close(self):
         if self._task is not None:
             self._task.cancel()
+            try:
+                # wait for the collector's CancelledError handler to
+                # fail whatever batch it was accumulating — shutting
+                # down the executor under a live flush would orphan it
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        # enqueued-but-never-collected submissions: with the collector
+        # gone, nothing else will ever pop these off the queue
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            fut = item[-1]
+            if not fut.done():
+                fut.set_exception(RuntimeError("batcher closed"))
         if self._sched is not None:
             # the stash is collector-owned; with the collector
             # cancelled nothing else will ever resolve these futures
@@ -103,116 +120,132 @@ class AioBatcher:
         # bound in-flight flushes (executor queue would otherwise grow
         # unboundedly when the device falls behind)
         slots = asyncio.Semaphore(self._n_flush + 1)
-        while True:
-            sched = self._sched
-            if sched is not None and sched.backlog:
-                # stashed backlog exists: don't block on an empty
-                # queue, just sweep in whatever already arrived
-                try:
-                    first = await asyncio.wait_for(self._q.get(),
-                                                   self.max_delay)
-                except asyncio.TimeoutError:
-                    first = None
-            else:
-                first = await self._q.get()
-            pending = [first] if first is not None else []
-            n = len(first[0]) if first is not None else 0
-            deadline = loop.time() + self.max_delay
-            while n < self.max_batch and first is not None:
-                timeout = deadline - loop.time()
-                if timeout <= 0:
-                    break
-                try:
-                    nxt = await asyncio.wait_for(self._q.get(), timeout)
-                except asyncio.TimeoutError:
-                    break
-                pending.append(nxt)
-                n += len(nxt[0])
-            if sched is not None:
-                # fair queueing at dequeue: stash the sweep, pop the
-                # next batch in deficit-round-robin order; whatever a
-                # saturating tenant over-queued waits in its lane
-                for it in pending:
-                    sched.push(it)
-                pending = sched.pop_batch(self.max_batch)
+        pending: list = []
+        try:
+            while True:
+                sched = self._sched
+                if sched is not None and sched.backlog:
+                    # stashed backlog exists: don't block on an empty
+                    # queue, just sweep in whatever already arrived
+                    try:
+                        first = await asyncio.wait_for(self._q.get(),
+                                                       self.max_delay)
+                    except asyncio.TimeoutError:
+                        first = None
+                else:
+                    first = await self._q.get()
+                pending = [first] if first is not None else []
+                n = len(first[0]) if first is not None else 0
+                deadline = loop.time() + self.max_delay
+                while n < self.max_batch and first is not None:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        nxt = await asyncio.wait_for(self._q.get(), timeout)
+                    except asyncio.TimeoutError:
+                        break
+                    pending.append(nxt)
+                    n += len(nxt[0])
+                if sched is not None:
+                    # fair queueing at dequeue: stash the sweep, pop the
+                    # next batch in deficit-round-robin order; whatever a
+                    # saturating tenant over-queued waits in its lane
+                    for it in pending:
+                        sched.push(it)
+                    pending = sched.pop_batch(self.max_batch)
+                    if not pending:
+                        continue
+                if faults.ACTIVE is not None:
+                    # dequeue fault: fail THIS batch's waiters with the
+                    # typed error and keep collecting — the collector task
+                    # must survive any chaos profile (a wait_for-cancelled
+                    # future is done(); skip it)
+                    try:
+                        await faults.hit_async("queue_get")
+                    except faults.FaultInjected as e:
+                        for *_, fut in pending:
+                            if not fut.done():
+                                fut.set_exception(e)
+                        continue
+                # dequeue-time deadline check (shared with the sync
+                # Batcher: (texts, trace, fut) has the same tail) — expired
+                # requests fail with DeadlineExceeded before this flush
+                # takes a slot
+                pending = Batcher._drop_expired(pending)
                 if not pending:
                     continue
-            if faults.ACTIVE is not None:
-                # dequeue fault: fail THIS batch's waiters with the
-                # typed error and keep collecting — the collector task
-                # must survive any chaos profile (a wait_for-cancelled
-                # future is done(); skip it)
-                try:
-                    await faults.hit_async("queue_get")
-                except faults.FaultInjected as e:
-                    for *_, fut in pending:
+                await slots.acquire()
+                texts = [t for ts, _, _ in pending for t in ts]
+                # one flush-scoped trace shared by every traced request in
+                # the batch (same grafting contract as batcher.Batcher)
+                ftrace = telemetry.Trace() \
+                    if any(tr is not None for _, tr, _ in pending) else None
+                if ftrace is not None:
+                    ftrace.adopt_constraints(tr for _, tr, _ in pending)
+
+                def _resolve(results, pending=pending, ftrace=ftrace):
+                    i = 0
+                    for ts, tr, fut in pending:
                         if not fut.done():
-                            fut.set_exception(e)
-                    continue
-            # dequeue-time deadline check (shared with the sync
-            # Batcher: (texts, trace, fut) has the same tail) — expired
-            # requests fail with DeadlineExceeded before this flush
-            # takes a slot
-            pending = Batcher._drop_expired(pending)
-            if not pending:
-                continue
-            await slots.acquire()
-            texts = [t for ts, _, _ in pending for t in ts]
-            # one flush-scoped trace shared by every traced request in
-            # the batch (same grafting contract as batcher.Batcher)
-            ftrace = telemetry.Trace() \
-                if any(tr is not None for _, tr, _ in pending) else None
-            if ftrace is not None:
-                ftrace.adopt_constraints(tr for _, tr, _ in pending)
+                            if tr is not None and ftrace is not None:
+                                tr.graft(ftrace, depth=1)
+                            fut.set_result(results[i:i + len(ts)])
+                        i += len(ts)
 
-            def _resolve(results, pending=pending, ftrace=ftrace):
-                i = 0
-                for ts, tr, fut in pending:
-                    if not fut.done():
-                        if tr is not None and ftrace is not None:
-                            tr.graft(ftrace, depth=1)
-                        fut.set_result(results[i:i + len(ts)])
-                    i += len(ts)
+                if self._cache is not None:
+                    vals = [self._cache.get((None, t)) for t in texts]
+                    miss = [i for i, v in enumerate(vals) if v is _MISS]
+                    if not miss:
+                        slots.release()
+                        _resolve(vals)
+                        continue
+                else:
+                    vals, miss = None, None
+                miss_texts = texts if miss is None \
+                    else [texts[i] for i in miss]
+                if self._detect_takes_trace:
+                    task = loop.run_in_executor(
+                        self._pool,
+                        lambda mt=miss_texts, ft=ftrace:
+                            self._detect(mt, trace=ft))
+                else:
+                    task = loop.run_in_executor(self._pool, self._detect,
+                                                miss_texts)
 
-            if self._cache is not None:
-                vals = [self._cache.get((None, t)) for t in texts]
-                miss = [i for i, v in enumerate(vals) if v is _MISS]
-                if not miss:
+                def _done(ftr, pending=pending, vals=vals, miss=miss,
+                          texts=texts, miss_texts=miss_texts,
+                          _resolve=_resolve):
                     slots.release()
+                    err = ftr.exception()
+                    if err is not None:
+                        for _, _, fut in pending:
+                            if not fut.done():
+                                fut.set_exception(err)
+                        return
+                    results = ftr.result()
+                    if miss is None:
+                        _resolve(results)
+                        return
+                    for i, v in zip(miss, results):
+                        vals[i] = v
+                        self._cache.put((None, texts[i]), v, texts[i])
                     _resolve(vals)
-                    continue
-            else:
-                vals, miss = None, None
-            miss_texts = texts if miss is None \
-                else [texts[i] for i in miss]
-            if self._detect_takes_trace:
-                task = loop.run_in_executor(
-                    self._pool,
-                    lambda mt=miss_texts, ft=ftrace:
-                        self._detect(mt, trace=ft))
-            else:
-                task = loop.run_in_executor(self._pool, self._detect,
-                                            miss_texts)
-
-            def _done(ftr, pending=pending, vals=vals, miss=miss,
-                      texts=texts, miss_texts=miss_texts,
-                      _resolve=_resolve):
-                slots.release()
-                err = ftr.exception()
-                if err is not None:
-                    for _, _, fut in pending:
-                        if not fut.done():
-                            fut.set_exception(err)
-                    return
-                results = ftr.result()
-                if miss is None:
-                    _resolve(results)
-                    return
-                for i, v in zip(miss, results):
-                    vals[i] = v
-                    self._cache.put((None, texts[i]), v, texts[i])
-                _resolve(vals)
-            task.add_done_callback(_done)
+                task.add_done_callback(_done)
+                # ownership transferred: _done (which runs even if the
+                # loop dies) now answers these futures, so a subsequent
+                # cancellation must not double-claim them
+                pending = []
+        except asyncio.CancelledError:
+            # close() cancelled us mid-accumulation: answer the
+            # batch we were holding before the task dies, else its
+            # submitters hang until their wait_for timeouts (the
+            # futures of an already-dispatched flush are owned by
+            # _done and stay out of `pending`)
+            for *_, fut in pending:
+                if not fut.done():
+                    fut.set_exception(RuntimeError("batcher closed"))
+            raise
 
 
 def _http_response(status: int, body: bytes,

@@ -288,8 +288,11 @@ class Batcher:
 
     @staticmethod
     def _fail(pending: list, err: Exception):
+        # done() (not just cancelled()) so _fail is idempotent: the
+        # flush catch-all and the cancellation done-callback can both
+        # sweep a batch whose futures already resolved
         for *_, fut in pending:
-            if not fut.cancelled():
+            if not fut.done():
                 fut.set_exception(err)
 
     def _run_detect(self, texts: list, ftrace):
@@ -385,5 +388,10 @@ class Batcher:
                 if not fut.cancelled():
                     self._graft(tr, ftrace)
                     fut.set_result(plan)
+        except Exception as e:  # noqa: BLE001 - never orphan a waiter
+            # anything unexpected (graft, cache fill, a half-resolved
+            # batch) fails the REMAINING futures instead of leaving
+            # them to their submit timeouts; _fail skips resolved ones
+            self._fail(pending, e)
         finally:
             self._slots.release()

@@ -65,6 +65,40 @@ def _log(msg: str, **fields):
     print(json.dumps({"msg": msg, **fields}), flush=True)
 
 
+# Worker lifecycle states, declared in tools/lint/fsm_registry.py
+# (machine "supervisor-worker"): the `worker` local in main() tracks
+# which phase the current generation is in, and the conformance
+# analyzer proves every phase change below matches the declared table.
+WORKER_IDLE = 0      # no generation spawned yet
+WORKER_RUNNING = 1   # child alive, supervisor in the wait loop
+WORKER_STOPPED = 2   # signal-initiated stop: propagate rc
+WORKER_RECYCLED = 3  # planned self-recycle: respawn immediately
+WORKER_EXITED = 4    # clean exit 0: propagate
+WORKER_CRASHED = 5   # crash: propagate or backoff-respawn
+
+# Blue/green swap drill phases (machine "supervisor-swap-drill"):
+# tracked by the `drill` local in _swap_drill().
+DRILL_IDLE = 0      # drill requested, standby not spawned yet
+DRILL_SPAWNED = 1   # standby alive, waiting on the ready handshake
+DRILL_CUTOVER = 2   # standby ready: draining the old generation
+DRILL_PROMOTED = 3  # standby is now the supervised child
+DRILL_ABORTED = 4   # any failure: old generation keeps serving
+
+
+def _forward_stop(child, signaled, signum=None):
+    """Forward a stop signal to `child` exactly once across all three
+    forwarding sites (signal handler, spawn race, wait loop). Returns
+    the new already-signaled child. A repeat SIGTERM can land
+    mid-shutdown, after the worker's handler is gone, and turn a clean
+    drain into a SIGTERM death — hence the `signaled` latch."""
+    if child is not None and child is not signaled \
+            and child.poll() is None:
+        child.send_signal(signum if signum is not None
+                          else signal.SIGTERM)
+        return child
+    return signaled
+
+
 def main() -> int:
     module = sys.argv[1] if len(sys.argv) > 1 else \
         "language_detector_tpu.service.aioserver"
@@ -98,6 +132,7 @@ def main() -> int:
     stopping = False
     swap_requested = False
     signaled: subprocess.Popen | None = None  # child already SIGTERMed
+    worker = WORKER_IDLE
     t0 = time.time()
 
     # PID-1 duty (the Dockerfile CMD): forward SIGTERM/SIGINT to the
@@ -107,9 +142,7 @@ def main() -> int:
     def _forward(signum, frame):
         nonlocal stopping, signaled
         stopping = True
-        if child is not None and child.poll() is None:
-            child.send_signal(signum)
-            signaled = child
+        signaled = _forward_stop(child, signaled, signum)
 
     signal.signal(signal.SIGTERM, _forward)
     signal.signal(signal.SIGINT, _forward)
@@ -124,7 +157,8 @@ def main() -> int:
         signal.signal(signal.SIGHUP, _request_swap)
 
     def _swap_drill():
-        nonlocal child, generation, t0
+        nonlocal child, generation, t0, signaled
+        drill = DRILL_IDLE
         old = child
         gen = generation + 1
         _log("supervisor: swap drill starting", reason="swap",
@@ -136,6 +170,7 @@ def main() -> int:
                 with open(pointer) as f:
                     artifact = f.read().strip()
             except OSError as e:
+                drill = DRILL_ABORTED
                 _log("supervisor: swap aborted — artifact pointer "
                      "unreadable", reason="swap-abort",
                      pointer=pointer, error=repr(e))
@@ -144,6 +179,7 @@ def main() -> int:
             if faults.ACTIVE is not None:
                 faults.hit("standby_spawn")
         except faults.FaultInjected as e:
+            drill = DRILL_ABORTED
             _log("supervisor: swap aborted — injected fault",
                  reason="swap-abort", error=repr(e))
             return
@@ -164,6 +200,7 @@ def main() -> int:
             env["LDT_ARTIFACT_PATH"] = artifact
         standby = subprocess.Popen([sys.executable, "-m", module],
                                    env=env)
+        drill = DRILL_SPAWNED
         st0 = time.time()
         timeout = knobs.get_float("LDT_SWAP_TIMEOUT_SEC") or 30.0
         deadline = st0 + timeout
@@ -172,6 +209,7 @@ def main() -> int:
             if standby.poll() is not None:
                 # a standby that dies before ready (corrupt artifact,
                 # port clash) aborts the drill; old keeps serving
+                drill = DRILL_ABORTED
                 _log("supervisor: swap aborted — standby died before "
                      "ready", reason="swap-abort",
                      rc=standby.returncode, standby_generation=gen)
@@ -187,6 +225,7 @@ def main() -> int:
                 break
             time.sleep(0.05)
         if not ready:
+            drill = DRILL_ABORTED
             standby.kill()
             standby.wait()
             _log("supervisor: swap aborted — standby not ready "
@@ -196,11 +235,14 @@ def main() -> int:
         # cutover: standby is warmed and listening (share the port via
         # LDT_REUSEPORT for zero-drop) — drain the old generation
         # gracefully (SIGTERM: stop accepting, flush in-flight, exit 0)
+        drill = DRILL_CUTOVER
         _log("supervisor: swap cutover — draining old generation",
              reason="swap", generation=generation,
              standby_generation=gen)
-        if old.poll() is None:
-            old.send_signal(signal.SIGTERM)
+        # the drain shares the exactly-once latch: if a stop already
+        # SIGTERMed the old generation mid-drill, a second SIGTERM here
+        # could land after its handler is gone and kill the drain
+        signaled = _forward_stop(old, signaled)
         try:
             old.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
@@ -211,6 +253,7 @@ def main() -> int:
         except OSError:
             pass
         child = standby
+        drill = DRILL_PROMOTED
         generation = gen
         t0 = st0
         _log("supervisor: swap complete", reason="swap",
@@ -229,8 +272,9 @@ def main() -> int:
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         child = subprocess.Popen([sys.executable, "-m", module], env=env)
+        worker = WORKER_RUNNING
         if stopping:  # signal raced the spawn: stop the new worker too
-            child.send_signal(signal.SIGTERM)
+            signaled = _forward_stop(child, signaled)
         while True:
             try:
                 # short-poll wait so a SIGHUP swap request is noticed
@@ -246,9 +290,7 @@ def main() -> int:
                     # (a repeat can land mid-shutdown, after the
                     # worker's handler is gone, and turn a clean drain
                     # into a SIGTERM death)
-                    if child is not signaled and child.poll() is None:
-                        child.send_signal(signal.SIGTERM)
-                        signaled = child
+                    signaled = _forward_stop(child, signaled)
                 elif swap_requested:
                     swap_requested = False
                     _swap_drill()
@@ -257,22 +299,25 @@ def main() -> int:
                 continue
         uptime = round(time.time() - t0, 3)
         if stopping:
+            worker = WORKER_STOPPED
             _log("supervisor: worker stopped by signal — propagating",
                  reason="signal", rc=rc, generation=generation,
                  uptime_sec=uptime)
             return rc
         if rc == RECYCLE_EXIT_CODE:
             # planned recycle: healthy; restart now and forget crashes
+            worker = WORKER_RECYCLED
             consec_crashes = 0
             _log("supervisor: worker recycled", reason="recycle",
                  rc=rc, generation=generation, uptime_sec=uptime)
             continue
         if rc == 0:
+            worker = WORKER_EXITED
             _log("supervisor: worker exited cleanly — propagating",
                  reason="clean-exit", rc=rc, generation=generation,
                  uptime_sec=uptime)
             return rc
-        # crash
+        worker = WORKER_CRASHED
         if not restart_on_crash:
             _log("supervisor: worker crashed — propagating "
                  "(LDT_RESTART_ON_CRASH not set)", reason="crash",

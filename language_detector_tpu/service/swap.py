@@ -38,6 +38,18 @@ class SwapError(RuntimeError):
     but never tear anything down."""
 
 
+# In-process swap phases, declared in tools/lint/fsm_registry.py
+# (machine "artifact-swap"): the `swap` local in swap_artifact()
+# tracks the attempt, and the conformance analyzer proves the phase
+# changes match the declared table (e.g. REBOUND is only reachable
+# through LOADING — never from a refused precondition).
+SWAP_IDLE = 0     # under the swap lock, preconditions being checked
+SWAP_LOADING = 1  # fresh mmap + engine build in progress
+SWAP_REBOUND = 2  # service references rebound to the new artifact
+SWAP_REFUSED = 3  # precondition refused the swap (breaker open)
+SWAP_ABORTED = 4  # load/cutover failed; old tables keep serving
+
+
 def _swap_engine(svc, tables):
     """Build a new device engine over `tables` and rebind. Stats carry
     over so the ldt_engine_* counters stay monotonic across swaps."""
@@ -61,17 +73,20 @@ def swap_artifact(svc, path) -> dict:
     the POST /swap response."""
     from ..tables import ScoringTables
     path = str(path)
+    swap = SWAP_IDLE
     with svc._swap_lock:
         # a swap while the device is circuit-broken would compile the
         # new engine's ladder straight into the failing device — refuse
         # and let the operator retry once the breaker closes
         if svc._engine is not None and \
                 svc.admission.breaker.stats()["state"] == BREAKER_OPEN:
+            swap = SWAP_REFUSED
             telemetry.REGISTRY.counter_inc("ldt_swap_total",
                                            result="error")
             raise SwapError("swap refused: device circuit breaker is "
                             "open; retry once it closes")
         t0 = time.monotonic()
+        swap = SWAP_LOADING
         try:
             # FRESH mmap, never the process-wide cache: the whole point
             # is picking up new bytes at an already-seen path
@@ -83,11 +98,14 @@ def swap_artifact(svc, path) -> dict:
             else:
                 svc._tables = tables
         except SwapError:
+            swap = SWAP_ABORTED
             raise
         except Exception as e:
+            swap = SWAP_ABORTED
             telemetry.REGISTRY.counter_inc("ldt_swap_total",
                                            result="error")
             raise SwapError(f"swap aborted ({path}): {e}") from e
+        swap = SWAP_REBOUND
         svc._artifact_path = path
         svc._swap_count += 1
         count = svc._swap_count

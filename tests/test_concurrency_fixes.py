@@ -26,6 +26,7 @@ from language_detector_tpu import native, telemetry
 from language_detector_tpu.locks import make_lock
 from language_detector_tpu.service import server as server_mod
 from language_detector_tpu.service.admission import BrownoutLadder
+from language_detector_tpu.service.batcher import Batcher
 
 THREADS = 8
 PER_THREAD = 250
@@ -172,3 +173,134 @@ def test_ladder_snapshot_is_atomic():
     finally:
         stop.set()
         w.join()
+
+
+# -- orphaned futures & stop-signal delivery (PR 8 fixes) --------------------
+# surfaced by the future-resolution analyzer and the bounded model
+# checker (tools/lint/future_resolution.py, tools/lint/model_check.py)
+
+
+def test_breaker_straggler_success_keeps_open():
+    """A success from a flush dispatched BEFORE the breaker tripped
+    must not close it: OPEN only recovers through the cooldown ->
+    half-open probe path (the FSM table declares no OPEN->CLOSED)."""
+    from language_detector_tpu.service.admission import (
+        BREAKER_HALF_OPEN, BREAKER_OPEN, CircuitBreaker)
+
+    t = {"now": 1000.0}
+    b = CircuitBreaker(failures=2, cooldown_sec=10.0,
+                       clock=lambda: t["now"])
+    b.record_failure()
+    b.record_failure()
+    assert b.stats()["state"] == BREAKER_OPEN
+    b.record_success(5.0)  # straggler from the pre-trip flush
+    assert b.stats()["state"] == BREAKER_OPEN
+    assert not b.allow_device()
+    t["now"] += 10.1  # cooldown elapsed: the probe path still works
+    assert b.allow_device()
+    assert b.stats()["state"] == BREAKER_HALF_OPEN
+
+
+def test_batcher_fail_skips_resolved_futures():
+    """_fail guards on done(), not just cancelled(): sweeping a batch
+    whose futures already resolved must neither raise nor clobber."""
+    from concurrent.futures import Future
+
+    f1, f2 = Future(), Future()
+    f1.set_result(["kept"])
+    Batcher._fail([(["a"], None, None, f1), (["b"], None, None, f2)],
+                  RuntimeError("swept"))
+    assert f1.result(timeout=1) == ["kept"]
+    with pytest.raises(RuntimeError, match="swept"):
+        f2.result(timeout=1)
+
+
+def test_flush_resolution_error_fails_futures(monkeypatch):
+    """An exception INSIDE result resolution (graft, cache fill) must
+    fail the batch's futures instead of orphaning them until their
+    submit timeouts."""
+    b = Batcher(lambda texts: [{"ok": t} for t in texts],
+                max_delay_ms=1.0)
+    try:
+        monkeypatch.setattr(
+            b, "_graft",
+            lambda tr, ftrace: (_ for _ in ()).throw(
+                RuntimeError("resolution exploded")))
+        fut = b.submit(["hello"], trace=telemetry.Trace())
+        with pytest.raises(RuntimeError, match="resolution exploded"):
+            fut.result(timeout=10)
+    finally:
+        b.close()
+
+
+def test_aio_close_drains_enqueued_futures():
+    """Submissions sitting in the queue when the collector dies must
+    be failed by close(), not left to their wait_for timeouts."""
+    import asyncio
+
+    from language_detector_tpu.service.aioserver import AioBatcher
+
+    async def main():
+        b = AioBatcher(lambda ts: [None] * len(ts))
+        fut = asyncio.get_running_loop().create_future()
+        await b._q.put((["x"], None, fut))
+        await b.close()
+        assert isinstance(fut.exception(), RuntimeError)
+
+    asyncio.run(main())
+
+
+def test_aio_close_fails_accumulating_batch():
+    """Cancelling the collector mid-accumulation must answer the batch
+    it was holding (the CancelledError handler), not strand it."""
+    import asyncio
+
+    from language_detector_tpu.service.aioserver import AioBatcher
+
+    async def main():
+        # a 60s accumulation window guarantees the request is parked
+        # in the collector's pending list when close() lands
+        b = AioBatcher(lambda ts: [None] * len(ts), max_batch=64,
+                       max_delay_ms=60_000.0)
+        b.start()
+        task = asyncio.ensure_future(b.submit(["hello"]))
+        await asyncio.sleep(0.05)
+        await b.close()
+        with pytest.raises(RuntimeError, match="batcher closed"):
+            await task
+
+    asyncio.run(main())
+
+
+def test_forward_stop_exactly_once():
+    """The shared latch delivers SIGTERM exactly once per child across
+    all forwarding sites (handler re-entry, spawn race, wait loop) —
+    invariant (c) of tools/lint/model_check.py, unit-scale."""
+    import signal as signal_mod
+
+    from language_detector_tpu.service.supervisor import _forward_stop
+
+    class Child:
+        def __init__(self, alive=True):
+            self.alive = alive
+            self.signals = []
+
+        def poll(self):
+            return None if self.alive else 0
+
+        def send_signal(self, sig):
+            self.signals.append(sig)
+
+    c = Child()
+    signaled = _forward_stop(c, None)
+    assert c.signals == [signal_mod.SIGTERM] and signaled is c
+    # repeat signal re-enters the handler: latched, no second delivery
+    signaled = _forward_stop(c, signaled)
+    assert c.signals == [signal_mod.SIGTERM]
+    # a NEW generation (spawn race, drill cutover) gets its own one
+    c2 = Child()
+    signaled = _forward_stop(c2, signaled)
+    assert c2.signals == [signal_mod.SIGTERM] and signaled is c2
+    # an already-exited child is never signaled
+    c3 = Child(alive=False)
+    assert _forward_stop(c3, None) is None and c3.signals == []

@@ -1,0 +1,140 @@
+"""Tests for the bounded model checker (tools/lint/model_check.py).
+
+Three layers: the shipped products must exhaust their abstract state
+spaces with zero invariant failures (and do so deterministically — the
+checker runs under a fake clock with no randomness); the generic
+explorer must actually DETECT violations when handed a deliberately
+broken system; and the lint-facing check() wrapper must leave the
+process-wide fault configuration alone.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from tools.lint import model_check
+from tools.lint.model_check import FakeClock, _explore, run_product
+
+REPO = Path(__file__).resolve().parent.parent
+
+PRODUCT_NAMES = [p[0] for p in model_check.PRODUCTS]
+
+
+# -- the shipped products hold ------------------------------------------------
+
+
+@pytest.mark.parametrize("name", PRODUCT_NAMES)
+def test_product_exhausts_with_no_failures(name):
+    failures, n_states, exhausted = run_product(name)
+    assert failures == [], failures
+    assert exhausted, (f"{name}: exploration hit a safety cap after "
+                       f"{n_states} states — raise the bound or "
+                       f"shrink the abstraction")
+    assert n_states > 1  # the walk actually went somewhere
+
+
+@pytest.mark.parametrize("name", PRODUCT_NAMES)
+def test_product_exploration_is_deterministic(name):
+    a = run_product(name)
+    b = run_product(name)
+    assert a == b
+
+
+def test_products_cover_all_four_invariants():
+    """The ISSUE's four properties each map to a named invariant."""
+    invs = {i for p in model_check.PRODUCTS for i in p[5]}
+    assert "never-serve-while-open" in invs             # (a)
+    assert "evicted-pool-recovers" in invs              # (b)
+    assert "sigterm-at-most-once" in invs               # (c)
+    assert "sigterm-delivered" in invs                  # (c)
+    assert "probe-admitted-through-shed" in invs        # (d)
+
+
+# -- the explorer detects broken systems --------------------------------------
+
+
+class _BrokenLatch:
+    """A stop-forwarding latch with the exactly-once guard removed:
+    every forward call signals, so a repeated stop double-delivers."""
+
+    def __init__(self):
+        self.terms = 0
+        self.stopping = False
+
+    def stop(self):
+        self.stopping = True
+        self.terms += 1  # no latch: re-entry delivers again
+
+
+def test_explorer_catches_double_delivery():
+    failures, n_states, exhausted = _explore(
+        build=lambda: (_BrokenLatch(),),
+        events={"stop": lambda m: m.stop()},
+        key_fn=lambda m: (m.stopping, min(m.terms, 3)),
+        invariants={
+            "at-most-once": lambda m:
+                None if m.terms <= 1 else f"delivered {m.terms}x"},
+        max_depth=4)
+    assert exhausted
+    assert failures, "broken latch escaped the invariant"
+    inv, trace, detail = failures[0]
+    assert inv == "at-most-once"
+    assert trace == ("stop", "stop")  # minimal counterexample
+    assert "2x" in detail
+
+
+def test_explorer_event_returning_false_prunes():
+    """An event that reports itself inapplicable must prune that
+    branch, not record a new state."""
+
+    def build():
+        return ([0],)
+
+    failures, n_states, exhausted = _explore(
+        build=build,
+        events={"bump": lambda s: (s.__setitem__(0, s[0] + 1)
+                                   if s[0] < 2 else False)},
+        key_fn=lambda s: s[0],
+        invariants={"bounded": lambda s:
+                    None if s[0] <= 2 else "escaped the guard"},
+        max_depth=10)
+    assert failures == []
+    assert exhausted
+    assert n_states == 3  # 0, 1, 2 — the guard stopped the walk
+
+
+def test_fake_clock_is_the_only_time_source():
+    clk = FakeClock()
+    t0 = clk()
+    clk.advance(5.0)
+    assert clk() == t0 + 5.0
+    # the module itself never reads wall clock or randomness
+    src = (REPO / "tools/lint/model_check.py").read_text()
+    for banned in ("time.monotonic()", "time.time()", "random."):
+        assert banned not in src, banned
+
+
+# -- lint wrapper -------------------------------------------------------------
+
+
+def test_check_clean_and_restores_fault_config():
+    from language_detector_tpu import faults
+
+    faults.configure("queue_put:error:p=0.0")
+    try:
+        before = faults.ACTIVE
+        violations, n_sup = model_check.check(root=REPO)
+        assert violations == []
+        assert n_sup == 0
+        # the pool product configures lane faults internally; the
+        # process-wide config must come back untouched
+        assert faults.ACTIVE is before
+    finally:
+        faults.configure(None)
+
+
+def test_check_files_filter_scopes_products():
+    v, _ = model_check.check(
+        root=REPO, files=["language_detector_tpu/parallel/pool.py"])
+    assert v == []

@@ -1,17 +1,23 @@
-"""CLI: python -m tools.lint [--rule r1,r2] [--knob-table]
-[--write-knob-docs]
+"""CLI: python -m tools.lint [--rule r1,r2] [--changed]
+[--knob-table] [--write-knob-docs]
 
-Default run executes all five analyzers over the live tree and exits
+Default run executes all nine analyzers over the live tree and exits
 non-zero on any violation — ci.sh runs exactly this before the test
-suite.
+suite. ``--changed`` is the editor-loop mode: analyzers scope to the
+files git reports as modified (unstaged + staged + untracked), and the
+run silently widens back to a full sweep whenever a registry or
+analyzer file itself changed — an edited transition table must re-judge
+every conforming file, not just the table.
 """
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 
-from . import faults_registry, knob_registry, lock_discipline, \
-    metric_registry, trace_safety
+from . import faults_registry, fsm_registry, future_resolution, \
+    jit_contract, knob_registry, lock_discipline, metric_registry, \
+    model_check, trace_safety
 from .base import RULE_IDS, repo_root
 
 # analyzer -> the rule ids it can emit (every analyzer can additionally
@@ -19,6 +25,8 @@ from .base import RULE_IDS, repo_root
 ANALYZERS = (
     ("trace-safety", trace_safety.check,
      {"trace-host-sync", "trace-python-branch", "jit-shape-source"}),
+    ("jit-contract", jit_contract.check,
+     {"jit-donated-read", "jit-recompile-capture"}),
     ("lock-discipline", lock_discipline.check, {"lock-discipline"}),
     ("knob-registry", knob_registry.check,
      {"knob-direct-env", "knob-undeclared", "knob-docs-drift"}),
@@ -26,10 +34,61 @@ ANALYZERS = (
      {"metric-undeclared", "metric-undocumented", "metric-unused"}),
     ("fault-registry", faults_registry.check,
      {"fault-undeclared", "fault-undocumented", "fault-unused"}),
+    ("fsm-conformance", fsm_registry.check,
+     {"fsm-undeclared-transition", "fsm-dead-transition"}),
+    ("model-check", model_check.check, {"model-check-invariant"}),
+    ("future-resolution", future_resolution.check,
+     {"future-unresolved", "future-consumer-guard"}),
+)
+
+# analyzers whose scan set is a fixed file list: in --changed mode they
+# run over (changed ∩ scan set) and are skipped when that is empty
+_SCOPED = {
+    "trace-safety": lambda: set(trace_safety.SCAN_FILES),
+    "jit-contract": lambda: set(trace_safety.SCAN_FILES),
+    "future-resolution": lambda: set(future_resolution.SCAN_FILES),
+    "fsm-conformance": lambda: {m.file for m in fsm_registry.MACHINES},
+    "model-check": lambda: {p[1] for p in model_check.PRODUCTS},
+}
+
+# any change here invalidates incremental scoping wholesale: the
+# analyzers themselves, the registries they read, and the doc tables
+# the drift rules compare against
+_FULL_RUN_TRIGGERS = (
+    "tools/lint/",
+    "language_detector_tpu/knobs.py",
+    "language_detector_tpu/faults.py",
+    "language_detector_tpu/telemetry.py",
+    "language_detector_tpu/locks.py",
+    "docs/OBSERVABILITY.md",
+    "docs/STATIC_ANALYSIS.md",
 )
 
 
-def run(rules=None, root=None) -> int:
+def _git_changed_files(root) -> set | None:
+    """Repo-relative paths git sees as touched (unstaged + staged +
+    untracked). None when git itself fails (not a work tree)."""
+    out: set = set()
+    cmds = (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    for cmd in cmds:
+        try:
+            r = subprocess.run(cmd, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        out.update(ln.strip() for ln in r.stdout.splitlines()
+                   if ln.strip())
+    return out
+
+
+def run(rules=None, root=None, changed=None) -> int:
+    """changed: None for a full run, else the set of repo-relative
+    changed paths to scope scoped analyzers to."""
     root = root or repo_root()
     want = None
     if rules:
@@ -40,12 +99,26 @@ def run(rules=None, root=None) -> int:
                   f"known: {', '.join(sorted(RULE_IDS))}",
                   file=sys.stderr)
             return 2
+    pkg_changed = changed is not None and any(
+        p.startswith("language_detector_tpu/") for p in changed)
     violations: list = []
     n_suppressed = 0
     for name, fn, emits in ANALYZERS:
         if want is not None and not (want & emits) and name not in want:
             continue
-        v, ns = fn(root=root)
+        if changed is not None:
+            if name in _SCOPED:
+                scope = sorted(_SCOPED[name]() & changed)
+                if not scope:
+                    continue
+                v, ns = fn(root=root, files=scope)
+            elif pkg_changed:
+                # cross-file drift analyzers are only sound whole-tree
+                v, ns = fn(root=root)
+            else:
+                continue
+        else:
+            v, ns = fn(root=root)
         if want is not None and name not in want:
             v = [x for x in v if x.rule in want
                  or x.rule == "lint-suppression-missing-reason"]
@@ -64,7 +137,9 @@ def run(rules=None, root=None) -> int:
               f"({summary}); {n_suppressed} suppressed",
               file=sys.stderr)
         return 1
-    print(f"ldt-lint: clean ({n_suppressed} suppressed)")
+    scope_note = "" if changed is None \
+        else f", scoped to {len(changed)} changed file(s)"
+    print(f"ldt-lint: clean ({n_suppressed} suppressed{scope_note})")
     return 0
 
 
@@ -76,6 +151,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rule", default=None,
                     help="comma-separated rule ids or analyzer names "
                          "to run (default: everything)")
+    ap.add_argument("--changed", action="store_true",
+                    help="scope analyzers to git-changed files; falls "
+                         "back to a full run when a registry/analyzer "
+                         "file changed (CI always runs full)")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the generated env-knob markdown table "
                          "and exit")
@@ -92,7 +171,18 @@ def main(argv=None) -> int:
         print("docs/OBSERVABILITY.md "
               + ("updated" if changed else "already current"))
         return 0
-    return run(rules=args.rule, root=root)
+    changed = None
+    if args.changed:
+        changed = _git_changed_files(root)
+        if changed is None:
+            print("ldt-lint: --changed: git unavailable, running full",
+                  file=sys.stderr)
+        elif any(p.startswith(t) for p in changed
+                 for t in _FULL_RUN_TRIGGERS):
+            print("ldt-lint: --changed: registry/analyzer files "
+                  "changed, running full", file=sys.stderr)
+            changed = None
+    return run(rules=args.rule, root=root, changed=changed)
 
 
 if __name__ == "__main__":

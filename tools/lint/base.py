@@ -35,6 +35,13 @@ RULE_IDS = frozenset({
     "fault-undeclared",
     "fault-undocumented",
     "fault-unused",
+    "fsm-undeclared-transition",
+    "fsm-dead-transition",
+    "model-check-invariant",
+    "future-unresolved",
+    "future-consumer-guard",
+    "jit-donated-read",
+    "jit-recompile-capture",
     "lint-suppression-missing-reason",
 })
 

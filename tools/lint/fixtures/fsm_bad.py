@@ -1,0 +1,34 @@
+"""Seeded fsm-conformance violations (tests/test_lint.py).
+
+The fixture machine (declared in the test, not fsm_registry.MACHINES):
+
+    states  IDLE=0, RUN=1, DONE=2, HALT=3
+    initial IDLE
+    table   IDLE->RUN, RUN->DONE, RUN->IDLE, DONE->HALT
+
+Expected: 3 fsm-undeclared-transition (wrong initial, undeclared
+guarded write, non-constant assignment) and 3 fsm-dead-transition
+(RUN->DONE, RUN->IDLE, DONE->HALT are declared but never written).
+"""
+
+IDLE, RUN, DONE, HALT = 0, 1, 2, 3
+
+
+class Widget:
+    def __init__(self):
+        self.count = 0
+        # wrong initial state: the table declares IDLE
+        self._state = RUN  # fsm-undeclared-transition
+
+    def start(self):
+        if self._state == IDLE:
+            self._state = RUN  # legal: IDLE->RUN declared
+
+    def finish(self):
+        if self._state == DONE:
+            # DONE -> RUN is not in the table
+            self._state = RUN  # fsm-undeclared-transition
+
+    def assign_dynamic(self, nxt):
+        if self._state == RUN:
+            self._state = nxt  # fsm-undeclared-transition (non-const)

@@ -1,0 +1,25 @@
+"""Conforming twin of fsm_bad.py: same declared machine, every write
+narrowed to a declared transition, every declared transition exercised
+(tests/test_lint.py drives this through a fixture Machine)."""
+
+IDLE, RUN, DONE, HALT = 0, 1, 2, 3
+
+
+class Widget:
+    def __init__(self):
+        self.count = 0
+        self._state = IDLE
+
+    def start(self):
+        if self._state == IDLE:
+            self._state = RUN
+
+    def finish(self):
+        if self._state == RUN:
+            self._state = DONE
+
+    def park(self):
+        if self._state == RUN:
+            self._state = IDLE
+        elif self._state == DONE:
+            self._state = HALT

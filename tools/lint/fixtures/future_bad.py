@@ -1,0 +1,29 @@
+"""Seeded future-resolution violations (tests/test_lint.py)."""
+from concurrent.futures import Future
+
+
+def leak_on_branch(cond, q):
+    fut = Future()
+    if cond:
+        q.put((1, fut))
+    # cond False: normal exit with fut pending  -> future-unresolved
+
+
+def leak_zero_iteration(items):
+    fut = Future()
+    for it in items:
+        fut.set_result(it)
+        break
+    # empty items: falls through pending        -> future-unresolved
+
+
+class Consumer:
+    def _drain(self, q):
+        pending = []
+        while True:
+            try:
+                pending.append(q.get_nowait())
+            except Exception:
+                # swallows without failing the batch
+                # -> future-consumer-guard
+                return

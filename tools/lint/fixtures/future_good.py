@@ -1,0 +1,52 @@
+"""Clean twin of future_bad.py: every creation resolves or escapes on
+every normal exit; the consumer's broad handler fails the batch."""
+from concurrent.futures import Future
+
+
+def resolve_both_branches(cond):
+    fut = Future()
+    if cond:
+        fut.set_result(1)
+    else:
+        fut.set_exception(RuntimeError("no"))
+    return None
+
+
+def escape_to_queue(q, texts):
+    fut = Future()
+    q.put((texts, fut))
+    return fut
+
+
+def raise_before_escape():
+    # nothing holds a reference yet: the caller sees the exception,
+    # not a hung future
+    fut = Future()
+    raise RuntimeError("rejected before enqueue")
+
+
+def defer_to_closure(schedule):
+    fut = Future()
+
+    def _done(v):
+        fut.set_result(v)
+
+    schedule(_done)
+    return fut
+
+
+class Consumer:
+    @staticmethod
+    def _fail(pending, err):
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(err)
+
+    def _drain(self, q):
+        pending = []
+        while True:
+            try:
+                pending.append(q.get_nowait())
+            except Exception as e:
+                self._fail(pending, e)
+                return

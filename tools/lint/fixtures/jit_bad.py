@@ -1,0 +1,25 @@
+"""Seeded jit-contract violations (tests/test_lint.py)."""
+import jax
+
+
+def accum_impl(acc, x):
+    return acc + x
+
+
+step = jax.jit(accum_impl, donate_argnums=(0,))
+
+
+def run_donated(acc, xs):
+    out = step(acc, xs)
+    return out + acc  # jit-donated-read: acc's buffer was donated
+
+
+def make_entry(tables):
+    scale = 1.0
+    for t in tables:
+        scale = scale * t  # reassigned under a loop: per-call-varying
+
+    def entry(x):
+        return x * scale  # jit-recompile-capture
+
+    return jax.jit(entry)

@@ -1,0 +1,606 @@
+"""FSM registry + conformance checking (docs/STATIC_ANALYSIS.md).
+
+Every state machine on the concurrency surface — the circuit breaker,
+the brownout ladder, pool lane health, the supervisor's worker
+lifecycle and blue/green swap drill, and the in-process artifact swap —
+declares its transition table here, and an AST pass proves the code
+against it in BOTH directions:
+
+  - fsm-undeclared-transition: a state assignment whose (source,
+    target) pair is not in the declared table. The pass is
+    flow-sensitive: it narrows the possible source set through
+    ``if self._state == CONST`` guards (including early returns,
+    ``and`` conjunctions, and booleans assigned from a state
+    comparison), so a write guarded down to one source only needs that
+    one transition declared.
+  - fsm-dead-transition: a declared transition no state assignment can
+    ever take — the table and the code drifted apart.
+
+Machines come in three shapes: ``attr`` (an instance attribute holding
+named integer constants, e.g. CircuitBreaker._state), ``counter`` (an
+instance attribute stepped by +=1/-=1 through a declared integer range,
+e.g. BrownoutLadder.level), and ``local`` (a function-local phase
+variable, e.g. the supervisor drill's ``drill``). Counter steps that
+would leave the declared range are assumed loop-guarded (the ladder's
+``while self.level < top`` bound is a runtime value).
+
+The conformance pass is deliberately scoped: only the declared file and
+class/function are scanned, so an unrelated ``self.level`` elsewhere
+never trips the ladder's table.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+
+
+@dataclasses.dataclass(frozen=True)
+class Machine:
+    """One declared state machine: where it lives, its states, and the
+    complete set of legal (source, target) transitions. Self-loops are
+    transitions too and must be declared (e.g. a success resetting an
+    already-closed breaker)."""
+    name: str
+    file: str          # repo-relative path holding the machine
+    scope: tuple       # ("class", name) methods scanned, or ("func", name)
+    kind: str          # "attr" | "counter" | "local"
+    var: str           # attribute name or local variable name
+    states: dict       # constant name -> int value
+    initial: str
+    transitions: frozenset  # of (src_name, dst_name)
+
+    def __post_init__(self):
+        unknown = {self.initial} | {s for t in self.transitions
+                                    for s in t}
+        unknown -= set(self.states)
+        if unknown:
+            raise ValueError(
+                f"machine {self.name}: transition/initial states not "
+                f"declared: {sorted(unknown)}")
+
+
+MACHINES = (
+    Machine(
+        name="circuit-breaker",
+        file="language_detector_tpu/service/admission.py",
+        scope=("class", "CircuitBreaker"),
+        kind="attr",
+        var="_state",
+        states={"BREAKER_CLOSED": 0, "BREAKER_HALF_OPEN": 1,
+                "BREAKER_OPEN": 2},
+        initial="BREAKER_CLOSED",
+        transitions=frozenset({
+            # success resets an already-closed breaker's failure count
+            ("BREAKER_CLOSED", "BREAKER_CLOSED"),
+            # consecutive failures trip
+            ("BREAKER_CLOSED", "BREAKER_OPEN"),
+            # half-open probe succeeded / failed
+            ("BREAKER_HALF_OPEN", "BREAKER_CLOSED"),
+            ("BREAKER_HALF_OPEN", "BREAKER_OPEN"),
+            # cooldown elapsed: admit one probe
+            ("BREAKER_OPEN", "BREAKER_HALF_OPEN"),
+            # straggler failures while open refresh the cooldown clock
+            ("BREAKER_OPEN", "BREAKER_OPEN"),
+        }),
+    ),
+    Machine(
+        name="brownout-ladder",
+        file="language_detector_tpu/service/admission.py",
+        scope=("class", "BrownoutLadder"),
+        kind="counter",
+        var="level",
+        states={"0": 0, "1": 1, "2": 2, "3": 3},
+        initial="0",
+        transitions=frozenset({
+            # the ladder only ever steps one level at a time
+            ("0", "1"), ("1", "2"), ("2", "3"),
+            ("3", "2"), ("2", "1"), ("1", "0"),
+        }),
+    ),
+    Machine(
+        name="pool-lane",
+        file="language_detector_tpu/parallel/pool.py",
+        scope=("class", "Lane"),
+        kind="attr",
+        var="_state",
+        states={"LANE_ACTIVE": 0, "LANE_EVICTED": 1, "LANE_PROBING": 2},
+        initial="LANE_ACTIVE",
+        transitions=frozenset({
+            ("LANE_ACTIVE", "LANE_EVICTED"),   # consecutive failures
+            ("LANE_EVICTED", "LANE_PROBING"),  # cooldown probe admitted
+            ("LANE_PROBING", "LANE_ACTIVE"),   # probe succeeded
+            ("LANE_PROBING", "LANE_EVICTED"),  # probe failed
+        }),
+    ),
+    Machine(
+        name="supervisor-worker",
+        file="language_detector_tpu/service/supervisor.py",
+        scope=("func", "main"),
+        kind="local",
+        var="worker",
+        states={"WORKER_IDLE": 0, "WORKER_RUNNING": 1,
+                "WORKER_STOPPED": 2, "WORKER_RECYCLED": 3,
+                "WORKER_EXITED": 4, "WORKER_CRASHED": 5},
+        initial="WORKER_IDLE",
+        transitions=frozenset({
+            ("WORKER_IDLE", "WORKER_RUNNING"),      # first spawn
+            ("WORKER_RECYCLED", "WORKER_RUNNING"),  # immediate respawn
+            ("WORKER_CRASHED", "WORKER_RUNNING"),   # post-backoff spawn
+            ("WORKER_RUNNING", "WORKER_STOPPED"),
+            ("WORKER_RUNNING", "WORKER_RECYCLED"),
+            ("WORKER_RUNNING", "WORKER_EXITED"),
+            ("WORKER_RUNNING", "WORKER_CRASHED"),
+        }),
+    ),
+    Machine(
+        name="supervisor-swap-drill",
+        file="language_detector_tpu/service/supervisor.py",
+        scope=("func", "_swap_drill"),
+        kind="local",
+        var="drill",
+        states={"DRILL_IDLE": 0, "DRILL_SPAWNED": 1,
+                "DRILL_CUTOVER": 2, "DRILL_PROMOTED": 3,
+                "DRILL_ABORTED": 4},
+        initial="DRILL_IDLE",
+        transitions=frozenset({
+            ("DRILL_IDLE", "DRILL_SPAWNED"),
+            # pointer unreadable / injected standby_spawn fault
+            ("DRILL_IDLE", "DRILL_ABORTED"),
+            # standby died or never landed the ready handshake
+            ("DRILL_SPAWNED", "DRILL_ABORTED"),
+            ("DRILL_SPAWNED", "DRILL_CUTOVER"),
+            ("DRILL_CUTOVER", "DRILL_PROMOTED"),
+        }),
+    ),
+    Machine(
+        name="artifact-swap",
+        file="language_detector_tpu/service/swap.py",
+        scope=("func", "swap_artifact"),
+        kind="local",
+        var="swap",
+        states={"SWAP_IDLE": 0, "SWAP_LOADING": 1, "SWAP_REBOUND": 2,
+                "SWAP_REFUSED": 3, "SWAP_ABORTED": 4},
+        initial="SWAP_IDLE",
+        transitions=frozenset({
+            ("SWAP_IDLE", "SWAP_REFUSED"),    # breaker open
+            ("SWAP_IDLE", "SWAP_LOADING"),
+            ("SWAP_LOADING", "SWAP_ABORTED"),  # load/cutover failed
+            ("SWAP_LOADING", "SWAP_REBOUND"),
+        }),
+    ),
+)
+
+
+# ---------------------------------------------------------------------
+# flow-sensitive conformance pass
+
+@dataclasses.dataclass
+class _Out:
+    """Result of analyzing a statement block: the possible-state set on
+    the fall-through edge (None = no path falls through) and the sets
+    carried by break/continue edges out of the block."""
+    fall: frozenset | None
+    breaks: list
+    continues: list
+
+
+def _union(*sets):
+    acc: set = set()
+    for s in sets:
+        if s:
+            acc |= s
+    return frozenset(acc)
+
+
+# sentinel member of a possible-state set marking "not yet assigned" —
+# distinct from None (= unreachable). The first write on an
+# uninitialized path is the initial-state check, not a transition.
+_UM = "<uninit>"
+_UNINIT = frozenset({_UM})
+
+
+class _Scan:
+    """One machine scanned against one function body."""
+
+    def __init__(self, m: Machine, sf, observed: set, out: list):
+        self.m = m
+        self.sf = sf
+        self.observed = observed
+        self.out = out
+        self.all = frozenset(m.states)
+        # bool local -> (true_set, false_set) recorded when the local
+        # was assigned from a state comparison
+        self.bool_narrow: dict = {}
+
+    # -- state expression / constant matching
+
+    def _is_state_ref(self, node) -> bool:
+        if self.m.kind in ("attr", "counter"):
+            return (isinstance(node, ast.Attribute)
+                    and node.attr == self.m.var
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self")
+        return isinstance(node, ast.Name) and node.id == self.m.var
+
+    def _const_state(self, node) -> str | None:
+        if self.m.kind == "counter":
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, int) \
+                    and not isinstance(node.value, bool) \
+                    and str(node.value) in self.m.states:
+                return str(node.value)
+            return None
+        if isinstance(node, ast.Name) and node.id in self.m.states:
+            return node.id
+        return None
+
+    # -- condition narrowing
+
+    def _narrow(self, test, P):
+        """(possible-if-true, possible-if-false) given possible P."""
+        if isinstance(test, ast.Constant):
+            if test.value:
+                return P, frozenset()
+            return frozenset(), P
+        if isinstance(test, ast.UnaryOp) \
+                and isinstance(test.op, ast.Not):
+            t, f = self._narrow(test.operand, P)
+            return f, t
+        if isinstance(test, ast.BoolOp):
+            ts, fs = [], []
+            for v in test.values:
+                t, f = self._narrow(v, P)
+                ts.append(t)
+                fs.append(f)
+            if isinstance(test.op, ast.And):
+                t = P
+                for x in ts:
+                    t = frozenset(t & x)
+                return t, P
+            t = _union(*ts)
+            f = P
+            for x in fs:
+                f = frozenset(f & x)
+            return frozenset(t & P), f
+        if isinstance(test, ast.Name) \
+                and test.id in self.bool_narrow:
+            t, f = self.bool_narrow[test.id]
+            return frozenset(t & P), frozenset(f & P)
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and self._is_state_ref(test.left):
+            op, rhs = test.ops[0], test.comparators[0]
+            c = self._const_state(rhs)
+            if c is not None:
+                if isinstance(op, ast.Eq):
+                    return frozenset(P & {c}), frozenset(P - {c})
+                if isinstance(op, ast.NotEq):
+                    return frozenset(P - {c}), frozenset(P & {c})
+            if self.m.kind == "counter" \
+                    and isinstance(rhs, ast.Constant) \
+                    and isinstance(rhs.value, int):
+                v = rhs.value
+                val = self.m.states
+                cmp = {ast.Gt: lambda s: val[s] > v,
+                       ast.GtE: lambda s: val[s] >= v,
+                       ast.Lt: lambda s: val[s] < v,
+                       ast.LtE: lambda s: val[s] <= v}.get(type(op))
+                if cmp is not None:
+                    t = frozenset(s for s in P if cmp(s))
+                    return t, frozenset(P - t)
+        return P, P
+
+    # -- violations
+
+    def _flag(self, node, msg):
+        self.out.append(Violation(
+            "fsm-undeclared-transition", self.sf.rel, node.lineno,
+            f"[{self.m.name}] {msg}"))
+
+    def _write(self, node, P, dst):
+        """Check one state write reaching targets `dst` from every
+        possible source in P; returns the new possible set."""
+        for src in sorted(P):
+            if (src, dst) in self.m.transitions:
+                self.observed.add((src, dst))
+            else:
+                self._flag(node,
+                           f"undeclared transition {src} -> {dst} "
+                           f"(declare it in tools/lint/fsm_registry.py "
+                           f"or guard the write)")
+        return frozenset({dst})
+
+    # -- statement analysis
+
+    def block(self, stmts, P) -> _Out:
+        breaks: list = []
+        continues: list = []
+        for st in stmts:
+            if P is None:
+                break  # unreachable tail
+            o = self._stmt(st, P)
+            breaks.extend(o.breaks)
+            continues.extend(o.continues)
+            P = o.fall
+        return _Out(P, breaks, continues)
+
+    def _states_written_in(self, stmts) -> frozenset:
+        """All state constants syntactically assigned anywhere in the
+        block — the sound entry set for exception handlers."""
+        found: set = set()
+        for st in stmts:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Assign) \
+                        and any(self._is_state_ref_store(t)
+                                for t in node.targets):
+                    c = self._const_state(node.value)
+                    if c is not None:
+                        found.add(c)
+                elif isinstance(node, ast.AugAssign) \
+                        and self._is_state_ref_store(node.target):
+                    found |= set(self.m.states)
+        return frozenset(found)
+
+    def _is_state_ref_store(self, node) -> bool:
+        if self.m.kind in ("attr", "counter"):
+            return (isinstance(node, ast.Attribute)
+                    and node.attr == self.m.var
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self")
+        return isinstance(node, ast.Name) and node.id == self.m.var
+
+    def _stmt(self, st, P) -> _Out:
+        if isinstance(st, (ast.Return, ast.Raise)):
+            return _Out(None, [], [])
+        if isinstance(st, ast.Break):
+            return _Out(None, [P], [])
+        if isinstance(st, ast.Continue):
+            return _Out(None, [], [P])
+        if isinstance(st, ast.Assign):
+            return _Out(self._assign(st, P), [], [])
+        if isinstance(st, ast.AugAssign):
+            return _Out(self._augassign(st, P), [], [])
+        if isinstance(st, ast.AnnAssign):
+            if st.target is not None \
+                    and self._is_state_ref_store(st.target) \
+                    and st.value is not None:
+                fake = ast.Assign(targets=[st.target], value=st.value)
+                ast.copy_location(fake, st)
+                return _Out(self._assign(fake, P), [], [])
+            return _Out(P, [], [])
+        if isinstance(st, ast.If):
+            t, f = self._narrow(st.test, P)
+            b = self.block(st.body, t)
+            e = self.block(st.orelse, f)
+            fall = None
+            if b.fall is not None or e.fall is not None:
+                fall = _union(b.fall, e.fall)
+            return _Out(fall, b.breaks + e.breaks,
+                        b.continues + e.continues)
+        if isinstance(st, (ast.While, ast.For)):
+            return self._loop(st, P)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return self.block(st.body, P)
+        if isinstance(st, ast.Try):
+            return self._try(st, P)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return _Out(P, [], [])  # separate scope
+        return _Out(P, [], [])
+
+    def _assign(self, st, P):
+        refs = [t for t in st.targets if self._is_state_ref_store(t)]
+        if not refs:
+            # a bool local assigned from a state comparison narrows a
+            # later `if <local>:` (Lane.record_success's `readmitted`)
+            if len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                if isinstance(st.value, ast.Compare):
+                    t, f = self._narrow(st.value, P)
+                    if (t, f) != (P, P):
+                        self.bool_narrow[name] = (t, f)
+                        return P
+                self.bool_narrow.pop(name, None)
+            return P
+        if len(st.targets) != 1 or len(refs) != 1:
+            self._flag(st, f"state {self.m.var} must be assigned "
+                           f"alone, not in a multi-target assignment")
+            return self.all
+        c = self._const_state(st.value)
+        if c is None:
+            self._flag(st, f"state {self.m.var} assigned from a "
+                           f"non-constant expression; only declared "
+                           f"state constants may be assigned")
+            return self.all
+        real = frozenset(P - {_UM})
+        if not real:
+            # the machine's very first write: the initial-state check
+            if c != self.m.initial:
+                self._flag(st, f"initial state must be "
+                               f"{self.m.initial}, not {c}")
+            return frozenset({c})
+        return self._write(st, real, c)
+
+    def _augassign(self, st, P):
+        if not self._is_state_ref_store(st.target):
+            return P
+        if self.m.kind != "counter":
+            self._flag(st, f"state {self.m.var} stepped arithmetically "
+                           f"but machine {self.m.name} is not a "
+                           f"counter")
+            return self.all
+        step = None
+        if isinstance(st.value, ast.Constant) \
+                and isinstance(st.value.value, int):
+            step = st.value.value
+            if isinstance(st.op, ast.Sub):
+                step = -step
+            elif not isinstance(st.op, ast.Add):
+                step = None
+        if step not in (1, -1):
+            self._flag(st, f"counter state {self.m.var} must step by "
+                           f"exactly +/-1")
+            return self.all
+        P = frozenset(P - {_UM})
+        if not P:
+            self._flag(st, f"counter state {self.m.var} stepped "
+                           f"before initialization")
+            return self.all
+        vals = self.m.states
+        byval = {v: k for k, v in vals.items()}
+        nxt: set = set()
+        for s in sorted(P):
+            d = byval.get(vals[s] + step)
+            if d is None:
+                # stepping out of the declared range is assumed
+                # loop-guarded (the bound is a runtime value)
+                continue
+            nxt.add(d)
+            if (s, d) in self.m.transitions:
+                self.observed.add((s, d))
+            else:
+                self._flag(st, f"undeclared transition {s} -> {d}")
+        return frozenset(nxt)
+
+    def _loop(self, st, P) -> _Out:
+        entry = P
+        body_out = _Out(None, [], [])
+        for _ in range(len(self.m.states) + 2):
+            if isinstance(st, ast.While):
+                t, _f = self._narrow(st.test, entry)
+            else:
+                t = entry
+            body_out = self.block(st.body, t)
+            back = _union(body_out.fall, *body_out.continues)
+            new_entry = _union(entry, back)
+            if new_entry == entry:
+                break
+            entry = new_entry
+        if isinstance(st, ast.While):
+            _t, f = self._narrow(st.test, entry)
+            always = isinstance(st.test, ast.Constant) \
+                and bool(st.test.value)
+            normal = None if always else f
+        else:
+            normal = entry
+        if st.orelse:
+            e = self.block(st.orelse, normal or frozenset())
+            normal = e.fall
+        fall = _union(normal, *body_out.breaks) \
+            if (normal is not None or body_out.breaks) else None
+        return _Out(fall, [], [])
+
+    def _try(self, st, P) -> _Out:
+        body = self.block(st.body, P)
+        breaks = list(body.breaks)
+        continues = list(body.continues)
+        h_entry = _union(P, self._states_written_in(st.body))
+        falls = [body.fall]
+        for h in st.handlers:
+            ho = self.block(h.body, h_entry)
+            falls.append(ho.fall)
+            breaks.extend(ho.breaks)
+            continues.extend(ho.continues)
+        if st.orelse and body.fall is not None:
+            eo = self.block(st.orelse, body.fall)
+            falls[0] = eo.fall
+            breaks.extend(eo.breaks)
+            continues.extend(eo.continues)
+        live = [f for f in falls if f is not None]
+        fall = _union(*live) if live else None
+        if st.finalbody:
+            fin_in = _union(fall or frozenset(), h_entry)
+            fo = self.block(st.finalbody, fin_in)
+            breaks.extend(fo.breaks)
+            continues.extend(fo.continues)
+            if fo.fall is None:
+                fall = None
+        return _Out(fall, breaks, continues)
+
+    # -- entry points
+
+    def run_function(self, fn, is_init=False, local=False):
+        self.bool_narrow = {}
+        entry = _UNINIT if (local or is_init) else self.all
+        self.block(fn.body, entry)
+
+
+def _find_scope(tree, scope):
+    """Locate the declared class or (possibly nested) function."""
+    want_cls = scope[0] == "class"
+    for node in ast.walk(tree):
+        if want_cls and isinstance(node, ast.ClassDef) \
+                and node.name == scope[1]:
+            return node
+        if not want_cls \
+                and isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                and node.name == scope[1]:
+            return node
+    return None
+
+
+def check_machine(m: Machine, root: Path):
+    """Run the conformance pass for one machine. Returns the raw
+    (unsuppressed) violation list plus the source file scanned, or
+    (violations, None) when the file/scope is missing."""
+    path = root / m.file
+    if not path.exists():
+        return [Violation("fsm-undeclared-transition", m.file, 1,
+                          f"[{m.name}] declared file does not exist")], \
+            None
+    sf = load_source(path, root)
+    scope = _find_scope(sf.tree, m.scope)
+    out: list = []
+    observed: set = set()
+    if scope is None:
+        out.append(Violation(
+            "fsm-undeclared-transition", sf.rel, 1,
+            f"[{m.name}] declared scope {m.scope[1]} not found"))
+        return out, sf
+    scan = _Scan(m, sf, observed, out)
+    if m.scope[0] == "class":
+        for node in scope.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                scan.run_function(node,
+                                  is_init=node.name == "__init__")
+    else:
+        scan.run_function(scope, local=True)
+    dead = m.transitions - observed
+    for src, dst in sorted(dead):
+        out.append(Violation(
+            "fsm-dead-transition", sf.rel, scope.lineno,
+            f"[{m.name}] declared transition {src} -> {dst} is never "
+            f"taken by any code path — remove it from the registry or "
+            f"restore the code path"))
+    return out, sf
+
+
+def check(root=None, files=None, machines=None):
+    """Conformance-check every declared machine. `machines` overrides
+    the registry (fixtures); `files` (iterable of repo-relative paths)
+    restricts the scan to machines living in those files."""
+    root = root or repo_root()
+    machines = MACHINES if machines is None else machines
+    if files is not None:
+        keep = {str(f) for f in files}
+        machines = [m for m in machines if m.file in keep]
+    violations: list = []
+    n_suppressed = 0
+    by_file: dict = {}  # rel -> (sf, raw) so a file hosting two
+    for m in machines:  # machines reports each suppression gap once
+        raw, sf = check_machine(m, root)
+        if sf is None:
+            violations.extend(raw)
+            continue
+        entry = by_file.setdefault(sf.rel, (sf, []))
+        entry[1].extend(raw)
+    for sf, raw in by_file.values():
+        kept, ns = apply_suppressions(sf, raw)
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed

@@ -1,0 +1,353 @@
+"""Future-resolution analyzer: every future this repo creates must be
+provably answered.
+
+The serving path hands waiters three kinds of futures — the sync
+Batcher's ``concurrent.futures.Future``, the aio front's
+``loop.create_future()``, and the device pool's ``_PoolFuture`` — and a
+future that is created but never resolved is the worst failure mode the
+stack has: the client connection pins until its flush timeout with no
+error, no metric, and no log line. Two rules over the batching files:
+
+  future-unresolved      a function creates a future (``Future()``,
+                         ``create_future()``, ``_PoolFuture(...)``)
+                         and some path reaches a NORMAL exit with the
+                         future neither resolved (set_result /
+                         set_exception / cancel) nor escaped to a
+                         declared handoff (returned to the caller, or
+                         enqueued via ``put``/``put_nowait``). A
+                         ``raise`` before the future ever escaped is
+                         fine — nothing holds a reference, so nothing
+                         waits on it.
+  future-consumer-guard  the declared consumer functions (the loops
+                         that pop futures off queues and own resolving
+                         them) must, in every broad exception handler
+                         (bare / Exception / BaseException /
+                         CancelledError / FaultInjected), either
+                         re-raise, call a bulk-resolver (``_fail``),
+                         or resolve futures inline — a swallowed
+                         exception in a consumer orphans the whole
+                         batch. A declared consumer that no longer
+                         exists is itself a violation (stale registry).
+
+The escape model is deliberately a whitelist: a future passed to an
+undeclared callee is NOT credited as handed off, so responsibility
+stays with the creator and the normal-exit check still fires.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+
+SCAN_FILES = (
+    "language_detector_tpu/service/batcher.py",
+    "language_detector_tpu/service/aioserver.py",
+    "language_detector_tpu/parallel/pool.py",
+)
+
+# constructors whose result is a future the creator must account for
+CREATOR_CALLS = frozenset({"Future", "create_future", "_PoolFuture"})
+# methods on the future that settle it
+RESOLVER_ATTRS = frozenset({"set_result", "set_exception", "cancel"})
+# declared handoffs: enqueue into a consumer-owned queue
+SINK_CALLS = frozenset({"put", "put_nowait"})
+
+# the functions that pop futures from queues/stashes and own resolving
+# them: (file rel, class name or None, function name). Every broad
+# except handler inside must raise, bulk-fail, or resolve inline.
+CONSUMERS = (
+    ("language_detector_tpu/service/batcher.py", "Batcher", "_run"),
+    ("language_detector_tpu/service/batcher.py", "Batcher", "_flush"),
+    ("language_detector_tpu/service/aioserver.py", "AioBatcher",
+     "_collector"),
+    ("language_detector_tpu/parallel/pool.py", "DevicePool", "_fetch"),
+)
+
+# handler types that catch "anything" on a consumer path and therefore
+# must prove they answer the batch. Narrow operational types
+# (TimeoutError, QueueEmpty, a typed RuntimeError probe) stay exempt.
+BROAD_HANDLER_TYPES = frozenset({
+    "Exception", "BaseException", "CancelledError", "FaultInjected"})
+
+# possible per-path statuses of one created future
+_PENDING = "pending"
+_DONE = "done"  # resolved or escaped to a declared owner
+
+
+def _handler_names(h: ast.ExceptHandler):
+    """Trailing identifiers of the caught types (bare -> [None])."""
+    t = h.type
+    if t is None:
+        yield None
+        return
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        if isinstance(e, ast.Attribute):
+            yield e.attr
+        elif isinstance(e, ast.Name):
+            yield e.id
+
+
+class _FutureScan:
+    """Track one created future through the rest of its function.
+
+    Statuses are possible-sets over {pending, done}; a statement list
+    returns the fall-through set, or None when every path out of it
+    raised/returned. Normal exits (Return, falling off the end) with
+    `pending` possible are the violation; exceptional exits never are
+    (pre-escape: nothing waits; post-escape: the consumer owns it).
+    """
+
+    def __init__(self, name: str, created: ast.stmt, rel: str,
+                 out: list):
+        self.name = name
+        self.created = created
+        self.rel = rel
+        self.out = out
+        self.flagged = False
+
+    def _flag(self, node):
+        if self.flagged:
+            return  # one report per creation is enough
+        self.flagged = True
+        self.out.append(Violation(
+            "future-unresolved", self.rel, node.lineno,
+            f"future `{self.name}` (created line "
+            f"{self.created.lineno}) can reach this exit neither "
+            f"resolved (set_result/set_exception/cancel) nor handed "
+            f"off (returned / put on a consumer queue)"))
+
+    # -- per-statement effects ----------------------------------------------
+
+    def _mentions(self, node) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id == self.name:
+                return True
+        return False
+
+    def _settles(self, stmt) -> bool:
+        """Does this statement resolve or hand off the future?"""
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in RESOLVER_ATTRS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == self.name:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in SINK_CALLS:
+                if any(self._mentions(a) for a in n.args):
+                    return True
+        return False
+
+    def _apply(self, stmt, status: frozenset) -> frozenset:
+        if self._settles(stmt):
+            return frozenset({_DONE})
+        # rebinding the name to a fresh value ends this future's story
+        # on that path (the old object is garbage; a new creation gets
+        # its own scan)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == self.name:
+                    return frozenset({_DONE})
+        return status
+
+    # -- control flow --------------------------------------------------------
+
+    def block(self, stmts, status):
+        for s in stmts:
+            if status is None:
+                return None
+            status = self.stmt(s, status)
+        return status
+
+    def stmt(self, s, status):
+        if isinstance(s, ast.Return):
+            if s.value is not None and self._mentions(s.value):
+                return None  # escaped to the caller
+            if _PENDING in status:
+                self._flag(s)
+            return None
+        if isinstance(s, ast.Raise):
+            return None  # exceptional exit: never a violation (above)
+        if isinstance(s, ast.If):
+            t = self.block(s.body, status)
+            f = self.block(s.orelse, status)
+            if t is None:
+                return f
+            if f is None:
+                return t
+            return t | f
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            # one settling statement anywhere in the body settles the
+            # loop's fall-through only if the body always runs; a
+            # zero-iteration loop keeps the entry status. Approximate:
+            # fall-through = entry ∪ one-pass body result.
+            body = self.block(s.body, status)
+            after = status if body is None else status | body
+            o = self.block(s.orelse, after)
+            return o if o is not None else after
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self.block(s.body, status)
+        if isinstance(s, ast.Try):
+            body = self.block(s.body, status)
+            # a handler can be entered from any point in the body; if
+            # the body could settle, the handler may still see pending
+            h_entry = status if not self._body_settles(s.body) \
+                else status | frozenset({_DONE})
+            outs = []
+            for h in s.handlers:
+                ho = self.block(h.body, h_entry)
+                if ho is not None:
+                    outs.append(ho)
+            if body is not None:
+                o = self.block(s.orelse, body)
+                if o is not None:
+                    outs.append(o)
+            fall = frozenset().union(*outs) if outs else None
+            if s.finalbody:
+                fin_entry = fall if fall is not None else h_entry
+                fin = self.block(s.finalbody, fin_entry)
+                if fin is None:
+                    return None
+                if fall is not None:
+                    # the finally body's settles apply to every path
+                    fall = fin
+            return fall
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            # a nested def CAPTURING the future defers resolution to
+            # call time — credit it as a declared resolver closure
+            if any(isinstance(n, ast.Name) and n.id == self.name
+                   for n in ast.walk(s)):
+                return frozenset({_DONE})
+            return status
+        # simple statement: settles/rebinds apply directly (compound
+        # statements above are handled structurally — a settle in one
+        # branch must not credit the other)
+        return self._apply(s, status)
+
+    def _body_settles(self, stmts) -> bool:
+        # _settles walks each statement, nested compounds included
+        return any(self._settles(st) for st in stmts)
+
+
+def _scan_function(fn, rel: str, out: list):
+    """Find creations in `fn` and run one _FutureScan per creation over
+    the statements that follow it (same block) plus enclosing blocks'
+    tails are out of scope — creations in this repo are function-top."""
+    def walk_block(stmts):
+        for i, s in enumerate(stmts):
+            if isinstance(s, (ast.Assign, ast.AnnAssign)):
+                targets = s.targets if isinstance(s, ast.Assign) \
+                    else [s.target]
+                val = s.value
+                if isinstance(val, ast.Call) and isinstance(
+                        val.func, (ast.Name, ast.Attribute)):
+                    cname = val.func.id if isinstance(
+                        val.func, ast.Name) else val.func.attr
+                    if cname in CREATOR_CALLS:
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                scan = _FutureScan(t.id, s, rel, out)
+                                st = scan.block(
+                                    stmts[i + 1:],
+                                    frozenset({_PENDING}))
+                                if st is not None and _PENDING in st:
+                                    scan._flag(stmts[-1])
+            # recurse into nested compound statements so creations
+            # inside loops/ifs are scanned against their own block —
+            # but not into nested defs, which the module walk visits
+            # as functions of their own
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for sub in (getattr(s, "body", None),
+                        getattr(s, "orelse", None),
+                        getattr(s, "finalbody", None)):
+                if sub:
+                    walk_block(sub)
+            for h in getattr(s, "handlers", ()):
+                walk_block(h.body)
+
+    walk_block(fn.body)
+
+
+def _check_consumers(sources_by_rel: dict, root: Path, out_by_rel):
+    for rel, cls, fname in CONSUMERS:
+        sf = sources_by_rel.get(rel)
+        if sf is None:
+            continue  # file filtered out of this run
+        fn = None
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub.name == fname:
+                        fn = sub
+        if fn is None:
+            out_by_rel[rel].append(Violation(
+                "future-consumer-guard", rel, 1,
+                f"declared consumer {cls}.{fname} no longer exists; "
+                f"update CONSUMERS in tools/lint/future_resolution.py"))
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not any(n is None or n in BROAD_HANDLER_TYPES
+                       for n in _handler_names(node)):
+                continue
+            ok = False
+            for n in ast.walk(node):
+                if isinstance(n, ast.Raise):
+                    ok = True
+                elif isinstance(n, ast.Call):
+                    f = n.func
+                    nm = f.attr if isinstance(f, ast.Attribute) \
+                        else getattr(f, "id", None)
+                    if nm == "_fail" or nm in RESOLVER_ATTRS:
+                        ok = True
+            if not ok:
+                out_by_rel[rel].append(Violation(
+                    "future-consumer-guard", rel, node.lineno,
+                    f"broad except in consumer {cls}.{fname} neither "
+                    f"re-raises nor resolves the pending futures "
+                    f"(_fail / set_exception): a swallowed error here "
+                    f"orphans the batch"))
+
+
+def check(root: Path | None = None, files=None, consumers=None):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    global CONSUMERS
+    root = root or repo_root()
+    rels = SCAN_FILES if files is None else files
+    sources = [load_source(root / rel, root) for rel in rels
+               if (root / rel).exists()]
+    by_rel = {sf.rel: sf for sf in sources}
+    out_by_rel: dict = {sf.rel: [] for sf in sources}
+
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_function(node, sf.rel, out_by_rel[sf.rel])
+
+    saved = CONSUMERS
+    if consumers is not None:
+        CONSUMERS = consumers
+    try:
+        _check_consumers(by_rel, root, out_by_rel)
+    finally:
+        CONSUMERS = saved
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        kept, ns = apply_suppressions(sf, out_by_rel[sf.rel])
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed

@@ -1,0 +1,310 @@
+"""jit-contract analyzer: donation and recompilation contracts on the
+device path, extending trace_safety's entry discovery.
+
+Two rules over the same device-path files trace_safety scans:
+
+  jit-donated-read       a buffer passed at a ``donate_argnums``
+                         position of a jitted callable is DEAD after
+                         the call — XLA may have aliased its memory
+                         into the outputs — so any later read of that
+                         name in the same function is a
+                         use-after-donate. The live tree donates
+                         nothing today; the rule exists so the first
+                         donation lands with its contract enforced.
+  jit-recompile-capture  a jitted entry that reads a per-call-varying
+                         Python value from an enclosing scope bakes it
+                         in as a trace-time constant: every new value
+                         is a silent retrace + recompile (the XLA
+                         cache-churn class). Flagged captures are
+                         enclosing-function locals that are reassigned
+                         or loop-assigned (single-assignment factory
+                         state is a legitimate per-instance constant),
+                         and module globals mutated via ``global``.
+
+Module constants, imports, parameters, and the entry's own locals are
+never flagged — exactly the names ops/score.py's entries rely on.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+from .trace_safety import SCAN_FILES, _collect_entries_and_jitted
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+# -- jit-donated-read --------------------------------------------------------
+
+
+def _donated_positions(call: ast.Call):
+    """donate_argnums positions of a jit(...) call, or None."""
+    fname = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else getattr(call.func, "id", None)
+    if fname not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for e in v.elts:
+                if isinstance(e, ast.Constant) \
+                        and isinstance(e.value, int):
+                    out.add(e.value)
+            return out
+    return None
+
+
+def _donating_bindings(sources) -> dict:
+    """name -> donated positional indices, for every
+    `X = jax.jit(f, donate_argnums=...)` binding in the scan set."""
+    donating: dict = {}
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    donating[tgt.id] = pos
+    return donating
+
+
+def _check_donated_reads(sf, donating: dict, out: list):
+    """Within each function: once a Name is passed at a donated
+    position of a donating callable, any later Load of it is flagged.
+    A Store rebinds the name to a live value and clears it."""
+
+    def scan_stmt(stmt, donated):
+        """One simple statement, in evaluation order: reads of a
+        previously-donated name flag; the statement's own donating
+        calls then register; its stores then rebind (so
+        `acc = step(acc, xs)` donates the old `acc` AND leaves the
+        name alive on the result)."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in donated:
+                out.append(Violation(
+                    "jit-donated-read", sf.rel, node.lineno,
+                    f"`{node.id}` was donated to a jitted call on "
+                    f"line {donated[node.id]} "
+                    f"(donate_argnums); its buffer may be aliased "
+                    f"into the outputs — rebind before reuse"))
+                donated.pop(node.id)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in donating:
+                for i, a in enumerate(node.args):
+                    if i in donating[node.func.id] \
+                            and isinstance(a, ast.Name):
+                        donated[a.id] = node.lineno
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                donated.pop(node.id, None)
+
+    def scan_scope(body):
+        donated: dict = {}  # name -> line it was donated on
+
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # separate scope
+                subs = [getattr(stmt, "body", None),
+                        getattr(stmt, "orelse", None),
+                        getattr(stmt, "finalbody", None)] + \
+                    [h.body for h in getattr(stmt, "handlers", ())]
+                subs = [s for s in subs if s]
+                if subs:
+                    # compound: headers (test/iter/items) read first,
+                    # then the branch bodies share one
+                    # flow-insensitive donation map
+                    for hdr in ("test", "iter"):
+                        h = getattr(stmt, hdr, None)
+                        if h is not None:
+                            scan_stmt(h, donated)
+                    for item in getattr(stmt, "items", ()):
+                        scan_stmt(item.context_expr, donated)
+                    tgt = getattr(stmt, "target", None)
+                    if tgt is not None:
+                        scan_stmt(tgt, donated)
+                    for sub in subs:
+                        walk(sub)
+                else:
+                    scan_stmt(stmt, donated)
+
+        walk(body)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node.body)
+    scan_scope([s for s in sf.tree.body
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef))])
+
+
+# -- jit-recompile-capture ---------------------------------------------------
+
+
+def _assigned_names(fn) -> set:
+    """Parameters plus every Name the function stores (its locals)."""
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args
+             + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     ast.Store):
+            names.add(node.id)
+    return names
+
+
+def _varying_locals(fn) -> set:
+    """Enclosing-scope names whose value plausibly changes between
+    calls of a nested jitted entry: reassigned more than once, or
+    assigned under a loop (single-assignment factory state is a
+    per-instance constant and fine to capture)."""
+    counts: dict = {}
+    in_loop: set = set()
+
+    def visit(node, loop_depth):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     ast.Store):
+            counts[node.id] = counts.get(node.id, 0) + 1
+            if loop_depth:
+                in_loop.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes own their stores
+            d = loop_depth + (1 if isinstance(
+                node, (ast.While, ast.For, ast.AsyncFor)) else 0)
+            visit(child, d)
+
+    visit(fn, 0)
+    # loop targets themselves vary by construction
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    in_loop.add(t.id)
+    return {n for n, c in counts.items() if c > 1} | in_loop
+
+
+def _module_facts(sf):
+    """(module-scope names, names mutated via `global` anywhere)."""
+    mod_names: set = set()
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            mod_names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                mod_names.add((a.asname or a.name).split(".")[0])
+        else:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                          ast.Store):
+                    mod_names.add(n.id)
+    mutated: set = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Global):
+            mutated.update(node.names)
+    return mod_names, mutated
+
+
+def _entry_defs_with_enclosers(sf, entries: set):
+    """Yield (entry def node, [enclosing function defs, outer-first])."""
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                if child.name in entries:
+                    yield child, list(stack)
+                yield from walk(child, stack + [child])
+            else:
+                yield from walk(child, stack)
+
+    yield from walk(sf.tree, [])
+
+
+def _check_recompile_capture(sf, entries: set, out: list):
+    mod_names, mutated_globals = _module_facts(sf)
+    for fn, enclosers in _entry_defs_with_enclosers(sf, entries):
+        own = _assigned_names(fn)
+        enclosing_local: dict = {}  # name -> defining fn (innermost)
+        varying: set = set()
+        for enc in enclosers:
+            v = _varying_locals(enc)
+            for n in _assigned_names(enc):
+                enclosing_local[n] = enc
+                if n in v:
+                    varying.add(n)
+                else:
+                    varying.discard(n)
+        nonlocal_names: set = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Nonlocal):
+                nonlocal_names.update(n.names)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if name in own or name in _BUILTINS:
+                continue
+            if name in enclosing_local:
+                if name in varying or name in nonlocal_names:
+                    out.append(Violation(
+                        "jit-recompile-capture", sf.rel, node.lineno,
+                        f"jitted entry `{fn.name}` closes over "
+                        f"`{name}`, a per-call-varying value of "
+                        f"enclosing `{enclosing_local[name].name}`: "
+                        f"each new value is a silent retrace; pass it "
+                        f"as an argument instead"))
+            elif name in mutated_globals and name in mod_names:
+                out.append(Violation(
+                    "jit-recompile-capture", sf.rel, node.lineno,
+                    f"jitted entry `{fn.name}` reads module global "
+                    f"`{name}` which is mutated via `global`: the "
+                    f"trace bakes in one value; pass it as an "
+                    f"argument instead"))
+
+
+def check(root: Path | None = None, files=None):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    rels = SCAN_FILES if files is None else files
+    sources = [load_source(root / rel, root) for rel in rels
+               if (root / rel).exists()]
+    entries, _ = _collect_entries_and_jitted(sources)
+    donating = _donating_bindings(sources)
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        raw: list = []
+        _check_donated_reads(sf, donating, raw)
+        _check_recompile_capture(sf, entries, raw)
+        kept, ns = apply_suppressions(sf, raw)
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed

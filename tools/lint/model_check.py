@@ -1,0 +1,543 @@
+"""Deterministic bounded model checking of the concurrency products
+(docs/STATIC_ANALYSIS.md).
+
+Where the FSM conformance pass proves each machine's code against its
+declared table, this module proves properties of the machines
+COMPOSED: a pure-Python BFS over event interleavings drives the real
+classes (CircuitBreaker, BrownoutLadder, Lane, DevicePool,
+AdmissionController, and the supervisor's ``_forward_stop`` latch)
+under a fake clock, using the existing fault points (``lane_dispatch``)
+to inject failures — no wall-clock reads, no randomness, no threads.
+
+Each product replays event sequences from scratch against freshly
+built systems and memoizes on an abstract state key, so exploration is
+exhaustive over the abstraction and terminates when no new abstract
+state is reachable. The proved invariants:
+
+  breaker x ladder      (a) never serve while not-ready: an OPEN
+                        breaker inside its cooldown never admits a
+                        device call, and once the cooldown elapses it
+                        always admits exactly the half-open probe;
+                        a CLOSED breaker never carries >= `failures`
+                        consecutive failures; the ladder level always
+                        matches its EMA under the hysteresis bounds.
+  pool-lane x brownout  (b) a fully evicted pool always recovers via
+                        the probe trickle (cooldown -> wants_probe ->
+                        _pick_lane admits a PROBING lane -> success
+                        re-activates it); (d) no reachable state has
+                        all lanes evicted AND admission shedding the
+                        due probe — the probe vehicle is admitted
+                        through a full-shed brownout.
+  stop forwarding       (c) SIGTERM is forwarded to each worker
+                        generation exactly once across the signal
+                        handler, the spawn race, the wait loop, and a
+                        racing swap drill's drain.
+
+A failed invariant is a ``model-check-invariant`` violation carrying
+the minimal event trace that reached the bad state. The state spaces
+are small by construction (tens to a few hundred abstract states), so
+the full run stays well inside the lint budget asserted by
+``bench.py --smoke``.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from .base import Violation, repo_root
+
+_REPO = repo_root()
+if str(_REPO) not in sys.path:  # `python -m tools.lint` has it; direct
+    sys.path.insert(0, str(_REPO))  # imports of this module may not
+
+
+class FakeClock:
+    """Injectable monotonic clock: time moves only via advance()."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _explore(build, events, key_fn, invariants, max_depth=12,
+             max_states=5000):
+    """Generic BFS over event interleavings.
+
+    build() -> system tuple; events: name -> fn(*system) (applied in
+    sorted-name order for determinism; a fn may return False to mark
+    itself inapplicable in the current state, pruning that branch);
+    key_fn(*system) -> hashable abstract state; invariants: name ->
+    fn(*system) returning None (holds) or a failure string, run on a
+    FRESH replay of each newly reached state (invariant probes may
+    mutate the system).
+
+    Returns (failures, n_states, exhausted): failures are
+    (invariant_name, event_trace, detail) tuples; exhausted is False
+    only if a safety cap stopped the walk early.
+    """
+    ordered = sorted(events)
+
+    def replay(trace):
+        sys_ = build()
+        for name in trace:
+            events[name](*sys_)
+        return sys_
+
+    def check(trace, failures):
+        for inv in sorted(invariants):
+            sys_ = replay(trace)
+            detail = invariants[inv](*sys_)
+            if detail:
+                failures.append((inv, trace, detail))
+
+    failures: list = []
+    seen = {key_fn(*build())}
+    check((), failures)
+    frontier: list = [()]
+    exhausted = True
+    for _ in range(max_depth):
+        if not frontier:
+            break
+        nxt: list = []
+        for trace in frontier:
+            for name in ordered:
+                seq = trace + (name,)
+                sys_ = build()
+                applicable = True
+                for ev in seq:
+                    if events[ev](*sys_) is False:
+                        applicable = False
+                        break
+                if not applicable:
+                    continue
+                key = key_fn(*sys_)
+                if key in seen:
+                    continue
+                seen.add(key)
+                check(seq, failures)
+                nxt.append(seq)
+                if len(seen) >= max_states:
+                    return failures, len(seen), False
+        frontier = nxt
+    else:
+        exhausted = not frontier
+    return failures, len(seen), exhausted
+
+
+# ---------------------------------------------------------------------
+# product 1: circuit breaker x brownout ladder
+
+_BL_FAILURES = 2
+_BL_COOLDOWN = 5.0
+_BL_STALL_MS = 2000.0
+_BL_ENTER = (0.60, 0.80, 0.95)
+_BL_EXIT = (0.45, 0.65, 0.80)
+
+
+def _bl_build():
+    from language_detector_tpu.service.admission import (
+        BrownoutLadder, CircuitBreaker)
+    clock = FakeClock()
+    # stall_factor=0 pins the watchdog to stall_min_ms so the explored
+    # space cannot depend on whatever the process-wide telemetry
+    # registry happens to hold
+    br = CircuitBreaker(failures=_BL_FAILURES,
+                        cooldown_sec=_BL_COOLDOWN, stall_factor=0.0,
+                        stall_min_ms=_BL_STALL_MS, clock=clock)
+    ladder = BrownoutLadder(enter=_BL_ENTER, exit=_BL_EXIT, alpha=1.0)
+    return clock, br, ladder
+
+
+def _bl_allow(clock, br, ladder):
+    br.allow_device()  # result intentionally dropped: the event is
+    # the state mutation (OPEN -> HALF_OPEN past cooldown, probe
+    # bookkeeping), not the verdict — verdicts are what invariants
+    # assert on fresh replicas
+
+
+_BL_EVENTS = {
+    "fail": lambda c, b, l: b.record_failure(),
+    "ok": lambda c, b, l: b.record_success(),
+    "stall": lambda c, b, l: b.record_success(_BL_STALL_MS + 1.0),
+    "allow": _bl_allow,
+    "cool": lambda c, b, l: c.advance(_BL_COOLDOWN + 0.1),
+    "load": lambda c, b, l: l.observe(1.2),
+    "drain": lambda c, b, l: l.observe(0.0),
+}
+
+
+def _bl_key(clock, br, ladder):
+    return (br._state, min(br._consec, _BL_FAILURES),
+            clock() - br._opened_at >= _BL_COOLDOWN,
+            None if br._probe_at is None
+            else (clock() - br._probe_at) * 1e3 >= _BL_STALL_MS,
+            ladder.level, round(ladder.ema, 6))
+
+
+def _bl_inv_never_serve_open(clock, br, ladder):
+    from language_detector_tpu.service.admission import BREAKER_OPEN
+    if br._state != BREAKER_OPEN:
+        return None
+    in_cooldown = clock() - br._opened_at < _BL_COOLDOWN
+    allowed = br.allow_device()
+    if in_cooldown and allowed:
+        return ("OPEN breaker inside its cooldown admitted a device "
+                "call")
+    if not in_cooldown and not allowed:
+        return ("OPEN breaker past its cooldown refused the half-open "
+                "probe — the device path can never recover")
+    return None
+
+
+def _bl_inv_closed_consec(clock, br, ladder):
+    from language_detector_tpu.service.admission import BREAKER_CLOSED
+    if br._state == BREAKER_CLOSED and br._consec >= _BL_FAILURES:
+        return (f"CLOSED breaker holding {br._consec} consecutive "
+                f"failures (trip threshold {_BL_FAILURES})")
+    return None
+
+
+def _bl_inv_ladder_consistent(clock, br, ladder):
+    lvl, ema = ladder.snapshot()
+    if not 0 <= lvl <= 3:
+        return f"ladder level {lvl} outside [0, 3]"
+    if lvl < 3 and ema >= _BL_ENTER[lvl]:
+        return (f"ladder level {lvl} with ema {ema:.3f} >= enter "
+                f"threshold {_BL_ENTER[lvl]} — failed to climb")
+    if lvl > 0 and ema < _BL_EXIT[lvl - 1]:
+        return (f"ladder level {lvl} with ema {ema:.3f} < exit "
+                f"threshold {_BL_EXIT[lvl - 1]} — failed to descend")
+    return None
+
+
+# ---------------------------------------------------------------------
+# product 2: pool lane health x brownout admission
+
+_P2_COOLDOWN = 10.0
+
+
+def _p2_build():
+    import numpy as np
+
+    from language_detector_tpu.parallel.pool import DevicePool, Lane
+    from language_detector_tpu.service.admission import (
+        AdmissionConfig, AdmissionController)
+
+    clock = FakeClock()
+    raw = np.zeros(1, dtype=np.int32)
+    lanes = [Lane(0, None), Lane(1, None)]
+    pool = DevicePool(lanes, hedge_factor=0.0, hedge_min_ms=0.0,
+                      evict_failures=1,
+                      probe_cooldown_sec=_P2_COOLDOWN,
+                      max_redispatch=1, clock=clock)
+    adm = AdmissionController(AdmissionConfig(
+        max_inflight=8, brownout_alpha=1.0, brownout_enter=_BL_ENTER,
+        brownout_exit=_BL_EXIT, breaker_failures=100))
+    adm.attach_pool(lambda: pool)
+    return clock, pool, adm, raw
+
+
+def _p2_fail(clock, pool, adm, raw):
+    """One dispatch with the real ``lane_dispatch`` fault armed: the
+    picked lane records the failure (ACTIVE -> EVICTED at
+    evict_failures=1; a PROBING lane re-evicts) and the launch
+    surfaces PoolExhausted — the typed error, never a hang."""
+    from language_detector_tpu import faults
+    from language_detector_tpu.parallel.pool import PoolExhausted
+    faults.configure("lane_dispatch:error")
+    try:
+        pool.launch(lambda lane: raw)
+    except PoolExhausted:
+        pass
+    finally:
+        faults.configure(None)
+
+
+def _p2_ok(clock, pool, adm, raw):
+    """One successful dispatch + fetch through the real pool paths
+    (launch -> _fetch_on); a PROBING lane's success re-admits it."""
+    pf = pool.launch(lambda lane: raw)
+    pool._fetch_on(pf.lane, pf.raw)
+
+
+def _p2_admit(clock, pool, adm, raw):
+    """One front-door round trip: the ladder observes the occupancy
+    (including pool capacity loss) on admit and on release."""
+    a = adm.try_admit(["probe text"], priority=False)
+    if not a.shed:
+        adm.release(a)
+
+
+_P2_EVENTS = {
+    "fail": _p2_fail,
+    "ok": _p2_ok,
+    "admit": _p2_admit,
+    "advance": lambda c, pool, adm, raw: c.advance(_P2_COOLDOWN + 0.1),
+}
+
+
+def _p2_key(clock, pool, adm, raw):
+    lanes = tuple(
+        (ln._state, min(ln._consecutive, 1),
+         ln.probe_due(clock(), pool.probe_cooldown_sec))
+        for ln in pool.lanes)
+    return (lanes, pool._rr % len(pool.lanes), adm.ladder.level,
+            round(adm.ladder.ema, 6))
+
+
+def _all_evicted(pool):
+    from language_detector_tpu.parallel.pool import LANE_EVICTED
+    return all(ln.state() == LANE_EVICTED for ln in pool.lanes)
+
+
+def _p2_inv_evicted_pool_recovers(clock, pool, adm, raw):
+    """(b) from any all-evicted state: once a cooldown elapses the pool
+    asks for a probe, the next dispatch runs as that probe, and its
+    success restores serving capacity."""
+    from language_detector_tpu.parallel.pool import LANE_PROBING
+    if not _all_evicted(pool):
+        return None
+    clock.advance(_P2_COOLDOWN + 0.1)
+    if not pool.wants_probe():
+        return ("all lanes evicted and cooldown elapsed, but the pool "
+                "does not want a probe — no recovery path")
+    lane = pool._pick_lane()
+    if lane.state() != LANE_PROBING:
+        return ("all lanes evicted past cooldown, but _pick_lane did "
+                "not admit a half-open probe")
+    lane.record_success(1.0, clock())
+    if pool.capacity()[0] < 1:
+        return "a successful probe did not restore any capacity"
+    return None
+
+
+def _p2_inv_probe_admitted_through_shed(clock, pool, adm, raw):
+    """(d) no reachable state may shed the due probe: with every lane
+    evicted (capacity load 1.2 -> brownout level 3) and a probe due,
+    try_admit must admit the request as the probe vehicle."""
+    if not _all_evicted(pool):
+        return None
+    clock.advance(_P2_COOLDOWN + 0.1)
+    if not pool.wants_probe():
+        return ("all lanes evicted and cooldown elapsed, but the pool "
+                "does not want a probe")
+    a = adm.try_admit(["probe text"], priority=False)
+    if a.shed:
+        return (f"admission shed (status {a.status}, reason "
+                f"{a.reason}) the due pool probe — a fully evicted "
+                f"pool would stay down forever")
+    if not a.probe:
+        return "the due probe was admitted but not marked probe=True"
+    adm.release(a)
+    return None
+
+
+# ---------------------------------------------------------------------
+# product 3: stop forwarding (SIGTERM exactly once)
+
+class _FakeChild:
+    """Popen stand-in: counts SIGTERMs, stays alive until told."""
+
+    def __init__(self):
+        self.terms = 0
+        self.alive = True
+
+    def poll(self):
+        return None if self.alive else 0
+
+    def send_signal(self, signum=None):
+        self.terms += 1
+
+
+class _SupModel:
+    """The supervisor's forwarding surface: the real _forward_stop
+    latch driven from all the call sites main() has (signal handler,
+    spawn race, wait loop, drill drain)."""
+
+    def __init__(self):
+        from language_detector_tpu.service.supervisor import \
+            _forward_stop
+        self._fwd = _forward_stop
+        self.children: list = []
+        self.child = None
+        self.signaled = None
+        self.stopping = False
+        self.spawns = 0
+        self.drills = 0
+        self.sigterms = 0
+
+    def spawn(self):
+        # main() only respawns after the current generation exited.
+        # Spawning with stopping already set models the race where the
+        # signal lands between the loop top and Popen — the post-spawn
+        # forwarding site must cover the fresh child.
+        if self.child is not None and self.child.alive:
+            return False
+        if self.spawns >= 2:
+            return False
+        self.child = _FakeChild()
+        self.children.append(self.child)
+        self.spawns += 1
+        if self.stopping:
+            self.signaled = self._fwd(self.child, self.signaled)
+        return True
+
+    def sigterm(self):
+        # repeat signals re-enter the handler; the latch (not the
+        # model) must keep delivery exactly-once
+        if self.sigterms >= 2:
+            return False
+        self.sigterms += 1
+        self.stopping = True
+        self.signaled = self._fwd(self.child, self.signaled)
+        return True
+
+    def tick(self):
+        # one wait-loop iteration under stopping
+        if not self.stopping or self.child is None:
+            return False
+        self.signaled = self._fwd(self.child, self.signaled)
+        return True
+
+    def exit(self):
+        if self.child is None or not self.child.alive:
+            return False
+        self.child.alive = False
+        return True
+
+    def drill(self, racing_stop):
+        # SIGHUP drill: only runs from the wait loop when not stopping
+        # and the worker is healthy; with racing_stop a SIGTERM lands
+        # mid-drill (handler forwards to the OLD child), then the
+        # cutover drains old through the same latch
+        if self.stopping or self.child is None \
+                or not self.child.alive or self.drills >= 2:
+            return False
+        self.drills += 1
+        old = self.child
+        standby = _FakeChild()
+        self.children.append(standby)
+        if racing_stop:
+            self.stopping = True
+            self.signaled = self._fwd(self.child, self.signaled)
+        self.signaled = self._fwd(old, self.signaled)  # drain
+        old.alive = False
+        self.child = standby
+        return True
+
+
+def _p3_build():
+    return (_SupModel(),)
+
+
+_P3_EVENTS = {
+    "spawn": lambda m: m.spawn(),
+    "sigterm": lambda m: m.sigterm(),
+    "tick": lambda m: m.tick(),
+    "exit": lambda m: m.exit(),
+    "drill": lambda m: m.drill(racing_stop=False),
+    "drill_racing_stop": lambda m: m.drill(racing_stop=True),
+}
+
+
+def _p3_key(m):
+    return (m.stopping, m.spawns, m.drills, m.sigterms,
+            None if m.child is None else m.children.index(m.child),
+            None if m.signaled is None
+            else m.children.index(m.signaled),
+            tuple((c.alive, min(c.terms, 2)) for c in m.children))
+
+
+def _p3_inv_at_most_once(m):
+    for i, c in enumerate(m.children):
+        if c.terms > 1:
+            return (f"generation {i + 1} received {c.terms} SIGTERMs "
+                    f"— forwarding is not exactly-once")
+    return None
+
+
+def _p3_inv_delivered(m):
+    """Stopping with a live current generation: the next wait-loop
+    iteration must leave it signaled exactly once (never zero — a
+    stop that was swallowed would hang `docker stop`)."""
+    if not (m.stopping and m.child is not None and m.child.alive):
+        return None
+    m.tick()
+    if m.child.terms != 1:
+        return (f"after a stop and one wait-loop tick the current "
+                f"generation holds {m.child.terms} SIGTERMs "
+                f"(want exactly 1)")
+    return None
+
+
+# ---------------------------------------------------------------------
+# analyzer entry point
+
+PRODUCTS = (
+    ("breaker-x-ladder", "language_detector_tpu/service/admission.py",
+     _bl_build, _BL_EVENTS, _bl_key, {
+         "never-serve-while-open": _bl_inv_never_serve_open,
+         "closed-consec-bound": _bl_inv_closed_consec,
+         "ladder-consistent": _bl_inv_ladder_consistent,
+     }),
+    ("pool-x-brownout", "language_detector_tpu/parallel/pool.py",
+     _p2_build, _P2_EVENTS, _p2_key, {
+         "evicted-pool-recovers": _p2_inv_evicted_pool_recovers,
+         "probe-admitted-through-shed":
+             _p2_inv_probe_admitted_through_shed,
+     }),
+    ("stop-forwarding", "language_detector_tpu/service/supervisor.py",
+     _p3_build, _P3_EVENTS, _p3_key, {
+         "sigterm-at-most-once": _p3_inv_at_most_once,
+         "sigterm-delivered": _p3_inv_delivered,
+     }),
+)
+
+
+def run_product(name, max_depth=12, max_states=5000):
+    """Explore one named product; returns (failures, n_states,
+    exhausted). Test hook — check() wraps this for the CLI."""
+    for pname, _path, build, events, key_fn, invs in PRODUCTS:
+        if pname == name:
+            return _explore(build, events, key_fn, invs,
+                            max_depth=max_depth,
+                            max_states=max_states)
+    raise KeyError(name)
+
+
+def check(root=None, files=None, products=PRODUCTS):
+    """Run every product's exploration. `files` (repo-relative paths)
+    restricts to products whose subject module is listed. Violations
+    carry the minimal event trace that reached the failing state."""
+    from language_detector_tpu import faults
+    root = Path(root) if root else _REPO
+    if files is not None:
+        keep = {str(f) for f in files}
+        products = [p for p in products if p[1] in keep]
+    violations: list = []
+    prev = faults.ACTIVE
+    try:
+        faults.configure(None)
+        for name, path, build, events, key_fn, invs in products:
+            failures, n_states, exhausted = _explore(
+                build, events, key_fn, invs)
+            if not exhausted:
+                violations.append(Violation(
+                    "model-check-invariant", path, 1,
+                    f"[{name}] exploration hit a safety cap after "
+                    f"{n_states} abstract states without closing — "
+                    f"shrink the event alphabet or raise the cap"))
+            for inv, trace, detail in failures:
+                violations.append(Violation(
+                    "model-check-invariant", path, 1,
+                    f"[{name}] invariant {inv} violated after "
+                    f"events {' -> '.join(trace) or '(initial)'}: "
+                    f"{detail}"))
+    finally:
+        faults.ACTIVE = prev
+    return violations, 0
