@@ -54,12 +54,30 @@ assert d["mixed_fallback_docs"] == 0, \
     f"mixed_fallback_docs = {d['mixed_fallback_docs']} (want 0)"
 assert d["cache_hit_rate"] is not None and d["cache_hit_rate"] > 0, \
     f"cache_hit_rate = {d['cache_hit_rate']} (want > 0)"
+# round-9 pipeline invariants: pack must actually overlap device
+# scoring at the default depth (measured ~0.57 on this host; depth 1
+# would pin it to 0.0), retried docs must re-enter at their own tier,
+# and the long-doc lane must stay within noise of lane-off on the
+# long-heavy mix (measured ~0.92x on the CPU host; 0.5 floors a real
+# collapse, not shared-host jitter)
+assert d["pack_overlap_ratio"] > 0.5, \
+    f"pack_overlap_ratio = {d['pack_overlap_ratio']} (want > 0.5)"
+assert d["mixed_retry_offtier_docs"] == 0, \
+    f"mixed_retry_offtier_docs = {d['mixed_retry_offtier_docs']} (want 0)"
+assert d["longheavy_lane_speedup"] > 0.5, \
+    f"longheavy_lane_speedup = {d['longheavy_lane_speedup']} (want > 0.5)"
 print("bucketed scheduler:",
       "cache_hit_rate", d["cache_hit_rate"],
       "| tier_dispatches", d["tier_dispatches"],
       "| dedup_docs", d["mixed_dedup_docs"],
       "| retry_lane_dispatches", d["mixed_retry_lane_dispatches"],
       "| lint_ms", d["lint_ms"])
+print("pipeline:",
+      "overlap_ratio", d["pack_overlap_ratio"],
+      "| depth", d["pipeline_depth"],
+      "| donation_hits", d["pipeline_donation_hits"],
+      "| longheavy_lane_speedup", d["longheavy_lane_speedup"],
+      "| longheavy_split_docs", d["longheavy_split_docs"])
 EOF
 
 echo "== telemetry smoke =="

@@ -201,6 +201,27 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Failover budget per batch: how many lane attempts (initial + "
        "re-dispatches) before the error surfaces to the batch's "
        "futures."),
+    # -- dispatch pipeline & long-doc lane (models/ngram.py) ----------
+    _k("LDT_PIPELINE_DEPTH", "int", 2,
+       "Dispatch-pipeline depth: max scheduler jobs in flight on the "
+       "device at once while later batches pack on the host. 1 = "
+       "strictly serial pack->score->epilogue (byte-identical "
+       "reference path); 2 (default) keeps one batch scoring while the "
+       "next packs, with one extra overlapped retry-lane launch."),
+    _k("LDT_LONGDOC_CHUNK_SLOTS", "int", 1024,
+       "Long-document lane sub-pack size: split documents are cut at "
+       "script-span boundaries into sub-packs of about this many "
+       "slots, scored as ordinary bucket-ladder work, and merged back "
+       "into one summary. 0 disables the lane entirely (oversized "
+       "docs ride the widest tier unsplit)."),
+    _k("LDT_LONGDOC_SPLIT_SLOTS", "int", 4096,
+       "Long-document lane engage threshold: only documents whose "
+       "estimated packer slot demand exceeds this enter the span-split "
+       "lane (clamped up to LDT_LONGDOC_CHUNK_SLOTS). Splitting costs "
+       "a host span scan and a chunk merge, and a doc that fails the "
+       "reliability gate re-scores whole regardless, so the lane takes "
+       "only the fat tail where bucket-shape inflation actually "
+       "bites."),
     # -- per-tenant isolation (service/admission.py) ------------------
     _k("LDT_TENANT_QUOTA_DOCS", "int", None,
        "Per-tenant cap on queued documents (X-LDT-Tenant header; "

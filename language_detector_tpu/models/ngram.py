@@ -37,7 +37,8 @@ from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              result_from_epilogue_row as _result_from_row)
 from ..locks import make_lock
 from ..ops.device_tables import DeviceTables
-from ..ops.score import score_chunks, unpack_chunks_out
+from ..ops.score import (score_chunks, score_chunks_donated,
+                         unpack_chunks_out)
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
@@ -76,12 +77,19 @@ class NgramBatchEngine:
     def __init__(self, tables: ScoringTables | None = None,
                  reg: Registry | None = None, flags: int = 0,
                  max_slots: int = 1 << 17, max_chunks: int = 1 << 14,
-                 mesh=None):
+                 mesh=None, longdoc_chunk_slots: int | None = None,
+                 longdoc_split_slots: int | None = None):
         """max_slots / max_chunks: PER-DOCUMENT budgets (packer scratch);
         a document exceeding either falls back to the scalar engine. The
         defaults admit ~100KB documents. mesh: optional jax.sharding.Mesh
         with a "batch" axis; when given, the chunk grid shards over it
-        data-parallel and batches pad to a multiple of the mesh size."""
+        data-parallel and batches pad to a multiple of the mesh size.
+        longdoc_chunk_slots: long-doc lane sub-pack size target; None
+        reads LDT_LONGDOC_CHUNK_SLOTS (bench passes 0 to build a
+        no-split comparison engine). longdoc_split_slots: slot-demand
+        threshold past which a doc enters the lane at all; None reads
+        LDT_LONGDOC_SPLIT_SLOTS (tests pass the sub-pack size here to
+        force splitting on mid-size docs)."""
         self.tables = tables or load_tables()
         self.reg = reg or default_registry
         self.flags = flags
@@ -161,12 +169,68 @@ class NgramBatchEngine:
                       # flush was near its deadline or the brownout
                       # ladder disabled the retry lane (trace.no_retry)
                       "retry_skipped_docs": 0,
+                      # long-doc lane: span-split documents, the
+                      # sub-documents they became, and longdoc-lane
+                      # dispatches (_count_tier reads the lane name)
+                      "longdoc_split_docs": 0,
+                      "longdoc_subdocs": 0,
+                      "tier_longdoc_dispatches": 0,
+                      # retried docs packed into a lane that does not
+                      # match their own tier — the mixed-stream retry
+                      # inflation the tier-keyed bins eliminate; bench
+                      # asserts this stays 0
+                      "retry_offtier_docs": 0,
                       # docs answered on the all-C tiny-batch path.
                       # Pre-seeded so the stats dict's key set is fixed
                       # at init: snapshot copies and key insertion must
                       # not race (stats_snapshot)
                       "c_path_docs": 0}
         self._stats_lock = make_lock("engine.stats")
+        # -- dispatch pipeline (round 9) ------------------------------
+        # depth = max scheduler jobs in flight on the device; 1 = the
+        # strictly serial pack->score->epilogue reference path. The
+        # in-flight bound the schedulers use is depth+1 (one batch may
+        # finish fetching while depth batches queue behind it), 0
+        # outstanding-while-packing at depth 1.
+        self.pipeline_depth = max(1, knobs.get_int("LDT_PIPELINE_DEPTH")
+                                  or 1)
+        self.longdoc_chunk_slots = (
+            knobs.get_int("LDT_LONGDOC_CHUNK_SLOTS") or 0
+            if longdoc_chunk_slots is None else longdoc_chunk_slots)
+        # engage threshold: splitting costs a Python span scan plus a
+        # merge, and a gate-failed doc re-scores whole anyway, so the
+        # lane takes only the fat tail where bucket inflation (and the
+        # packer's per-span candidate ceiling) actually bites; docs
+        # between the sub-pack size and this ride their tier unsplit
+        self.longdoc_split_slots = max(
+            self.longdoc_chunk_slots,
+            knobs.get_int("LDT_LONGDOC_SPLIT_SLOTS") or 0
+            if longdoc_split_slots is None else longdoc_split_slots)
+        # host staging ring for the wire's bucketed arrays: capacity
+        # covers the in-flight bound plus the batch being packed
+        self._staging = native.StagingRing(
+            cap=self._inflight_bound() + 1)
+        # donation composes with the plain single-lane scorer only (the
+        # sharded/pooled programs keep their own jit); depth 1 keeps
+        # the non-donating scorer so the serial path stays the exact
+        # pre-pipeline program
+        self._donate = (self.pipeline_depth > 1 and
+                        self._score_fn is score_chunks)
+        if self._donate:
+            import warnings
+            # CPU backends warn that buffer donation is unimplemented
+            # and fall back to copying — expected on the simulator
+            warnings.filterwarnings(
+                "ignore",
+                message="Some donated buffers were not usable")
+        # overlap accounting: pack wall time total/overlapped (a pack
+        # counts as overlapped when any dispatch was in flight when it
+        # started), donation hits, longdoc chunk count. Own lock — the
+        # pack hot path must not contend with stats_snapshot readers.
+        self._pipe = {"pack_ms_total": 0.0, "pack_ms_overlapped": 0.0,
+                      "donation_hits": 0, "longdoc_chunks": 0}
+        self._inflight = 0
+        self._pipe_lock = make_lock("engine.pipe")
 
     def stats_snapshot(self) -> dict:
         """Copy of the running stats under the stats lock — the only
@@ -174,6 +238,41 @@ class NgramBatchEngine:
         them; iterating the live dict races flush-worker updates."""
         with self._stats_lock:
             return dict(self.stats)
+
+    def _inflight_bound(self) -> int:
+        """Scheduler in-flight bound: how many dispatched jobs may be
+        outstanding while the main thread packs the next one. Depth 1
+        is strictly serial (0 outstanding — collect right after every
+        submit); depth d >= 2 allows d+1 so one batch can drain while d
+        queue behind it (the round-5 engine's hardcoded 3 == depth 2)."""
+        d = self.pipeline_depth
+        return 0 if d == 1 else d + 1
+
+    def pipeline_stats(self) -> dict:
+        """Dispatch-pipeline snapshot for /metrics and /debug/vars:
+        overlap ratio (overlapped pack wall time / total pack wall
+        time), configured depth, donation hits, staging-ring state, and
+        longdoc chunk production."""
+        with self._pipe_lock:
+            p = dict(self._pipe)
+            inflight = self._inflight
+        ring = self._staging.stats()
+        total = p["pack_ms_total"]
+        return {
+            "depth": self.pipeline_depth,
+            "overlap_ratio":
+                round(p["pack_ms_overlapped"] / total, 4) if total
+                else 0.0,
+            "pack_ms_total": round(total, 3),
+            "pack_ms_overlapped": round(p["pack_ms_overlapped"], 3),
+            "inflight": inflight,
+            "donation_hits": p["donation_hits"],
+            "longdoc_chunks": p["longdoc_chunks"],
+            "staging_ring_occupancy": ring["occupancy"],
+            "staging_ring_hits": ring["hits"],
+            "staging_ring_misses": ring["misses"],
+            "staging_ring_shapes": ring["shapes"],
+        }
 
     # -- device dispatch ----------------------------------------------------
 
@@ -190,6 +289,16 @@ class NgramBatchEngine:
         compiles instead of hiding behind another lane's warm mark."""
         if score_fn is None:
             score_fn = self._score_fn
+        if self._donate and score_fn is score_chunks:
+            # pipelined depth: donate the wire into the scorer so the
+            # device reuses the transferred buffers (ops/score.py); the
+            # host staging arrays are safe to reuse once the call
+            # returns — jax copies numpy inputs synchronously
+            score_fn = score_chunks_donated
+            with self._pipe_lock:
+                self._pipe["donation_hits"] += 1
+            telemetry.REGISTRY.counter_inc(
+                "ldt_pipeline_donation_hits_total")
         # fault seam BEFORE first_seen: an injected launch error must
         # not consume the first-shape marker and mislabel the real
         # retry's compile as warm
@@ -218,17 +327,58 @@ class NgramBatchEngine:
         failover (parallel/pool.py). Every fetch site already uses
         np.asarray(fut), which is exactly the pool future's supervised
         entry point."""
-        if self.pool is None:
-            return self._launch_raw(cb, lane)
-        return self.pool.launch(
-            lambda pl: self._launch_raw(cb, lane, pl.score_fn),
-            trace=trace)
+        with self._pipe_lock:
+            self._inflight += 1
+        try:
+            if self.pool is None:
+                return self._launch_raw(cb, lane)
+            return self.pool.launch(
+                lambda pl: self._launch_raw(cb, lane, pl.score_fn),
+                trace=trace)
+        except BaseException:
+            # failed launch: the flush errors as a unit (the batcher
+            # retries with a fresh pack), so retire the lease here
+            with self._pipe_lock:
+                self._inflight -= 1
+            cb.release_staging()
+            raise
+
+    def _fetch_rows(self, cb, fut) -> np.ndarray:
+        """Resolve a dispatch future and unpack it against the wire's
+        chunk meta, then retire the dispatch: decrement the in-flight
+        gauge (overlap accounting) and hand the wire's staging lease
+        back to the ring. The release happens only AFTER
+        unpack_chunks_out — it reads cb.wire["cmeta"] on the host, and
+        a re-acquired lease zero-fills its arrays. On the pooled path
+        the lease is released when the pool future SETTLES — a
+        straggler hedge or failover may re-read the wire until its
+        last launch attempt finishes (parallel/pool.py settled
+        accounting); the direct path has no further reader."""
+        try:
+            out = np.asarray(fut)
+            rows = unpack_chunks_out(out, cb.wire["cmeta"])
+        except BaseException:
+            # failed fetch: no retry reuses this pack (the pool only
+            # surfaces errors after its failover budget), so the lease
+            # must not leak
+            with self._pipe_lock:
+                self._inflight -= 1
+            cb.release_staging()
+            raise
+        with self._pipe_lock:
+            self._inflight -= 1
+        if cb.staging is not None:
+            settle = getattr(fut, "on_settled", None)
+            if settle is not None:
+                settle(cb.release_staging)
+            else:
+                cb.release_staging()
+        return rows
 
     def score_chunk_batch(self, cb) -> np.ndarray:
         """Run the jitted device program over a ChunkBatch; returns the
         flat [G, 5] chunk-summary rows on host (test/debug seam)."""
-        out = np.asarray(self._launch(cb))
-        return unpack_chunks_out(out, cb.wire["cmeta"])
+        return self._fetch_rows(cb, self._launch(cb))
 
     # -- public API ---------------------------------------------------------
 
@@ -384,7 +534,7 @@ class NgramBatchEngine:
             # engine with the ORIGINAL text + hints — the batched retry
             # pass does not carry hint state
             _, orig, _ = job
-            rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+            rows = self._fetch_rows(cb, fut)
             ep = native.epilogue_flat_native(rows, cb, self.flags,
                                              self.reg)
             out: list = []
@@ -561,12 +711,42 @@ class NgramBatchEngine:
             with self._stats_lock:
                 self.stats["dedup_docs"] += len(dups)
         t_stage = telemetry.observe_stage("dedup", t_stage, trace=trace)
-        # -- tier partition + per-lane volume slicing -----------------
-        from ..preprocess.pack import N_TIERS, TIER_NAMES, tier_of_text
-        if len(uniq_txt) > self.TIER_MIN_DOCS:
-            by_tier: list = [[] for _ in range(N_TIERS)]
+        # -- long-doc lane: span-aligned splitting --------------------
+        # Docs whose slot demand exceeds the top bucket split into
+        # span-exact sub-packs (preprocess/pack.py split_longdoc) and
+        # score as ordinary bucket-ladder work; the merge back into one
+        # summary happens in the scheduler's longdoc worker. Only the
+        # CHEAP pre-gate runs here (length bound + one vectorized
+        # script scan): the Python span scan itself streams through
+        # the scheduler's dispatch loop, overlapping the device rounds
+        # of the main lanes instead of serializing ahead of them. A
+        # candidate the scheduler then fails to split spills back into
+        # an ordinary wide-lane job there.
+        from ..preprocess.pack import (N_TIERS, TIER_NAMES,
+                                       _TIER_BASE_SLOTS,
+                                       _maybe_multi_span, tier_of_text)
+        ld_cand: set = set()
+        if self.longdoc_chunk_slots > 0:
+            # length pre-gate: est_slot_demand is 8 + len//4, so docs
+            # under the char threshold can never exceed the engage
+            # threshold (longdoc_split_slots >= the sub-pack size)
+            min_chars = (self.longdoc_split_slots
+                         - _TIER_BASE_SLOTS) << 2
             for p, t in enumerate(uniq_txt):
-                by_tier[tier_of_text(t)].append(p)
+                if len(t) > min_chars and \
+                        _maybe_multi_span(t, self.tables):
+                    ld_cand.add(p)
+        ld_cands = [(uniq_idx[p], uniq_txt[p]) for p in sorted(ld_cand)]
+        t_stage = telemetry.observe_stage("longdoc_split", t_stage,
+                                          trace=trace)
+        # -- tier partition + per-lane volume slicing -----------------
+        positions = ([p for p in range(len(uniq_txt))
+                      if p not in ld_cand]
+                     if ld_cand else list(range(len(uniq_txt))))
+        if len(positions) > self.TIER_MIN_DOCS:
+            by_tier: list = [[] for _ in range(N_TIERS)]
+            for p in positions:
+                by_tier[tier_of_text(uniq_txt[p])].append(p)
             # coalesce undersized lanes upward into the next wider
             # budget (routing-only: a wider lane holds smaller docs
             # bit-exactly) — a near-empty lane is a full dispatch
@@ -580,7 +760,7 @@ class NgramBatchEngine:
             lanes = [(TIER_NAMES[k], lane)
                      for k, lane in enumerate(by_tier) if lane]
         else:
-            lanes = [("mixed", list(range(len(uniq_txt))))]
+            lanes = [("mixed", positions)] if positions else []
         jobs: list = []  # (tier name, global indices, texts)
         for name, lane in lanes:
             ltxt = [uniq_txt[p] for p in lane]
@@ -591,7 +771,7 @@ class NgramBatchEngine:
                              ltxt[s:e]))
         telemetry.observe_stage("tier_plan", t_stage, trace=trace)
         # -- dispatch -------------------------------------------------
-        if len(jobs) == 1:
+        if len(jobs) == 1 and not ld_cands:
             # single-dispatch fast path (the service batcher's common
             # flush): no pool, local deferred retry as before
             name, idxs, txts = jobs[0]
@@ -611,30 +791,49 @@ class NgramBatchEngine:
                         [(idxs[b], t, sq) for b, t, sq in d]).items():
                     out[g] = patch_value(r)
                 telemetry.observe_stage("retry_lane", t0, trace=trace)
-        elif jobs:
+        elif jobs or ld_cands:
             self._run_scheduler(jobs, batch_size, finish_fn,
-                                patch_value, out, trace=trace)
+                                patch_value, out, trace=trace,
+                                ld_cands=ld_cands)
         for i, p in dups:
             out[i] = out[uniq_idx[p]]
         return out
 
     def _run_scheduler(self, jobs, batch_size, finish_fn, patch_value,
-                       out, trace=None):
+                       out, trace=None, ld_cands=None):
         """Multi-lane pipeline with the overlapped retry lane. The main
         thread only packs (C++, GIL-released); pool workers launch the
-        device program and run the epilogue (same depth-3 structure as
-        _pipelined_jobs — see its docstring for why 3). Main jobs drop
-        their gate failures into per-flag retry bins; whenever a bin
-        reaches RETRY_LANE_MIN the bin re-packs and dispatches as a
-        retry job on the SAME pending queue, so recursion rounds overlap
-        main-lane scoring. Retry jobs carry FINISH so they can never
-        defer again — the drain loop terminates."""
+        device program and run the epilogue. In-flight depth comes from
+        LDT_PIPELINE_DEPTH via _inflight_bound (depth 1 collects every
+        dispatch before the next pack — the strictly serial reference
+        path; depth 2, the default, keeps the device busy across the
+        next pack plus one overlapped retry launch). Main jobs drop
+        their gate failures into (squeezed, tier)-keyed retry bins;
+        whenever a bin reaches RETRY_LANE_MIN the bin re-packs AT ITS
+        OWN TIER and dispatches as a retry job on the SAME pending
+        queue, so recursion rounds overlap main-lane scoring without
+        inflating every retried doc to the tail lane's bucket shape
+        (retry_offtier_docs audits that invariant — it must stay 0).
+        Retry jobs carry FINISH so they can never defer again — the
+        drain loop terminates. Long-doc CANDIDATES (pre-gated in
+        _detect_stream) stream through the dispatch loop AFTER the main
+        jobs: each one's Python span scan (split_longdoc) runs on the
+        main thread while earlier dispatches score on the device —
+        pack is GIL-released C++ and the device wait parks in XLA, so
+        the scan is host work the pipeline hides. Split docs group by
+        char volume into longdoc jobs (score as ordinary bucket-ladder
+        work, merge per-chunk rows back into one virtual document via
+        result_vector.merge_longdoc_chunks); candidates that refuse to
+        split spill into ordinary wide-lane jobs at the end."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
         from .. import native
+        from ..preprocess.pack import split_longdoc, tier_of_text
+        from ..result_vector import merge_longdoc_chunks
 
         retry_lock = make_lock("engine.retry")
-        retry_bins = {False: [], True: []}  # squeezed -> [(gidx, text)]
+        # (squeezed, tier) -> [(gidx, text)]
+        retry_bins: dict = {}
 
         def run_main(lane, idxs, txts, cb):
             fut = self._launch(cb, lane, trace=trace)
@@ -645,14 +844,73 @@ class NgramBatchEngine:
                     self.stats["scalar_recursion_docs"] += len(d)
                 with retry_lock:
                     for b, t, sq in d:
-                        retry_bins[sq].append((idxs[b], t))
+                        retry_bins.setdefault(
+                            (sq, tier_of_text(t)), []).append((idxs[b], t))
             return ("main", idxs, vals)
+
+        def run_longdoc(cb, groups, gidx, origs):
+            """One long-doc job: sub-documents score as a normal pack,
+            then merge back into per-document chunk sequences for the
+            flat epilogue. Exactness: the split is span-aligned and
+            verify-checked (split_longdoc), the DocTote is additive, so
+            the merged epilogue equals the unsplit one. Fallback or
+            squeeze on any sub-doc resolves the WHOLE doc via the
+            scalar engine; gate failures re-enter the stream retry
+            bins UNSPLIT at their own tier — the REPEATS squeeze in
+            the recursion pass dedups words across the whole document,
+            so a span-split retry would keep cross-span repeats the
+            reference deletes; only the clean first pass is safe to
+            split. run_retry resolves them exactly like any deferred
+            doc (scalar if still failing)."""
+            t0 = _time.monotonic()
+            rows = self._fetch_rows(
+                cb, self._launch(cb, "longdoc", trace=trace))
+            with self._stats_lock:
+                self.stats["device_dispatches"] += 1
+            mrows, mcb = merge_longdoc_chunks(rows, cb, groups)
+            nch = int(mcb.n_chunks.sum())
+            with self._pipe_lock:
+                self._pipe["longdoc_chunks"] += nch
+            telemetry.REGISTRY.counter_inc(
+                "ldt_pipeline_longdoc_chunks_total", nch)
+            ep = native.epilogue_flat_native(mrows, mcb, self.flags,
+                                             self.reg)
+            no_retry = trace is not None and getattr(trace, "no_retry",
+                                                     False)
+            patches: dict = {}
+            gate_fail: list = []
+            n_fb = n_skip = 0
+            for j, g in enumerate(gidx):
+                if mcb.fallback[j] or mcb.squeezed[j]:
+                    n_fb += 1
+                    patches[g] = detect_scalar(origs[j], self.tables,
+                                               self.reg, self.flags)
+                elif ep[j, 12]:
+                    if no_retry:
+                        n_skip += 1
+                        patches[g] = detect_scalar(
+                            origs[j], self.tables, self.reg, self.flags)
+                    else:
+                        gate_fail.append(j)
+                else:
+                    patches[g] = _result_from_row(ep[j])
+            if gate_fail:
+                with retry_lock:
+                    for j in gate_fail:
+                        retry_bins.setdefault(
+                            (False, tier_of_text(origs[j])),
+                            []).append((gidx[j], origs[j]))
+            with self._stats_lock:
+                self.stats["fallback_docs"] += n_fb
+                self.stats["retry_skipped_docs"] += n_skip
+                self.stats["scalar_recursion_docs"] += len(gate_fail)
+            telemetry.observe_stage("longdoc", t0, trace=trace)
+            return ("retry", patches)
 
         def run_retry(idxs, txts, cb, flags):
             t0 = _time.monotonic()
-            rows = unpack_chunks_out(
-                np.asarray(self._launch(cb, "retry", trace=trace)),
-                cb.wire["cmeta"])
+            rows = self._fetch_rows(
+                cb, self._launch(cb, "retry", trace=trace))
             with self._stats_lock:
                 self.stats["device_dispatches"] += 1
                 self.stats["retry_lane_dispatches"] += 1
@@ -681,19 +939,26 @@ class NgramBatchEngine:
                 for g, r in res[1].items():
                     out[g] = patch_value(r)
 
-        with ThreadPoolExecutor(3) as pool:
+        bound = self._inflight_bound()
+        with ThreadPoolExecutor(max(1, bound)) as pool:
 
             def submit_retries(min_docs):
                 grabbed = []
                 with retry_lock:
-                    for sq in (False, True):
-                        if len(retry_bins[sq]) >= max(min_docs, 1):
-                            grabbed.append((sq, retry_bins[sq]))
-                            retry_bins[sq] = []
-                for sq, group in grabbed:
+                    for key, docs in retry_bins.items():
+                        if docs and len(docs) >= max(min_docs, 1):
+                            grabbed.append((key, docs))
+                            retry_bins[key] = []
+                for (sq, tier), group in grabbed:
                     flags = self._retry_flags(sq)
                     gidx = [g for g, _ in group]
                     gtxt = [t for _, t in group]
+                    # tier-keyed bins repack each doc at its own bucket
+                    # shape; any doc landing off-tier is a routing bug
+                    off = sum(1 for t in gtxt if tier_of_text(t) != tier)
+                    if off:
+                        with self._stats_lock:
+                            self.stats["retry_offtier_docs"] += off
                     for s, e in self._slice_bounds(
                             [len(t) for t in gtxt], batch_size):
                         t0 = _time.monotonic()
@@ -702,19 +967,88 @@ class NgramBatchEngine:
                         pending.append(pool.submit(
                             run_retry, gidx[s:e], gtxt[s:e], cb, flags))
 
-            for name, idxs, txts in jobs:
-                self._count_tier(name)
-                t0 = _time.monotonic()
-                cb = self._pack(txts)
-                telemetry.observe_stage("pack", t0, trace=trace)
-                pending.append(pool.submit(run_main, name, idxs, txts,
-                                           cb))
-                while len(pending) > 3:
+            def keep_bound():
+                while len(pending) > bound:
                     collect(pending.popleft().result())
                 submit_retries(self.RETRY_LANE_MIN)
+
+            # long-doc job accumulator: each doc's sub-packs stay
+            # contiguous in one job — the merge needs the whole chunk
+            # sequence in one fetch
+            cur_txt: list = []
+            cur_groups: list = []
+            cur_gidx: list = []
+            cur_orig: list = []
+            cur_vol = 0
+
+            def flush_ld():
+                nonlocal cur_txt, cur_groups, cur_gidx, cur_orig, \
+                    cur_vol
+                if not cur_txt:
+                    return
+                t0 = _time.monotonic()
+                self._count_tier("longdoc")
+                cb = self._pack(cur_txt)
+                telemetry.observe_stage("pack", t0, trace=trace)
+                pending.append(pool.submit(run_longdoc, cb, cur_groups,
+                                           cur_gidx, cur_orig))
+                cur_txt, cur_groups, cur_gidx, cur_orig = \
+                    [], [], [], []
+                cur_vol = 0
+                keep_bound()
+
+            # main jobs first: their dispatches put work on the device
+            # so the long-doc span scans below run under it
+            for name, idxs, txts in jobs:
+                t0 = _time.monotonic()
+                self._count_tier(name)
+                cb = self._pack(txts)
+                telemetry.observe_stage("pack", t0, trace=trace)
+                pending.append(pool.submit(run_main, name, idxs,
+                                           txts, cb))
+                keep_bound()
+            # stream the long-doc candidates: split (main-thread
+            # Python, overlapped with the in-flight device rounds),
+            # group by char volume, dispatch as the budget fills
+            spill_idx: list = []
+            spill_txt: list = []
+            for gidx_one, text in (ld_cands or []):
+                t0 = _time.monotonic()
+                subs = split_longdoc(text, self.tables,
+                                     self.longdoc_chunk_slots)
+                telemetry.observe_stage("longdoc_split", t0,
+                                        trace=trace)
+                if not subs:
+                    # pre-gate optimism didn't pan out: ride the wide
+                    # lane unsplit with the other spills
+                    spill_idx.append(gidx_one)
+                    spill_txt.append(text)
+                    continue
+                with self._stats_lock:
+                    self.stats["longdoc_split_docs"] += 1
+                    self.stats["longdoc_subdocs"] += len(subs)
+                vol = sum(len(s) for s in subs)
+                if cur_txt and cur_vol + vol > self.DISPATCH_CHAR_BUDGET:
+                    flush_ld()
+                cur_groups.append((len(cur_txt), len(subs)))
+                cur_txt.extend(subs)
+                cur_gidx.append(gidx_one)
+                cur_orig.append(text)
+                cur_vol += vol
+            flush_ld()
+            for s, e in self._slice_bounds(
+                    [len(t) for t in spill_txt], batch_size):
+                t0 = _time.monotonic()
+                self._count_tier("long")
+                cb = self._pack(spill_txt[s:e])
+                telemetry.observe_stage("pack", t0, trace=trace)
+                pending.append(pool.submit(run_main, "long",
+                                           spill_idx[s:e],
+                                           spill_txt[s:e], cb))
+                keep_bound()
             # drain: once pending empties no worker is running, so the
             # bins are stable and min_docs=1 flushes the residue
-            while pending or retry_bins[False] or retry_bins[True]:
+            while pending or any(retry_bins.values()):
                 if pending:
                     collect(pending.popleft().result())
                 submit_retries(self.RETRY_LANE_MIN if pending else 1)
@@ -728,15 +1062,18 @@ class NgramBatchEngine:
         GIL-released); each pool worker launches its slice's device
         program — paying the host->device wire transfer there, off the
         critical path — then forces execution and runs the epilogue.
-        Yields finish(job, cb, fut) values in job order. Depth 3 keeps
-        the device queue full across the ~95ms dispatch latency of this
-        host's TPU tunnel (>= 3 concurrent fetches reach the backend's
-        overlap ceiling; concurrent launches from worker threads are the
-        service batcher's proven pattern). A single-job call (the
-        service batcher's common flush) skips the pool entirely — its
-        flushes already overlap on the batcher's worker pool, and
-        per-call thread spawning is real cost on the single-core
-        host."""
+        Yields finish(job, cb, fut) values in job order. The in-flight
+        bound comes from LDT_PIPELINE_DEPTH via _inflight_bound: depth
+        1 collects each dispatch before the next pack (strictly serial
+        reference path), depth 2 — the default — bounds at 3, which
+        keeps the device queue full across the ~95ms dispatch latency
+        of this host's TPU tunnel (>= 3 concurrent fetches reach the
+        backend's overlap ceiling; concurrent launches from worker
+        threads are the service batcher's proven pattern). A single-job
+        call (the service batcher's common flush) skips the pool
+        entirely — its flushes already overlap on the batcher's worker
+        pool, and per-call thread spawning is real cost on the
+        single-core host."""
         jobs = iter(jobs)
         first = next(jobs, None)
         if first is None:
@@ -752,12 +1089,13 @@ class NgramBatchEngine:
         def launch_and_finish(job, cb):
             return finish(job, cb, self._launch(cb))
 
+        bound = self._inflight_bound()
         pending: list = []
-        with ThreadPoolExecutor(3) as pool:
+        with ThreadPoolExecutor(max(1, bound)) as pool:
             for job in itertools.chain([first, second], jobs):
                 cb = pack(job)
                 pending.append(pool.submit(launch_and_finish, job, cb))
-                while len(pending) > 3:
+                while len(pending) > bound:
                     yield pending.pop(0).result()
             for f in pending:
                 yield f.result()
@@ -797,17 +1135,31 @@ class NgramBatchEngine:
               hint_boosts: list | None = None):
         """Pack only (no device launch): the pipeline core launches on
         its worker pool so slice N's host->device transfer never blocks
-        slice N+1's pack on the single-core host."""
+        slice N+1's pack on the single-core host. Wire arrays come from
+        the staging ring (steady state allocates nothing), and the pack
+        is timed for the overlap ratio: it counts as overlapped when a
+        dispatch was in flight while it ran — the stall the pipeline
+        exists to erase."""
         from .. import native
         fl = self.flags if flags is None else flags
         pad = -len(texts) % self._mesh_size
         padded = list(texts) + [""] * pad if pad else texts
         if pad and hint_boosts is not None:
             hint_boosts = list(hint_boosts) + [None] * pad
-        return native.pack_chunks_native(
+        t0 = _time.monotonic()
+        with self._pipe_lock:
+            overlapped = self._inflight > 0
+        cb = native.pack_chunks_native(
             padded, self.tables, self.reg, flags=fl,
             n_shards=self._mesh_size, l_doc=self.max_slots,
-            c_doc=self.max_chunks, hint_boosts=hint_boosts)
+            c_doc=self.max_chunks, hint_boosts=hint_boosts,
+            staging=self._staging)
+        ms = (_time.monotonic() - t0) * 1e3
+        with self._pipe_lock:
+            self._pipe["pack_ms_total"] += ms
+            if overlapped or self._inflight > 0:
+                self._pipe["pack_ms_overlapped"] += ms
+        return cb
 
     def _dispatch(self, texts: list[str], flags: int | None = None,
                   hint_boosts: list | None = None):
@@ -839,9 +1191,18 @@ class NgramBatchEngine:
         pipeline (the launch itself is asynchronous)."""
         from .. import native
         if faults.ACTIVE is not None:
-            faults.hit("device_flush")
+            try:
+                faults.hit("device_flush")
+            except BaseException:
+                # the flush dies before its fetch: retire the dispatch
+                # so the in-flight gauge and the staging ring cannot
+                # drift when the batcher's failure path re-dispatches
+                with self._pipe_lock:
+                    self._inflight -= 1
+                cb.release_staging()
+                raise
         t0 = _time.monotonic()
-        rows = unpack_chunks_out(np.asarray(fut), cb.wire["cmeta"])
+        rows = self._fetch_rows(cb, fut)
         t1 = _time.monotonic()
         B = len(texts)
         ep = native.epilogue_flat_native(rows, cb, self.flags, self.reg)
@@ -986,8 +1347,7 @@ class NgramBatchEngine:
         def finish(chunk, cb, fut):
             with self._stats_lock:
                 self.stats["device_dispatches"] += 1
-            rows = unpack_chunks_out(np.asarray(fut),
-                                     cb.wire["cmeta"])
+            rows = self._fetch_rows(cb, fut)
             ep = native.epilogue_flat_native(rows, cb, flags, self.reg)
             out: list = []
             for b, text in enumerate(chunk):

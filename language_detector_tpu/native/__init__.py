@@ -437,6 +437,109 @@ class ChunkBatch:
     # original bytes, crid [D,Gs] i32 hit-round ids (-1 = direct-add),
     # cdir [D,Gs] u8 direct-add flags
     ranges: dict | None = None
+    # staging-ring lease backing the wire's bucketed arrays (None when
+    # the pack allocated fresh arrays). The OWNER of the dispatch calls
+    # release() once no launch can read the wire again — after the
+    # result future resolves (direct path) or the pool future settles
+    # (pooled path: hedges/failovers may re-read the wire until then).
+    staging: "StagingLease | None" = None
+
+    def release_staging(self) -> None:
+        if self.staging is not None:
+            self.staging.release()
+            self.staging = None
+
+
+class StagingLease:
+    """One checked-out set of staging arrays; release() returns it to
+    its ring exactly once (idempotent, thread-safe via the ring lock)."""
+
+    __slots__ = ("ring", "key", "arrays", "_done")
+
+    def __init__(self, ring: "StagingRing", key: tuple, arrays: dict):
+        self.ring = ring
+        self.key = key
+        self.arrays = arrays
+        self._done = False
+
+    def release(self) -> None:
+        self.ring._release(self)
+
+
+class StagingRing:
+    """Per-bucket-tier ring of pre-allocated host staging arrays for the
+    flat wire's bucketed lanes (idx/cnsl/cmeta/cscript/cwhack).
+
+    The pipelined engine packs batch N+1 while batch N scores; without a
+    ring every pack allocates (and the allocator touches) megabytes of
+    fresh pages per dispatch. Arrays are keyed by the padded shape
+    bucket (D, N, Gs, whacked) — the same small ladder the compile
+    cache keys on — so steady state allocates nothing: acquire() hands
+    back a zeroed lease from the free list, the pack writes it, the
+    dispatch reads it, and the engine releases it once the result
+    future settles. Over-depth demand (ring empty) falls back to a
+    fresh allocation that joins the ring on release, up to `cap` sets
+    per shape; beyond that the arrays are simply dropped.
+
+    JAX copies host numpy inputs into device buffers synchronously
+    during the jitted call, so a released lease can never alias live
+    device memory; the pool's settled accounting guarantees no
+    host-side reader (hedge/failover re-dispatch) is left either."""
+
+    _KEYS = ("idx", "cnsl", "cmeta", "cscript", "cwhack")
+
+    def __init__(self, cap: int = 4):
+        self.cap = cap
+        self._free: dict = {}      # key -> list[dict of arrays]
+        self._out = 0              # leases currently checked out
+        self._hits = 0             # acquires served from the free list
+        self._misses = 0           # acquires that had to allocate
+        self._lock = __import__("threading").Lock()
+
+    @staticmethod
+    def _alloc(key: tuple) -> dict:
+        D, N, Gs, whacked = key
+        return dict(idx=np.zeros((D, N), np.uint16),
+                    cnsl=np.zeros((D, Gs), np.uint8),
+                    cmeta=np.zeros((D, Gs), np.uint32),
+                    cscript=np.zeros((D, Gs), np.uint8),
+                    cwhack=np.zeros((D, Gs if whacked else 1),
+                                    np.uint16))
+
+    def acquire(self, D: int, N: int, Gs: int,
+                whacked: bool) -> StagingLease:
+        key = (D, N, Gs, whacked)
+        with self._lock:
+            free = self._free.get(key)
+            arrays = free.pop() if free else None
+            self._out += 1
+            if arrays is not None:
+                self._hits += 1
+            else:
+                self._misses += 1
+        if arrays is None:
+            arrays = self._alloc(key)
+        else:
+            for a in arrays.values():
+                a.fill(0)  # pack relies on zero-initialized padding
+        return StagingLease(self, key, arrays)
+
+    def _release(self, lease: StagingLease) -> None:
+        with self._lock:
+            if lease._done:
+                return
+            lease._done = True
+            self._out -= 1
+            free = self._free.setdefault(lease.key, [])
+            if len(free) < self.cap:
+                free.append(lease.arrays)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"occupancy": self._out,
+                    "hits": self._hits,
+                    "misses": self._misses,
+                    "shapes": len(self._free)}
 
 
 def _next_pow2_min(n: int, lo: int) -> int:
@@ -522,7 +625,8 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
                        l_doc: int = 1 << 17, c_doc: int = 1 << 14,
                        max_direct: int = 64, n_threads: int = 0,
                        hint_boosts: list | None = None,
-                       want_ranges: bool = False) -> ChunkBatch:
+                       want_ranges: bool = False,
+                       staging: "StagingRing | None" = None) -> ChunkBatch:
     """texts -> chunk-major flat wire (one dispatch regardless of the
     batch's document-length mix). len(texts) must divide n_shards.
     hint_boosts: optional per-doc hints.HintBoosts (None entries fine) —
@@ -569,6 +673,7 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         _ptr(n_slots, np.int32), _ptr(n_chunks, np.int32),
         ctypes.byref(max_nsl))
 
+    lease = None
     try:
         D = n_shards
         shard_slots = n_slots.reshape(D, B // D).sum(axis=1)
@@ -580,15 +685,23 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
         Gs = _bucket_step(int(shard_chunks.max()), 8192, 512)
         K = next(k for k in _K_BUCKETS if k >= max(int(max_nsl.value), 1))
 
-        idx = np.zeros((D, N), np.uint16)
-        cnsl = np.zeros((D, Gs), np.uint8)
-        cmeta = np.zeros((D, Gs), np.uint32)
-        cscript = np.zeros((D, Gs), np.uint8)
-        # hint-free batches (the overwhelmingly common case) ship a
-        # 1-wide dummy whack lane: the scorer skips the whack gather at
-        # trace time and ~64KB/batch stays off the wire
-        cwhack = np.zeros((D, Gs if doc_whack is not None else 1),
-                          np.uint16)
+        if staging is not None:
+            lease = staging.acquire(D, N, Gs, doc_whack is not None)
+            idx = lease.arrays["idx"]
+            cnsl = lease.arrays["cnsl"]
+            cmeta = lease.arrays["cmeta"]
+            cscript = lease.arrays["cscript"]
+            cwhack = lease.arrays["cwhack"]
+        else:
+            idx = np.zeros((D, N), np.uint16)
+            cnsl = np.zeros((D, Gs), np.uint8)
+            cmeta = np.zeros((D, Gs), np.uint32)
+            cscript = np.zeros((D, Gs), np.uint8)
+            # hint-free batches (the overwhelmingly common case) ship a
+            # 1-wide dummy whack lane: the scorer skips the whack gather
+            # at trace time and ~64KB/batch stays off the wire
+            cwhack = np.zeros((D, Gs if doc_whack is not None else 1),
+                              np.uint16)
         doc_chunk_start = np.zeros(B, np.int64)
         # hint leaves pad to power-of-two buckets to bound program-count
         # growth with hint-table size. Per (N, Gs, K) shape there are
@@ -618,6 +731,8 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
     except BaseException:
         # finish() is the only free-er; without this the C++-owned
         # compacted batch would leak on allocation failure / interrupt
+        if lease is not None:
+            lease.release()
         lib.ldt_pack_flat_free(ctypes.c_int64(handle))
         raise
     lib.ldt_pack_flat_finish(
@@ -651,7 +766,7 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
                       direct_adds=direct_adds, text_bytes=text_bytes,
                       fallback=fallback, squeezed=squeezed,
                       n_slots=n_slots, n_chunks=n_chunks, n_docs=B,
-                      ranges=ranges)
+                      ranges=ranges, staging=lease)
 
 
 # Reference 160KB-per-document scoring subset (packer.cc

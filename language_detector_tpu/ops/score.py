@@ -254,6 +254,16 @@ score_chunks = jax.jit(score_chunks_impl)
 # result-vector variant: [G, 2] u32 (word1 as above + lang2/rd/rs word)
 score_chunks_full = jax.jit(
     lambda dt, p: score_chunks_impl(dt, p, full_out=True))
+# pipelined variant: the wire dict (arg 1) is donated, so the device
+# reuses the transferred dispatch buffers in place instead of holding
+# them alive alongside fresh output allocations. Host numpy inputs are
+# copied to the device synchronously during the call, so the staging
+# arrays behind the wire are reusable as soon as the launch returns —
+# the contract the pack staging ring (native/__init__.py) relies on.
+# On CPU backends jax warns that donation is unimplemented and falls
+# back to copying; harmless, so the engine filters that warning at the
+# launch site.
+score_chunks_donated = jax.jit(score_chunks_impl, donate_argnums=(1,))
 
 
 def unpack_chunks_out2(out2: np.ndarray) -> np.ndarray:

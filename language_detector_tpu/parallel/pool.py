@@ -91,8 +91,27 @@ class Lane:
         self._consecutive = 0
         self._dispatches = 0
         self._failures = 0
+        self._inflight = 0         # launched, fetch not yet finished
         self._last_completion = 0.0
         self._evicted_at = 0.0
+
+    def begin_dispatch(self) -> None:
+        """A program launched on this lane: count it in flight until
+        its fetch finishes (success OR failure). The gauge is what the
+        donation path audits — while any lane shows in-flight work for
+        a batch, a hedge or failover relaunch may still re-read that
+        batch's host wire arrays, so its staging lease must not be
+        back in the ring yet (_PoolFuture.on_settled orders that)."""
+        with self._lock:
+            self._inflight += 1
+
+    def end_dispatch(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
 
     def state(self) -> int:
         with self._lock:
@@ -174,6 +193,7 @@ class Lane:
                 "dispatches": self._dispatches,
                 "failures": self._failures,
                 "consecutive_failures": self._consecutive,
+                "inflight": self._inflight,
                 "last_completion": self._last_completion,
             }
 
@@ -182,10 +202,25 @@ class _PoolFuture:
     """Handle for a pool-supervised dispatch. `__array__` runs the
     supervised fetch (hedge + failover), so every np.asarray(fut) site
     in the engine resolves through the pool; the result is memoized so
-    a double fetch can never re-dispatch (never double-resolved)."""
+    a double fetch can never re-dispatch (never double-resolved).
+
+    Settled accounting: the future SETTLES when the supervised fetch
+    has returned or raised — at that point every launch_fn invocation
+    this batch will ever make (initial dispatch, hedge, failover
+    relaunches) has already returned, because they all run
+    synchronously inside the supervised fetch. launch_fn is the only
+    consumer of the batch's host wire arrays (JAX copies them into
+    device buffers during the call), so on_settled is exactly the
+    point where a donated staging lease may re-enter the ring. A
+    hedge-loser fetch can still be draining on its executor thread
+    after settlement — it only reads the lane's DEVICE result buffer,
+    never the host wire, and its value is discarded. `attempts` is the
+    number of lane attempts the supervised fetch spent (1 = no
+    failover)."""
 
     __slots__ = ("_pool", "lane", "raw", "launch_fn", "trace",
-                 "_result")
+                 "_result", "_lock", "_settled", "_callbacks",
+                 "attempts")
 
     def __init__(self, pool: "DevicePool", lane: Lane, raw,
                  launch_fn, trace) -> None:
@@ -195,10 +230,36 @@ class _PoolFuture:
         self.launch_fn = launch_fn
         self.trace = trace
         self._result = None
+        self._lock = make_lock("pool.future")
+        self._settled = False
+        self._callbacks: list = []
+        self.attempts = 0
+
+    def on_settled(self, callback) -> None:
+        """Run callback once the future settles (immediately when it
+        already has). The engine releases donated staging leases here;
+        callbacks must be idempotent and must not block."""
+        with self._lock:
+            if not self._settled:
+                self._callbacks.append(callback)
+                return
+        callback()
+
+    def _settle(self) -> None:
+        with self._lock:
+            if self._settled:
+                return
+            self._settled = True
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb()
 
     def __array__(self, dtype=None) -> np.ndarray:
         if self._result is None:
-            self._result = self._pool._fetch(self)
+            try:
+                self._result = self._pool._fetch(self)
+            finally:
+                self._settle()
         out = self._result
         return out if dtype is None else np.asarray(out, dtype=dtype)
 
@@ -305,7 +366,11 @@ class DevicePool:
     def _launch_on(self, lane: Lane, launch_fn):
         if faults.ACTIVE is not None:
             faults.hit("lane_dispatch")
-        return launch_fn(lane)
+        raw = launch_fn(lane)
+        # only a launch that RETURNED is in flight; a raising launch_fn
+        # never occupied the lane
+        lane.begin_dispatch()
+        return raw
 
     # -- fetch: hedge + failover --------------------------------------------
 
@@ -313,15 +378,22 @@ class DevicePool:
         """Blocking fetch of one raw future on one lane (executor
         thread). Success and latency fold into the lane's health; a
         probing lane's success re-admits it."""
-        if faults.ACTIVE is not None:
-            faults.hit("lane_stall")
-            faults.hit("lane_lost")
-        t0 = self._now()
-        out = np.asarray(raw)
-        if lane.record_success((self._now() - t0) * 1e3, self._now()):
-            telemetry.REGISTRY.counter_inc(
-                "ldt_pool_lane_readmitted_total", lane=lane.name)
-        return out
+        try:
+            if faults.ACTIVE is not None:
+                faults.hit("lane_stall")
+                faults.hit("lane_lost")
+            t0 = self._now()
+            out = np.asarray(raw)
+            if lane.record_success((self._now() - t0) * 1e3,
+                                   self._now()):
+                telemetry.REGISTRY.counter_inc(
+                    "ldt_pool_lane_readmitted_total", lane=lane.name)
+            return out
+        finally:
+            # success OR failure retires the dispatch: the lane's
+            # in-flight gauge must drain so redispatch of a donated
+            # batch never double-counts the lost lane
+            lane.end_dispatch()
 
     def _hedge_threshold_sec(self, lane: Lane, trace) -> float | None:
         """Seconds to wait before hedging this lane's fetch, or None
@@ -383,6 +455,10 @@ class DevicePool:
                 and loser.exception() is None:
             winner, loser = loser, winner
         loser.cancel()
+        if loser.cancelled():
+            # the loser's _fetch_on never ran, so retire its dispatch
+            # here — the in-flight gauge must not leak on a cancel
+            (hlane if loser is hfut else lane).end_dispatch()
         if loser.done() and not loser.cancelled() \
                 and loser.exception() is not None:
             self._lane_failed(hlane if loser is hfut else lane)
@@ -403,6 +479,7 @@ class DevicePool:
         last_err: Exception | None = None
         while True:
             attempts += 1
+            pf.attempts = attempts
             try:
                 return self._await_result(lane, raw, pf)
             except Exception as e:  # noqa: BLE001 - any fetch error is a lost batch
@@ -423,6 +500,7 @@ class DevicePool:
                     self._lane_failed(lane)
                     last_err = e
                     attempts += 1
+                    pf.attempts = attempts
                     continue
                 relaunched = True
                 break

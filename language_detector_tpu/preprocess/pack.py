@@ -77,6 +77,119 @@ def tier_max_chars(k: int) -> int:
 BASE_KINDS = (SEED, QUAD, UNI)
 
 
+# -- long-document span splitting (the longdoc lane) ------------------------
+#
+# Documents whose slot demand exceeds the top shape bucket serialize the
+# lane they ride (one 100KB doc costs as much wire as 600 tweets). The
+# engine splits them into sub-documents at SCRIPT-SPAN boundaries so each
+# sub-pack is ordinary bucket-ladder work, then merges the per-chunk score
+# rows back into one document summary (result_vector.merge_longdoc_chunks).
+# Span boundaries are the only exact split points: chunk assignment, the
+# octa repeat cache, and word-pair hashes all reset at a span edge, so a
+# sub-document packs chunk-for-chunk identically to its slice of the
+# unsplit document. Splitting inside a span would drop cross-word pair
+# candidates and shift chunk boundaries — never exact — so single-span
+# documents (monolingual text under the scanner's ~40KB span cap) ride
+# their tier unsplit.
+
+def _maybe_multi_span(text: str, tables) -> bool:
+    """Cheap vectorized pre-filter: can this text segment into more than
+    one script span? One span is certain when the letters are a single
+    script and the text sits under the scanner's span cap — the common
+    monolingual long doc, which must not pay the Python span scan."""
+    from .segment import MAX_SPAN_PUT_BYTES, _decode_utf32
+    cps = _decode_utf32(text)
+    if len(cps) == 0:
+        return False
+    scripts = tables.script_of_cp[np.minimum(cps, 0x10FFFF)]
+    letters = scripts[(scripts != 0) & (scripts != 40)]  # 40 = Inherited
+    if len(letters) == 0:
+        return False
+    if letters.min() != letters.max():
+        return True  # two scripts -> at least two spans possible
+    # single script: multiple spans only if the scanner's cap can split
+    return len(text.encode("utf-8", "surrogatepass")) >= MAX_SPAN_PUT_BYTES
+
+
+def split_longdoc(text: str, tables: ScoringTables,
+                  max_slots: int) -> list[str] | None:
+    """Split one oversized document into span-aligned sub-documents of
+    about `max_slots` estimated slots each. Returns the sub-texts (>= 2,
+    source-order slices of `text`), or None when the document cannot be
+    split exactly (single span, or re-segmentation of a slice would not
+    reproduce the document's own spans).
+
+    Exactness contract: each returned slice re-segments into exactly the
+    spans the full document produced for that range, so packing the
+    sub-documents yields the same per-span candidates and chunk layout
+    as the unsplit pack. Slices under the scanner's span cap are exact
+    by construction (no soft-limit or truncation rule can fire inside
+    them); larger slices are verified by re-segmentation and the whole
+    split is abandoned on any mismatch."""
+    from .segment import SOFT_SPAN_PUT_BYTES, segment_text
+    if max_slots <= 0 or est_slot_demand(text) <= max_slots:
+        return None
+    if not _maybe_multi_span(text, tables):
+        return None
+    spans = segment_text(text, tables)
+    if len(spans) < 2:
+        return None
+
+    # source-char extent of each span (src_idx maps span-buffer bytes to
+    # source char indices; the final entry names the first char past the
+    # last letter run, so end is exclusive after +1 at end-of-input)
+    extents = []
+    for sp in spans:
+        if sp.src_idx is None or len(sp.src_idx) < 2:
+            return None
+        extents.append((int(sp.src_idx[1]), int(sp.src_idx[-1]) + 1))
+
+    # greedy grouping toward the per-sub-doc char budget; a span bigger
+    # than the budget (e.g. a scanner-capped 40KB run) is its own group
+    budget_chars = max(1, (max_slots - _TIER_BASE_SLOTS) * 4)
+    groups: list[list[int]] = [[]]
+    cur_chars = 0
+    for i, (s0, s1) in enumerate(extents):
+        span_chars = s1 - s0
+        if groups[-1] and cur_chars + span_chars > budget_chars:
+            groups.append([])
+            cur_chars = 0
+        groups[-1].append(i)
+        cur_chars += span_chars
+    if len(groups) < 2:
+        return None
+
+    subs = []
+    for g in groups:
+        a = extents[g[0]][0]
+        b = extents[g[-1]][1]
+        sub = text[a:b]
+        # consecutive same-script spans exist only where a scanner size
+        # rule fired; re-segmenting the slice without that rule would
+        # merge them, so they always need the verify pass
+        same_script_pair = any(
+            spans[i].ulscript == spans[j].ulscript
+            for i, j in zip(g, g[1:]))
+        if same_script_pair or \
+                len(sub.encode("utf-8", "surrogatepass")) >= \
+                SOFT_SPAN_PUT_BYTES:
+            # the scanner's soft-limit / even-split rules could fire
+            # inside a slice this big: verify the slice reproduces the
+            # document's own spans, else refuse to split
+            re_spans = segment_text(sub, tables)
+            if len(re_spans) != len(g):
+                return None
+            for rs, i in zip(re_spans, g):
+                os_ = spans[i]
+                if rs.text_bytes != os_.text_bytes or \
+                        rs.ulscript != os_.ulscript or \
+                        not np.array_equal(rs.buf[:rs.text_bytes],
+                                           os_.buf[:os_.text_bytes]):
+                    return None
+        subs.append(sub)
+    return subs
+
+
 @dataclasses.dataclass
 class PackedBatch:
     """Fixed-shape candidate tensors for one batch of documents."""

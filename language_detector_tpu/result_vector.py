@@ -176,3 +176,72 @@ def chunks_for_doc(text: str, records: list, reg: Registry):
     """Mapped records -> ResultChunk vector over the original bytes."""
     raw = text.encode("utf-8", "surrogatepass")
     return merge_mapped_records(raw, records, reg)
+
+
+# -- long-doc chunk merge (the engine's longdoc lane) ------------------------
+
+
+def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
+    """Per-chunk score rows of span-aligned sub-documents -> one virtual
+    document per group, ready for the flat epilogue.
+
+    `rows` is the fetched [G, 5] chunk-summary array for a ChunkBatch
+    whose B docs are sub-documents (preprocess/pack.py split_longdoc);
+    `groups` lists (first_subdoc, n_subdocs) per original document, in
+    order, covering all B sub-docs. Returns (merged_rows, merged_cb):
+    merged_cb is a ChunkBatch-shaped view whose doc b replays exactly
+    the chunk sequence the unsplit document would have produced —
+    sub-doc row slices concatenate in source order, direct-add chunk
+    ids shift by the chunks of prior sub-docs (they are doc-local in
+    the wire, epilogue.cc ldt_epilogue_flat), text bytes sum, and
+    fallback/squeeze on ANY sub-doc marks the whole document (those
+    resolve via the scalar engine, same as an unsplit fallback). The
+    DocTote is purely additive over chunks, so epilogue(merged) ==
+    epilogue(unsplit) whenever the split was span-exact."""
+    from .native import ChunkBatch
+    rows = np.asarray(rows)
+    n_out = len(groups)
+    total_chunks = int(cb.n_chunks.sum())
+    merged_rows = np.zeros((max(total_chunks, 1), rows.shape[1]),
+                           np.int32)
+    # widest merged direct-add row set decides the output Dcap
+    dcap = 1
+    for s, n in groups:
+        valid = int((cb.direct_adds[s:s + n, :, 0] >= 0).sum())
+        dcap = max(dcap, valid)
+    doc_chunk_start = np.zeros(n_out, np.int64)
+    direct_adds = np.full((n_out, dcap, 3), -1, np.int32)
+    text_bytes = np.zeros(n_out, np.int32)
+    fallback = np.zeros(n_out, bool)
+    squeezed = np.zeros(n_out, bool)
+    n_slots = np.zeros(n_out, np.int32)
+    n_chunks = np.zeros(n_out, np.int32)
+
+    pos = 0  # write cursor in merged_rows
+    for j, (s, n) in enumerate(groups):
+        doc_chunk_start[j] = pos
+        chunk_off = 0  # doc-local chunk ids of later sub-docs shift up
+        nd = 0
+        for i in range(s, s + n):
+            nc = int(cb.n_chunks[i])
+            g0 = int(cb.doc_chunk_start[i])
+            merged_rows[pos:pos + nc] = rows[g0:g0 + nc]
+            for pos_d in range(cb.direct_adds.shape[1]):
+                c, lang, nbytes = cb.direct_adds[i, pos_d]
+                if c < 0:
+                    break
+                direct_adds[j, nd] = (int(c) + chunk_off, lang, nbytes)
+                nd += 1
+            pos += nc
+            chunk_off += nc
+            text_bytes[j] += int(cb.text_bytes[i])
+            fallback[j] |= bool(cb.fallback[i])
+            squeezed[j] |= bool(cb.squeezed[i])
+            n_slots[j] += int(cb.n_slots[i])
+        n_chunks[j] = chunk_off
+    merged = ChunkBatch(wire={}, doc_chunk_start=doc_chunk_start,
+                        direct_adds=direct_adds, text_bytes=text_bytes,
+                        fallback=fallback, squeezed=squeezed,
+                        n_slots=n_slots, n_chunks=n_chunks,
+                        n_docs=n_out)
+    return merged_rows, merged

@@ -45,8 +45,12 @@ def flush_workers() -> int:
     widened when the device pool runs more lanes — N lanes can carry N
     concurrent flushes (plus one accumulating), and a narrower worker
     pool would idle healthy lanes exactly when a sick lane is being
-    covered for."""
-    return max(_FLUSH_WORKERS, (knobs.get_int("LDT_POOL_LANES") or 0) + 1)
+    covered for — and when the dispatch pipeline runs deeper than the
+    default (LDT_PIPELINE_DEPTH batches in flight plus one packing
+    need as many flush slots to stay full)."""
+    return max(_FLUSH_WORKERS,
+               (knobs.get_int("LDT_POOL_LANES") or 0) + 1,
+               (knobs.get_int("LDT_PIPELINE_DEPTH") or 0) + 1)
 
 _MISS = object()  # cache sentinel: any real result (even None) differs
 

@@ -108,6 +108,10 @@ class Metrics:
         # DevicePool): () -> parallel.pool.DevicePool.stats() dict or
         # None (pool disabled — the gauges render 0)
         self.pool_stats = lambda: None
+        # live dispatch-pipeline gauge source (set when a device engine
+        # exists): () -> models/ngram.py pipeline_stats() dict or None
+        # (overlap ratio, prefetch depth, staging-ring occupancy)
+        self.pipeline_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -246,6 +250,15 @@ class Metrics:
                         ps.get("lanes_total", 0)))
         fams.append(one("ldt_pool_lanes_active",
                         ps.get("lanes_active", 0)))
+        # dispatch pipeline (models/ngram.py pipeline_stats; the
+        # donation-hit and longdoc-chunk counters are registry counters
+        # and render with the families below)
+        pl = self.pipeline_stats() or {}
+        fams.append(one("ldt_pipeline_overlap_ratio",
+                        pl.get("overlap_ratio", 0.0)))
+        fams.append(one("ldt_pipeline_depth", pl.get("depth", 0)))
+        fams.append(one("ldt_pipeline_staging_ring_occupancy",
+                        pl.get("staging_ring_occupancy", 0)))
         # readiness + supervision (docs/ROBUSTNESS.md): ldt_ready
         # mirrors /readyz, the generation gauge is set by the
         # supervisor through the child's environment
@@ -383,6 +396,10 @@ class DetectorService:
 
                 metrics.pool_stats = pool_stats
                 self.admission.attach_pool(pool_of)
+                # dispatch-pipeline gauges (same hot-swap-safe read
+                # through self._engine as the pool wiring above)
+                metrics.pipeline_stats = \
+                    lambda: self._engine.pipeline_stats()
 
                 def detect(texts, trace=None):
                     # codes-only engine path: the handler needs just the

@@ -163,6 +163,29 @@ METRICS: dict = {
         "Device-pool lanes currently in rotation (active + probing)."),
     "ldt_pool_lanes_total": (
         "gauge", "Device-pool lane count (0 = pool disabled)."),
+    "ldt_pipeline_overlap_ratio": (
+        "gauge",
+        "Fraction of host pack wall time that ran while a device "
+        "dispatch was in flight (models/ngram.py pipeline; 0 = fully "
+        "serial)."),
+    "ldt_pipeline_depth": (
+        "gauge",
+        "Configured dispatch-pipeline depth (LDT_PIPELINE_DEPTH; 1 = "
+        "serial reference path)."),
+    "ldt_pipeline_donation_hits_total": (
+        "counter",
+        "Launches through the donating jitted scorer "
+        "(donate_argnums): the device reused the dispatch buffers "
+        "instead of allocating fresh ones."),
+    "ldt_pipeline_staging_ring_occupancy": (
+        "gauge",
+        "Host staging-ring arrays currently checked out by in-flight "
+        "dispatches (native pack staging; steady state stays below "
+        "the ring capacity, so packing allocates nothing)."),
+    "ldt_pipeline_longdoc_chunks_total": (
+        "counter",
+        "Span-aligned sub-documents created by the long-doc lane "
+        "(LDT_LONGDOC_CHUNK_SLOTS splitting in preprocess/pack.py)."),
 }
 
 
@@ -647,6 +670,11 @@ def debug_vars(metrics=None) -> dict:
             p = pool_fn()
             if p:
                 d["pool"] = p
+        pipeline_fn = getattr(metrics, "pipeline_stats", None)
+        if pipeline_fn is not None:
+            pl = pipeline_fn()
+            if pl:
+                d["pipeline"] = pl
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

@@ -318,6 +318,27 @@ def test_jit_good_fixture_is_clean():
     assert ns == 0
 
 
+def test_jit_ring_bad_fixture_trips():
+    # staging-ring donation pattern: a wire read between the donating
+    # launch and its result future's resolution, and a wire whose
+    # future was rebound before ever resolving
+    v, _ = jit_contract.check(root=REPO,
+                              files=[f"{FIX}/jit_ring_bad.py"])
+    rules = _rules(v)
+    assert rules["jit-donated-read"] == 2
+    assert sum(rules.values()) == 2
+
+
+def test_jit_ring_good_fixture_is_clean():
+    # ring-slot reuse AFTER np.asarray(fut) / fut.block_until_ready()
+    # is the legal staging pattern, including the engine's
+    # resolve-and-read-in-one-statement fetch shape
+    v, ns = jit_contract.check(root=REPO,
+                               files=[f"{FIX}/jit_ring_good.py"])
+    assert v == []
+    assert ns == 0
+
+
 def test_jit_live_device_path_is_clean():
     v, _ = jit_contract.check(root=REPO)
     assert v == []

@@ -1,0 +1,305 @@
+"""Pipelined dispatch (PR 9) identity and drain tests.
+
+LDT_PIPELINE_DEPTH=1 is the serial reference: pack, score, fetch, one
+batch at a time, no buffer donation. Depth 2+ overlaps host packing
+with device scoring and donates the wire buffers of the staging ring
+into the jitted scorer. The contract is BYTE-IDENTITY: every depth, on
+every corpus — including under injected lane faults and a mid-stream
+artifact swap — produces exactly the serial engine's results, and the
+serial engine is itself pinned to the scalar oracle by
+test_batch_agreement.py.
+
+The long-doc lane splits docs whose slot demand exceeds the top bucket
+into span-aligned sub-packs and merges the per-chunk score vectors
+back into one doc summary (result_vector.merge_longdoc_chunks); its
+exactness is pinned directly against engine_scalar here.
+"""
+import os
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+BATCH = 32
+
+# shuffled-vocabulary composition: multi-script so long docs split
+# into several spans, non-repetitive so the spam squeezer stays out
+# of the way (a squeezed doc resolves scalar and never exercises the
+# chunk-merge path this file exists to pin)
+_VOCAB = {
+    "en": ("the quick brown fox jumps over a lazy dog while bright "
+           "stars shine above quiet rivers and old houses near the "
+           "harbor where fishermen mend their nets every "
+           "morning").split(),
+    "fr": ("le renard brun rapide saute par dessus le chien paresseux "
+           "pendant que les etoiles brillantes scintillent au dessus "
+           "des rivieres tranquilles et des vieilles maisons du "
+           "port").split(),
+    "ru": ("быстрая коричневая лиса прыгает через ленивую собаку пока "
+           "яркие звезды сияют над тихими реками и старыми домами "
+           "возле гавани где рыбаки чинят свои сети каждое "
+           "утро").split(),
+    "el": ("η γρηγορη καφε αλεπου πηδαει πανω απο το τεμπελικο σκυλι "
+           "ενω τα λαμπερα αστερια λαμπουν πανω απο ησυχα ποταμια και "
+           "παλια σπιτια κοντα στο λιμανι").split(),
+}
+
+
+def _sentence(rng, lang):
+    words = [rng.choice(_VOCAB[lang])
+             for _ in range(rng.randint(8, 14))]
+    return " ".join(words) + ". "
+
+
+def _long_doc(rng, size):
+    """Multi-span doc: runs of one script long enough to form spans,
+    switching scripts every few sentences."""
+    parts: list = []
+    total = 0
+    while total < size:
+        lang = rng.choice(list(_VOCAB))
+        for _ in range(rng.randint(2, 5)):
+            s = _sentence(rng, lang)
+            parts.append(s)
+            total += len(s)
+    return "".join(parts)
+
+
+def _mixed_corpus(rng, n_short=160, n_long=8):
+    texts = []
+    langs = list(_VOCAB)
+    for i in range(n_short):
+        lang = langs[i % len(langs)]
+        words = [rng.choice(_VOCAB[lang])
+                 for _ in range(rng.randint(6, 40))]
+        texts.append(" ".join(words) + f" tag{i}")
+    for _ in range(n_long):
+        texts.append(_long_doc(rng, rng.randint(5000, 18000)))
+    texts += ["", "a", "   ", "12345 67890 $$$"]
+    rng.shuffle(texts)
+    return texts
+
+
+def _engine(depth, **kw):
+    """Engine constructed under LDT_PIPELINE_DEPTH=depth (knobs read
+    the environment at construction, so env — not monkeypatch — must
+    bracket the constructor)."""
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    saved = os.environ.get("LDT_PIPELINE_DEPTH")
+    os.environ["LDT_PIPELINE_DEPTH"] = str(depth)
+    try:
+        return NgramBatchEngine(**kw)
+    finally:
+        if saved is None:
+            os.environ.pop("LDT_PIPELINE_DEPTH", None)
+        else:
+            os.environ["LDT_PIPELINE_DEPTH"] = saved
+
+
+def _result_tuple(r):
+    return (r.summary_lang, tuple(r.language3), tuple(r.percent3),
+            tuple(r.normalized_score3), r.text_bytes, r.is_reliable)
+
+
+def _tuples(results):
+    return [_result_tuple(r) for r in results]
+
+
+# -- depth identity ----------------------------------------------------------
+
+
+def test_depth1_vs_depth3_byte_identical():
+    """The whole point of the pipeline: depth is a latency knob, not a
+    semantics knob. Depth 1 (serial) and depth 3 (two batches in
+    flight, donated wire buffers) agree byte-for-byte over a mixed
+    corpus of short docs, multi-span long docs, and the empty/tiny
+    edge paths."""
+    rng = random.Random(42)
+    corpus = _mixed_corpus(rng)
+    # split_slots == chunk_slots forces the long-doc lane for every
+    # multi-span doc over the sub-pack size, so the identity claim
+    # covers the merge path, not just the plain pipeline
+    ld = dict(longdoc_split_slots=1024)
+    e1, e3 = _engine(1, **ld), _engine(3, **ld)
+    ref = _tuples(e1.detect_many(corpus, batch_size=BATCH))
+    got = _tuples(e3.detect_many(corpus, batch_size=BATCH))
+    assert got == ref
+    s1, s3 = e1.pipeline_stats(), e3.pipeline_stats()
+    # the serial reference must not pipeline; depth 3 must have
+    # actually exercised the machinery it claims to
+    assert s1["depth"] == 1 and s1["donation_hits"] == 0
+    assert s3["depth"] == 3
+    # every dispatch retired, every staging lease returned
+    for s in (s1, s3):
+        assert s["inflight"] == 0
+        assert s["staging_ring_occupancy"] == 0
+    assert e3.stats["longdoc_split_docs"] > 0
+    assert e3.stats["retry_offtier_docs"] == 0
+
+
+def test_depth2_default_matches_serial_small_batches():
+    """Default depth over many small slices — the steady-state ring
+    reuse shape (same bucket tier over and over)."""
+    rng = random.Random(7)
+    corpus = [" ".join(rng.choice(_VOCAB["en"]) for _ in range(12))
+              + f" doc{i}" for i in range(96)]
+    ref = _tuples(_engine(1).detect_many(corpus, batch_size=16))
+    e2 = _engine(2)
+    got = _tuples(e2.detect_many(corpus, batch_size=16))
+    assert got == ref
+    s = e2.pipeline_stats()
+    assert s["staging_ring_hits"] > 0
+    assert s["staging_ring_occupancy"] == 0
+
+
+# -- identity under faults ---------------------------------------------------
+
+
+_POOL_ENV = {"LDT_POOL_LANES": "2",
+             "LDT_POOL_HEDGE_FACTOR": "0",
+             "LDT_POOL_EVICT_FAILURES": "5",
+             "LDT_POOL_PROBE_COOLDOWN_SEC": "0.2",
+             "LDT_POOL_MAX_REDISPATCH": "8"}
+
+
+def test_depth_identity_under_lane_faults():
+    """Depth 3 over a 2-lane pool with lane_lost errors firing on half
+    the fetches and device_flush latency jitter: failover re-dispatches
+    donated batches, and the results stay byte-identical to the clean
+    serial run. The lane in-flight gauges must drain to zero — a
+    re-dispatched donated batch that double-counted would leak here."""
+    from language_detector_tpu import faults
+    saved = {k: os.environ.get(k) for k in _POOL_ENV}
+    os.environ.update(_POOL_ENV)
+    try:
+        e1 = _engine(1, longdoc_split_slots=1024)
+        if e1.pool is None:
+            pytest.skip("pooled device engine unavailable")
+        rng = random.Random(5)
+        corpus = _mixed_corpus(rng, n_short=96, n_long=4)
+        ref = _tuples(e1.detect_many(corpus, batch_size=BATCH))
+        e3 = _engine(3, longdoc_split_slots=1024)
+        faults.configure("lane_lost:error:p=0.5:seed=9,"
+                         "device_flush:delay_ms=2:p=0.5:seed=3")
+        try:
+            got = _tuples(e3.detect_many(corpus, batch_size=BATCH))
+        finally:
+            faults.configure(None)
+        assert got == ref
+        for ln in e3.pool.lanes:
+            assert ln.snapshot()["inflight"] == 0
+        s = e3.pipeline_stats()
+        assert s["inflight"] == 0
+        assert s["staging_ring_occupancy"] == 0
+        e1.pool.close()
+        e3.pool.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_device_flush_error_retires_dispatch():
+    """A flush that dies before its fetch must retire the dispatch:
+    in-flight gauge back to zero, every staging lease released, and the
+    engine healthy for the next call (which must still be exact)."""
+    from language_detector_tpu import faults
+    e3 = _engine(3)
+    corpus = [f"plain english words number {i} for the flush test run"
+              for i in range(48)]
+    ref = _tuples(_engine(1).detect_many(corpus, batch_size=16))
+    faults.configure("device_flush:error:once")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            e3.detect_many(corpus, batch_size=16)
+    finally:
+        faults.configure(None)
+    s = e3.pipeline_stats()
+    assert s["inflight"] == 0
+    assert s["staging_ring_occupancy"] == 0
+    assert _tuples(e3.detect_many(corpus, batch_size=16)) == ref
+
+
+# -- mid-stream artifact swap ------------------------------------------------
+
+
+def test_midstream_swap_identity():
+    """The swap contract (service/swap.py): in-flight flushes finish on
+    the engine they captured, new flushes land on the new engine. At
+    the engine level that means a stream split across two engines of
+    the same artifact — donated buffers, staging rings and all — must
+    equal one serial engine's run over the whole stream."""
+    rng = random.Random(11)
+    corpus = _mixed_corpus(rng, n_short=96, n_long=4)
+    ref = _tuples(_engine(1, longdoc_split_slots=1024)
+                  .detect_many(corpus, batch_size=BATCH))
+    e_a = _engine(3, longdoc_split_slots=1024)
+    half = len(corpus) // 2
+    got = _tuples(e_a.detect_many(corpus[:half], batch_size=BATCH))
+    # the swapped-in engine (same artifact, fresh pipeline state)
+    e_b = _engine(3, tables=e_a.tables, longdoc_split_slots=1024)
+    got += _tuples(e_b.detect_many(corpus[half:], batch_size=BATCH))
+    assert got == ref
+    for e in (e_a, e_b):
+        s = e.pipeline_stats()
+        assert s["inflight"] == 0
+        assert s["staging_ring_occupancy"] == 0
+
+
+# -- long-doc lane exactness -------------------------------------------------
+
+
+def test_longdoc_chunk_merge_exact_vs_scalar():
+    """≥100 multi-span long docs: the span-parallel chunk lane (split
+    in preprocess/pack.py, merged in result_vector.py) is byte-exact
+    against the scalar reference engine on every doc."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    rng = random.Random(23)
+    docs = [_long_doc(rng, rng.randint(5000, 14000))
+            for _ in range(104)]
+    eng = _engine(2, longdoc_split_slots=1024)
+    got = eng.detect_many(docs, batch_size=BATCH)
+    bad = []
+    for i, t in enumerate(docs):
+        want = detect_scalar(t, eng.tables, eng.reg)
+        if _result_tuple(got[i]) != _result_tuple(want):
+            bad.append((i, _result_tuple(got[i]), _result_tuple(want)))
+    assert not bad, f"{len(bad)} long-doc disagreements, first: {bad[0]}"
+    # the lane must actually have split — a corpus that fit the top
+    # bucket would pin nothing
+    assert eng.stats["longdoc_split_docs"] >= 100
+    assert eng.stats["longdoc_subdocs"] > eng.stats["longdoc_split_docs"]
+    assert eng.pipeline_stats()["longdoc_chunks"] > 0
+
+
+def test_longdoc_lane_off_still_exact():
+    """longdoc_chunk_slots=0 disables the lane (docs take the ordinary
+    tail-bucket path); results must not depend on the lane being on."""
+    rng = random.Random(29)
+    docs = [_long_doc(rng, rng.randint(5000, 9000)) for _ in range(12)]
+    ref = _tuples(_engine(1, longdoc_chunk_slots=0)
+                  .detect_many(docs, batch_size=BATCH))
+    eng = _engine(2, longdoc_split_slots=1024)
+    assert _tuples(eng.detect_many(docs, batch_size=BATCH)) == ref
+    assert eng.stats["longdoc_split_docs"] > 0
+
+
+def test_longdoc_default_threshold_takes_fat_tail():
+    """At the default LDT_LONGDOC_SPLIT_SLOTS, mid-size docs ride
+    their tier unsplit (the split scan + merge is pure overhead for
+    them) while the fat tail still splits — and stays exact."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    rng = random.Random(31)
+    mids = [_long_doc(rng, 6000) for _ in range(4)]
+    fats = [_long_doc(rng, 30000) for _ in range(4)]
+    eng = _engine(2)
+    got = eng.detect_many(mids + fats, batch_size=BATCH)
+    assert eng.stats["longdoc_split_docs"] == len(fats)
+    for t, r in zip(mids + fats, got):
+        want = detect_scalar(t, eng.tables, eng.reg)
+        assert _result_tuple(r) == _result_tuple(want)

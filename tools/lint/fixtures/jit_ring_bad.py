@@ -1,0 +1,26 @@
+"""Seeded staging-ring donation violations (tests/test_lint.py):
+reads of a donated wire between the launch and the result future's
+resolution."""
+import jax
+import numpy as np
+
+
+def score_impl(dt, wire):
+    return wire * dt
+
+
+score_donated = jax.jit(score_impl, donate_argnums=(1,))
+
+
+def read_before_resolve(dt, wire):
+    fut = score_donated(dt, wire)
+    peek = wire.sum()  # jit-donated-read: fut not resolved yet
+    rows = np.asarray(fut)
+    return rows, peek
+
+
+def never_resolved(dt, wire, other):
+    fut = score_donated(dt, wire)
+    fut = score_donated(dt, other)  # rebinds fut: first future lost
+    np.asarray(fut)
+    return wire  # jit-donated-read: first call's future never resolved

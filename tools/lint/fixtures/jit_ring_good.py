@@ -1,0 +1,33 @@
+"""Clean twin of jit_ring_bad.py: the staging-ring reuse pattern —
+wire reads only AFTER the donating call's result future resolves
+(np.asarray / block_until_ready), which is when every host byte has
+been copied into device buffers and the ring slot is reusable."""
+import jax
+import numpy as np
+
+
+def score_impl(dt, wire):
+    return wire * dt
+
+
+score_donated = jax.jit(score_impl, donate_argnums=(1,))
+
+
+def fetch_then_reuse(dt, wire, ring):
+    fut = score_donated(dt, wire)
+    rows = np.asarray(fut)  # resolution settles the dispatch
+    meta = wire.sum()  # legal: ring-slot reuse after resolution
+    ring.release(wire)
+    return rows, meta
+
+
+def fetch_and_read_one_statement(dt, wire, unpack):
+    # the engine's fetch shape: resolve and read in one statement
+    fut = score_donated(dt, wire)
+    return unpack(np.asarray(fut), wire)
+
+
+def block_until_ready_form(dt, wire):
+    fut = score_donated(dt, wire)
+    fut.block_until_ready()
+    return wire.sum()

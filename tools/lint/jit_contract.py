@@ -8,9 +8,17 @@ Two rules over the same device-path files trace_safety scans:
                          the call — XLA may have aliased its memory
                          into the outputs — so any later read of that
                          name in the same function is a
-                         use-after-donate. The live tree donates
-                         nothing today; the rule exists so the first
-                         donation lands with its contract enforced.
+                         use-after-donate. One legal exception, the
+                         engine's staging-ring pattern
+                         (models/ngram.py): when the donating call's
+                         result future is bound to a name, resolving
+                         that future (``np.asarray(fut)`` or
+                         ``fut.block_until_ready()``) settles the
+                         dispatch — every host byte was copied to the
+                         device during the call — so reads AFTER the
+                         resolution are ring-slot reuse and clean;
+                         reads between launch and resolution still
+                         flag.
   jit-recompile-capture  a jitted entry that reads a per-call-varying
                          Python value from an enclosing scope bakes it
                          in as a trace-time constant: every new value
@@ -79,17 +87,51 @@ def _donating_bindings(sources) -> dict:
     return donating
 
 
+def _resolved_future(node) -> str | None:
+    """The future Name this expression resolves, or None: matches
+    ``np.asarray(fut)`` / ``asarray(fut)`` / ``fut.block_until_ready()``
+    — the fetch forms every engine dispatch site uses."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready" and isinstance(f.value,
+                                                        ast.Name):
+            return f.value.id
+        if f.attr == "asarray" and node.args \
+                and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+    elif isinstance(f, ast.Name) and f.id == "asarray" and node.args \
+            and isinstance(node.args[0], ast.Name):
+        return node.args[0].id
+    return None
+
+
 def _check_donated_reads(sf, donating: dict, out: list):
     """Within each function: once a Name is passed at a donated
     position of a donating callable, any later Load of it is flagged.
-    A Store rebinds the name to a live value and clears it."""
+    A Store rebinds the name to a live value and clears it. When the
+    donating call's result is bound (`fut = score(dt, wire)`),
+    resolving that future (`np.asarray(fut)`,
+    `fut.block_until_ready()`) clears the call's donated names — the
+    staging-ring reuse pattern — while reads before the resolution
+    still flag."""
 
-    def scan_stmt(stmt, donated):
-        """One simple statement, in evaluation order: reads of a
-        previously-donated name flag; the statement's own donating
-        calls then register; its stores then rebind (so
+    def scan_stmt(stmt, donated, futures):
+        """One simple statement, in evaluation order: resolutions of a
+        bound result future settle their donated names first (so
+        `rows = unpack(np.asarray(fut), wire)` is the legal
+        fetch-then-read shape); reads of a still-donated name then
+        flag; the statement's own donating calls register (binding
+        their result future when assigned); its stores then rebind (so
         `acc = step(acc, xs)` donates the old `acc` AND leaves the
         name alive on the result)."""
+        just_bound: set = set()
+        for node in ast.walk(stmt):
+            fname = _resolved_future(node)
+            if fname is not None and fname in futures:
+                for n in futures.pop(fname):
+                    donated.pop(n, None)
         for node in ast.walk(stmt):
             if isinstance(node, ast.Name) \
                     and isinstance(node.ctx, ast.Load) \
@@ -99,23 +141,37 @@ def _check_donated_reads(sf, donating: dict, out: list):
                     f"`{node.id}` was donated to a jitted call on "
                     f"line {donated[node.id]} "
                     f"(donate_argnums); its buffer may be aliased "
-                    f"into the outputs — rebind before reuse"))
+                    f"into the outputs — rebind before reuse, or "
+                    f"resolve the call's result future first "
+                    f"(staging-ring reuse)"))
                 donated.pop(node.id)
         for node in ast.walk(stmt):
             if isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Name) and \
                     node.func.id in donating:
-                for i, a in enumerate(node.args):
-                    if i in donating[node.func.id] \
-                            and isinstance(a, ast.Name):
-                        donated[a.id] = node.lineno
+                names = [a.id for i, a in enumerate(node.args)
+                         if i in donating[node.func.id]
+                         and isinstance(a, ast.Name)]
+                for n in names:
+                    donated[n] = node.lineno
+                if names and isinstance(stmt, ast.Assign) \
+                        and stmt.value is node:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            futures[tgt.id] = set(names)
+                            just_bound.add(tgt.id)
         for node in ast.walk(stmt):
             if isinstance(node, ast.Name) \
                     and isinstance(node.ctx, ast.Store):
                 donated.pop(node.id, None)
+                # rebinding a future name abandons the old future: its
+                # donated names can never resolve and stay flagged
+                if node.id not in just_bound:
+                    futures.pop(node.id, None)
 
     def scan_scope(body):
         donated: dict = {}  # name -> line it was donated on
+        futures: dict = {}  # future name -> names donated by its call
 
         def walk(stmts):
             for stmt in stmts:
@@ -135,16 +191,16 @@ def _check_donated_reads(sf, donating: dict, out: list):
                     for hdr in ("test", "iter"):
                         h = getattr(stmt, hdr, None)
                         if h is not None:
-                            scan_stmt(h, donated)
+                            scan_stmt(h, donated, futures)
                     for item in getattr(stmt, "items", ()):
-                        scan_stmt(item.context_expr, donated)
+                        scan_stmt(item.context_expr, donated, futures)
                     tgt = getattr(stmt, "target", None)
                     if tgt is not None:
-                        scan_stmt(tgt, donated)
+                        scan_stmt(tgt, donated, futures)
                     for sub in subs:
                         walk(sub)
                 else:
-                    scan_stmt(stmt, donated)
+                    scan_stmt(stmt, donated, futures)
 
         walk(body)
 

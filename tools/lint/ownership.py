@@ -103,6 +103,8 @@ LOCK_OWNERSHIP: dict = {
                              "assignment-at-init contract",
                 "pool_stats": "callable reference, same single-"
                               "assignment-at-init contract",
+                "pipeline_stats": "callable reference, same single-"
+                                  "assignment-at-init contract",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
@@ -166,7 +168,7 @@ LOCK_OWNERSHIP: dict = {
             lock="_lock",
             attrs=("_state", "_ewma_ms", "_samples", "_sample_pos",
                    "_consecutive", "_dispatches", "_failures",
-                   "_last_completion", "_evicted_at"),
+                   "_inflight", "_last_completion", "_evicted_at"),
             lockfree={
                 "idx": "int assigned once at init, read-only",
                 "name": "str assigned once at init, read-only",
