@@ -168,6 +168,17 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "standby generation can overlap on the same port during a "
        "blue/green swap. Required (on the supervisor env) for "
        "zero-downtime SIGHUP drills on a fixed port."),
+    # -- wire fast path & unix-socket lane (service/wire.py) ----------
+    _k("LDT_UNIX_SOCKET", "str", None,
+       "Filesystem path for the unix-domain-socket ingest lane on "
+       "both fronts (length-prefixed frames, wire.py contract). "
+       "Co-located callers skip HTTP parsing entirely; responses are "
+       "byte-identical to the TCP front. Unset: no UDS listener."),
+    _k("LDT_WIRE_FASTPATH", "bool", True,
+       "Use the zero-copy request scanner for the strict common "
+       "request shape (wire.fast_parse_texts); any deviation falls "
+       "back to json.loads either way. Set 0 to force the json.loads "
+       "path (parity debugging)."),
     # -- startup warmup & compile cache (server.py, models/ngram.py) --
     _k("LDT_WARMUP", "bool", False,
        "Pre-compile the bucket ladder's jitted shapes at startup and "

@@ -17,16 +17,17 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import os
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import faults, knobs, telemetry
+from . import wire
 from .admission import (DeadlineExceeded, FairScheduler,
                         degraded_detect)
 from .batcher import (_MISS, Batcher, ResultCache, _accepts_trace,
                       flush_workers)
 from .server import (BODY_LIMIT_BYTES, USAGE, DetectorService,
-                     health_response, parse_post_body, post_detect,
-                     pre_detect)
+                     health_response)
 
 _MAX_HEADER_BYTES = 16384
 
@@ -248,10 +249,10 @@ class AioBatcher:
             raise
 
 
-def _http_response(status: int, body: bytes,
-                   content_type: bytes = b"application/json; "
-                                         b"charset=utf-8",
-                   extra_headers: tuple = ()) -> bytes:
+def _http_head(status: int, length: int,
+               content_type: bytes = b"application/json; "
+                                     b"charset=utf-8",
+               extra_headers: tuple = ()) -> bytes:
     reason = {200: b"OK", 203: b"Non-Authoritative Information",
               400: b"Bad Request", 404: b"Not Found",
               413: b"Payload Too Large",
@@ -262,10 +263,27 @@ def _http_response(status: int, body: bytes,
               504: b"Gateway Timeout"}.get(status, b"OK")
     head = (b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
             b"Content-Length: %d\r\n"
-            % (status, reason, content_type, len(body)))
+            % (status, reason, content_type, length))
     for k, v in extra_headers:
         head += k + b": " + v + b"\r\n"
-    return head + b"\r\n" + body
+    return head + b"\r\n"
+
+
+def _http_response(status: int, body: bytes,
+                   content_type: bytes = b"application/json; "
+                                         b"charset=utf-8",
+                   extra_headers: tuple = ()) -> bytes:
+    return _http_head(status, len(body), content_type,
+                      extra_headers) + body
+
+
+def _http_response_buffers(status: int, buffers: list) -> list:
+    """writev-style response: the head plus the batch-envelope buffer
+    list, handed to writer.writelines without concatenation."""
+    length = 0
+    for b in buffers:
+        length += len(b)
+    return [_http_head(status, length), *buffers]
 
 
 class AioService:
@@ -398,7 +416,10 @@ class AioService:
                             "augmentation_errors_logged_total")
                         resp = _http_response(
                             500, b'{"error":"internal error"}')
-                    writer.write(resp)
+                    if isinstance(resp, list):
+                        writer.writelines(resp)
+                    else:
+                        writer.write(resp)
                     await writer.drain()
                 except (asyncio.IncompleteReadError, ConnectionError,
                         TimeoutError):
@@ -417,7 +438,7 @@ class AioService:
                 pass
 
     async def _route(self, method: bytes, path: str, headers: dict,
-                     body: bytes) -> bytes:
+                     body: bytes) -> "bytes | list":
         svc = self.svc
         m = svc.metrics
         import time
@@ -436,22 +457,18 @@ class AioService:
             if method != b"POST" or path not in ("/", ""):
                 m.inc("augmentation_invalid_requests_total")
                 return _http_response(404, b'{"error":"Not found"}')
+            telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
+                                           lane="tcp")
             trace = telemetry.Trace()
             t = trace.t0
             ct = headers.get(b"content-type")
-            doc, err = parse_post_body(
-                m, ct.decode("latin-1") if ct is not None else None, body)
+            pre, err = wire.parse_request(
+                svc, ct.decode("latin-1") if ct is not None else None,
+                body)
             if err is not None:
                 meta["status"] = err[0]
                 return _http_response(*err)
-            pre = pre_detect(svc, doc)
             t = telemetry.observe_stage("parse", t, trace=trace)
-            if pre is None:
-                m.inc("augmentation_errors_logged_total")
-                meta["status"] = 400
-                return _http_response(400, json.dumps(
-                    {"error": "Unable to parse request - invalid JSON "
-                              "detected"}).encode())
             texts, slots, responses, status = pre
             meta["docs"] = len(texts)
             adm = svc.admission
@@ -515,11 +532,11 @@ class AioService:
                 if admit is not None:
                     adm.release(admit)
             t = telemetry.observe_stage("detect", t, trace=trace)
-            status, payload = post_detect(svc, codes, slots, responses,
-                                          status)
+            status, buffers = wire.post_detect(svc, codes, slots,
+                                               responses, status)
             telemetry.observe_stage("encode", t, trace=trace)
             meta["status"] = status
-            return _http_response(status, payload)
+            return _http_response_buffers(status, buffers)
         finally:
             m.inc("augmentation_requests_total")
             if trace is not None:
@@ -527,6 +544,144 @@ class AioService:
                 telemetry.finish_request(trace, meta=meta)
             else:
                 m.observe_request_ms((time.time() - t0) * 1e3)
+
+    async def handle_uds(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter):
+        """Unix-socket ingest lane (wire.py frame contract): length-
+        prefixed JSON bodies, no HTTP parsing. An oversize frame
+        answers a 413 error frame and closes — a length-prefixed
+        stream cannot resync past a rejected body. Connections join
+        the same _writers/_busy sets as TCP, so recycle and SIGTERM
+        drains cover both lanes."""
+        self._writers.add(writer)
+        svc = self.svc
+        try:
+            while True:
+                try:
+                    hdr = await reader.readexactly(
+                        wire.FRAME_HEADER.size)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                (length,) = wire.FRAME_HEADER.unpack(hdr)
+                if length > BODY_LIMIT_BYTES:
+                    m = svc.metrics
+                    m.inc("augmentation_requests_total")
+                    m.inc("augmentation_invalid_requests_total")
+                    m.inc_object("unsuccessful")
+                    telemetry.REGISTRY.counter_inc(
+                        "ldt_http_requests_total", lane="uds")
+                    writer.write(wire.FRAME_RESP_HEADER.pack(
+                        len(wire.OVERSIZE_BODY), 413))
+                    writer.write(wire.OVERSIZE_BODY)
+                    with contextlib.suppress(Exception):
+                        await writer.drain()
+                    break
+                self._busy.add(writer)
+                try:
+                    body = await reader.readexactly(length) \
+                        if length else b""
+                    try:
+                        status, buffers = await self._frame(body)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionError, TimeoutError):
+                        raise
+                    except Exception:  # noqa: BLE001 - typed 500,
+                        # never a torn frame (chaos invariant)
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "uds frame handler error (answering 500)")
+                        svc.metrics.inc(
+                            "augmentation_errors_logged_total")
+                        status = 500
+                        buffers = [b'{"error":"internal error"}']
+                    blen = sum(len(b) for b in buffers)
+                    writer.write(
+                        wire.FRAME_RESP_HEADER.pack(blen, status))
+                    writer.writelines(buffers)
+                    await writer.drain()
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        TimeoutError):
+                    break
+                finally:
+                    self._busy.discard(writer)
+        finally:
+            self._busy.discard(writer)
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - already torn down
+                pass
+
+    async def _frame(self, body: bytes) -> tuple:
+        """One UDS frame body through the shared wire path ->
+        (status, buffer list); the async twin of wire.handle_frame
+        over the aio batcher. The concatenated buffers are identical
+        to the TCP front's payload for the same batch."""
+        svc = self.svc
+        m = svc.metrics
+        m.inc("augmentation_requests_total")
+        telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
+                                       lane="uds")
+        trace = telemetry.Trace()
+        t = trace.t0
+        meta: dict = {"front": "uds"}
+        try:
+            pre, err = wire.parse_request(svc, "application/json",
+                                          body)
+            if err is not None:
+                meta["status"] = err[0]
+                return err[0], [err[1]]
+            t = telemetry.observe_stage("parse", t, trace=trace)
+            texts, slots, responses, status = pre
+            meta["docs"] = len(texts)
+            adm = svc.admission
+            admit = None
+            if texts:
+                admit = adm.try_admit(texts, priority=False,
+                                      tenant=None)
+                if admit.shed:
+                    m.inc("augmentation_errors_logged_total")
+                    meta["status"] = admit.status
+                    meta["shed"] = admit.reason
+                    return admit.status, [json.dumps(
+                        {"error": admit.message}).encode()]
+                trace.tenant = admit.tenant
+                if admit.level >= 1 and not admit.probe:
+                    trace.no_retry = True
+            try:
+                if admit is not None and admit.degrade:
+                    loop = asyncio.get_running_loop()
+                    cache = self.batcher._cache
+                    codes = await loop.run_in_executor(
+                        self.batcher._pool,
+                        lambda: degraded_detect(texts,
+                                                svc.scalar_codes,
+                                                cache=cache,
+                                                trace=trace))
+                else:
+                    codes = await self.batcher.submit(
+                        texts, trace=trace) if texts else []
+            except DeadlineExceeded:
+                m.inc("augmentation_errors_logged_total")
+                meta["status"] = 504
+                return 504, [b'{"error":"deadline expired before '
+                             b'dispatch"}']
+            except (asyncio.TimeoutError, TimeoutError):
+                m.inc("augmentation_errors_logged_total")
+                meta["status"] = 504
+                meta["timeout"] = "flush"
+                return 504, [b'{"error":"detection timed out"}']
+            finally:
+                if admit is not None:
+                    adm.release(admit)
+            t = telemetry.observe_stage("detect", t, trace=trace)
+            status, buffers = wire.post_detect(svc, codes, slots,
+                                               responses, status)
+            telemetry.observe_stage("encode", t, trace=trace)
+            meta["status"] = status
+            return status, buffers
+        finally:
+            telemetry.finish_request(trace, meta=meta)
 
     async def handle_metrics(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter):
@@ -624,7 +779,8 @@ class AioService:
         return 200, json.dumps(info).encode()
 
 
-async def _recycle_watch(aio: "AioService", server, mserver):
+async def _recycle_watch(aio: "AioService", server, mserver,
+                         userver=None):
     """Planned self-recycle for the plugin's per-dispatch host RSS leak
     (docs/PERF.md; tunneled backend only): past LDT_MAX_DISPATCHES /
     LDT_MAX_RSS_MB, stop accepting, give in-flight handlers a moment,
@@ -662,7 +818,8 @@ async def _recycle_watch(aio: "AioService", server, mserver):
             # bounded window to finish writing their response, then any
             # stragglers abort.
             aio.recycling = True
-            await _teardown(aio, server, mserver, spare_idle=True)
+            await _teardown(aio, server, mserver, spare_idle=True,
+                            userver=userver)
             return
 
 
@@ -674,7 +831,7 @@ def _abort(w):
 
 
 async def _teardown(aio: "AioService", server, mserver,
-                    spare_idle: bool = False):
+                    spare_idle: bool = False, userver=None):
     """Shared drain for recycle and SIGTERM (swap cutover): stop
     accepting, give in-flight requests a bounded window, then abort
     whatever is left so wait_closed() cannot hang on a survivor.
@@ -685,6 +842,8 @@ async def _teardown(aio: "AioService", server, mserver,
     sweep still aborts true idlers, so wait_closed() never hangs."""
     server.close()
     mserver.close()
+    if userver is not None:
+        userver.close()
     if not spare_idle:
         for w in list(aio._writers):
             if w not in aio._busy:
@@ -726,6 +885,18 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
                                         **kw)
     mserver = await asyncio.start_server(aio.handle_metrics, "0.0.0.0",
                                          metrics_port, **kw)
+    # co-located callers can skip HTTP entirely: length-prefixed frames
+    # over a unix socket, same batch contract, byte-identical responses
+    userver = None
+    uds_path = knobs.get_str("LDT_UNIX_SOCKET")
+    if uds_path:
+        with contextlib.suppress(OSError):
+            os.unlink(uds_path)
+        userver = await asyncio.start_unix_server(
+            aio.handle_uds, path=uds_path,
+            limit=BODY_LIMIT_BYTES + 65536)
+        print(json.dumps({"msg": f"unix-socket lane on {uds_path}"}),
+              flush=True)
     ports = (server.sockets[0].getsockname()[1],
              mserver.sockets[0].getsockname()[1])
     print(json.dumps({"msg": f"language-detector (asyncio) listening on "
@@ -749,23 +920,31 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
         print(json.dumps({"msg": "draining worker: SIGTERM"}),
               flush=True)
         loop.create_task(_teardown(aio, server, mserver,
-                                   spare_idle=True))
+                                   spare_idle=True, userver=userver))
 
     try:
         import signal as _signal
         loop.add_signal_handler(_signal.SIGTERM, _on_term)
     except (ValueError, RuntimeError, NotImplementedError):
         pass  # embedded in a non-main thread (tests) or no signals
-    watch = loop.create_task(_recycle_watch(aio, server, mserver))
+    watch = loop.create_task(_recycle_watch(aio, server, mserver,
+                                            userver=userver))
     try:
         async with server, mserver:
-            await asyncio.gather(server.serve_forever(),
-                                 mserver.serve_forever())
+            aws = [server.serve_forever(), mserver.serve_forever()]
+            if userver is not None:
+                aws.append(userver.serve_forever())
+            await asyncio.gather(*aws)
     except asyncio.CancelledError:
         if not (aio.recycling or aio.draining):
             raise  # external cancellation (tests, embedding callers)
     finally:
         watch.cancel()
+        if userver is not None:
+            userver.close()
+            if uds_path:
+                with contextlib.suppress(OSError):
+                    os.unlink(uds_path)
         with contextlib.suppress(ValueError, RuntimeError,
                                  NotImplementedError):
             import signal as _signal

@@ -160,18 +160,25 @@ class Batcher:
         cached results are only ever shared within one hints_key.
         trace: optional telemetry.Trace; the flush serving this request
         grafts its stage spans (dedup/pack/dispatch/...) into it before
-        resolving the future."""
-        fut: Future = Future()
+        resolving the future.
+
+        Callers: the sync front's detect closure and the UDS lane
+        (wire.handle_frame) — every ingest path funnels through here,
+        so the Future only gets armed once the request is certain to
+        enter the queue (a fault-seam raise or post-close fail-fast
+        never allocates one just to abandon it)."""
         if self._stop.is_set():
             # post-close submits fail fast instead of sitting in a
             # queue nobody drains until the caller's 60s result timeout
-            fut.set_exception(RuntimeError("batcher closed"))
-            return fut
+            closed: Future = Future()
+            closed.set_exception(RuntimeError("batcher closed"))
+            return closed
         if faults.ACTIVE is not None:
             # an injected queue_put error raises out of submit: the
-            # handler answers it like any enqueue failure, and the
-            # future never enters the queue half-armed
+            # handler answers it like any enqueue failure, and no
+            # future enters the queue half-armed
             faults.hit("queue_put")
+        fut: Future = Future()
         self._q.put((texts, hints_key, trace, fut))
         return fut
 

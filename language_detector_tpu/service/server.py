@@ -34,12 +34,17 @@ from pathlib import Path
 
 from .. import faults, knobs, telemetry
 from ..locks import make_lock
+from . import wire
 from .admission import (BREAKER_OPEN, BREAKER_STATE_NAMES,
                         AdmissionController, DeadlineExceeded,
                         degraded_detect)
 from .batcher import Batcher
+# contract helpers live in wire.py (shared with the asyncio front and
+# the UDS lane); re-exported here for existing importers
+from .wire import (BODY_LIMIT_BYTES, FragmentCache,  # noqa: F401
+                   parse_post_body, post_detect, pre_detect,
+                   strip_extras)
 
-BODY_LIMIT_BYTES = 1_000_000            # main.go:59
 OBJECTS_PER_LOG = 1000                  # main.go:61
 
 USAGE = {
@@ -54,21 +59,6 @@ USAGE = {
 }
 
 _CODES_FILE = Path(__file__).parent / "cld_codes.json"
-
-
-def strip_extras(text: str) -> str:
-    """Remove @mentions and links, which skew detection
-    (StripExtras, handlers.go:198-210; note the trailing space the
-    word-join loop leaves behind). Texts without '@' or 'http' pass
-    through untouched: the split/join also collapses whitespace, but
-    the engine maps every non-letter run to one space during
-    segmentation, so detection output is identical — and the scan-only
-    fast path saves ~6us/doc of the single core."""
-    if "@" not in text and "http" not in text:
-        return text
-    kept = [w for w in text.split()
-            if not (w.startswith("@") or w.startswith("http"))]
-    return "".join(w + " " for w in kept)
 
 
 class Metrics:
@@ -295,9 +285,10 @@ class DetectorService:
         # per-code pre-serialized response fragments (the reference
         # pre-renders its static JSON for the same reason, main.go:150-166;
         # here the per-item object is a pure function of the code, so the
-        # whole response body assembles by joining cached byte fragments
-        # instead of building dicts + json.dumps per document)
-        self._frag_cache: dict = {}
+        # whole response body assembles from cached byte fragments
+        # instead of building dicts + json.dumps per document); the
+        # cache type lives in wire.py, shared with the asyncio front
+        self._frag_cache = FragmentCache(self.known)
         # throughput-window counters: handler threads race on the
         # read-modify-write in log_processed, so they get their own lock
         self._log_lock = make_lock("server.processed")
@@ -587,6 +578,20 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _send_buffers(self, status: int, buffers: list, headers=None):
+        """writev-style twin of _send_json: Content-Length is the sum
+        of the fragments and the body goes out via writelines, so the
+        batch envelope is never concatenated host-side."""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length",
+                         str(sum(len(b) for b in buffers)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
+        self.end_headers()
+        self.wfile.writelines(buffers)
+
     def _send_error_json(self, message: str, status: int, headers=None):
         self.service.metrics.inc("augmentation_errors_logged_total")
         self._send_json(status,
@@ -688,23 +693,18 @@ class Handler(BaseHTTPRequestHandler):
     def _detector(self, body: bytes):
         """LanguageDetectorHandler (handlers.go:105-186)."""
         svc = self.service
+        telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
+                                       lane="tcp")
         trace = telemetry.Trace()
         t = trace.t0
-        doc, err = parse_post_body(svc.metrics,
-                                   self.headers.get("Content-Type"), body)
+        pre, err = wire.parse_request(
+            svc, self.headers.get("Content-Type"), body)
         if err is not None:
             self._send_json(*err)
             telemetry.finish_request(
                 trace, meta={"front": "sync", "status": err[0]})
             return
-        pre = pre_detect(svc, doc)
         t = telemetry.observe_stage("parse", t, trace=trace)
-        if pre is None:
-            self._send_error_json(
-                "Unable to parse request - invalid JSON detected", 400)
-            telemetry.finish_request(
-                trace, meta={"front": "sync", "status": 400})
-            return
         texts, slots, responses, status = pre
         adm = svc.admission
         admit = None
@@ -772,111 +772,17 @@ class Handler(BaseHTTPRequestHandler):
             if admit is not None:
                 adm.release(admit)
         t = telemetry.observe_stage("detect", t, trace=trace)
-        status, payload = post_detect(svc, codes, slots, responses, status)
+        status, buffers = wire.post_detect(
+            svc, codes, slots, responses, status)
         telemetry.observe_stage("encode", t, trace=trace)
-        self._send_json(status, payload)
+        self._send_buffers(status, buffers)
         telemetry.finish_request(
             trace, meta={"front": "sync", "docs": len(texts),
                          "status": status})
 
 
-# -- shared contract logic (sync Handler above + the asyncio server) --------
-
-
-def parse_post_body(m: Metrics, content_type: str | None, body: bytes):
-    """Content-Type + JSON validation (GetRequests, handlers.go:33-69).
-    Returns (doc, None) on success or (None, (status, payload_bytes))
-    for the error response — single source of the contract's error
-    strings and metric increments for both servers."""
-    if content_type != "application/json":
-        m.inc("augmentation_invalid_requests_total")
-        m.inc("augmentation_errors_logged_total")
-        m.inc_object("unsuccessful")
-        return None, (400, json.dumps(
-            {"error": "Content-Type must be set to application/json"}
-        ).encode())
-    try:
-        return json.loads(body), None
-    except json.JSONDecodeError:
-        m.inc("augmentation_invalid_requests_total")
-        m.inc("augmentation_errors_logged_total")
-        m.inc_object("unsuccessful")
-        return None, (400, json.dumps(
-            {"error": "Unable to parse request - invalid JSON detected"}
-        ).encode())
-
-
-def pre_detect(svc: DetectorService, doc):
-    """Parsed request body -> (texts, slots, responses, status), or None
-    when the body is not the {"request": [...]} shape (caller answers
-    400). Per-item "Missing text key" errors keep the batch going with
-    overall HTTP 400 (handlers.go:133-150)."""
-    m = svc.metrics
-    if not isinstance(doc, dict) or "request" not in doc:
-        m.inc("augmentation_invalid_requests_total")
-        return None
-    requests = doc["request"]
-    if not isinstance(requests, list):
-        requests = []
-    status = 200
-    responses: list = []
-    texts: list = []
-    slots: list = []
-    # fast path: every item is a {"text": ...} dict (the overwhelmingly
-    # common shape) — one comprehension instead of a per-item branch loop
-    try:
-        texts = [strip_extras(str(item["text"])) for item in requests]
-    except (TypeError, KeyError):
-        pass
-    else:
-        return texts, range(len(texts)), [None] * len(texts), status
-    texts = []
-    for i, item in enumerate(requests):
-        if not isinstance(item, dict) or "text" not in item:
-            m.inc_object("unsuccessful")
-            responses.append(_MISSING_TEXT_FRAG)
-            status = 400
-            continue
-        texts.append(strip_extras(str(item["text"])))
-        slots.append(i)
-        responses.append(None)
-    return texts, slots, responses, status
-
-
-_MISSING_TEXT_FRAG = b'{"error": "Missing text key"}'
-
-
-def post_detect(svc: DetectorService, codes: list, slots: list,
-                responses: list, status: int):
-    """Detected codes -> (status, response payload bytes) + metrics.
-    Unknown code answers name "Unknown" with HTTP 203
-    (handlers.go:151-166). The payload joins per-code cached byte
-    fragments — byte-identical to the json.dumps it replaces (fragments
-    are built BY json.dumps, once per distinct code)."""
-    m = svc.metrics
-    lang_counts: dict = {}
-    cache = svc._frag_cache
-    known_get = svc.known.get
-    for i, code in zip(slots, codes):
-        ent = cache.get(code)
-        if ent is None:
-            name = known_get(code)
-            unknown = name is None
-            if unknown:
-                name = "Unknown"
-            ent = (json.dumps({"iso6391code": code,
-                               "name": name}).encode(), name, unknown)
-            cache[code] = ent
-        frag, name, unknown = ent
-        if unknown and status == 200:
-            status = 203
-        responses[i] = frag
-        lang_counts[name] = lang_counts.get(name, 0) + 1
-    if codes:
-        m.add_languages(lang_counts)
-        m.inc_object("successful", len(codes))
-        svc.log_processed(len(codes))
-    return status, b'{"response": [' + b", ".join(responses) + b']}'
+# shared contract logic (parse_post_body / pre_detect / post_detect /
+# strip_extras) moved to wire.py — re-exported at the top of this module
 
 
 class MetricsHandler(BaseHTTPRequestHandler):
@@ -1033,6 +939,15 @@ def main():
     metrics_port = knobs.get_int("PROMETHEUS_PORT") or 0
     httpd, metricsd, svc = make_server(port, metrics_port)
     _recycle_watch_thread(svc, httpd)
+    # co-located callers can skip HTTP entirely: length-prefixed frames
+    # over a unix socket, same batch contract, byte-identical responses
+    uds = None
+    uds_path = knobs.get_str("LDT_UNIX_SOCKET")
+    if uds_path:
+        uds = wire.UnixFrameServer(svc, uds_path)
+        uds.start()
+        print(json.dumps({"msg": f"unix-socket lane on {uds_path}"}),
+              flush=True)
     threading.Thread(target=metricsd.serve_forever, daemon=True).start()
     # report the BOUND ports (port 0 picks ephemerals — supervised and
     # test runs parse this line)
@@ -1070,14 +985,19 @@ def main():
     except KeyboardInterrupt:
         pass
     finally:
-        if getattr(httpd, "_ldt_recycle", False) or \
-                getattr(httpd, "_ldt_drain", False):
+        planned = getattr(httpd, "_ldt_recycle", False) or \
+            getattr(httpd, "_ldt_drain", False)
+        drain_sec = knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0
+        if uds is not None:
+            # same drain contract as the HTTP accept loop: stop taking
+            # frames, let in-flight ones answer before the batcher closes
+            uds.close(drain_sec=drain_sec if planned else 0.0)
+        if planned:
             # shutdown() only stops the accept loop: wait for in-flight
             # handler threads (a full-size flush mid-request must
             # survive a planned recycle / swap cutover) up to the drain
             # bound before the batcher closes under them
-            deadline = time.monotonic() + \
-                (knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0)
+            deadline = time.monotonic() + drain_sec
             while svc.http_inflight() > 0 and \
                     time.monotonic() < deadline:
                 time.sleep(0.05)

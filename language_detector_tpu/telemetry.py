@@ -186,6 +186,24 @@ METRICS: dict = {
         "counter",
         "Span-aligned sub-documents created by the long-doc lane "
         "(LDT_LONGDOC_CHUNK_SLOTS splitting in preprocess/pack.py)."),
+    "ldt_http_parse_ms": (
+        "histogram",
+        "Request-body parse wall time (ms) through the shared wire "
+        "path (service/wire.py), fast scanner and json.loads "
+        "fallback alike, on every lane."),
+    "ldt_http_serialize_ms": (
+        "histogram",
+        "Response assembly wall time (ms): per-code fragment fill + "
+        "writev-style buffer-list build (wire.post_detect)."),
+    "ldt_http_parse_fast_total": (
+        "counter",
+        "Zero-copy scanner outcomes by result=hit|miss; a miss fell "
+        "back to json.loads (non-conforming shape, escapes needing "
+        "exact semantics, or invalid bodies)."),
+    "ldt_http_requests_total": (
+        "counter",
+        "Detection requests by ingest lane (lane=tcp|uds), counted "
+        "on both fronts."),
 }
 
 

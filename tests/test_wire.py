@@ -1,0 +1,389 @@
+"""Wire fast-path conformance + unix-socket lane e2e.
+
+The zero-copy scanner (wire.fast_parse_texts) must be indistinguishable
+from the json.loads path: same parse results, same 400s, same response
+BYTES, same metric increments — on both fronts. The adversarial corpus
+below covers escapes, surrogate pairs, duplicate keys, nested/huge
+bodies, raw control bytes, truncation at every interesting position and
+trailing garbage; each case runs with LDT_WIRE_FASTPATH on and off and
+the outcomes are compared, so a scanner bug shows up as a diff against
+the stdlib, not against a hand-written expectation.
+
+The UDS tests pin the frame contract: byte-identity with the TCP
+payload, oversize -> 413 error frame + close, keep-alive buffer reuse
+across growing frames, and drain-on-close finishing in-flight frames.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import struct
+import tempfile
+import threading
+import time
+
+import pytest
+
+from language_detector_tpu.service import wire
+from language_detector_tpu.service.server import (DetectorService,
+                                                  make_server)
+
+BIG_BODY = json.dumps(
+    {"request": [{"text": f"document number {i} with some text"}
+                 for i in range(1500)]}).encode()
+LONG_DOC = json.dumps({"request": [{"text": "word " * 10000}]}).encode()
+
+CASES = [
+    b'{"request": [{"text": "hello world"}]}',
+    b'{"request":[{"text":"compact"}]}',
+    b'{ "request" : [ { "text" : "spaced" } ] }',
+    b'{\n\t"request": [\r\n{"text": "ws"}\n]\n}',
+    b'{"request": []}',
+    b'{"request": [{"text": ""}]}',
+    b'{"request": [{"text": "a"}, {"text": "b"}, {"text": "c"}]}',
+    b'{"request": [{"text": "a"} , {"text": "b"}]}',
+    # escapes / unicode: ensure_ascii bodies (every non-ASCII char
+    # \uXXXX-escaped, the json.dumps default) and raw-UTF-8 bodies
+    json.dumps({"request": [{"text": "café 中文"}]}
+               ).encode(),
+    json.dumps({"request": [{"text": "café 中文"}]},
+               ensure_ascii=False).encode(),
+    json.dumps({"request": [{"text": "emoji \U0001f600 end"}]}
+               ).encode(),                       # surrogate-pair escape
+    json.dumps({"request": [{"text": "emoji \U0001f600 end"}]},
+               ensure_ascii=False).encode(),
+    b'{"request": [{"text": "esc \\" q \\\\ b \\n nl \\u0041"}]}',
+    b'{"request": [{"text": "ends in backslash \\\\"}]}',
+    b'{"request": [{"text": "\\ud83d\\ude00"}]}',  # paired surrogates
+    # shape deviations (fast path must bail; behavior via json.loads)
+    b'{"request": [{"text": "dup", "text": "dup2"}]}',
+    b'{"request": [{"text": "x", "extra": 1}]}',
+    b'{"request": [{"other": "x"}]}',
+    b'{"request": [{"text": 5}]}',
+    b'{"request": [{"text": null}]}',
+    b'{"request": [{"text": ["a"]}]}',
+    b'{"request": [{"text": {"deep": {"er": 1}}}]}',
+    b'{"request": ["nope"]}',
+    b'{"request": [17]}',
+    b'{"request": "nope"}',
+    b'{"request": 5}',
+    b'{"request": [{"text": NaN}]}',   # stdlib json accepts NaN
+    b'{"other": []}',
+    b'{}',
+    b'[]',
+    b'5',
+    b'',
+    # truncation at every interesting position + trailing garbage
+    b'{"request": [{"text": "a"}]} trailing',
+    b'{"request": [{"text": "a"}]',
+    b'{"request": [{"text": "a"',
+    b'{"request": [{"text": "unterminated',
+    b'{"request": [{"text"',
+    b'{"request": [{',
+    b'{"request',
+    b'{',
+    b'not json{{',
+    # raw control bytes inside a string literal are invalid JSON
+    b'{"request": [{"text": "ctrl \x01 char"}]}',
+    b'{"request": [{"text": "tab\ttab"}]}',
+    json.dumps({"request": [{"text": "line sep"}]},
+               ensure_ascii=False).encode(),     # legal raw U+2028
+    # strip_extras interaction (mentions/links)
+    b'{"request": [{"text": "hi @user see http://x.com now"}]}',
+    BIG_BODY,
+    LONG_DOC,
+]
+
+
+@pytest.fixture(scope="module")
+def sync_server():
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    httpd, metricsd, svc = make_server(0, 0, service=svc)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (httpd, metricsd)]
+    for t in threads:
+        t.start()
+    yield {"port": httpd.server_address[1], "svc": svc}
+    httpd.shutdown()
+    metricsd.shutdown()
+    svc.batcher.close()
+
+
+@pytest.fixture(scope="module")
+def aio_server():
+    """Asyncio front with the UDS lane enabled, mirroring the
+    test_service aio pattern."""
+    import asyncio
+    import queue as _q
+
+    from language_detector_tpu.service.aioserver import serve
+
+    uds_path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"),
+                            "aio.sock")
+    old = os.environ.get("LDT_UNIX_SOCKET")
+    os.environ["LDT_UNIX_SOCKET"] = uds_path
+    ports_q: _q.Queue = _q.Queue()
+    loop_holder = {}
+
+    def run_loop():
+        async def main():
+            loop_holder["loop"] = asyncio.get_running_loop()
+            ready = asyncio.get_running_loop().create_future()
+            svc = DetectorService(use_device=False, max_delay_ms=1.0,
+                                  start_batcher=False)
+            task = asyncio.get_running_loop().create_task(
+                serve(0, 0, svc=svc, ready=ready))
+            ports_q.put(await ready)
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        try:
+            asyncio.run(main())
+        except RuntimeError:
+            pass  # loop.stop() teardown ends the run mid-await
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    port, _ = ports_q.get(timeout=30)
+    yield {"port": port, "uds_path": uds_path}
+    loop = loop_holder.get("loop")
+    if loop is not None:
+        loop.call_soon_threadsafe(loop.stop)
+    if old is None:
+        os.environ.pop("LDT_UNIX_SOCKET", None)
+    else:
+        os.environ["LDT_UNIX_SOCKET"] = old
+
+
+def _post_raw(port: int, body: bytes):
+    """(status, payload bytes) for POST / — raw bytes, no JSON parse."""
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    try:
+        conn.request("POST", "/", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _normalize(pre, err):
+    if err is not None:
+        return ("err", err[0], err[1])
+    texts, slots, responses, status = pre
+    return ("ok", list(texts), list(slots), list(responses), status)
+
+
+def test_function_level_parity(monkeypatch):
+    """parse_request with the scanner on vs off: identical results AND
+    identical metric increments for every adversarial body."""
+    svc = DetectorService(use_device=False, start_batcher=False)
+    for body in CASES:
+        outcomes = []
+        for flag in ("1", "0"):
+            monkeypatch.setenv("LDT_WIRE_FASTPATH", flag)
+            before = (dict(svc.metrics.counters),
+                      dict(svc.metrics.objects))
+            try:
+                pre, err = wire.parse_request(svc, "application/json",
+                                              body)
+                result = _normalize(pre, err)
+            except Exception as e:  # noqa: BLE001 - e.g. bad UTF-8
+                result = ("raise", type(e).__name__)
+            after = (dict(svc.metrics.counters), dict(svc.metrics.objects))
+            deltas = tuple(
+                tuple(sorted((k, a[k] - b.get(k, 0))
+                             for k in a)) for a, b in zip(after, before))
+            outcomes.append((result, deltas))
+        assert outcomes[0] == outcomes[1], body[:120]
+
+
+def test_fast_parse_hits_common_shapes():
+    """The shapes real clients send (incl. ensure_ascii escapes) must
+    take the scanner, not the fallback — the >0.9 hit-rate floor in ci
+    depends on it."""
+    hits = [
+        json.dumps({"request": [{"text": t}]}).encode()
+        for t in ("plain ascii", "café 中文",
+                  "emoji \U0001f600", 'quote " inside', "line\nbreak")
+    ] + [BIG_BODY, LONG_DOC, b'{"request": []}']
+    for body in hits:
+        texts = wire.fast_parse_texts(body)
+        assert texts is not None, body[:80]
+        assert texts == [d["text"] for d in json.loads(body)["request"]]
+
+
+def test_fast_parse_rejects_deviations():
+    for body in (b'{"request": [{"text": "x", "e": 1}]}',
+                 b'{"request": [{"text": 5}]}',
+                 b'{"request": [{"text": "a"}]} junk',
+                 b'{"request": [{"text": "a"}]',
+                 b'{"request": [{"text": "ctrl\x01"}]}',
+                 b'\xff\xfe broken utf8'):
+        assert wire.fast_parse_texts(body) is None, body[:80]
+
+
+def test_e2e_byte_identity_both_fronts(sync_server, aio_server,
+                                       monkeypatch):
+    """For every adversarial body: sync-fast, sync-slow, aio-fast and
+    aio-slow answer the same (status, payload BYTES)."""
+    for body in CASES:
+        seen = []
+        for flag in ("1", "0"):
+            monkeypatch.setenv("LDT_WIRE_FASTPATH", flag)
+            seen.append(_post_raw(sync_server["port"], body))
+            seen.append(_post_raw(aio_server["port"], body))
+        assert len(set(seen)) == 1, (body[:120], [s[0] for s in seen])
+
+
+def _uds_request(sock, body: bytes):
+    sock.sendall(struct.pack("!I", len(body)) + body)
+    hdr = b""
+    while len(hdr) < 6:
+        chunk = sock.recv(6 - len(hdr))
+        if not chunk:
+            return None, None
+        hdr += chunk
+    length, status = struct.unpack("!IH", hdr)
+    payload = b""
+    while len(payload) < length:
+        chunk = sock.recv(length - len(payload))
+        if not chunk:
+            return status, payload
+        payload += chunk
+    return status, payload
+
+
+def test_uds_sync_identity_and_keepalive(sync_server):
+    """Sync-front UDS lane: responses byte-identical to TCP for the
+    same bodies, over ONE keep-alive connection with growing then
+    shrinking frames (exercises the reused grow-only buffer)."""
+    svc = sync_server["svc"]
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "s.sock")
+    uds = wire.UnixFrameServer(svc, path)
+    uds.start()
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        bodies = [
+            b'{"request": [{"text": "uds hello"}]}',
+            BIG_BODY,                        # grows the buffer
+            b'{"request": [{"text": "small again"}]}',
+            b'not json',                     # error frame, conn stays up
+            b'{"request": [{"other": 1}]}',  # per-item error, 400
+        ]
+        for body in bodies:
+            ustatus, upayload = _uds_request(s, body)
+            tstatus, tpayload = _post_raw(sync_server["port"], body)
+            assert (ustatus, upayload) == (tstatus, tpayload), body[:80]
+        s.close()
+    finally:
+        uds.close()
+    assert not os.path.exists(path)
+
+
+def test_uds_oversize_answers_413_and_closes(sync_server):
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "o.sock")
+    uds = wire.UnixFrameServer(sync_server["svc"], path)
+    uds.start()
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(struct.pack("!I", wire.BODY_LIMIT_BYTES + 1))
+        hdr = s.recv(6)
+        length, status = struct.unpack("!IH", hdr)
+        assert status == 413
+        body = s.recv(length)
+        assert body == wire.OVERSIZE_BODY
+        assert json.loads(body)["error"].startswith("Request body")
+        # server closed its side: next read is EOF
+        assert s.recv(1) == b""
+        s.close()
+    finally:
+        uds.close()
+
+
+def test_uds_drain_finishes_inflight(sync_server):
+    """close(drain_sec) must let an in-flight frame answer before the
+    connection is torn down — the SIGTERM drain contract."""
+    svc = sync_server["svc"]
+    release = threading.Event()
+
+    def slow_detect(texts, trace=None):
+        release.wait(5.0)
+        return ["en"] * len(texts)
+
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "d.sock")
+    uds = wire.UnixFrameServer(svc, path, detect=slow_detect)
+    uds.start()
+    got = {}
+
+    def client():
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        got["resp"] = _uds_request(s, b'{"request": [{"text": "x"}]}')
+        s.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while uds.inflight() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert uds.inflight() == 1
+
+    def closer():
+        time.sleep(0.1)
+        release.set()
+
+    threading.Thread(target=closer, daemon=True).start()
+    uds.close(drain_sec=5.0)     # blocks until the frame resolves
+    t.join(timeout=5.0)
+    status, payload = got["resp"]
+    assert status == 200
+    assert json.loads(payload)["response"][0]["iso6391code"] == "en"
+
+
+def test_uds_aio_identity_and_oversize(aio_server):
+    """Asyncio front's UDS lane: byte-identity with its TCP responses
+    and the oversize error frame."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(aio_server["uds_path"])
+    for body in (b'{"request": [{"text": "aio uds"}]}',
+                 b'{"request": [{"text": "\\u4e2d\\u6587"}]}',
+                 b'broken'):
+        ustatus, upayload = _uds_request(s, body)
+        tstatus, tpayload = _post_raw(aio_server["port"], body)
+        assert (ustatus, upayload) == (tstatus, tpayload), body[:80]
+    s.close()
+    # oversize: 413 frame then close
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(aio_server["uds_path"])
+    s.sendall(struct.pack("!I", wire.BODY_LIMIT_BYTES + 1))
+    hdr = s.recv(6)
+    length, status = struct.unpack("!IH", hdr)
+    assert status == 413 and s.recv(length) == wire.OVERSIZE_BODY
+    assert s.recv(1) == b""
+    s.close()
+
+
+def test_fragment_cache_shared_shape(sync_server):
+    """Both fronts share wire.FragmentCache; entries are the exact
+    json.dumps bytes of the per-item object."""
+    svc = sync_server["svc"]
+    frag, name, unknown = svc._frag_cache.entry("en")
+    assert frag == json.dumps(
+        {"iso6391code": "en", "name": "English"}).encode()
+    assert name == "English" and unknown is False
+    frag, name, unknown = svc._frag_cache.entry("zz-bogus")
+    assert name == "Unknown" and unknown is True
+    assert b'"name": "Unknown"' in frag
+
+
+def test_assemble_response_matches_join():
+    frags = [b'{"a": 1}', b'{"b": 2}', b'{"c": 3}']
+    assert b"".join(wire.assemble_response(frags)) == \
+        b'{"response": [' + b", ".join(frags) + b']}'
+    assert b"".join(wire.assemble_response([])) == b'{"response": []}'

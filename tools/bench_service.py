@@ -10,15 +10,23 @@ docs/PERF.md.
 
 Usage: bench_service.py [total_docs] [clients] [docs_per_request]
        bench_service.py --aio [total_docs] [clients] [docs_per_request]
+       bench_service.py --aio-cold [total_docs] [clients] [docs_per_request]
 Prints one JSON line. --aio benches the asyncio server (the single-core
-production front) with a same-loop asyncio load generator; the default
-benches the threaded server with threaded clients.
+production front) with a same-loop asyncio load generator, plus a
+unix-socket pass and wire-stage stats; the default benches the threaded
+server with threaded clients. --aio-cold runs exactly ONE pass and
+reports it as the value: run it in a FRESH process with
+LDT_COMPILE_CACHE_DIR pointing at an empty directory for an honest
+cold number (bench.py does this).
 """
 from __future__ import annotations
 
 import http.client
 import json
+import os
+import struct
 import sys
+import tempfile
 import threading
 import time
 from pathlib import Path
@@ -26,9 +34,12 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from language_detector_tpu import enable_jit_cache  # noqa: E402
+from language_detector_tpu import enable_jit_cache, knobs  # noqa: E402
 
-enable_jit_cache()
+# honor LDT_COMPILE_CACHE_DIR when set (the cold bench points it at a
+# fresh temp dir so the pass genuinely compiles); default persistent dir
+# otherwise
+enable_jit_cache(knobs.get_str("LDT_COMPILE_CACHE_DIR"))
 
 
 def run(total_docs: int = 98304, clients: int = 8,
@@ -111,10 +122,34 @@ def run(total_docs: int = 98304, clients: int = 8,
     )
 
 
+def _wire_stats() -> dict:
+    """Parse/serialize stage stats + fast-path hit rate, read straight
+    off the in-process telemetry registry (the server shares it)."""
+    from language_detector_tpu import telemetry
+    reg = telemetry.REGISTRY
+    out: dict = {}
+    for field, name in (("parse_ms", "ldt_http_parse_ms"),
+                        ("serialize_ms", "ldt_http_serialize_ms")):
+        h = reg.histogram_peek(name)
+        if h is not None:
+            _, hsum, hcount, _ = h.snapshot()
+            if hcount:
+                out[field + "_mean"] = round(hsum / hcount, 4)
+                out[field + "_p95"] = round(h.percentile(95), 4)
+    hit = reg.counter_value("ldt_http_parse_fast_total", result="hit")
+    miss = reg.counter_value("ldt_http_parse_fast_total", result="miss")
+    if hit + miss:
+        out["parse_fast_hit_rate"] = round(hit / (hit + miss), 4)
+    return out
+
+
 def run_aio(total_docs: int = 98304, clients: int = 32,
-            docs_per_request: int = 512) -> dict:
+            docs_per_request: int = 512,
+            cold_only: bool = False) -> dict:
     """Bench the asyncio server: server + clients share one event loop
-    (and the one CPU core), no thread thrash."""
+    (and the one CPU core), no thread thrash. The full bench runs a
+    cold pass, a warm timed pass, and a unix-socket pass; cold_only
+    stops after the first pass (see module docstring)."""
     import asyncio
 
     from bench import make_corpus
@@ -123,15 +158,21 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
 
     docs = make_corpus(total_docs)
     n_requests = total_docs // docs_per_request
+    bodies = []
     payloads = []
     for r in range(n_requests):
         chunk = docs[r * docs_per_request:(r + 1) * docs_per_request]
         body = json.dumps(
             {"request": [{"text": d} for d in chunk]}).encode()
+        bodies.append(body)
         payloads.append(
             b"POST / HTTP/1.1\r\nHost: x\r\n"
             b"Content-Type: application/json\r\n"
             b"Content-Length: %d\r\n\r\n" % len(body) + body)
+
+    uds_path = os.path.join(tempfile.mkdtemp(prefix="ldt-bench-"),
+                            "ldt.sock")
+    os.environ["LDT_UNIX_SOCKET"] = uds_path
 
     async def client(port, work, results):
         reader, writer = await asyncio.open_connection(
@@ -154,6 +195,23 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
                 results["errors"] += 1
         writer.close()
 
+    async def uds_client(work, results):
+        reader, writer = await asyncio.open_unix_connection(
+            uds_path, limit=1 << 22)
+        while work:
+            body = work.pop()
+            writer.write(struct.pack("!I", len(body)))
+            writer.write(body)
+            await writer.drain()
+            hdr = await reader.readexactly(6)
+            length, status = struct.unpack("!IH", hdr)
+            payload = await reader.readexactly(length)
+            if status in (200, 203):
+                results["docs"] += payload.count(b'"iso6391code"')
+            else:
+                results["errors"] += 1
+        writer.close()
+
     async def main():
         svc = DetectorService(use_device=True, max_delay_ms=4.0,
                               start_batcher=False)
@@ -162,25 +220,51 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
             serve(0, 0, svc=svc, ready=ready))
         port, _ = await ready
 
-        async def one_pass():
+        async def one_pass(fn, work_items):
             results = {"docs": 0, "errors": 0}
-            work = list(payloads)
+            work = list(work_items)
             t0 = time.time()
-            await asyncio.gather(*[client(port, work, results)
+            await asyncio.gather(*[fn(work, results)
                                    for _ in range(clients)])
             return results, time.time() - t0
+
+        def tcp(work, results):
+            return client(port, work, results)
 
         # Cold pass first (compiles + first-flush shapes land inside it;
         # reported as cold_docs_sec), then the warm timed pass. Sequential
         # small warm-ups are NOT enough: the full-size flush shapes only
         # appear under concurrent load, so a cold "warmed" window used to
-        # pay them and read ~40% low.
-        cold_results, cold_took = await one_pass()
-        results, took = await one_pass()
+        # pay them and read ~40% low. NOTE the in-process "cold" still
+        # sees whatever persistent compile cache this process started
+        # with — bench.py's --aio-cold subprocess is the honest number.
+        cold_results, cold_took = await one_pass(tcp, payloads)
+        if cold_only:
+            server_task.cancel()
+            return cold_results, cold_took, None, None, None, None
+        results, took = await one_pass(tcp, payloads)
+        uds_results, uds_took = await one_pass(uds_client, bodies)
         server_task.cancel()
-        return results, took, cold_results, cold_took
+        return (cold_results, cold_took, results, took,
+                uds_results, uds_took)
 
-    results, took, cold_results, cold_took = asyncio.run(main())
+    (cold_results, cold_took, results, took,
+     uds_results, uds_took) = asyncio.run(main())
+    if cold_only:
+        docs_sec = cold_results["docs"] / cold_took
+        return dict(
+            metric="service_http_throughput_aio_cold",
+            value=round(docs_sec, 1),
+            unit="docs/sec",
+            detail=dict(total_docs=cold_results["docs"],
+                        errors=cold_results["errors"],
+                        clients=clients,
+                        docs_per_request=docs_per_request,
+                        took_sec=round(cold_took, 2),
+                        compile_cache_dir=knobs.get_str(
+                            "LDT_COMPILE_CACHE_DIR"),
+                        **_wire_stats()),
+        )
     docs_sec = results["docs"] / took
     return dict(
         metric="service_http_throughput_aio",
@@ -191,7 +275,11 @@ def run_aio(total_docs: int = 98304, clients: int = 32,
                     took_sec=round(took, 2),
                     cold_docs_sec=round(
                         cold_results["docs"] / cold_took, 1),
-                    cold_errors=cold_results["errors"]),
+                    cold_errors=cold_results["errors"],
+                    uds_docs_sec=round(
+                        uds_results["docs"] / uds_took, 1),
+                    uds_errors=uds_results["errors"],
+                    **_wire_stats()),
     )
 
 
@@ -199,5 +287,8 @@ if __name__ == "__main__":
     argv = sys.argv[1:]
     if argv and argv[0] == "--aio":
         print(json.dumps(run_aio(*[int(a) for a in argv[1:]])))
+    elif argv and argv[0] == "--aio-cold":
+        print(json.dumps(run_aio(*[int(a) for a in argv[1:]],
+                                 cold_only=True)))
     else:
         print(json.dumps(run(*[int(a) for a in argv])))

@@ -111,10 +111,12 @@ LOCK_OWNERSHIP: dict = {
             attrs=("_num_processed", "_window_start",
                    "_inflight_http"),
             lockfree={
-                "_frag_cache": "per-code response fragments: value for "
-                               "a key is a pure function of the key, so "
-                               "a racing double-compute stores the same "
-                               "bytes; dict get/set are GIL-atomic",
+                "_frag_cache": "wire.FragmentCache (shared with the "
+                               "aio front): the value for a key is a "
+                               "pure function of the key, so a racing "
+                               "double-compute stores the same bytes; "
+                               "its internal dict get/set are "
+                               "GIL-atomic",
                 "_artifact_loaded": "bool written only during __init__ "
                                     "(before handler threads exist), "
                                     "read-only afterwards by "
@@ -138,6 +140,18 @@ LOCK_OWNERSHIP: dict = {
                 "_warmup_ms": "float written once by the warmup "
                               "thread before _warmed flips; readers "
                               "see it only after the flip",
+            }),
+    },
+    "language_detector_tpu/service/wire.py": {
+        "UnixFrameServer": _cl(
+            lock="_lock",
+            attrs=("_conns", "_inflight", "_closing"),
+            lockfree={
+                "_sock": "listening socket assigned by start() before "
+                         "the accept thread exists; close() racing "
+                         "accept() IS the shutdown signal (accept "
+                         "raises OSError and the thread exits)",
+                "_detect": "callable assigned once at init, read-only",
             }),
     },
     "language_detector_tpu/parallel/pool.py": {
