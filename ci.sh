@@ -789,4 +789,169 @@ print("swap drill:", statuses.count(200), "served,",
       "connection retries — generation 2 promoted, zero 5xx")
 EOF
 
+echo "== fleet chaos smoke =="
+# fleet supervisor under fire (docs/ROBUSTNESS.md): a 3-worker front
+# tier sharing the listen port, 64 concurrent clients bursting, then a
+# SIGKILL of one READY member (pid taken from /fleetz) AND a SIGHUP
+# rolling swap, both mid-burst. The invariants: every HTTP status is a
+# 2xx, 429, or 503 (never any other 5xx, never a hang — surviving
+# members keep the port answering while the dead slot respawns and the
+# roll replaces generations one at a time), the fleet recovers to 3
+# READY members with the circuit closed, and SIGINT drains every
+# member and exits 0. Runs under the lock-order watchdog like the
+# rest of CI.
+python3 - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+PORT, MBASE, SPORT = 3183, 31830, 31839
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MBASE),
+    "LDT_FLEET_WORKERS": "3",
+    "LDT_FLEET_STATUS_PORT": str(SPORT),
+    "LDT_CRASH_BACKOFF_BASE_SEC": "0.2",
+    "LDT_CRASH_BACKOFF_MAX_SEC": "1.0",
+    "LDT_SWAP_TIMEOUT_SEC": "150",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_fleet_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+body = json.dumps({"request": [
+    {"text": f"the quick brown fox jumps over the lazy dog {i}"}
+    for i in range(4)
+]}).encode()
+stop = threading.Event()
+statuses, conn_errors = [], []
+threads = []
+lock = threading.Lock()
+
+
+def client():
+    while not stop.is_set():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{PORT}/", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+                status = r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            status = e.code
+        except Exception as e:
+            # connection-level blips (a SIGKILLed member's sockets die
+            # with it) are retried and counted — only HTTP statuses
+            # feed the status invariant below
+            with lock:
+                conn_errors.append(repr(e))
+            time.sleep(0.05)
+            continue
+        with lock:
+            statuses.append(status)
+
+
+def fleetz():
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{SPORT}/fleetz", timeout=10) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def wait_fleet(pred, what, deadline_sec):
+    deadline = time.time() + deadline_sec
+    while True:
+        snap = fleetz()
+        if snap is not None and pred(snap):
+            return snap
+        assert time.time() < deadline, \
+            f"fleet never reached: {what} — last: {snap}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+
+try:
+    snap = wait_fleet(
+        lambda s: s["ready"] == 3 and s["circuit"] == "closed",
+        "3 READY members", 240)
+    gen0 = max(m["generation"] for m in snap["members"])
+
+    threads = [threading.Thread(target=client) for _ in range(64)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)                      # burst established
+
+    victim = next(m for m in snap["members"] if m["state"] == "ready")
+    os.kill(victim["pid"], signal.SIGKILL)   # hard member loss
+
+    # failover first: the dead slot respawns on a fresh generation
+    # while the survivors keep the port answering
+    snap = wait_fleet(
+        lambda s: (s["ready"] == 3
+                   and max(m["generation"] for m in s["members"])
+                   > gen0),
+        "3 READY post-SIGKILL", 240)
+    gen1 = max(m["generation"] for m in snap["members"])
+
+    os.kill(sup.pid, signal.SIGHUP)          # rolling swap, mid-burst
+
+    # the roll replaces every member one standby at a time (never
+    # below N-1 ready), still under the burst: all generations fresh,
+    # 3 READY again, circuit closed
+    wait_fleet(
+        lambda s: (s["ready"] == 3 and s["circuit"] == "closed"
+                   and min(m["generation"] for m in s["members"])
+                   > gen1),
+        "3 READY post-roll", 420)
+    time.sleep(0.5)                      # traffic rides the new fleet
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), "client hung"
+
+    bad = [s for s in statuses
+           if not (200 <= s < 300 or s in (429, 503))]
+    assert not bad, f"unexpected statuses mid-chaos: {sorted(set(bad))}"
+    assert statuses.count(200) > 0, "nothing served during the chaos"
+
+    sup.send_signal(signal.SIGINT)       # drain all members, exit 0
+    rc = sup.wait(timeout=120)
+    assert rc == 0, f"fleet exit {rc}"
+finally:
+    stop.set()                           # a failed assert must not
+    for t in threads:                    # leave 64 clients spinning
+        t.join(timeout=10)
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+suplog = open("/tmp/ldt_fleet_smoke.log").read()
+assert '"reason": "crash"' in suplog, "SIGKILL never seen as a crash"
+assert "rolling swap complete" in suplog, "the roll never completed"
+assert "swap-abort" not in suplog, "roll aborted:\n" + suplog
+assert '"fleet-circuit-open"' not in suplog, \
+    "one kill must not open the fleet circuit:\n" + suplog
+print("fleet chaos:", statuses.count(200), "served,",
+      statuses.count(429) + statuses.count(503), "shed,",
+      len(conn_errors), "connection retries —",
+      "member respawned + fleet rolled, 3 READY, clean exit")
+EOF
+
 echo "CI OK"

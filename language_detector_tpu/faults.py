@@ -73,6 +73,15 @@ FAULT_POINTS: dict = {
                  "re-dispatches it on a surviving lane)",
     "lane_stall": "parallel/pool fetch path, before the fetch (a delay "
                   "models a straggler lane and triggers hedging)",
+    "worker_spawn": "service/fleet member spawn, before the Popen (an "
+                    "error fails that spawn; the member retries after "
+                    "backoff)",
+    "worker_lost": "service/fleet reap pass, per live member poll (an "
+                   "error SIGKILLs the member — simulated silent loss; "
+                   "the fleet treats it as a crash and fails over)",
+    "fleet_route": "service/fleet health plane, before each member's "
+                   "/debug/vars scrape (an error counts a failed "
+                   "sample toward DEGRADED)",
 }
 
 

@@ -140,6 +140,43 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Set BY the supervisor on each spawned worker (1, 2, ...); "
        "exported as the ldt_worker_generation gauge. 0 = running "
        "unsupervised."),
+    # -- fleet supervisor (service/fleet.py) --------------------------
+    _k("LDT_FLEET_WORKERS", "int", 0,
+       "Worker count for the fleet supervisor: N members share the "
+       "listen port via SO_REUSEPORT, each with its own generation, "
+       "ready handshake, and crash policy. 0/unset = classic "
+       "single-worker supervisor."),
+    _k("LDT_FLEET_MIN", "int", None,
+       "Autoscale floor (defaults to LDT_FLEET_WORKERS): scale-down "
+       "never drains below this many members.", bound=True),
+    _k("LDT_FLEET_MAX", "int", None,
+       "Autoscale ceiling (defaults to LDT_FLEET_WORKERS; equal "
+       "min/max disables autoscaling).", bound=True),
+    _k("LDT_FLEET_HEALTH_SEC", "float", 1.0,
+       "Per-member health-scrape period: the fleet GETs each member's "
+       "/debug/vars for readiness, queue depth, and brownout level."),
+    _k("LDT_FLEET_DEGRADED_FAILS", "int", 3,
+       "Consecutive failed health scrapes that mark a member DEGRADED "
+       "(at 3x this the member is killed and respawned)."),
+    _k("LDT_FLEET_SCALE_UP_DEPTH", "int", 64,
+       "Sustained per-member admission queue depth (or brownout level "
+       ">= 2) that scales the fleet up one member."),
+    _k("LDT_FLEET_SCALE_DOWN_DEPTH", "int", 0,
+       "Queue depth at or below which (with no brownout) the fleet "
+       "scales down one member via a zero-drop drain."),
+    _k("LDT_FLEET_SCALE_HOLD_SEC", "float", 10.0,
+       "Hysteresis hold: the overload/idle condition must persist this "
+       "long before one scale step fires (and the timer re-arms)."),
+    _k("LDT_FLEET_CIRCUIT_COOLDOWN_SEC", "float", 5.0,
+       "Open fleet-circuit cooldown before one half-open probe member "
+       "is spawned; its readiness closes the circuit."),
+    _k("LDT_FLEET_STATUS_PORT", "int", 0,
+       "Fleet control-plane HTTP port (127.0.0.1): GET /fleetz (JSON "
+       "member table) and /metrics (the fleet series; see docs/OBSERVABILITY.md). 0 = off."),
+    _k("LDT_FLEET_SLOT", "int", None,
+       "Set BY the fleet supervisor on each member (0, 1, ...): its "
+       "stable slot index, independent of generation numbers.",
+       bound=True),
     # -- artifact & hot swap (supervisor + service/swap.py) -----------
     _k("LDT_ARTIFACT_PATH", "str", None,
        "Path to the .ldta scoring artifact to serve. Unset -> the "

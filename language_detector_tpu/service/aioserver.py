@@ -563,6 +563,29 @@ class AioService:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 (length,) = wire.FRAME_HEADER.unpack(hdr)
+                tenant = None
+                deadline_ms = None
+                priority = False
+                if length & wire.FRAME_V2_FLAG:
+                    length &= ~wire.FRAME_V2_FLAG
+                    try:
+                        ext = await reader.readexactly(
+                            wire.FRAME_EXT_HEADER.size)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionError):
+                        break
+                    flags, tlen, dl = \
+                        wire.FRAME_EXT_HEADER.unpack(ext)
+                    priority = bool(flags & wire.FRAME_PRIORITY)
+                    if dl:
+                        deadline_ms = dl
+                    if tlen:
+                        try:
+                            tenant = (await reader.readexactly(
+                                tlen)).decode("latin-1")
+                        except (asyncio.IncompleteReadError,
+                                ConnectionError):
+                            break
                 if length > BODY_LIMIT_BYTES:
                     m = svc.metrics
                     m.inc("augmentation_requests_total")
@@ -581,7 +604,10 @@ class AioService:
                     body = await reader.readexactly(length) \
                         if length else b""
                     try:
-                        status, buffers = await self._frame(body)
+                        status, buffers = await self._frame(
+                            body, tenant=tenant,
+                            deadline_ms=deadline_ms,
+                            priority=priority)
                     except (asyncio.IncompleteReadError,
                             ConnectionError, TimeoutError):
                         raise
@@ -612,10 +638,13 @@ class AioService:
             except Exception:  # noqa: BLE001 - already torn down
                 pass
 
-    async def _frame(self, body: bytes) -> tuple:
+    async def _frame(self, body: bytes, tenant=None, deadline_ms=None,
+                     priority=False) -> tuple:
         """One UDS frame body through the shared wire path ->
         (status, buffer list); the async twin of wire.handle_frame
-        over the aio batcher. The concatenated buffers are identical
+        over the aio batcher. tenant/deadline_ms/priority come from a
+        v2 frame's ext header and drive the same admission decisions
+        as the HTTP headers. The concatenated buffers are identical
         to the TCP front's payload for the same batch."""
         svc = self.svc
         m = svc.metrics
@@ -637,14 +666,15 @@ class AioService:
             adm = svc.admission
             admit = None
             if texts:
-                admit = adm.try_admit(texts, priority=False,
-                                      tenant=None)
+                admit = adm.try_admit(texts, priority=priority,
+                                      tenant=tenant)
                 if admit.shed:
                     m.inc("augmentation_errors_logged_total")
                     meta["status"] = admit.status
                     meta["shed"] = admit.reason
                     return admit.status, [json.dumps(
                         {"error": admit.message}).encode()]
+                trace.deadline = adm.deadline_from_header(deadline_ms)
                 trace.tenant = admit.tenant
                 if admit.level >= 1 and not admit.probe:
                     trace.no_retry = True

@@ -102,6 +102,12 @@ def _forward_stop(child, signaled, signum=None):
 def main() -> int:
     module = sys.argv[1] if len(sys.argv) > 1 else \
         "language_detector_tpu.service.aioserver"
+    if (knobs.get_int("LDT_FLEET_WORKERS") or 0) >= 1:
+        # N-member front tier: same entry point, fleet control plane
+        # (health-gated membership, crash circuit, rolling SIGHUP swap,
+        # autoscaling) — see service/fleet.py
+        from .fleet import fleet_main
+        return fleet_main(module)
     restart_on_crash = knobs.get_bool("LDT_RESTART_ON_CRASH")
     backoff_base = knobs.get_float("LDT_CRASH_BACKOFF_BASE_SEC") or 0.5
     backoff_max = knobs.get_float("LDT_CRASH_BACKOFF_MAX_SEC") or 30.0

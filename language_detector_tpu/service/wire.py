@@ -352,13 +352,40 @@ def post_detect(svc, codes: list, slots, responses: list, status: int):
 # -- unix-domain-socket lane ------------------------------------------------
 #
 # Frame contract (both fronts):
-#     request  = !I  body_len        | body (same JSON as POST /)
-#     response = !IH body_len status | body
-# The response body is byte-identical to the TCP front's HTTP payload
-# for the same batch — pinned by tests and the ci wire smoke.
+#     v1 request  = !I  body_len        | body (same JSON as POST /)
+#     v2 request  = !I  (V2|body_len)   | !BHI flags tenant_len deadline_ms
+#                   | tenant (latin-1)  | body
+#     response    = !IH body_len status | body
+# The v2 bit lives in the length word's MSB — the body contract caps
+# body_len at 1 MB, so no v1 client can ever emit it, which makes v1
+# frames byte-compatible on a v2 server. The ext header carries what
+# the HTTP front reads from X-LDT-Tenant / X-LDT-Priority /
+# X-LDT-Deadline-Ms: flags bit0 = priority, tenant_len sizes the
+# tenant id that follows (0 = default tenant), deadline_ms is the
+# request budget (0 = absent, server default applies). The response
+# body is byte-identical to the TCP front's HTTP payload for the same
+# batch — pinned by tests and the ci wire smoke.
 
 FRAME_HEADER = struct.Struct("!I")
 FRAME_RESP_HEADER = struct.Struct("!IH")
+FRAME_V2_FLAG = 0x80000000
+FRAME_EXT_HEADER = struct.Struct("!BHI")   # flags, tenant_len, deadline_ms
+FRAME_PRIORITY = 0x01                      # flags bit0
+
+
+def pack_frame(body: bytes, tenant: str | None = None,
+               deadline_ms: int | None = None,
+               priority: bool = False) -> bytes:
+    """Client-side frame builder. With no admission fields set this
+    emits a plain v1 frame, so existing callers (and the parity tests'
+    baseline) are untouched; any field promotes the frame to v2."""
+    if tenant is None and deadline_ms is None and not priority:
+        return FRAME_HEADER.pack(len(body)) + body
+    tb = (tenant or "").encode("latin-1")
+    flags = FRAME_PRIORITY if priority else 0
+    ext = FRAME_EXT_HEADER.pack(flags, len(tb),
+                                min(deadline_ms or 0, 0xFFFFFFFF))
+    return FRAME_HEADER.pack(FRAME_V2_FLAG | len(body)) + ext + tb + body
 
 _IOV_BATCH = 512  # sendmsg segments per call, safely under IOV_MAX
 
@@ -401,12 +428,15 @@ def _recv_exact_into(sock, view, n: int) -> bool:
     return True
 
 
-def handle_frame(svc, body, detect=None, nbytes=None, lane="uds"):
+def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
+                 tenant=None, deadline_ms=None, priority=False):
     """One UDS request body through the shared wire path ->
     (status, buffer list). Mirrors the HTTP fronts' POST flow
     (admission, degrade ladder, typed errors) minus header parsing;
-    the concatenated buffers are identical to the TCP payload for the
-    same batch."""
+    tenant/deadline_ms/priority come from a v2 frame's ext header and
+    feed the same per-tenant quota, deadline, and brownout decisions
+    as the HTTP headers they mirror. The concatenated buffers are
+    identical to the TCP payload for the same batch."""
     m = svc.metrics
     m.inc("augmentation_requests_total")
     telemetry.REGISTRY.counter_inc("ldt_http_requests_total", lane=lane)
@@ -424,7 +454,7 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds"):
     adm = svc.admission
     admit = None
     if texts:
-        admit = adm.try_admit(texts, priority=False, tenant=None)
+        admit = adm.try_admit(texts, priority=priority, tenant=tenant)
         if admit.shed:
             m.inc("augmentation_errors_logged_total")
             telemetry.finish_request(
@@ -433,6 +463,7 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds"):
                              "shed": admit.reason})
             return admit.status, [json.dumps(
                 {"error": admit.message}).encode()]
+        trace.deadline = adm.deadline_from_header(deadline_ms)
         trace.tenant = admit.tenant
         if admit.level >= 1 and not admit.probe:
             trace.no_retry = True
@@ -524,12 +555,31 @@ class UnixFrameServer:
         svc = self.svc
         hdr = bytearray(FRAME_HEADER.size)
         hview = memoryview(hdr)
+        ext = bytearray(FRAME_EXT_HEADER.size)
+        eview = memoryview(ext)
         buf = bytearray(65536)
         try:
             while True:
                 if not _recv_exact_into(conn, hview, len(hdr)):
                     return      # clean EOF (or truncated header)
                 (length,) = FRAME_HEADER.unpack(hdr)
+                tenant = None
+                deadline_ms = None
+                priority = False
+                if length & FRAME_V2_FLAG:
+                    length &= ~FRAME_V2_FLAG
+                    if not _recv_exact_into(conn, eview, len(ext)):
+                        return  # truncated ext header
+                    flags, tlen, dl = FRAME_EXT_HEADER.unpack(ext)
+                    priority = bool(flags & FRAME_PRIORITY)
+                    if dl:
+                        deadline_ms = dl
+                    if tlen:
+                        tbuf = bytearray(tlen)
+                        if not _recv_exact_into(conn, memoryview(tbuf),
+                                                tlen):
+                            return
+                        tenant = tbuf.decode("latin-1")
                 if length > BODY_LIMIT_BYTES:
                     m = svc.metrics
                     m.inc("augmentation_requests_total")
@@ -548,7 +598,9 @@ class UnixFrameServer:
                     self._inflight += 1
                 try:
                     status, buffers = handle_frame(
-                        svc, buf, detect=self._detect, nbytes=length)
+                        svc, buf, detect=self._detect, nbytes=length,
+                        tenant=tenant, deadline_ms=deadline_ms,
+                        priority=priority)
                     send_frame(conn, status, buffers)
                 finally:
                     with self._lock:

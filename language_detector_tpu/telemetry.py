@@ -204,6 +204,31 @@ METRICS: dict = {
         "counter",
         "Detection requests by ingest lane (lane=tcp|uds), counted "
         "on both fronts."),
+    "ldt_fleet_spawn_total": (
+        "counter",
+        "Fleet member spawns by reason=initial|restart|probe|swap "
+        "(service/fleet.py)."),
+    "ldt_fleet_worker_lost_total": (
+        "counter",
+        "Fleet members lost by reason=crash (nonzero exit) or "
+        "reason=lost (killed via the worker_lost fault seam / health "
+        "kill)."),
+    "ldt_fleet_scale_total": (
+        "counter",
+        "Autoscale steps by direction=up|down (hysteresis-held queue "
+        "depth / brownout signal)."),
+    "ldt_fleet_desired": (
+        "gauge",
+        "Fleet desired member count (between LDT_FLEET_MIN/MAX)."),
+    "ldt_fleet_ready": (
+        "gauge", "Fleet members currently READY."),
+    "ldt_fleet_members": (
+        "gauge",
+        "Fleet member slots (including spawning/dead/parked)."),
+    "ldt_fleet_circuit_state": (
+        "gauge",
+        "Fleet crash circuit: 0 closed, 1 open (correlated crash — "
+        "restarts parked), 2 half-open probe in flight."),
 }
 
 

@@ -22,9 +22,20 @@ supervisor can run it with its normal `python -m <module>` spawn:
   FAKE_WORKER_STANDBY_CRASH  with FAKE_WORKER_SERVE: if this run is the
                          standby (LDT_SWAPPED set), exit 9 before the
                          ready file — exercises the drill's abort path
+  FAKE_WORKER_CRASH_FILE  with FAKE_WORKER_SERVE: poll this path while
+                         serving; when it appears, CONSUME it (unlink)
+                         and exit with the int it contains (default 9).
+                         Lets fleet tests kill one specific member —
+                         and exactly once, so the respawn serves.
+  FAKE_WORKER_READY_DELAY  with FAKE_WORKER_SERVE: sleep this many
+                         seconds between the .up marker and the ready
+                         file — holds a fleet roll/spawn in its
+                         not-yet-ready window so tests can race it.
 
-Every run prints one JSON line with the LDT_WORKER_GENERATION it was
-handed, so tests can assert the supervisor numbers its children.
+Every path-valued variable substitutes %SLOT% with LDT_FLEET_SLOT (or
+"0"), so one template addresses per-member files in a fleet. Every run
+prints one JSON line with the LDT_WORKER_GENERATION and LDT_FLEET_SLOT
+it was handed, so tests can assert the supervisor numbers its children.
 """
 from __future__ import annotations
 
@@ -37,10 +48,20 @@ import time
 from language_detector_tpu.service.recycle import RECYCLE_EXIT_CODE
 
 
+def _path(name: str) -> str | None:
+    """Env lookup with %SLOT% substitution for path-valued modes."""
+    val = os.environ.get(name)
+    if val is None:
+        return None
+    return val.replace("%SLOT%", os.environ.get("LDT_FLEET_SLOT", "0"))
+
+
 def main() -> int:
     print(json.dumps({
         "fake_worker_generation":
             os.environ.get("LDT_WORKER_GENERATION", "unset"),
+        "fake_worker_slot":
+            os.environ.get("LDT_FLEET_SLOT", "unset"),
         "fake_worker_cache_dir":
             os.environ.get("LDT_COMPILE_CACHE_DIR", "unset"),
     }), flush=True)
@@ -49,7 +70,7 @@ def main() -> int:
     if exit_code is not None:
         return int(exit_code)
 
-    crash_until = os.environ.get("FAKE_WORKER_CRASH_UNTIL")
+    crash_until = _path("FAKE_WORKER_CRASH_UNTIL")
     if crash_until is not None:
         path, _, n = crash_until.rpartition(":")
         runs = 0
@@ -61,7 +82,7 @@ def main() -> int:
             f.write(str(runs))
         return 9 if runs <= int(n) else 0
 
-    marker = os.environ.get("FAKE_WORKER_RECYCLE")
+    marker = _path("FAKE_WORKER_RECYCLE")
     if marker is not None:
         if os.path.exists(marker):
             return 0  # second generation: a clean exit ends the loop
@@ -69,9 +90,12 @@ def main() -> int:
             f.write("recycled")
         return RECYCLE_EXIT_CODE
 
-    serve_dir = os.environ.get("FAKE_WORKER_SERVE")
+    serve_dir = _path("FAKE_WORKER_SERVE")
     if serve_dir is not None:
         gen = os.environ.get("LDT_WORKER_GENERATION", "0")
+        crash_file = _path("FAKE_WORKER_CRASH_FILE")
+        ready_delay = float(
+            os.environ.get("FAKE_WORKER_READY_DELAY") or 0)
         stop = []
 
         def on_stop(signum, frame):
@@ -88,16 +112,23 @@ def main() -> int:
             if os.environ.get("FAKE_WORKER_STANDBY_CRASH") and \
                     os.environ.get("LDT_SWAPPED"):
                 return 9  # standby dies before ready: drill must abort
+            if ready_delay:
+                time.sleep(ready_delay)
             with open(ready_file, "w") as f:
                 json.dump({"generation": int(gen), "pid": os.getpid(),
                            "port": 0, "metrics_port": 0,
                            "warmup_ms": 0.0}, f)
         deadline = time.time() + 60
         while time.time() < deadline and not stop:
+            if crash_file and os.path.exists(crash_file):
+                with open(crash_file) as f:
+                    code = f.read().strip()
+                os.remove(crash_file)  # consume: the respawn serves
+                return int(code or "9")
             time.sleep(0.05)
         return 0 if stop else 3
 
-    sigfile = os.environ.get("FAKE_WORKER_SIGFILE")
+    sigfile = _path("FAKE_WORKER_SIGFILE")
     if sigfile is not None:
         def on_signal(signum, frame):
             with open(sigfile, "w") as f:

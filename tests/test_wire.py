@@ -133,6 +133,7 @@ def aio_server():
             ready = asyncio.get_running_loop().create_future()
             svc = DetectorService(use_device=False, max_delay_ms=1.0,
                                   start_batcher=False)
+            loop_holder["svc"] = svc
             task = asyncio.get_running_loop().create_task(
                 serve(0, 0, svc=svc, ready=ready))
             ports_q.put(await ready)
@@ -148,7 +149,8 @@ def aio_server():
     t = threading.Thread(target=run_loop, daemon=True)
     t.start()
     port, _ = ports_q.get(timeout=30)
-    yield {"port": port, "uds_path": uds_path}
+    yield {"port": port, "uds_path": uds_path,
+           "svc": loop_holder["svc"]}
     loop = loop_holder.get("loop")
     if loop is not None:
         loop.call_soon_threadsafe(loop.stop)
@@ -158,12 +160,14 @@ def aio_server():
         os.environ["LDT_UNIX_SOCKET"] = old
 
 
-def _post_raw(port: int, body: bytes):
+def _post_raw(port: int, body: bytes, headers: dict | None = None):
     """(status, payload bytes) for POST / — raw bytes, no JSON parse."""
     conn = http.client.HTTPConnection("127.0.0.1", port)
     try:
-        conn.request("POST", "/", body,
-                     {"Content-Type": "application/json"})
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", "/", body, hdrs)
         resp = conn.getresponse()
         return resp.status, resp.read()
     finally:
@@ -239,8 +243,10 @@ def test_e2e_byte_identity_both_fronts(sync_server, aio_server,
         assert len(set(seen)) == 1, (body[:120], [s[0] for s in seen])
 
 
-def _uds_request(sock, body: bytes):
-    sock.sendall(struct.pack("!I", len(body)) + body)
+def _uds_request(sock, body: bytes, **frame_kw):
+    """Send one frame (v1, or v2 when tenant/deadline_ms/priority are
+    passed through to wire.pack_frame) and read the response."""
+    sock.sendall(wire.pack_frame(bytes(body), **frame_kw))
     hdr = b""
     while len(hdr) < 6:
         chunk = sock.recv(6 - len(hdr))
@@ -367,6 +373,131 @@ def test_uds_aio_identity_and_oversize(aio_server):
     assert status == 413 and s.recv(length) == wire.OVERSIZE_BODY
     assert s.recv(1) == b""
     s.close()
+
+
+# -- v2 frames: tenant / deadline / priority parity -------------------------
+
+
+def test_pack_frame_v1_byte_compat_and_v2_roundtrip():
+    body = b'{"request": []}'
+    # no admission fields -> exactly the legacy v1 bytes
+    assert wire.pack_frame(body) == struct.pack("!I", len(body)) + body
+    # any field -> v2: MSB flag, ext header, tenant bytes, body
+    f = wire.pack_frame(body, tenant="acme", deadline_ms=1500,
+                        priority=True)
+    (word,) = wire.FRAME_HEADER.unpack(f[:4])
+    assert word & wire.FRAME_V2_FLAG
+    assert word & ~wire.FRAME_V2_FLAG == len(body)
+    flags, tlen, dl = wire.FRAME_EXT_HEADER.unpack(
+        f[4:4 + wire.FRAME_EXT_HEADER.size])
+    assert flags & wire.FRAME_PRIORITY and dl == 1500
+    off = 4 + wire.FRAME_EXT_HEADER.size
+    assert f[off:off + tlen] == b"acme"
+    assert f[off + tlen:] == body
+    # the 1 MB body cap keeps the flag bit unreachable for v1 clients
+    assert wire.BODY_LIMIT_BYTES < wire.FRAME_V2_FLAG
+
+
+def test_uds_v2_fields_reach_admission_sync(sync_server):
+    """A v2 frame's ext fields drive the same admission inputs as the
+    HTTP headers (priority flag, tenant id, deadline on the trace); a
+    v1 frame on the SAME keep-alive connection keeps the legacy
+    default-tenant behavior."""
+    svc = sync_server["svc"]
+    adm = svc.admission
+    seen = []
+    orig = adm.try_admit
+
+    def spy(texts, priority=False, tenant=None):
+        seen.append((priority, tenant))
+        return orig(texts, priority=priority, tenant=tenant)
+
+    traces = []
+
+    def rec(texts, trace=None):
+        traces.append((trace.tenant, trace.deadline))
+        return ["en"] * len(texts)
+
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "v2.sock")
+    uds = wire.UnixFrameServer(svc, path, detect=rec)
+    uds.start()
+    adm.try_admit = spy
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        body = b'{"request": [{"text": "hello v2"}]}'
+        status, _ = _uds_request(s, body, tenant="acme",
+                                 deadline_ms=30000, priority=True)
+        assert status == 200
+        status2, _ = _uds_request(s, body)      # v1 on the same conn
+        assert status2 == 200
+        s.close()
+    finally:
+        adm.try_admit = orig
+        uds.close()
+    assert seen == [(True, "acme"), (False, None)]
+    tenant, deadline = traces[0]
+    assert tenant == "acme"
+    assert deadline is not None
+    assert 0 < deadline.remaining_ms() <= 30000
+    assert traces[1] == ("default", None)
+
+
+def test_uds_v2_tenant_quota_parity_sync(sync_server):
+    """The satellite gap this closes: the UDS lane used to bypass
+    per-tenant quotas. A v2 frame over quota now sheds with the SAME
+    status and payload bytes as the HTTP front; a small v1 frame still
+    serves."""
+    svc = sync_server["svc"]
+    c = svc.admission.config
+    old = c.tenant_quota_docs
+    c.tenant_quota_docs = 1
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "q.sock")
+    uds = wire.UnixFrameServer(svc, path)
+    uds.start()
+    try:
+        over = json.dumps(
+            {"request": [{"text": "a"}, {"text": "b"}]}).encode()
+        tstatus, tpayload = _post_raw(sync_server["port"], over,
+                                      headers={"X-LDT-Tenant": "hot"})
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        ustatus, upayload = _uds_request(s, over, tenant="hot")
+        assert tstatus == ustatus == 429
+        assert upayload == tpayload
+        status, payload = _uds_request(
+            s, b'{"request": [{"text": "one doc fits"}]}')
+        assert status < 400          # served (maybe 203 unknown-lang)
+        assert json.loads(payload)["response"][0]["iso6391code"]
+        s.close()
+    finally:
+        c.tenant_quota_docs = old
+        uds.close()
+
+
+def test_uds_v2_tenant_quota_parity_aio(aio_server):
+    """Same quota parity on the asyncio front's UDS lane: v2 429
+    byte-identical to its TCP 429, v1 unaffected below quota."""
+    c = aio_server["svc"].admission.config
+    old = c.tenant_quota_docs
+    c.tenant_quota_docs = 1
+    try:
+        over = json.dumps(
+            {"request": [{"text": "a"}, {"text": "b"}]}).encode()
+        tstatus, tpayload = _post_raw(aio_server["port"], over,
+                                      headers={"X-LDT-Tenant": "hot"})
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(aio_server["uds_path"])
+        ustatus, upayload = _uds_request(s, over, tenant="hot")
+        assert tstatus == ustatus == 429
+        assert upayload == tpayload
+        status, payload = _uds_request(
+            s, b'{"request": [{"text": "one doc fits"}]}')
+        assert status < 400          # served (maybe 203 unknown-lang)
+        assert json.loads(payload)["response"][0]["iso6391code"]
+        s.close()
+    finally:
+        c.tenant_quota_docs = old
 
 
 def test_fragment_cache_shared_shape(sync_server):

@@ -156,6 +156,45 @@ MACHINES = (
         }),
     ),
     Machine(
+        name="fleet-member",
+        file="language_detector_tpu/service/fleet.py",
+        scope=("class", "FleetMember"),
+        kind="attr",
+        var="state",
+        states={"FLEET_SPAWNING": 0, "FLEET_READY": 1,
+                "FLEET_DEGRADED": 2, "FLEET_DEAD": 3,
+                "FLEET_RESTARTING": 4},
+        initial="FLEET_SPAWNING",
+        transitions=frozenset({
+            ("FLEET_SPAWNING", "FLEET_READY"),     # ready file landed
+            ("FLEET_DEGRADED", "FLEET_READY"),     # scrapes recovered
+            ("FLEET_READY", "FLEET_DEGRADED"),     # scrapes failing
+            ("FLEET_SPAWNING", "FLEET_DEAD"),      # died before ready
+            ("FLEET_READY", "FLEET_DEAD"),
+            ("FLEET_DEGRADED", "FLEET_DEAD"),
+            ("FLEET_DEAD", "FLEET_RESTARTING"),    # respawn decided
+            ("FLEET_RESTARTING", "FLEET_SPAWNING"),  # Popen issued
+        }),
+    ),
+    Machine(
+        name="fleet-circuit",
+        file="language_detector_tpu/service/fleet.py",
+        scope=("class", "FleetControl"),
+        kind="attr",
+        var="circuit",
+        states={"CIRCUIT_CLOSED": 0, "CIRCUIT_OPEN": 1,
+                "CIRCUIT_PROBE": 2},
+        initial="CIRCUIT_CLOSED",
+        transitions=frozenset({
+            # correlated crash (window full, or zero accepting) trips
+            ("CIRCUIT_CLOSED", "CIRCUIT_OPEN"),
+            # cooldown elapsed: admit one probe member
+            ("CIRCUIT_OPEN", "CIRCUIT_PROBE"),
+            ("CIRCUIT_PROBE", "CIRCUIT_CLOSED"),  # probe became READY
+            ("CIRCUIT_PROBE", "CIRCUIT_OPEN"),    # probe member died
+        }),
+    ),
+    Machine(
         name="artifact-swap",
         file="language_detector_tpu/service/swap.py",
         scope=("func", "swap_artifact"),

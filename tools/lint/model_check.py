@@ -64,7 +64,7 @@ class FakeClock:
         self.t += dt
 
 
-def _explore(build, events, key_fn, invariants, max_depth=12,
+def _explore(build, events, key_fn, invariants, max_depth=24,
              max_states=5000):
     """Generic BFS over event interleavings.
 
@@ -476,6 +476,199 @@ def _p3_inv_delivered(m):
 
 
 # ---------------------------------------------------------------------
+# product 4: fleet control plane (members x crash circuit)
+
+_P4_N = 2
+_P4_LOOP_MAX = 2
+_P4_COOLDOWN = 5.0
+
+
+class _FleetModel:
+    """The fleet supervisor's control surface: real FleetMember FSMs
+    composed with the real FleetControl crash circuit, driven exactly
+    the way fleet_main drives them (reap -> record_crash with the
+    post-death accepting count; probe selection; respawn gating on the
+    circuit). Process I/O (Popen, scrapes) is abstracted away — the
+    policy composition is what the invariants are about."""
+
+    def __init__(self):
+        from language_detector_tpu.service.fleet import (
+            FleetControl, FleetMember)
+        self.clock = FakeClock()
+        self.control = FleetControl(
+            loop_max=_P4_LOOP_MAX, loop_window=60.0,
+            cooldown_sec=_P4_COOLDOWN, scale_hold_sec=10.0,
+            up_depth=64, down_depth=0)
+        self.members = [FleetMember(slot) for slot in range(_P4_N)]
+        self.probe_slot = None
+        self.crashes = 0  # bounds the walk, like product 3's counters
+
+    def _accepting(self):
+        return sum(1 for m in self.members if m.accepting())
+
+    def ready(self, i):
+        """Member i's ready handshake lands (fleet_main._health_step).
+        A probe member reaching READY closes the circuit."""
+        from language_detector_tpu.service.fleet import FLEET_SPAWNING
+        m = self.members[i]
+        if m.state != FLEET_SPAWNING:
+            return False
+        m.mark_ready()
+        self.control.bootstrapped = True
+        if self.probe_slot == m.slot:
+            self.probe_slot = None
+            self.control.probe_ok()
+        return True
+
+    def degrade(self, i):
+        from language_detector_tpu.service.fleet import FLEET_READY
+        m = self.members[i]
+        if m.state != FLEET_READY:
+            return False
+        m.mark_degraded()
+        return True
+
+    def crash(self, i):
+        """Member i's process dies (fleet_main._reap crash branch):
+        mark dead, then account the crash with the post-death
+        accepting count — probe deaths re-open, others may trip."""
+        from language_detector_tpu.service.fleet import FLEET_SPAWNING
+        m = self.members[i]
+        alive = m.accepting() or m.state == FLEET_SPAWNING
+        if not alive or self.crashes >= 3:
+            return False
+        m.mark_dead()
+        self.crashes += 1
+        if self.probe_slot == m.slot:
+            self.probe_slot = None
+            self.control.probe_failed(self.clock())
+        else:
+            self.control.record_crash(self.clock(), self._accepting())
+        return True
+
+    def respawn(self, i):
+        """fleet_main._spawn_step for one member: only while the
+        circuit is closed (or the member is the admitted probe)."""
+        from language_detector_tpu.service.fleet import (
+            CIRCUIT_CLOSED, FLEET_DEAD)
+        m = self.members[i]
+        if m.state != FLEET_DEAD or m.parked:
+            return False
+        if self.control.circuit != CIRCUIT_CLOSED \
+                and m.slot != self.probe_slot:
+            return False
+        m.mark_restarting()
+        m.mark_spawning()
+        return True
+
+    def cool(self):
+        if self.clock() - self.control.opened_at > 100.0:
+            return False  # idempotent past the window: prune
+        self.clock.advance(_P4_COOLDOWN + 0.1)
+        return True
+
+    def probe(self):
+        """fleet_main._probe_step: cooldown elapsed -> one half-open
+        probe; capacity that survived closes the circuit outright."""
+        from language_detector_tpu.service.fleet import FLEET_DEAD
+        if not self.control.probe_due(self.clock()):
+            return False
+        self.control.begin_probe()
+        if self._accepting() > 0:
+            self.control.probe_ok()
+            return True
+        cand = next((m for m in self.members
+                     if m.state == FLEET_DEAD and not m.parked), None)
+        if cand is None:
+            self.control.probe_failed(self.clock())
+            return True
+        self.probe_slot = cand.slot
+        return True
+
+
+def _p4_build():
+    return (_FleetModel(),)
+
+
+_P4_EVENTS = {
+    "ready_0": lambda f: f.ready(0),
+    "ready_1": lambda f: f.ready(1),
+    "degrade_0": lambda f: f.degrade(0),
+    "degrade_1": lambda f: f.degrade(1),
+    "crash_0": lambda f: f.crash(0),
+    "crash_1": lambda f: f.crash(1),
+    "respawn_0": lambda f: f.respawn(0),
+    "respawn_1": lambda f: f.respawn(1),
+    "cool": lambda f: f.cool(),
+    "probe": lambda f: f.probe(),
+}
+
+
+def _p4_key(f):
+    return (tuple(m.state for m in f.members),
+            f.control.circuit,
+            min(len(f.control.crash_times), _P4_LOOP_MAX),
+            f.control.probe_due(f.clock()),
+            f.control.bootstrapped,
+            f.probe_slot,
+            f.crashes)
+
+
+def _p4_inv_min_one_accepting(f):
+    """The headline fleet invariant: while the fleet is nominally up
+    (bootstrapped, circuit closed — i.e. NOT in declared-outage
+    posture) at least one member is accepting. Equivalently: losing
+    the last accepting member always trips the circuit, so a silent
+    zero-capacity fleet is unreachable."""
+    from language_detector_tpu.service.fleet import CIRCUIT_CLOSED
+    if not f.control.bootstrapped:
+        return None
+    if f.control.circuit != CIRCUIT_CLOSED:
+        return None
+    if f._accepting() == 0:
+        return ("fleet nominally up (bootstrapped, circuit closed) "
+                "with zero accepting members")
+    return None
+
+
+def _p4_inv_open_recovers(f):
+    """An open circuit always has a recovery path: once the cooldown
+    elapses, the probe step either closes it (capacity survived) or
+    admits exactly one probe member to respawn."""
+    from language_detector_tpu.service.fleet import (
+        CIRCUIT_CLOSED, CIRCUIT_OPEN, CIRCUIT_PROBE, FLEET_SPAWNING)
+    if f.control.circuit != CIRCUIT_OPEN:
+        return None
+    f.clock.advance(_P4_COOLDOWN + 0.1)
+    if not f.control.probe_due(f.clock()):
+        return ("open fleet circuit past its cooldown does not admit "
+                "a probe — restarts are parked forever")
+    f.probe()
+    if f.control.circuit == CIRCUIT_CLOSED:
+        return None
+    if f.control.circuit != CIRCUIT_PROBE or f.probe_slot is None:
+        return ("probe step on a due circuit neither closed it nor "
+                "selected a probe member")
+    if not f.respawn(f.probe_slot):
+        return "the selected probe member was refused its respawn"
+    if f.members[f.probe_slot].state != FLEET_SPAWNING:
+        return "the probe member did not enter SPAWNING"
+    return None
+
+
+def _p4_inv_closed_window(f):
+    """A closed circuit never silently holds a full crash window —
+    mirror of the breaker's closed-consec bound."""
+    from language_detector_tpu.service.fleet import CIRCUIT_CLOSED
+    n = len([t for t in f.control.crash_times
+             if f.clock() - t <= f.control.loop_window])
+    if f.control.circuit == CIRCUIT_CLOSED and n >= _P4_LOOP_MAX:
+        return (f"closed fleet circuit holding {n} crashes inside the "
+                f"window (trip threshold {_P4_LOOP_MAX})")
+    return None
+
+
+# ---------------------------------------------------------------------
 # analyzer entry point
 
 PRODUCTS = (
@@ -496,10 +689,16 @@ PRODUCTS = (
          "sigterm-at-most-once": _p3_inv_at_most_once,
          "sigterm-delivered": _p3_inv_delivered,
      }),
+    ("fleet-control", "language_detector_tpu/service/fleet.py",
+     _p4_build, _P4_EVENTS, _p4_key, {
+         "fleet-min-one-accepting": _p4_inv_min_one_accepting,
+         "fleet-open-circuit-recovers": _p4_inv_open_recovers,
+         "fleet-closed-window-bound": _p4_inv_closed_window,
+     }),
 )
 
 
-def run_product(name, max_depth=12, max_states=5000):
+def run_product(name, max_depth=24, max_states=5000):
     """Explore one named product; returns (failures, n_states,
     exhausted). Test hook — check() wraps this for the CLI."""
     for pname, _path, build, events, key_fn, invs in PRODUCTS:

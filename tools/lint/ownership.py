@@ -196,6 +196,13 @@ LOCK_OWNERSHIP: dict = {
             lock="_lock",
             attrs=("_d", "bytes", "hits", "misses")),
     },
+    "language_detector_tpu/service/fleet.py": {
+        "FleetStatus": _cl(lock="_lock", attrs=("_snap",)),
+        # FleetMember and FleetControl are deliberately lock-free by
+        # OWNERSHIP: every field is confined to the fleet main loop;
+        # the status thread only reads the immutable snapshot dicts
+        # FleetStatus republishes under its lock
+    },
     "language_detector_tpu/service/aioserver.py": {
         # the asyncio front deliberately holds no locks: every mutation
         # below happens on the one event loop (or before it starts)
