@@ -759,6 +759,208 @@ def bench_fleet(n_workers: int = 3, total_docs: int = 24576,
     )
 
 
+def bench_shm(total_docs: int = 8192, docs_per_request: int = 64) -> dict:
+    """Shared-memory ring lane (service/shmring.py) vs the framed UDS
+    lane, both served by ONE sync-front worker so the scorer is the
+    shared bottleneck and only the transport differs (the sync front
+    scores both lanes on the caller's thread — no event-loop bridge to
+    muddy the comparison). The UDS pass is the lane's natural shape
+    (one framed request in flight per connection); the shm pass
+    pipelines across the ring's slots, which is the whole point of the
+    lane. Three gates, all ASSERTIONS:
+      - zero-drop: every doc of both timed passes answers 2xx,
+      - shm_docs_sec >= uds_docs_sec (the lane must pay for itself),
+      - a hard p99 ceiling on the shm pass — a stuck lease, a fence
+        hang, or a sweep stall shows up as a blown tail long before it
+        shows up as a timeout, so the bench doubles as a liveness gate.
+    """
+    import os
+    import signal
+    import socket
+    import struct
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from language_detector_tpu.service import shmring
+
+    docs = make_corpus(total_docs)
+    payloads = []
+    for r in range(total_docs // docs_per_request):
+        chunk = docs[r * docs_per_request:(r + 1) * docs_per_request]
+        payloads.append(json.dumps(
+            {"request": [{"text": d} for d in chunk]}).encode())
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = tempfile.mkdtemp(prefix="ldt_shm_bench_")
+    uds_path = os.path.join(tmp, "ldt.sock")
+    shm_dir = os.path.join(tmp, "rings")
+    env = os.environ.copy()
+    env.update({
+        "LISTEN_PORT": str(port),
+        "PROMETHEUS_PORT": "0",
+        "LDT_UNIX_SOCKET": uds_path,
+        "LDT_SHM_DIR": shm_dir,
+    })
+    log = open("/tmp/ldt_shm_bench.log", "w")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "language_detector_tpu.service.server"],
+        cwd=str(REPO), env=env, stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    hdr = struct.Struct("!I")
+    rhdr = struct.Struct("!IH")
+    try:
+        deadline = time.time() + 300
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/readyz",
+                        timeout=5) as resp:
+                    if resp.status == 200 and os.path.exists(uds_path):
+                        break
+            except Exception:  # noqa: BLE001 - still booting
+                pass
+            if srv.poll() is not None:
+                raise RuntimeError(f"worker died rc={srv.poll()}")
+            if time.time() > deadline:
+                raise RuntimeError("worker never became ready")
+            time.sleep(0.2)
+
+        def uds_pass(lat, drops):
+            conn = socket.socket(socket.AF_UNIX)
+            conn.connect(uds_path)
+            served = 0
+            t0 = time.time()
+            for body in payloads:
+                t1 = time.time()
+                conn.sendall(hdr.pack(len(body)) + body)
+                raw = b""
+                while len(raw) < rhdr.size:
+                    chunk = conn.recv(rhdr.size - len(raw))
+                    if not chunk:
+                        raise RuntimeError("UDS peer closed mid-frame")
+                    raw += chunk
+                length, status = rhdr.unpack(raw)
+                resp = bytearray()
+                while len(resp) < length:
+                    chunk = conn.recv(length - len(resp))
+                    if not chunk:
+                        raise RuntimeError("UDS peer closed mid-body")
+                    resp += chunk
+                if status in (200, 203):
+                    served += bytes(resp).count(b'"iso6391code"')
+                    if lat is not None:
+                        lat.append((time.time() - t1) * 1e3)
+                else:
+                    drops[0] += 1
+            conn.close()
+            return served, time.time() - t0
+
+        def shm_pass(cli, lat, drops):
+            served = 0
+            pending = []          # (slot, t_submit), submit order
+            t0 = time.time()
+
+            def drain_oldest():
+                nonlocal served
+                i, t1 = pending.pop(0)
+                status, resp = cli.wait(i, timeout=60.0)
+                if status in (200, 203):
+                    served += resp.count(b'"iso6391code"')
+                    if lat is not None:
+                        lat.append((time.time() - t1) * 1e3)
+                else:
+                    drops[0] += 1
+
+            for body in payloads:
+                while True:
+                    i = cli.submit(body)
+                    if i is not None:
+                        break
+                    drain_oldest()      # ring full: free a slot first
+                pending.append((i, time.time()))
+            while pending:
+                drain_oldest()
+            return served, time.time() - t0
+
+        # untimed warm passes: both lanes pay the bucket-ladder
+        # compiles before anything is measured
+        warm_drops = [0]
+        uds_pass(None, warm_drops)
+        cli = shmring.RingClient(shm_dir)
+        cli.wait_attached(60.0)
+        shm_pass(cli, None, warm_drops)
+
+        # two timed passes per lane, interleaved, best-of per lane:
+        # a single-core host gives ±1% run-to-run scheduling noise,
+        # larger than the lane difference under test. Every pass —
+        # kept or not — must still be zero-drop and fully served.
+        lanes = {}
+        for _ in range(2):
+            for name, one_pass in (
+                    ("uds", uds_pass),
+                    ("shm", lambda l, d: shm_pass(cli, l, d))):
+                lat: list = []
+                drops = [0]
+                served, took = one_pass(lat, drops)
+                assert drops[0] == 0, \
+                    f"{drops[0]} dropped frames on the {name} lane — " \
+                    "the shm bench must be zero-drop"
+                assert served == total_docs, \
+                    f"{name} lane answered {served}/{total_docs} docs"
+                lat.sort()
+                res = dict(
+                    docs_sec=round(served / took, 1),
+                    took_sec=round(took, 2),
+                    p50_ms=round(lat[len(lat) // 2], 2),
+                    p99_ms=round(lat[min(len(lat) - 1,
+                                         int(len(lat) * 0.99))], 2),
+                    drops=0,
+                )
+                if name not in lanes or \
+                        res["docs_sec"] > lanes[name]["docs_sec"]:
+                    lanes[name] = res
+        cli.close(unlink=True)
+
+        p99_ceiling_ms = 5_000.0
+        assert lanes["shm"]["p99_ms"] < p99_ceiling_ms, \
+            f"shm p99 {lanes['shm']['p99_ms']}ms blew the " \
+            f"{p99_ceiling_ms}ms ceiling — a lease/fence stall, " \
+            "not a throughput problem"
+        assert lanes["shm"]["docs_sec"] >= lanes["uds"]["docs_sec"], \
+            f"shm lane ({lanes['shm']['docs_sec']} docs/s) slower " \
+            f"than UDS ({lanes['uds']['docs_sec']} docs/s)"
+
+        srv.send_signal(signal.SIGTERM)
+        rc = srv.wait(timeout=120)
+        assert rc == 0, f"worker exit {rc}"
+        return dict(
+            metric="shm_ring_ingest",
+            value=lanes["shm"]["docs_sec"],
+            unit="docs/sec",
+            detail=dict(
+                total_docs=total_docs,
+                docs_per_request=docs_per_request,
+                zero_drop=True,
+                p99_ceiling_ms=p99_ceiling_ms,
+                shm_over_uds=round(lanes["shm"]["docs_sec"] /
+                                   lanes["uds"]["docs_sec"], 3),
+                **{"shm_" + k: v for k, v in lanes["shm"].items()},
+                **{"uds_" + k: v for k, v in lanes["uds"].items()},
+            ),
+        )
+    finally:
+        try:
+            os.killpg(srv.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        srv.wait(timeout=30)
+        log.close()
+
+
 if __name__ == "__main__":
     # --profile DIR: wrap the run in a jax.profiler trace (open DIR with
     # tensorboard / xprof to see the device timeline per op)
@@ -766,6 +968,7 @@ if __name__ == "__main__":
     # --multichip [N]: pooled throughput over an N-device virtual mesh
     # --longdoc [N]: span-parallel lane A/B over a fat-tail corpus
     # --fleet [N]: N-worker front-tier saturation vs 1-worker baseline
+    # --shm: shared-memory ring lane vs the UDS lane, one sync worker
     if len(sys.argv) > 1 and sys.argv[1] == "--longdoc":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 256
         print(json.dumps(bench_longdoc(n)))
@@ -778,6 +981,12 @@ if __name__ == "__main__":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 3
         out = bench_fleet(n)
         with open(REPO / "BENCH_r08.json", "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--shm":
+        out = bench_shm()
+        with open(REPO / "BENCH_r09.json", "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
         print(json.dumps(out))

@@ -954,4 +954,187 @@ print("fleet chaos:", statuses.count(200), "served,",
       "member respawned + fleet rolled, 3 READY, clean exit")
 EOF
 
+echo "== shm chaos smoke =="
+# the shared-memory ring lane under fire (docs/ROBUSTNESS.md): a
+# SUPERVISED asyncio front with LDT_SHM_DIR set, shm_lease errors
+# (p=0.2) and the poison_doc fault armed, under the lock-order
+# watchdog. The invariants: every frame answers despite the lease
+# chaos (a failed lease retries next sweep — zero hangs, zero drops),
+# a poison frame bisects down to exactly the planted docs (quarantine
+# count == docs planted, the rest of the frame still answers), a
+# client SIGKILLed mid-burst has its slots reclaimed and its ring
+# unlinked (the lane returns to all-FREE), and SIGTERM exits 0.
+python3 - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+from language_detector_tpu.service import shmring
+
+PORT, MPORT = 3185, 31851
+SHM_DIR = f"/tmp/ldt_shm_smoke_{os.getpid()}"
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MPORT),
+    "LDT_SHM_DIR": SHM_DIR,
+    "LDT_SHM_LEASE_TIMEOUT_SEC": "1.0",
+    "LDT_FAULTS": "shm_lease:error:p=0.2:seed=3,poison_doc:error",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_shm_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+# a second client process for the kill drill: fills ring slots as fast
+# as they free up until it is SIGKILLed mid-burst
+CHILD_SRC = """
+import json, sys, time
+from language_detector_tpu.service import shmring
+cli = shmring.RingClient(sys.argv[1])
+cli.wait_attached(120.0)
+body = json.dumps({"request": [
+    {"text": f"child burst doc {i}"} for i in range(4)]}).encode()
+while True:
+    if cli.submit(body) is None:
+        time.sleep(0.001)
+"""
+
+
+def scrape(path="/metrics"):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{MPORT}{path}", timeout=10) as r:
+            return r.read().decode()
+    except Exception:
+        return ""
+
+
+def series_sum(text, prefix):
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
+
+
+def shm_vars():
+    try:
+        return json.loads(scrape("/debug/vars")).get("shm")
+    except Exception:
+        return None
+
+
+child = None
+try:
+    deadline = time.time() + 180
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{MPORT}/readyz", timeout=10) as r:
+                if r.status == 200:
+                    break
+        except Exception:
+            pass
+        assert time.time() < deadline, "worker never became ready"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+    cli = shmring.RingClient(SHM_DIR)
+    cli.wait_attached(120.0)
+
+    # -- burst under lease chaos: every frame answers, zero drops ----
+    served = 0
+    for r in range(48):
+        body = json.dumps({"request": [
+            {"text": f"the quick brown fox jumps over the lazy dog "
+                     f"round {r} doc {i}"} for i in range(8)
+        ]}).encode()
+        status, resp = cli.request(body, timeout=120.0)
+        assert 200 <= status < 300, f"frame {r} answered {status}"
+        served += resp.count(b'"iso6391code"')
+    assert served == 48 * 8, f"served {served}/384 docs under chaos"
+    faults_fired = series_sum(
+        scrape(), 'ldt_fault_injected_total{point="shm_lease"}')
+    assert faults_fired and faults_fired > 0, \
+        "shm_lease fault never fired — the burst proved nothing"
+
+    # -- poison frame: bisection isolates exactly the planted docs ---
+    poison_at = (3, 7, 11)
+    docs = [{"text": f"the quick brown fox jumps poison round doc {i}"}
+            for i in range(16)]
+    for j, i in enumerate(poison_at):
+        docs[i]["text"] = \
+            f"poison {j} {shmring.POISON_MARKER} kills the batch"
+    pbody = json.dumps({"request": docs}).encode()
+    status, resp = cli.request(pbody, timeout=120.0)
+    assert 200 <= status < 300, f"poison frame answered {status}"
+    answers = json.loads(resp)["response"]
+    # every doc answers (the seed model's codes are not asserted here —
+    # tests/test_shmring.py pins exact poison/healthy isolation with a
+    # deterministic detector; this smoke pins the quarantine counts)
+    assert len(answers) == 16 and \
+        all("iso6391code" in a for a in answers), \
+        f"poison frame answered {len(answers)}/16 docs"
+    quarantined = series_sum(scrape(), "ldt_quarantine_docs_total")
+    assert quarantined == len(poison_at), \
+        f"quarantined {quarantined} docs, planted {len(poison_at)}"
+    # resubmission: known poison answers from quarantine, count stays
+    status, _ = cli.request(pbody, timeout=120.0)
+    assert 200 <= status < 300
+    quarantined = series_sum(scrape(), "ldt_quarantine_docs_total")
+    assert quarantined == len(poison_at), \
+        f"resubmission re-quarantined: {quarantined}"
+
+    # -- client SIGKILLed mid-burst: slots reclaimed, ring unlinked --
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD_SRC, SHM_DIR], env=env)
+    time.sleep(1.5)                      # burst established
+    assert child.poll() is None, "child client died on its own"
+    child.kill()
+    child.wait(timeout=30)
+
+    deadline = time.time() + 120
+    while True:
+        v = shm_vars()
+        if (v and v["rings"] == 1
+                and v["slots_free"] == v["slots_total"]):
+            break                        # child ring gone, all FREE
+        assert time.time() < deadline, \
+            f"killed client never reclaimed: {v}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+    reclaimed = series_sum(scrape(), "ldt_shm_reclaimed_total")
+    assert reclaimed and reclaimed > 0, "no slot reclaims counted"
+
+    frames = series_sum(scrape(), "ldt_shm_frames_total")
+    cli.close(unlink=True)
+    sup.send_signal(signal.SIGTERM)
+    rc = sup.wait(timeout=60)
+    assert rc == 0, f"supervisor exit {rc}"
+finally:
+    if child is not None and child.poll() is None:
+        child.kill()
+        child.wait(timeout=10)
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+print("shm chaos:", served, "docs served under lease faults",
+      f"({int(faults_fired)} fired),", int(quarantined),
+      "docs quarantined by bisection,", int(reclaimed),
+      "slots reclaimed after the client kill,",
+      int(frames or 0), "frames total — all-FREE, clean exit")
+EOF
+
 echo "CI OK"

@@ -29,6 +29,7 @@ mmap the same file (the cgo seam's table story).
 from __future__ import annotations
 
 import mmap
+import os
 import struct
 from pathlib import Path
 
@@ -93,11 +94,49 @@ def write_artifact(arrays: dict, path: str | Path) -> None:
 def load_artifact(path: str | Path) -> dict:
     """mmap the artifact and return name -> zero-copy ndarray views.
     The mapping stays alive as long as any view does (numpy holds the
-    buffer reference)."""
+    buffer reference).
+
+    Every load failure — including open/mmap OS errors and a
+    half-written file (ENOSPC mid-pack, a swap drill racing the
+    packer) — surfaces as a typed ArtifactError with an actionable
+    message, so ScoringTables.load_mmap callers (startup, hot swap)
+    abort cleanly on the old tables instead of dying on a raw OSError."""
     if faults.ACTIVE is not None:
         faults.hit("artifact_load")
-    with open(path, "rb") as f:
-        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    try:
+        f = open(path, "rb")
+    except OSError as e:
+        raise ArtifactError(
+            f"{path}: cannot open artifact ({e.strerror or e}) — check "
+            "the path/permissions or re-pack with "
+            "tools/artifact_tool.py --pack") from e
+    with f:
+        # size-vs-header validation BEFORE the mapping exists: a
+        # truncated or still-being-written file must produce a typed,
+        # actionable error here, not a raw mmap ValueError/OSError or
+        # a SIGBUS past the end of a short mapping later
+        size = os.fstat(f.fileno()).st_size
+        if size < _HDR.size:
+            raise ArtifactError(
+                f"{path}: {size}-byte file is shorter than the header "
+                f"({_HDR.size} bytes; empty or half-written artifact) "
+                "— re-pack with tools/artifact_tool.py --pack")
+        pre_magic, _pv, _pn, _pr, _phb, pre_total = \
+            _HDR.unpack(f.read(_HDR.size))
+        if pre_magic == MAGIC and pre_total != size:
+            raise ArtifactError(
+                f"{path}: file is {size} bytes but the header records "
+                f"{pre_total} (truncated or corrupt — half-written "
+                "pack: packer died or disk filled mid-write) — restore "
+                "it from source or re-pack with tools/artifact_tool.py "
+                "--pack")
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (OSError, ValueError) as e:
+            raise ArtifactError(
+                f"{path}: cannot mmap artifact ({e}) — the file must "
+                "be a regular, readable, non-empty LDTA pack; re-pack "
+                "with tools/artifact_tool.py --pack") from e
     try:
         if len(mm) < _HDR.size:
             raise ArtifactError(

@@ -82,6 +82,19 @@ FAULT_POINTS: dict = {
     "fleet_route": "service/fleet health plane, before each member's "
                    "/debug/vars scrape (an error counts a failed "
                    "sample toward DEGRADED)",
+    "shm_attach": "service/shmring worker attach, before a discovered "
+                  "ring file is mapped and its generation bumped (an "
+                  "error skips the ring; the scan retries it)",
+    "shm_lease": "service/shmring frame lease, before a READY frame "
+                 "moves to LEASED (an error leaves the frame READY "
+                 "for the next sweep)",
+    "shm_reclaim": "service/shmring reclaim sweep, before a stale "
+                   "WRITING/DONE slot is forced back to FREE (an "
+                   "error defers that reclaim one sweep)",
+    "poison_doc": "service/shmring scorer feed, per batch containing "
+                  "the poison marker (an error models a doc that "
+                  "deterministically kills its scorer batch and "
+                  "exercises bisection + quarantine)",
 }
 
 

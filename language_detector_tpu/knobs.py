@@ -216,6 +216,36 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "request shape (wire.fast_parse_texts); any deviation falls "
        "back to json.loads either way. Set 0 to force the json.loads "
        "path (parity debugging)."),
+    _k("LDT_FRAME_READ_TIMEOUT_SEC", "float", 5.0,
+       "Slow-loris guard for the UDS frame lane on both fronts: once "
+       "the first byte of a frame arrives, the rest of the header and "
+       "body must land within this budget or the connection is "
+       "answered with a 408 error frame and closed (idle keep-alive "
+       "between frames stays unbounded). 0 = off.", bound=True),
+    # -- shared-memory ring ingest lane (service/shmring.py) ----------
+    _k("LDT_SHM_DIR", "str", None,
+       "Directory of mmap'd shared-memory ring files: the worker "
+       "scans it and serves frames written by co-located RingClient "
+       "processes in place (zero-copy twin of the UDS lane; see "
+       "docs/ROBUSTNESS.md for the lease/fencing protocol). Under the "
+       "fleet supervisor each member gets its own m<slot>/ subdir. "
+       "Unset: no shm lane."),
+    _k("LDT_SHM_SLOTS", "int", 8,
+       "Slots per ring a RingClient creates (max 63): the client's "
+       "max in-flight frames on the shm lane."),
+    _k("LDT_SHM_SLOT_BYTES", "int", 65536,
+       "Payload capacity per ring slot in bytes (rounded up to the "
+       "mmap allocation granularity so each slot maps page-aligned); "
+       "bounds both request and response frame size on the shm lane."),
+    _k("LDT_SHM_LEASE_TIMEOUT_SEC", "float", 2.0,
+       "Crash-reclaim horizon for ring slots: a WRITING slot whose "
+       "client died (or stalled) longer than this is reclaimed to "
+       "FREE, and a DONE slot with a dead client is reclaimed after "
+       "the same grace."),
+    _k("LDT_SHM_SCAN_INTERVAL_MS", "float", 1.0,
+       "Idle sleep of the shm scan thread between sweeps when no "
+       "frame was handled; the worst-case added latency for a frame "
+       "landing in an idle ring."),
     # -- startup warmup & compile cache (server.py, models/ngram.py) --
     _k("LDT_WARMUP", "bool", False,
        "Pre-compile the bucket ladder's jitted shapes at startup and "

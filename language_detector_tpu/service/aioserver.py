@@ -555,11 +555,40 @@ class AioService:
         drains cover both lanes."""
         self._writers.add(writer)
         svc = self.svc
+
+        async def _send_408():
+            # best-effort explicit refusal before closing: a stalled
+            # writer gets told why instead of a silent reset
+            with contextlib.suppress(Exception):
+                writer.write(wire.FRAME_RESP_HEADER.pack(
+                    len(wire.TIMEOUT_BODY), 408))
+                writer.write(wire.TIMEOUT_BODY)
+                await writer.drain()
+
         try:
             while True:
+                # the FIRST byte of a frame may wait forever (idle
+                # keep-alive is legal); the rest of the frame must land
+                # within the slow-loris budget or the connection is
+                # answered with a 408 frame and closed
                 try:
-                    hdr = await reader.readexactly(
-                        wire.FRAME_HEADER.size)
+                    first = await reader.readexactly(1)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                tmo = knobs.get_float("LDT_FRAME_READ_TIMEOUT_SEC")
+
+                def _tread(n):
+                    if tmo:
+                        return asyncio.wait_for(
+                            reader.readexactly(n), tmo)
+                    return reader.readexactly(n)
+
+                try:
+                    hdr = first + await _tread(
+                        wire.FRAME_HEADER.size - 1)
+                except asyncio.TimeoutError:
+                    await _send_408()
+                    break
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 (length,) = wire.FRAME_HEADER.unpack(hdr)
@@ -569,8 +598,10 @@ class AioService:
                 if length & wire.FRAME_V2_FLAG:
                     length &= ~wire.FRAME_V2_FLAG
                     try:
-                        ext = await reader.readexactly(
-                            wire.FRAME_EXT_HEADER.size)
+                        ext = await _tread(wire.FRAME_EXT_HEADER.size)
+                    except asyncio.TimeoutError:
+                        await _send_408()
+                        break
                     except (asyncio.IncompleteReadError,
                             ConnectionError):
                         break
@@ -581,8 +612,11 @@ class AioService:
                         deadline_ms = dl
                     if tlen:
                         try:
-                            tenant = (await reader.readexactly(
-                                tlen)).decode("latin-1")
+                            tenant = (await _tread(tlen)) \
+                                .decode("latin-1")
+                        except asyncio.TimeoutError:
+                            await _send_408()
+                            break
                         except (asyncio.IncompleteReadError,
                                 ConnectionError):
                             break
@@ -601,8 +635,11 @@ class AioService:
                     break
                 self._busy.add(writer)
                 try:
-                    body = await reader.readexactly(length) \
-                        if length else b""
+                    try:
+                        body = await _tread(length) if length else b""
+                    except asyncio.TimeoutError:
+                        await _send_408()
+                        break
                     try:
                         status, buffers = await self._frame(
                             body, tenant=tenant,
@@ -935,6 +972,24 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
     if ready is not None and not ready.done():
         ready.set_result(ports)
     loop = asyncio.get_running_loop()
+    # shared-memory ring lane (service/shmring.py): the scan thread is
+    # synchronous, so its detect bridges onto this loop's batcher
+    shm = None
+    shm_dir = knobs.get_str("LDT_SHM_DIR")
+    if shm_dir:
+        from . import shmring
+
+        def _shm_detect(texts, trace=None):
+            fut = asyncio.run_coroutine_threadsafe(
+                aio.batcher.submit(texts, trace=trace), loop)
+            return fut.result(
+                (knobs.get_float("LDT_FLUSH_TIMEOUT_SEC") or 60.0) + 5.0)
+
+        shm = shmring.ShmRingServer(aio.svc, shm_dir,
+                                    detect=_shm_detect)
+        shm.start()
+        print(json.dumps({"msg": f"shm ring lane on {shm_dir}"}),
+              flush=True)
     # warmup (LDT_WARMUP) + readiness handshake (LDT_READY_FILE /
     # LDT_SWAPPED) off the loop: the standby contract with the
     # supervisor's swap drill
@@ -970,6 +1025,10 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
             raise  # external cancellation (tests, embedding callers)
     finally:
         watch.cancel()
+        if shm is not None:
+            # stop the scan thread before the loop dies: a leased frame
+            # mid-bridge would otherwise wait on a dead loop
+            await asyncio.to_thread(shm.close, 1.0)
         if userver is not None:
             userver.close()
             if uds_path:

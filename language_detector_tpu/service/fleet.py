@@ -352,6 +352,7 @@ def fleet_main(module: str) -> int:
     status_port = knobs.get_int("LDT_FLEET_STATUS_PORT") or 0
     metrics_base = knobs.get_int("PROMETHEUS_PORT") or 0
     uds_base = knobs.get_str("LDT_UNIX_SOCKET")
+    shm_base = knobs.get_str("LDT_SHM_DIR")
 
     control = FleetControl(
         loop_max=loop_max, loop_window=loop_window,
@@ -392,6 +393,16 @@ def fleet_main(module: str) -> int:
             str(metrics_base + m.slot) if metrics_base > 0 else "0"
         if uds_base:
             env["LDT_UNIX_SOCKET"] = f"{uds_base}.{m.slot}"
+        if shm_base:
+            # per-member ring directory: each member's scan thread owns
+            # its own rings, and a respawn re-attaches the same dir —
+            # the generation bump fences whatever the dead member left
+            shm_dir = os.path.join(shm_base, f"m{m.slot}")
+            try:
+                os.makedirs(shm_dir, exist_ok=True)
+            except OSError:
+                pass
+            env["LDT_SHM_DIR"] = shm_dir
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         if swapped:

@@ -102,6 +102,11 @@ class Metrics:
         # exists): () -> models/ngram.py pipeline_stats() dict or None
         # (overlap ratio, prefetch depth, staging-ring occupancy)
         self.pipeline_stats = lambda: None
+        # shm ring lane sources (set by ShmRingServer.start when
+        # LDT_SHM_DIR is set): () -> shmring snapshot / quarantine
+        # stats dict or None (lane disabled — the gauges render 0)
+        self.shm_stats = lambda: None
+        self.quarantine_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -249,6 +254,12 @@ class Metrics:
         fams.append(one("ldt_pipeline_depth", pl.get("depth", 0)))
         fams.append(one("ldt_pipeline_staging_ring_occupancy",
                         pl.get("staging_ring_occupancy", 0)))
+        # shm ring ingest lane (service/shmring.py; the frame /
+        # reclaim / quarantine counters are registry counters and
+        # render with the families below)
+        sh = self.shm_stats() or {}
+        fams.append(one("ldt_shm_rings", sh.get("rings", 0)))
+        fams.append(one("ldt_shm_slots_free", sh.get("slots_free", 0)))
         # readiness + supervision (docs/ROBUSTNESS.md): ldt_ready
         # mirrors /readyz, the generation gauge is set by the
         # supervisor through the child's environment
@@ -948,6 +959,16 @@ def main():
         uds.start()
         print(json.dumps({"msg": f"unix-socket lane on {uds_path}"}),
               flush=True)
+    # shared-memory ring lane: co-located heavy producers mmap frames
+    # in, the scan thread parses them in place (service/shmring.py)
+    shm = None
+    shm_dir = knobs.get_str("LDT_SHM_DIR")
+    if shm_dir:
+        from . import shmring
+        shm = shmring.ShmRingServer(svc, shm_dir)
+        shm.start()
+        print(json.dumps({"msg": f"shm ring lane on {shm_dir}"}),
+              flush=True)
     threading.Thread(target=metricsd.serve_forever, daemon=True).start()
     # report the BOUND ports (port 0 picks ephemerals — supervised and
     # test runs parse this line)
@@ -992,6 +1013,8 @@ def main():
             # same drain contract as the HTTP accept loop: stop taking
             # frames, let in-flight ones answer before the batcher closes
             uds.close(drain_sec=drain_sec if planned else 0.0)
+        if shm is not None:
+            shm.close(drain_sec=drain_sec if planned else 0.0)
         if planned:
             # shutdown() only stops the accept loop: wait for in-flight
             # handler threads (a full-size flush mid-request must

@@ -48,6 +48,9 @@ PARSE_ERROR_BODY = json.dumps(
     {"error": "Unable to parse request - invalid JSON detected"}).encode()
 OVERSIZE_BODY = json.dumps(
     {"error": "Request body exceeds 1MB limit"}).encode()
+TIMEOUT_BODY = json.dumps(
+    {"error": "Frame read timed out "
+              "(LDT_FRAME_READ_TIMEOUT_SEC)"}).encode()
 _MISSING_TEXT_FRAG = b'{"error": "Missing text key"}'
 
 RESP_OPEN = b'{"response": ['
@@ -145,8 +148,9 @@ def fast_parse_texts(body, n: int | None = None):
     ``{"request": [{"text": <string>}, ...]}`` -> list of raw text
     strings, or None to fall back to ``json.loads``.
 
-    ``body`` is bytes or a (reused) bytearray; ``n`` bounds the scan so
-    a UDS frame can parse in place inside a larger buffer. Strings
+    ``body`` is bytes, a (reused) bytearray, or an mmap (shm ring
+    slots); ``n`` bounds the scan so a UDS or shm frame can parse in
+    place inside a larger buffer. Strings
     without a backslash decode straight off a memoryview slice (after
     rejecting raw control bytes, which json would 400); strings WITH a
     backslash hand just the quoted token to ``json.loads`` for exact
@@ -162,7 +166,10 @@ def fast_parse_texts(body, n: int | None = None):
     if i >= n or body[i] != 0x7B:                       # {
         return None
     i = _skip_ws(body, i + 1, n)
-    if not body.startswith(b'"request"', i, n):
+    # slice-compare instead of startswith: mmap objects (the shm ring
+    # lane parses frames in place off the shared mapping) have no
+    # startswith, and the bound check keeps the scan inside n
+    if i + 9 > n or mv[i:i + 9] != b'"request"':
         return None
     i = _skip_ws(body, i + 9, n)
     if i >= n or body[i] != 0x3A:                       # :
@@ -179,7 +186,7 @@ def fast_parse_texts(body, n: int | None = None):
             if i >= n or body[i] != 0x7B:               # {
                 return None
             i = _skip_ws(body, i + 1, n)
-            if not body.startswith(b'"text"', i, n):
+            if i + 6 > n or mv[i:i + 6] != b'"text"':
                 return None
             i = _skip_ws(body, i + 6, n)
             if i >= n or body[i] != 0x3A:               # :
@@ -560,40 +567,62 @@ class UnixFrameServer:
         buf = bytearray(65536)
         try:
             while True:
-                if not _recv_exact_into(conn, hview, len(hdr)):
-                    return      # clean EOF (or truncated header)
-                (length,) = FRAME_HEADER.unpack(hdr)
-                tenant = None
-                deadline_ms = None
-                priority = False
-                if length & FRAME_V2_FLAG:
-                    length &= ~FRAME_V2_FLAG
-                    if not _recv_exact_into(conn, eview, len(ext)):
-                        return  # truncated ext header
-                    flags, tlen, dl = FRAME_EXT_HEADER.unpack(ext)
-                    priority = bool(flags & FRAME_PRIORITY)
-                    if dl:
-                        deadline_ms = dl
-                    if tlen:
-                        tbuf = bytearray(tlen)
-                        if not _recv_exact_into(conn, memoryview(tbuf),
-                                                tlen):
-                            return
-                        tenant = tbuf.decode("latin-1")
-                if length > BODY_LIMIT_BYTES:
-                    m = svc.metrics
-                    m.inc("augmentation_requests_total")
-                    m.inc("augmentation_invalid_requests_total")
-                    m.inc_object("unsuccessful")
-                    telemetry.REGISTRY.counter_inc(
-                        "ldt_http_requests_total", lane="uds")
-                    send_frame(conn, 413, [OVERSIZE_BODY])
+                # the FIRST byte of a frame may wait forever (idle
+                # keep-alive between frames is legal); once it arrives
+                # the rest of the header and body must land within the
+                # slow-loris budget or the connection answers a 408
+                # frame and closes — a stalled writer cannot hold its
+                # thread and grow-only buffer open indefinitely
+                first = conn.recv(1)
+                if not first:
+                    return      # clean EOF
+                hdr[0:1] = first
+                tmo = knobs.get_float("LDT_FRAME_READ_TIMEOUT_SEC")
+                if tmo:
+                    conn.settimeout(tmo)
+                try:
+                    if not _recv_exact_into(conn, hview[1:],
+                                            len(hdr) - 1):
+                        return  # truncated header
+                    (length,) = FRAME_HEADER.unpack(hdr)
+                    tenant = None
+                    deadline_ms = None
+                    priority = False
+                    if length & FRAME_V2_FLAG:
+                        length &= ~FRAME_V2_FLAG
+                        if not _recv_exact_into(conn, eview, len(ext)):
+                            return  # truncated ext header
+                        flags, tlen, dl = FRAME_EXT_HEADER.unpack(ext)
+                        priority = bool(flags & FRAME_PRIORITY)
+                        if dl:
+                            deadline_ms = dl
+                        if tlen:
+                            tbuf = bytearray(tlen)
+                            if not _recv_exact_into(
+                                    conn, memoryview(tbuf), tlen):
+                                return
+                            tenant = tbuf.decode("latin-1")
+                    if length > BODY_LIMIT_BYTES:
+                        m = svc.metrics
+                        m.inc("augmentation_requests_total")
+                        m.inc("augmentation_invalid_requests_total")
+                        m.inc_object("unsuccessful")
+                        telemetry.REGISTRY.counter_inc(
+                            "ldt_http_requests_total", lane="uds")
+                        send_frame(conn, 413, [OVERSIZE_BODY])
+                        return
+                    if length > len(buf):
+                        buf = bytearray(length)
+                    if not _recv_exact_into(
+                            conn, memoryview(buf)[:length], length):
+                        return  # truncated frame: no resync possible
+                except socket.timeout:
+                    # best-effort explicit refusal, then close (the
+                    # stream cannot resync mid-frame either way)
+                    send_frame(conn, 408, [TIMEOUT_BODY])
                     return
-                if length > len(buf):
-                    buf = bytearray(length)
-                if not _recv_exact_into(conn, memoryview(buf)[:length],
-                                        length):
-                    return      # truncated frame: no resync possible
+                if tmo:
+                    conn.settimeout(None)
                 with self._lock:
                     self._inflight += 1
                 try:

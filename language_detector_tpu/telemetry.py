@@ -229,6 +229,35 @@ METRICS: dict = {
         "gauge",
         "Fleet crash circuit: 0 closed, 1 open (correlated crash — "
         "restarts parked), 2 half-open probe in flight."),
+    "ldt_shm_rings": (
+        "gauge",
+        "Shared-memory ring files currently attached by the scan "
+        "thread (service/shmring.py)."),
+    "ldt_shm_slots_free": (
+        "gauge",
+        "FREE slots across all attached shm rings (ring capacity "
+        "headroom; equals total slots when the lane is idle)."),
+    "ldt_shm_frames_total": (
+        "counter",
+        "Frames answered on the shm ring lane by result=ok|error|"
+        "fenced (fenced = stale-generation frame failed back with an "
+        "explicit error frame)."),
+    "ldt_shm_reclaimed_total": (
+        "counter",
+        "Ring slots reclaimed by reason=writer-lost (client dead or "
+        "stalled mid-WRITING), client-dead (unconsumed DONE), "
+        "generation (fenced frame failed back), corrupt (header with "
+        "no legal transition path), attach-fault (injected attach "
+        "failure, ring retried)."),
+    "ldt_quarantine_docs_total": (
+        "counter",
+        "Docs quarantined after bisection proved they "
+        "deterministically kill a scorer batch; quarantined docs "
+        "answer \"un\" and never reach the scorer again."),
+    "ldt_quarantine_bisect_total": (
+        "counter",
+        "Bisection passes run while isolating poison docs from a "
+        "killed batch (each pass re-scores the two halves)."),
 }
 
 
@@ -718,6 +747,16 @@ def debug_vars(metrics=None) -> dict:
             pl = pipeline_fn()
             if pl:
                 d["pipeline"] = pl
+        shm_fn = getattr(metrics, "shm_stats", None)
+        if shm_fn is not None:
+            sh = shm_fn()
+            if sh:
+                d["shm"] = sh
+        quar_fn = getattr(metrics, "quarantine_stats", None)
+        if quar_fn is not None:
+            qs = quar_fn()
+            if qs:
+                d["quarantine"] = qs
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

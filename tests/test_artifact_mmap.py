@@ -96,3 +96,46 @@ def test_detection_over_mmap_tables():
         b = detect_scalar(text, t_npz, registry)
         assert (a.summary_lang, a.language3, a.percent3) == \
             (b.summary_lang, b.language3, b.percent3), text
+
+
+def test_empty_artifact_is_typed(tmp_path):
+    """An empty file (open() succeeded, nothing written yet) is a typed
+    ArtifactError, not mmap's raw 'cannot mmap an empty file'."""
+    from language_detector_tpu.artifact import ArtifactError
+    p = tmp_path / "empty.ldta"
+    p.write_bytes(b"")
+    with pytest.raises(ArtifactError, match="shorter than"):
+        load_artifact(p)
+
+
+def test_missing_artifact_is_typed(tmp_path):
+    from language_detector_tpu.artifact import ArtifactError
+    with pytest.raises(ArtifactError, match="cannot open"):
+        load_artifact(tmp_path / "never-written.ldta")
+
+
+def test_half_written_artifact_aborts_swap_cleanly(tmp_path):
+    """A half-written pack (ENOSPC / packer died mid-write) fails
+    size-vs-header validation BEFORE the mmap exists, with an
+    actionable typed error — and ScoringTables.load_mmap surfaces the
+    same ArtifactError, so a swap drill aborts on the old tables
+    instead of dying on a raw OSError."""
+    from language_detector_tpu.artifact import ArtifactError
+    p = tmp_path / "half.ldta"
+    write_artifact({"x": np.arange(8192, dtype=np.uint32)}, p)
+    data = p.read_bytes()
+    p.write_bytes(data[:len(data) // 2])
+    with pytest.raises(ArtifactError, match="half-written|truncated"):
+        load_artifact(p)
+    with pytest.raises(ArtifactError):
+        ScoringTables.load_mmap(p)
+
+
+def test_short_garbage_header_is_typed(tmp_path):
+    """A few stray bytes (shorter than the header struct) are refused
+    before fstat-vs-header comparison can even run."""
+    from language_detector_tpu.artifact import ArtifactError
+    p = tmp_path / "stub.ldta"
+    p.write_bytes(b"LD")
+    with pytest.raises(ArtifactError, match="shorter than"):
+        load_artifact(p)

@@ -1,0 +1,242 @@
+"""Crash-safe shared-memory ring lane (service/shmring.py).
+
+Chaos suite for the lease/fencing protocol: a client SIGKILLed
+mid-WRITING is reclaimed (and its orphaned ring file garbage-collected),
+a worker crash mid-LEASED fences the old generation and answers
+explicit error frames instead of hanging the client, and quarantine
+bisection isolates exactly the poison doc out of a 32-doc frame. The
+happy path pins byte-identity with the UDS frame contract
+(wire.handle_frame) — the shm lane is a transport, not a different
+protocol.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from language_detector_tpu import faults, telemetry
+from language_detector_tpu.service import shmring, wire
+from language_detector_tpu.service.server import DetectorService
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _echo(texts, trace=None):
+    return ["en"] * len(texts)
+
+
+def _svc():
+    return DetectorService(use_device=False, start_batcher=False)
+
+
+def _body(n=1, poison_at=None):
+    docs = [{"text": f"plain document number {i}"} for i in range(n)]
+    if poison_at is not None:
+        docs[poison_at]["text"] = \
+            f"bad doc {shmring.POISON_MARKER} kills the batch"
+    return json.dumps({"request": docs}).encode()
+
+
+def _reclaims(reason):
+    return telemetry.REGISTRY.counter_value(
+        "ldt_shm_reclaimed_total", reason=reason)
+
+
+def test_roundtrip_parity_and_pipelining(tmp_path):
+    """Responses on the shm lane are byte-identical to handle_frame's
+    UDS output, and several frames pipeline across slots."""
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    cli = shmring.RingClient(str(tmp_path))
+    try:
+        cli.wait_attached(10.0)
+        body = _body(3)
+        st, resp = cli.request(body, timeout=10.0)
+        st2, bufs = wire.handle_frame(svc, body, detect=_echo)
+        assert (st, resp) == (st2, b"".join(bufs))
+        assert json.loads(resp)["response"][0]["iso6391code"] == "en"
+        idxs = [cli.submit(body) for _ in range(4)]
+        assert all(i is not None for i in idxs)
+        for i in idxs:
+            s, r = cli.wait(i, timeout=10.0)
+            assert (s, r) == (st, resp)
+        stats = srv.stats()
+        assert stats["rings"] == 1
+        assert stats["frames"] >= 5
+    finally:
+        cli.close(unlink=True)
+        srv.close()
+
+
+def test_error_frames_match_uds_contract(tmp_path):
+    """Malformed bodies answer the SAME error frames as the UDS lane."""
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    cli = shmring.RingClient(str(tmp_path))
+    try:
+        cli.wait_attached(10.0)
+        for body in (b"not json{{", b'{"request": [{"other": 1}]}'):
+            st, resp = cli.request(body, timeout=10.0)
+            st2, bufs = wire.handle_frame(svc, body, detect=_echo)
+            assert (st, resp) == (st2, b"".join(bufs)), body
+    finally:
+        cli.close(unlink=True)
+        srv.close()
+
+
+_CHILD_MID_WRITING = """
+import os, sys, time
+sys.path.insert(0, sys.argv[2])
+from language_detector_tpu.service import shmring
+c = shmring.RingClient(sys.argv[1])
+c.slots[0].mark_writing()
+c.rf.write_slot(0, shmring.SLOT_WRITING, c.rf.generation, os.getpid(),
+                time.time(), 0, 0)
+print("WRITING", flush=True)
+time.sleep(60)
+"""
+
+
+def test_client_sigkill_mid_writing_is_reclaimed(tmp_path, monkeypatch):
+    """SIGKILL a client that claimed a slot mid-WRITING: the lease
+    sweep reclaims the slot (dead owner pid), and once every slot of
+    the dead client's ring is FREE the ring file itself is GC'd."""
+    monkeypatch.setenv("LDT_SHM_LEASE_TIMEOUT_SEC", "0.2")
+    before = _reclaims("writer-lost")
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_MID_WRITING, str(tmp_path), ROOT],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        assert "WRITING" in child.stdout.readline()
+        ring = shmring.client_ring_path(str(tmp_path), child.pid)
+        assert os.path.exists(ring)
+        # live writer with a fresh lease: the sweep must NOT reclaim
+        time.sleep(0.15)
+        rf = shmring.RingFile(ring)
+        assert rf.read_slot(0)[0] == shmring.SLOT_WRITING
+        rf.close()
+        child.kill()
+        child.wait(10)
+        deadline = time.monotonic() + 10.0
+        while os.path.exists(ring) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # slot reclaimed -> all-FREE ring of a dead client -> unlinked
+        assert not os.path.exists(ring)
+        assert _reclaims("writer-lost") >= before + 1
+    finally:
+        if child.poll() is None:
+            child.kill()
+        srv.close()
+
+
+def test_worker_crash_fences_old_generation_no_hang(tmp_path):
+    """The fleet-member-crash drill, in-process: a previous worker
+    attached the ring (generation 1), leased one frame, and died with
+    another frame committed READY. The restarted worker's attach bumps
+    the generation, and BOTH stale frames come back as explicit 503
+    error frames — the client's wait() resolves, it never hangs."""
+    cli = shmring.RingClient(str(tmp_path))
+    dead_pid = 2 ** 22 + 1025     # beyond pid_max: definitely dead
+    cli.rf.set_generation(1, dead_pid)   # the "previous worker"
+    body = _body(2)
+    i0 = cli.submit(body)
+    i1 = cli.submit(body)
+    assert i0 is not None and i1 is not None
+    # the old worker leased i1 mid-score and crashed
+    cli.rf.write_slot(i1, shmring.SLOT_LEASED, 1, dead_pid,
+                      time.time(), len(body), 0)
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    try:
+        for i in (i0, i1):
+            st, resp = cli.wait(i, timeout=10.0)
+            assert st == 503
+            assert b"fenced" in resp
+        # the ring stays serviceable on the new generation
+        st, resp = cli.request(body, timeout=10.0)
+        assert st in (200, 203)
+    finally:
+        cli.close(unlink=True)
+        srv.close()
+
+
+def test_quarantine_bisection_isolates_poison_doc(tmp_path):
+    """A 32-doc frame with ONE poison doc (deterministically kills its
+    scorer batch under the poison_doc fault): bisection isolates and
+    quarantines exactly that doc, the other 31 docs still answer, and a
+    re-submission pre-filters the quarantined doc without re-bisecting."""
+    docs_before = telemetry.REGISTRY.counter_value(
+        "ldt_quarantine_docs_total")
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    cli = shmring.RingClient(str(tmp_path))
+    faults.configure("poison_doc:error")
+    try:
+        cli.wait_attached(10.0)
+        body = _body(32, poison_at=13)
+        st, resp = cli.request(body, timeout=30.0)
+        codes = [r["iso6391code"]
+                 for r in json.loads(resp)["response"]]
+        assert len(codes) == 32
+        assert codes[13] == "un"
+        assert codes.count("un") == 1          # exactly the poison doc
+        assert set(codes) == {"en", "un"}
+        assert srv.quarantine.total == 1
+        assert srv.quarantine.stats()["bisect_batches"] >= 5
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_quarantine_docs_total") == docs_before + 1
+        # resubmit: the quarantined doc is pre-filtered (answers "un"
+        # without touching the scorer) — no new bisection burned
+        bisects = srv.quarantine.stats()["bisect_batches"]
+        st, resp = cli.request(body, timeout=30.0)
+        codes = [r["iso6391code"]
+                 for r in json.loads(resp)["response"]]
+        assert codes[13] == "un" and codes.count("un") == 1
+        assert srv.quarantine.stats()["bisect_batches"] == bisects
+        assert srv.quarantine.total == 1
+    finally:
+        faults.configure(None)
+        cli.close(unlink=True)
+        srv.close()
+
+
+def test_lease_fault_retries_frame(tmp_path):
+    """An injected shm_lease fault leaves the frame READY; the next
+    sweep (fault disarmed) serves it — no frame is lost."""
+    svc = _svc()
+    srv = shmring.ShmRingServer(svc, str(tmp_path), detect=_echo)
+    srv.start()
+    cli = shmring.RingClient(str(tmp_path))
+    faults.configure("shm_lease:error:p=0.5:seed=7")
+    try:
+        cli.wait_attached(10.0)
+        for _ in range(8):
+            st, resp = cli.request(_body(2), timeout=10.0)
+            assert st in (200, 203)
+    finally:
+        faults.configure(None)
+        cli.close(unlink=True)
+        srv.close()
+
+
+def test_oversize_frame_refused_at_submit(tmp_path):
+    cli = shmring.RingClient(str(tmp_path), slot_bytes=4096)
+    try:
+        with pytest.raises(ValueError, match="slot capacity"):
+            cli.submit(b"x" * (cli.rf.slot_bytes + 1))
+    finally:
+        cli.close(unlink=True)

@@ -518,3 +518,78 @@ def test_assemble_response_matches_join():
     assert b"".join(wire.assemble_response(frags)) == \
         b'{"response": [' + b", ".join(frags) + b']}'
     assert b"".join(wire.assemble_response([])) == b'{"response": []}'
+
+
+def _recv_resp(s):
+    hdr = b""
+    while len(hdr) < 6:
+        chunk = s.recv(6 - len(hdr))
+        if not chunk:
+            return None, None
+        hdr += chunk
+    length, status = struct.unpack("!IH", hdr)
+    payload = b""
+    while len(payload) < length:
+        payload += s.recv(length - len(payload))
+    return status, payload
+
+
+def test_uds_slow_loris_sync_408(sync_server, monkeypatch):
+    """Slow-loris guard on the threaded front's UDS lane: a stalled
+    partial frame answers a 408 error frame and closes, while idle
+    keep-alive BETWEEN frames stays unbounded and a prompt frame on
+    the same settings still serves."""
+    monkeypatch.setenv("LDT_FRAME_READ_TIMEOUT_SEC", "0.2")
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "sl.sock")
+    uds = wire.UnixFrameServer(sync_server["svc"], path)
+    uds.start()
+    try:
+        # idle keep-alive longer than the budget: NOT a timeout (the
+        # clock only arms once a frame's first byte arrives)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.settimeout(10.0)
+        time.sleep(0.4)
+        status, payload = _uds_request(
+            s, b'{"request": [{"text": "after idle"}]}')
+        assert status < 400
+        # now stall mid-header: 2 of 4 length bytes, then nothing
+        s.sendall(b"\x00\x00")
+        t0 = time.monotonic()
+        status, payload = _recv_resp(s)
+        assert status == 408
+        assert payload == wire.TIMEOUT_BODY
+        assert "timed out" in json.loads(payload)["error"]
+        assert time.monotonic() - t0 < 5.0   # the 0.2s budget, not 10s
+        assert s.recv(1) == b""              # server closed its side
+        s.close()
+        # stall mid-BODY on a fresh connection
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.settimeout(10.0)
+        s.sendall(struct.pack("!I", 100) + b'{"request"')
+        status, payload = _recv_resp(s)
+        assert status == 408 and payload == wire.TIMEOUT_BODY
+        s.close()
+    finally:
+        uds.close()
+
+
+def test_uds_slow_loris_aio_408(aio_server, monkeypatch):
+    """Same stalled-client regression against the asyncio front."""
+    monkeypatch.setenv("LDT_FRAME_READ_TIMEOUT_SEC", "0.2")
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(aio_server["uds_path"])
+    s.settimeout(10.0)
+    try:
+        # a healthy frame first (keep-alive), then a stalled body
+        status, payload = _uds_request(
+            s, b'{"request": [{"text": "warm"}]}')
+        assert status < 400
+        s.sendall(struct.pack("!I", 64) + b'{"partial')
+        status, payload = _recv_resp(s)
+        assert status == 408
+        assert payload == wire.TIMEOUT_BODY
+        assert s.recv(1) == b""
+    finally:
+        s.close()

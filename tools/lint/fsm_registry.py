@@ -210,6 +210,28 @@ MACHINES = (
             ("SWAP_LOADING", "SWAP_REBOUND"),
         }),
     ),
+    Machine(
+        name="shm-slot",
+        file="language_detector_tpu/service/shmring.py",
+        scope=("class", "RingSlot"),
+        kind="attr",
+        var="state",
+        states={"SLOT_FREE": 0, "SLOT_WRITING": 1, "SLOT_READY": 2,
+                "SLOT_LEASED": 3, "SLOT_DONE": 4},
+        initial="SLOT_FREE",
+        transitions=frozenset({
+            ("SLOT_FREE", "SLOT_WRITING"),    # client claims the slot
+            ("SLOT_WRITING", "SLOT_READY"),   # frame committed
+            ("SLOT_READY", "SLOT_LEASED"),    # worker leases it
+            ("SLOT_LEASED", "SLOT_DONE"),     # response written
+            # fail-back: fenced READY / orphaned LEASED answers an
+            # explicit error frame instead of hanging the client
+            ("SLOT_READY", "SLOT_DONE"),
+            ("SLOT_LEASED", "SLOT_DONE"),
+            ("SLOT_DONE", "SLOT_FREE"),       # client consumed
+            ("SLOT_WRITING", "SLOT_FREE"),    # dead writer reclaimed
+        }),
+    ),
 )
 
 

@@ -669,6 +669,260 @@ def _p4_inv_closed_window(f):
 
 
 # ---------------------------------------------------------------------
+# product 5: shm ring slot lifecycle x client-crash x worker-crash x
+# generation bump (service/shmring.py)
+#
+# Drives the REAL RingSlot FSM mirrors through the abstract twin of the
+# worker's sweep (lease, reclaim, fence) and the client's write/commit/
+# consume, with at most one client crash, one worker crash, and one
+# fleet-roll generation bump. The headline obligation from the lease
+# protocol: every interleaving leaves every slot reclaimable — a
+# bounded recovery procedure (restart the worker if dead, let leases
+# and sweeps run, let a live client consume) always reaches
+# every-slot-FREE, with fenced frames passing through an explicit
+# error frame (DONE+failed), never a silent hang.
+
+_R5_TIMEOUT = 2.0
+
+
+class _RingModel:
+    def __init__(self):
+        from language_detector_tpu.service.shmring import RingSlot
+        self.clock = FakeClock()
+        self.slots = [RingSlot(0), RingSlot(1)]
+        self.slot_gen = [0, 0]    # generation stamped on the frame
+        self.lease_ts = [0.0, 0.0]
+        self.failed = [False, False]  # DONE carries an error frame
+        self.gen = 1              # worker's current ring generation
+        self.client_alive = True
+        self.worker_alive = True
+        self.ccrashes = 0
+        self.wcrashes = 0
+        self.bumps = 0
+
+    def _fresh(self, i):
+        return self.clock() - self.lease_ts[i] <= _R5_TIMEOUT
+
+    # -- client side --------------------------------------------------
+
+    def write(self, i):
+        from language_detector_tpu.service.shmring import SLOT_FREE
+        s = self.slots[i]
+        if not self.client_alive or s.state != SLOT_FREE:
+            return False
+        s.mark_writing()
+        self.slot_gen[i] = self.gen   # client stamps what it observed
+        self.lease_ts[i] = self.clock()
+        self.failed[i] = False
+        return True
+
+    def commit(self, i):
+        from language_detector_tpu.service.shmring import SLOT_WRITING
+        s = self.slots[i]
+        if not self.client_alive or s.state != SLOT_WRITING:
+            return False
+        s.mark_ready()
+        return True
+
+    def consume(self, i):
+        from language_detector_tpu.service.shmring import SLOT_DONE
+        s = self.slots[i]
+        if not self.client_alive or s.state != SLOT_DONE:
+            return False
+        s.mark_free()
+        self.failed[i] = False
+        self.slot_gen[i] = 0
+        return True
+
+    # -- worker side --------------------------------------------------
+
+    def lease(self, i):
+        from language_detector_tpu.service.shmring import SLOT_READY
+        s = self.slots[i]
+        if not self.worker_alive or s.state != SLOT_READY \
+                or self.slot_gen[i] != self.gen:
+            return False
+        s.mark_leased()
+        self.lease_ts[i] = self.clock()
+        return True
+
+    def done(self, i):
+        from language_detector_tpu.service.shmring import SLOT_LEASED
+        s = self.slots[i]
+        if not self.worker_alive or s.state != SLOT_LEASED \
+                or self.slot_gen[i] != self.gen:
+            return False
+        s.mark_done()
+        self.failed[i] = False
+        return True
+
+    def sweep(self):
+        """One reclaim/fence pass of ShmRingServer._sweep_ring (no
+        clock advance — `expire` models the lease horizon passing)."""
+        from language_detector_tpu.service.shmring import (
+            SLOT_DONE, SLOT_LEASED, SLOT_READY, SLOT_WRITING)
+        if not self.worker_alive:
+            return False
+        changed = False
+        for i, s in enumerate(self.slots):
+            if s.state == SLOT_WRITING and \
+                    (not self.client_alive or not self._fresh(i)):
+                s.mark_free()
+                changed = True
+            elif s.state in (SLOT_READY, SLOT_LEASED) and \
+                    self.slot_gen[i] != self.gen:
+                s.mark_failed()        # explicit error frame
+                self.failed[i] = True
+                changed = True
+            elif s.state == SLOT_DONE and not self.client_alive \
+                    and not self._fresh(i):
+                s.mark_free()
+                self.failed[i] = False
+                changed = True
+        return changed
+
+    def expire(self):
+        """The lease horizon passes (idempotent: prune when nothing is
+        fresh so the clock stays bounded in the abstraction)."""
+        if not any(self._fresh(i) for i in range(len(self.slots))):
+            return False
+        self.clock.advance(_R5_TIMEOUT + 0.1)
+        return True
+
+    # -- crashes & generations ---------------------------------------
+
+    def client_crash(self):
+        if not self.client_alive or self.ccrashes >= 1:
+            return False
+        self.client_alive = False
+        self.ccrashes += 1
+        return True
+
+    def worker_crash(self):
+        if not self.worker_alive or self.wcrashes >= 1:
+            return False
+        self.worker_alive = False
+        self.wcrashes += 1
+        return True
+
+    def worker_restart(self):
+        """Re-attach after a crash: the generation bump IS the fence."""
+        if self.worker_alive:
+            return False
+        self.worker_alive = True
+        self.gen += 1
+        return True
+
+    def gen_bump(self):
+        """Fleet roll: a live re-attach (new member process adopts the
+        member's ring directory) bumps the generation once."""
+        if not self.worker_alive or self.bumps >= 1:
+            return False
+        self.gen += 1
+        self.bumps += 1
+        return True
+
+
+def _r5_build():
+    return (_RingModel(),)
+
+
+_R5_EVENTS = {
+    "write_0": lambda r: r.write(0),
+    "write_1": lambda r: r.write(1),
+    "commit_0": lambda r: r.commit(0),
+    "commit_1": lambda r: r.commit(1),
+    "lease_0": lambda r: r.lease(0),
+    "lease_1": lambda r: r.lease(1),
+    "done_0": lambda r: r.done(0),
+    "done_1": lambda r: r.done(1),
+    "consume_0": lambda r: r.consume(0),
+    "consume_1": lambda r: r.consume(1),
+    "sweep": lambda r: r.sweep(),
+    "expire": lambda r: r.expire(),
+    "client_crash": lambda r: r.client_crash(),
+    "worker_crash": lambda r: r.worker_crash(),
+    "worker_restart": lambda r: r.worker_restart(),
+    "gen_bump": lambda r: r.gen_bump(),
+}
+
+
+def _r5_key(r):
+    return (tuple(s.state for s in r.slots),
+            tuple(g == r.gen for g in r.slot_gen),
+            tuple(r.failed),
+            tuple(r._fresh(i) for i in range(len(r.slots))),
+            r.client_alive, r.worker_alive,
+            r.ccrashes, r.wcrashes, r.bumps)
+
+
+def _r5_recover(r):
+    """The bounded recovery procedure every reachable state must admit:
+    restart the worker if it crashed, then let the protocol run (lease
+    horizon passes, sweeps reclaim/fence, the worker serves what it
+    legally can, a live client consumes)."""
+    if not r.worker_alive:
+        r.worker_restart()
+    for _ in range(4):
+        r.expire()
+        r.sweep()
+        for i in range(len(r.slots)):
+            r.lease(i)
+            r.done(i)
+            if r.client_alive:
+                r.consume(i)
+
+
+def _r5_inv_recovers(r):
+    from language_detector_tpu.service.shmring import SLOT_FREE
+    _r5_recover(r)
+    bad = [i for i, s in enumerate(r.slots) if s.state != SLOT_FREE]
+    if bad:
+        return (f"slots {bad} not reclaimed to FREE after recovery "
+                f"(states {[r.slots[i].state for i in bad]}, "
+                f"client_alive={r.client_alive})")
+    return None
+
+
+def _r5_inv_no_premature_reclaim(r):
+    """A live client's fresh WRITING slot survives a sweep: reclaim
+    only fires on a dead writer or an expired lease."""
+    from language_detector_tpu.service.shmring import SLOT_WRITING
+    if not r.worker_alive:
+        return None
+    fresh_writing = [i for i, s in enumerate(r.slots)
+                     if s.state == SLOT_WRITING and r.client_alive
+                     and r._fresh(i)]
+    r.sweep()
+    for i in fresh_writing:
+        if r.slots[i].state != SLOT_WRITING:
+            return (f"sweep reclaimed slot {i} although its writer is "
+                    f"alive and its lease is fresh")
+    return None
+
+
+def _r5_inv_fenced_fail_explicitly(r):
+    """A committed or leased frame stamped by a previous generation
+    always answers an explicit error frame (DONE+failed) on the next
+    sweep — the client polls it out; it never silently vanishes or
+    dangles LEASED forever."""
+    from language_detector_tpu.service.shmring import (
+        SLOT_DONE, SLOT_LEASED, SLOT_READY)
+    if not r.worker_alive:
+        r.worker_restart()
+    stale = [i for i, s in enumerate(r.slots)
+             if s.state in (SLOT_READY, SLOT_LEASED)
+             and r.slot_gen[i] != r.gen]
+    r.sweep()
+    for i in stale:
+        if r.slots[i].state != SLOT_DONE or not r.failed[i]:
+            return (f"fenced frame in slot {i} did not fail back as an "
+                    f"explicit error frame (state "
+                    f"{r.slots[i].state}, failed={r.failed[i]})")
+    return None
+
+
+# ---------------------------------------------------------------------
 # analyzer entry point
 
 PRODUCTS = (
@@ -694,6 +948,12 @@ PRODUCTS = (
          "fleet-min-one-accepting": _p4_inv_min_one_accepting,
          "fleet-open-circuit-recovers": _p4_inv_open_recovers,
          "fleet-closed-window-bound": _p4_inv_closed_window,
+     }),
+    ("ring-reclaim", "language_detector_tpu/service/shmring.py",
+     _r5_build, _R5_EVENTS, _r5_key, {
+         "ring-every-slot-recovers": _r5_inv_recovers,
+         "ring-no-premature-reclaim": _r5_inv_no_premature_reclaim,
+         "ring-fenced-fail-explicitly": _r5_inv_fenced_fail_explicitly,
      }),
 )
 

@@ -105,6 +105,14 @@ LOCK_OWNERSHIP: dict = {
                               "assignment-at-init contract",
                 "pipeline_stats": "callable reference, same single-"
                                   "assignment-at-init contract",
+                "shm_stats": "callable reference, rebound once by "
+                             "ShmRingServer.start during service "
+                             "build (before traffic); the callee "
+                             "returns an immutable snapshot",
+                "quarantine_stats": "callable reference, same "
+                                    "ShmRingServer.start single-"
+                                    "assignment contract; the callee "
+                                    "locks its own state",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
@@ -202,6 +210,27 @@ LOCK_OWNERSHIP: dict = {
         # OWNERSHIP: every field is confined to the fleet main loop;
         # the status thread only reads the immutable snapshot dicts
         # FleetStatus republishes under its lock
+    },
+    "language_detector_tpu/service/shmring.py": {
+        "Quarantine": _cl(
+            lock="_lock",
+            attrs=("_docs", "total", "bisects")),
+        "ShmRingServer": _cl(
+            lock="_stat_lock",
+            attrs=("_frames",),
+            lockfree={
+                "_snap": "immutable snapshot dict rebuilt and rebound "
+                         "by the scan thread each sweep; stats() "
+                         "readers take ONE GIL-atomic reference and "
+                         "never mutate it",
+            }),
+        # RingSlot / RingFile / _WorkerRing / RingClient are
+        # deliberately lock-free by OWNERSHIP: SPSC contract — the
+        # mirrors and ring map are confined to the scan thread (which
+        # also serves every leased frame inline, publish-order header
+        # write with the state word last), the client objects to their
+        # caller. Cross-process coordination happens through the
+        # mmap'd slot headers.
     },
     "language_detector_tpu/service/aioserver.py": {
         # the asyncio front deliberately holds no locks: every mutation
