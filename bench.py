@@ -24,6 +24,13 @@ PER_CHIP_TARGET = 1_000_000 / 8  # docs/sec (BASELINE.json north star, v5e-8)
 # in the model checker lands well past this).
 LINT_BUDGET_MS = 30_000
 
+# Per-record budgets for the always-on observability hot paths: one
+# flight-recorder emit (JSON encode + mmap store) and one trace span.
+# Both are single-digit microseconds in practice; 50µs absorbs a
+# loaded CI host while still catching an accidental fsync, lock
+# convoy, or O(n) scan creeping into the per-request path.
+TELEM_BUDGET_NS = 50_000
+
 # Self-contained corpus: service-sized snippets in several scripts; padded
 # with index salt so quad repeat filters see realistic variety.
 _SEEDS = [
@@ -961,6 +968,45 @@ def bench_shm(total_docs: int = 8192, docs_per_request: int = 64) -> dict:
         log.close()
 
 
+def bench_telemetry_overhead(n: int = 20_000) -> dict:
+    """ns per flight-recorder event and per trace span, measured on
+    the real code paths (armed recorder into a temp ring, module-level
+    emit_event; Trace spans via observe_stage)."""
+    import shutil
+    import tempfile
+
+    from language_detector_tpu import flightrec, telemetry
+
+    tmp = tempfile.mkdtemp(prefix="ldt-bench-fr-")
+    saved = flightrec.RECORDER
+    try:
+        flightrec.RECORDER = flightrec.FlightRecorder(
+            flightrec.ring_path(tmp), slots=256, slot_bytes=512)
+        t0 = time.perf_counter()
+        for i in range(n):
+            flightrec.emit_event("request_end", request_id="bench",
+                                 status=200, total_ms=1.25)
+        event_ns = (time.perf_counter() - t0) * 1e9 / n
+        flightrec.RECORDER.close()
+    finally:
+        flightrec.RECORDER = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+    spans_per_trace = 8
+    t0 = time.perf_counter()
+    for i in range(n // spans_per_trace):
+        tr = telemetry.Trace()
+        t = tr.t0
+        for _ in range(spans_per_trace):
+            t = telemetry.observe_stage("bench", t, trace=tr)
+    span_ns = (time.perf_counter() - t0) * 1e9 \
+        / ((n // spans_per_trace) * spans_per_trace)
+    # the calibration loops above are not workload: drop their stage
+    # histograms so the real bench summary stays clean
+    telemetry.REGISTRY.reset()
+    return {"flightrec_ns_per_event": round(event_ns, 1),
+            "trace_ns_per_span": round(span_ns, 1)}
+
+
 if __name__ == "__main__":
     # --profile DIR: wrap the run in a jax.profiler trace (open DIR with
     # tensorboard / xprof to see the device timeline per op)
@@ -1015,8 +1061,16 @@ if __name__ == "__main__":
         if lint_ms > LINT_BUDGET_MS:
             sys.exit(f"bench --smoke: lint suite took {lint_ms:.0f}ms "
                      f"(budget {LINT_BUDGET_MS}ms)")
+        # telemetry overhead gate: the recorder and tracer ride every
+        # request, so their per-record cost is held to a hard budget
+        telem = bench_telemetry_overhead()
+        for key, ns in telem.items():
+            if ns > TELEM_BUDGET_NS:
+                sys.exit(f"bench --smoke: {key} = {ns:.0f}ns "
+                         f"(budget {TELEM_BUDGET_NS}ns)")
         out = bench(batch_size=2048, n_batches=2, http_bench=False)
         out["detail"]["lint_ms"] = lint_ms
+        out["detail"].update(telem)
         print(json.dumps(out))
     else:
         print(json.dumps(bench()))

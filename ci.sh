@@ -954,6 +954,195 @@ print("fleet chaos:", statuses.count(200), "served,",
       "member respawned + fleet rolled, 3 READY, clean exit")
 EOF
 
+echo "== postmortem chaos smoke =="
+# the flight-recorder tentpole end-to-end (docs/OBSERVABILITY.md): a
+# 2-member fleet with LDT_FLIGHTREC_DIR armed and clients bursting
+# with X-LDT-Request-Id headers, then a SIGKILL of a READY member
+# mid-burst. The invariants: /fleetz carries a postmortem for the dead
+# pid — harvested from its crash-safe mmap ring, so nonzero recorder
+# events and the request ids in flight at the kill survive the SIGKILL
+# — and ONE correlation id sent over both members' UDS lanes merges
+# into a single /tracez entry spanning two pids.
+python3 - <<'EOF'
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from language_detector_tpu.service import wire
+
+PORT, MBASE, SPORT = 3187, 31870, 31879
+FR_DIR = f"/tmp/ldt_fr_smoke_{os.getpid()}"
+UDS = f"/tmp/ldt_fr_smoke_{os.getpid()}.sock"
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MBASE),
+    "LDT_FLEET_WORKERS": "2",
+    "LDT_FLEET_STATUS_PORT": str(SPORT),
+    "LDT_FLIGHTREC_DIR": FR_DIR,
+    "LDT_UNIX_SOCKET": UDS,
+    "LDT_CRASH_BACKOFF_BASE_SEC": "0.2",
+    "LDT_CRASH_BACKOFF_MAX_SEC": "1.0",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_postmortem_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+body = json.dumps({"request": [
+    {"text": f"the quick brown fox jumps over the lazy dog {i}"}
+    for i in range(4)
+]}).encode()
+stop = threading.Event()
+lock = threading.Lock()
+served = [0]
+rid_seq = [0]
+threads = []
+
+
+def client():
+    while not stop.is_set():
+        with lock:
+            rid_seq[0] += 1
+            rid = f"pm-{rid_seq[0]}"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{PORT}/", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-LDT-Request-Id": rid})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+                assert r.headers.get("X-LDT-Request-Id") == rid, \
+                    "request id not echoed on the response"
+                with lock:
+                    served[0] += 1
+        except Exception:
+            time.sleep(0.05)    # kill blips retry; harvest is the test
+
+
+def fleetz():
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{SPORT}/fleetz", timeout=10) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def wait_fleet(pred, what, deadline_sec):
+    deadline = time.time() + deadline_sec
+    while True:
+        snap = fleetz()
+        if snap is not None and pred(snap):
+            return snap
+        assert time.time() < deadline, \
+            f"fleet never reached: {what} — last: {snap}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+
+def uds_request_id(path, rid):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(30)
+    s.connect(path)
+    try:
+        s.sendall(wire.pack_frame(body, request_id=rid))
+        status, echoed, _ = wire.recv_response_frame(s)
+        assert echoed == rid, f"UDS echo {echoed!r} != {rid!r}"
+        return status
+    finally:
+        s.close()
+
+
+try:
+    snap = wait_fleet(lambda s: s["ready"] == 2, "2 READY members", 240)
+
+    threads = [threading.Thread(target=client) for _ in range(32)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 60
+    while served[0] < 20 and time.time() < deadline:
+        time.sleep(0.1)                  # burst established end-to-end
+    assert served[0] >= 20, "burst never served"
+
+    victim = next(m for m in snap["members"] if m["state"] == "ready")
+    os.kill(victim["pid"], signal.SIGKILL)   # mid-burst hard loss
+
+    # the dead slot's ring is harvested into /fleetz postmortems while
+    # the slot respawns
+    snap = wait_fleet(
+        lambda s: (s["ready"] == 2
+                   and any(p.get("pid") == victim["pid"]
+                           for p in s.get("postmortems", []))),
+        "postmortem harvested + 2 READY", 240)
+    pm = next(p for p in snap["postmortems"]
+              if p["pid"] == victim["pid"])
+    assert pm["reason"] in ("crash", "lost"), pm["reason"]
+    assert pm["rc"] == -signal.SIGKILL, pm["rc"]
+    assert pm["clean_exit"] is False
+    assert pm["events_total"] > 0, "empty ring survived the SIGKILL?"
+    assert pm["tail"], "no recorder tail in the postmortem"
+    inflight = pm["inflight_request_ids"]
+    assert inflight and all(r.startswith("pm-") for r in inflight), \
+        f"in-flight ids not recovered from the dead member: {inflight}"
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), "client hung"
+
+    # cross-process correlation: the SAME id over both members' UDS
+    # lanes must merge into one /tracez entry spanning two pids
+    rid = "cafe0001"
+    for slot in (0, 1):
+        assert uds_request_id(f"{UDS}.{slot}", rid) < 500
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{SPORT}/tracez", timeout=10) as r:
+        tz = json.loads(r.read().decode())
+    entry = next((e for e in tz["requests"]
+                  if e["request_id"] == rid), None)
+    assert entry is not None, \
+        f"/tracez lost the correlation id: {tz['count']} entries"
+    pids = {p for p in entry["processes"] if p.startswith("pid:")}
+    assert len(pids) >= 2, \
+        f"one id across two members merged to {sorted(pids)}"
+    lanes = {e.get("lane") for e in entry["events"]
+             if e["ev"] == "request_start"}
+    assert "uds" in lanes, f"recorder lanes: {lanes}"
+
+    sup.send_signal(signal.SIGINT)
+    rc = sup.wait(timeout=120)
+    assert rc == 0, f"fleet exit {rc}"
+finally:
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+    shutil.rmtree(FR_DIR, ignore_errors=True)
+
+suplog = open("/tmp/ldt_postmortem_smoke.log").read()
+assert "postmortem harvested" in suplog, \
+    "the fleet never logged the harvest:\n" + suplog[-2000:]
+print("postmortem chaos:", served[0], "served with id echo —",
+      "SIGKILL ring harvested (events:", pm["events_total"],
+      "inflight:", len(pm["inflight_request_ids"]), ") and one id",
+      "correlated across", len(pids), "pids via /tracez")
+EOF
+
 echo "== shm chaos smoke =="
 # the shared-memory ring lane under fire (docs/ROBUSTNESS.md): a
 # SUPERVISED asyncio front with LDT_SHM_DIR set, shm_lease errors

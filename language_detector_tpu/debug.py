@@ -230,6 +230,41 @@ def format_slow_traces(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def format_fleet_traces(doc: dict) -> str:
+    """Pretty-print a fleet /tracez merge (service/fleet._fleet_traces):
+    one block per request id, listing the processes it touched, every
+    slow-trace capture (with owning member slot), and the recorder
+    events carrying that id in time order — the cross-process twin of
+    format_slow_traces."""
+    reqs = doc.get("requests", [])
+    lines = [f"fleet traces: {doc.get('count', len(reqs))} request "
+             f"id(s) merged"]
+    for e in reqs:
+        procs = ", ".join(str(p) for p in e.get("processes", []))
+        lines.append(f"\nrequest {e.get('request_id', '?')} "
+                     f"[{procs}]")
+        for tr in e.get("traces", []):
+            meta = " ".join(f"{k}={v}" for k, v in
+                            sorted(tr.get("meta", {}).items()))
+            lines.append(f"  slot {tr.get('slot', '?')} trace "
+                         f"total={tr.get('total_ms', 0)}ms"
+                         + (f" [{meta}]" if meta else ""))
+            for sp in tr.get("spans", []):
+                pad = "  " * (sp.get("depth", 0) + 2)
+                lines.append(f"{pad}{sp.get('name', '?'):<12} "
+                             f"@{sp.get('start_ms', 0):>9.3f}ms "
+                             f"+{sp.get('dur_ms', 0):.3f}ms")
+        for ev in sorted(e.get("events", []),
+                         key=lambda x: x.get("ts", 0)):
+            fields = " ".join(
+                f"{k}={v}" for k, v in sorted(ev.items())
+                if k not in ("ev", "ts", "pid", "request_id"))
+            lines.append(f"  pid {ev.get('pid', '?')} "
+                         f"{ev.get('ev', '?'):<14}"
+                         + (f" {fields}" if fields else ""))
+    return "\n".join(lines)
+
+
 def format_admission(doc: dict) -> str:
     """Human-readable render of the admission controller's state as
     published under /debug/vars "admission" (service/admission.py
@@ -316,6 +351,11 @@ def _main(argv=None):
                          "GET /debug/slow), a JSON file, or '-' for "
                          "stdin (requires LDT_SLOW_TRACE_MS set on the "
                          "server)")
+    ap.add_argument("--fleet-traces", metavar="SRC",
+                    help="pretty-print the fleet-wide request-id merge: "
+                         "SRC is the fleet status port's GET /tracez "
+                         "URL, a JSON file, or '-' for stdin (requires "
+                         "LDT_FLEET_STATUS_PORT on the fleet)")
     ap.add_argument("--admission", metavar="SRC",
                     help="pretty-print admission-control state "
                          "(queue occupancy, brownout level, breaker, "
@@ -325,6 +365,10 @@ def _main(argv=None):
     args = ap.parse_args(argv)
     if args.slow_traces:
         print(format_slow_traces(_read_slow_source(args.slow_traces)))
+        return 0
+    if args.fleet_traces:
+        print(format_fleet_traces(
+            _read_slow_source(args.fleet_traces)))
         return 0
     if args.admission:
         print(format_admission(_read_slow_source(args.admission)))

@@ -224,6 +224,9 @@ def evaluate(point: str) -> tuple:
     if fired:
         telemetry.REGISTRY.counter_inc("ldt_fault_injected_total",
                                        fired, point=point)
+        from . import flightrec
+        flightrec.emit_event("fault_fired", point=point, fired=fired,
+                             action="error" if err else "delay")
     return delay, err
 
 

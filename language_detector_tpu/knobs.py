@@ -318,6 +318,27 @@ _DECLARATIONS: tuple[Knob, ...] = (
     _k("LDT_WFQ_QUANTUM_BYTES", "int", 65536,
        "DRR quantum: bytes of queued cost a weight-1 tenant may "
        "dequeue per scheduler round."),
+    # -- flight recorder & device profiling (flightrec.py) ------------
+    _k("LDT_FLIGHTREC_DIR", "str", None,
+       "Directory for the crash-safe flight recorder: each process "
+       "writes flightrec-<pid>.ring there (mmap'd bounded event ring, "
+       "readable after SIGKILL; see docs/OBSERVABILITY.md). The fleet "
+       "supervisor harvests a dead member's ring into a postmortem on "
+       "/fleetz. Unset: recorder off, every emit is one None check."),
+    _k("LDT_FLIGHTREC_SLOTS", "int", 256,
+       "Event slots per flight-recorder ring (newest events win; the "
+       "total committed count survives eviction)."),
+    _k("LDT_FLIGHTREC_SLOT_BYTES", "int", 512,
+       "Bytes per flight-recorder slot including the 16-byte header; "
+       "an event whose JSON payload exceeds the slot is dropped and "
+       "counted in ldt_flightrec_dropped_total."),
+    _k("LDT_PROFILE_DIR", "str", None,
+       "Output directory for on-demand device-profiler captures "
+       "(POST /profilez or SIGUSR2 arms jax.profiler for a bounded "
+       "window). Unset: /profilez answers 503 profiling_disabled."),
+    _k("LDT_PROFILE_WINDOW_SEC", "float", 5.0,
+       "Capture window for an on-demand profile: the trace stops "
+       "itself this many seconds after it was armed.", bound=True),
     # -- debug / CI ---------------------------------------------------
     _k("LDT_LOCK_DEBUG", "bool", False,
        "Build order-checking debug locks (language_detector_tpu/locks)"

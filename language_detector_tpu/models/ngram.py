@@ -1213,6 +1213,12 @@ class NgramBatchEngine:
         # counters and spans must come out exactly once
         telemetry.observe_stage("dispatch", t0, t1, trace=trace)
         telemetry.observe_stage("epilogue", t1, t2, trace=trace)
+        # device-time vs host-time split per flush: the profiler's
+        # always-on shadow (POST /profilez arms the real one)
+        telemetry.REGISTRY.histogram(
+            "ldt_device_ms", phase="device").observe((t1 - t0) * 1000.0)
+        telemetry.REGISTRY.histogram(
+            "ldt_device_ms", phase="host").observe((t2 - t1) * 1000.0)
         with self._stats_lock:
             self.stats["batches"] += 1
             self.stats["device_dispatches"] += 1

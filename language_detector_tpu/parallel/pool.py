@@ -46,7 +46,7 @@ from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
 
 import numpy as np
 
-from .. import faults, knobs, telemetry
+from .. import faults, flightrec, knobs, telemetry
 from ..locks import make_lock
 
 # lane states: ACTIVE lanes are in rotation; EVICTED lanes sit out
@@ -338,6 +338,8 @@ class DevicePool:
         if lane.record_failure(self._now(), self.evict_failures):
             telemetry.REGISTRY.counter_inc(
                 "ldt_pool_lane_evicted_total", lane=lane.name)
+            flightrec.emit_event("pool_lane_state", lane=lane.name,
+                                 state="evicted")
 
     # -- dispatch -----------------------------------------------------------
 
@@ -388,6 +390,8 @@ class DevicePool:
                                    self._now()):
                 telemetry.REGISTRY.counter_inc(
                     "ldt_pool_lane_readmitted_total", lane=lane.name)
+                flightrec.emit_event("pool_lane_state", lane=lane.name,
+                                     state="readmitted")
             return out
         finally:
             # success OR failure retires the dispatch: the lane's

@@ -44,7 +44,7 @@ import math
 import time
 from collections import deque
 
-from .. import knobs, telemetry
+from .. import flightrec, knobs, telemetry
 from ..locks import make_lock
 from ..preprocess.pack import est_slot_demand
 
@@ -236,6 +236,7 @@ class BrownoutLadder:
         level. Called on every admit/release, so single samples move the
         EMA by alpha — spikes must persist to climb the ladder."""
         with self._lock:
+            prev = self.level
             self.ema += self.alpha * (load - self.ema)
             top = len(self.enter)
             while self.level < top and \
@@ -244,7 +245,11 @@ class BrownoutLadder:
             while self.level > 0 and \
                     self.ema < self.exit[self.level - 1]:
                 self.level -= 1
-            return self.level
+            level = self.level
+        if level != prev:  # recorder event outside the hot-path lock
+            flightrec.emit_event("brownout_level", level=level,
+                                 prev=prev)
+        return level
 
     def snapshot(self) -> tuple:
         """(level, ema) read under the ladder's own lock — stats
@@ -313,6 +318,8 @@ class CircuitBreaker:
                 self._state = BREAKER_HALF_OPEN
                 self._probe_at = now
                 self.probes += 1
+                flightrec.emit_event("breaker_state",
+                                     state="half_open")
                 return True
             # half-open with a probe already in flight
             if self._probe_at is not None and \
@@ -333,8 +340,11 @@ class CircuitBreaker:
                 # through the cooldown -> half-open probe path
                 return
             self._consec = 0
+            reclosed = self._state != BREAKER_CLOSED
             self._state = BREAKER_CLOSED
             self._probe_at = None
+        if reclosed:
+            flightrec.emit_event("breaker_state", state="closed")
 
     def record_failure(self, stalled: bool = False):
         with self._lock:
@@ -342,14 +352,19 @@ class CircuitBreaker:
             if stalled:
                 self.stalls_total += 1
             self._consec += 1
+            tripped = False
             if self._state == BREAKER_HALF_OPEN or \
                     self._consec >= self.failures:
                 if self._state != BREAKER_OPEN:
                     self.trips += 1
+                    tripped = True
                 self._state = BREAKER_OPEN
                 self._opened_at = self._clock()
                 self._probe_at = None
                 self._consec = 0
+        if tripped:
+            flightrec.emit_event("breaker_state", state="open",
+                                 stalled=bool(stalled))
 
     def stats(self) -> dict:
         with self._lock:

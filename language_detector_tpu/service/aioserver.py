@@ -20,7 +20,7 @@ import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import faults, knobs, telemetry
+from .. import faults, flightrec, knobs, telemetry
 from . import wire
 from .admission import (DeadlineExceeded, FairScheduler,
                         degraded_detect)
@@ -277,13 +277,15 @@ def _http_response(status: int, body: bytes,
                       extra_headers) + body
 
 
-def _http_response_buffers(status: int, buffers: list) -> list:
+def _http_response_buffers(status: int, buffers: list,
+                           extra_headers: tuple = ()) -> list:
     """writev-style response: the head plus the batch-envelope buffer
     list, handed to writer.writelines without concatenation."""
     length = 0
     for b in buffers:
         length += len(b)
-    return [_http_head(status, length), *buffers]
+    return [_http_head(status, length,
+                       extra_headers=extra_headers), *buffers]
 
 
 class AioService:
@@ -388,10 +390,16 @@ class AioService:
                             if not chunk:
                                 break
                             remaining -= len(chunk)
+                    eh: tuple = ((b"Connection", b"close"),)
+                    rid413 = wire.clean_request_id(
+                        headers.get(b"x-ldt-request-id"))
+                    if rid413:  # the id echoes even on a rejection
+                        eh += ((b"X-LDT-Request-Id",
+                                rid413.encode("ascii")),)
                     writer.write(_http_response(
                         413, b'{"error":"Request body exceeds 1MB '
                              b'limit"}',
-                        extra_headers=((b"Connection", b"close"),)))
+                        extra_headers=eh))
                     with contextlib.suppress(Exception):
                         await writer.drain()
                     break
@@ -460,6 +468,13 @@ class AioService:
             telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
                                            lane="tcp")
             trace = telemetry.Trace()
+            rid = wire.clean_request_id(
+                headers.get(b"x-ldt-request-id")) \
+                or wire.gen_request_id()
+            trace.request_id = rid
+            eh = ((b"X-LDT-Request-Id", rid.encode("ascii")),)
+            flightrec.emit_event("request_start", request_id=rid,
+                                 lane="tcp")
             t = trace.t0
             ct = headers.get(b"content-type")
             pre, err = wire.parse_request(
@@ -467,7 +482,7 @@ class AioService:
                 body)
             if err is not None:
                 meta["status"] = err[0]
-                return _http_response(*err)
+                return _http_response(*err, extra_headers=eh)
             t = telemetry.observe_stage("parse", t, trace=trace)
             texts, slots, responses, status = pre
             meta["docs"] = len(texts)
@@ -487,9 +502,9 @@ class AioService:
                     return _http_response(
                         admit.status,
                         json.dumps({"error": admit.message}).encode(),
-                        extra_headers=((b"Retry-After",
-                                        str(admit.retry_after)
-                                        .encode()),))
+                        extra_headers=eh + (
+                            (b"Retry-After",
+                             str(admit.retry_after).encode()),))
                 trace.deadline = adm.deadline_from_header(
                     headers.get(b"x-ldt-deadline-ms"))
                 trace.tenant = admit.tenant
@@ -517,7 +532,8 @@ class AioService:
                 meta["status"] = 504
                 return _http_response(
                     504,
-                    b'{"error":"deadline expired before dispatch"}')
+                    b'{"error":"deadline expired before dispatch"}',
+                    extra_headers=eh)
             except (asyncio.TimeoutError, TimeoutError):
                 # wedged flush (LDT_FLUSH_TIMEOUT_SEC): fail THIS
                 # request with a 504 — the backend stalled, the request
@@ -527,7 +543,8 @@ class AioService:
                 meta["status"] = 504
                 meta["timeout"] = "flush"
                 return _http_response(
-                    504, b'{"error":"detection timed out"}')
+                    504, b'{"error":"detection timed out"}',
+                    extra_headers=eh)
             finally:
                 if admit is not None:
                     adm.release(admit)
@@ -536,7 +553,8 @@ class AioService:
                                                responses, status)
             telemetry.observe_stage("encode", t, trace=trace)
             meta["status"] = status
-            return _http_response_buffers(status, buffers)
+            return _http_response_buffers(status, buffers,
+                                          extra_headers=eh)
         finally:
             m.inc("augmentation_requests_total")
             if trace is not None:
@@ -595,6 +613,7 @@ class AioService:
                 tenant = None
                 deadline_ms = None
                 priority = False
+                request_id = None
                 if length & wire.FRAME_V2_FLAG:
                     length &= ~wire.FRAME_V2_FLAG
                     try:
@@ -620,6 +639,28 @@ class AioService:
                         except (asyncio.IncompleteReadError,
                                 ConnectionError):
                             break
+                    if flags & wire.FRAME_REQID:
+                        try:
+                            (rlen,) = await _tread(1)
+                            request_id = wire.clean_request_id(
+                                await _tread(rlen) if rlen else b"")
+                        except asyncio.TimeoutError:
+                            await _send_408()
+                            break
+                        except (asyncio.IncompleteReadError,
+                                ConnectionError):
+                            break
+
+                def _resp_head(blen, status, rid=None):
+                    # echo the client-supplied id (v1 responses stay
+                    # byte-identical; see wire.send_frame)
+                    if rid is None:
+                        return wire.FRAME_RESP_HEADER.pack(blen, status)
+                    rb = rid.encode("ascii")
+                    return wire.FRAME_RESP_HEADER.pack(
+                        wire.FRAME_V2_FLAG | blen, status) \
+                        + bytes([len(rb)]) + rb
+
                 if length > BODY_LIMIT_BYTES:
                     m = svc.metrics
                     m.inc("augmentation_requests_total")
@@ -627,8 +668,8 @@ class AioService:
                     m.inc_object("unsuccessful")
                     telemetry.REGISTRY.counter_inc(
                         "ldt_http_requests_total", lane="uds")
-                    writer.write(wire.FRAME_RESP_HEADER.pack(
-                        len(wire.OVERSIZE_BODY), 413))
+                    writer.write(_resp_head(len(wire.OVERSIZE_BODY),
+                                            413, request_id))
                     writer.write(wire.OVERSIZE_BODY)
                     with contextlib.suppress(Exception):
                         await writer.drain()
@@ -644,7 +685,7 @@ class AioService:
                         status, buffers = await self._frame(
                             body, tenant=tenant,
                             deadline_ms=deadline_ms,
-                            priority=priority)
+                            priority=priority, request_id=request_id)
                     except (asyncio.IncompleteReadError,
                             ConnectionError, TimeoutError):
                         raise
@@ -658,8 +699,7 @@ class AioService:
                         status = 500
                         buffers = [b'{"error":"internal error"}']
                     blen = sum(len(b) for b in buffers)
-                    writer.write(
-                        wire.FRAME_RESP_HEADER.pack(blen, status))
+                    writer.write(_resp_head(blen, status, request_id))
                     writer.writelines(buffers)
                     await writer.drain()
                 except (asyncio.IncompleteReadError, ConnectionError,
@@ -676,7 +716,7 @@ class AioService:
                 pass
 
     async def _frame(self, body: bytes, tenant=None, deadline_ms=None,
-                     priority=False) -> tuple:
+                     priority=False, request_id=None) -> tuple:
         """One UDS frame body through the shared wire path ->
         (status, buffer list); the async twin of wire.handle_frame
         over the aio batcher. tenant/deadline_ms/priority come from a
@@ -689,6 +729,11 @@ class AioService:
         telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
                                        lane="uds")
         trace = telemetry.Trace()
+        # correlate even id-less callers: the recorder/trace id is
+        # server-generated then, just never echoed on the wire
+        trace.request_id = request_id or wire.gen_request_id()
+        flightrec.emit_event("request_start",
+                             request_id=trace.request_id, lane="uds")
         t = trace.t0
         meta: dict = {"front": "uds"}
         try:
@@ -792,6 +837,11 @@ class AioService:
                     if method == b"POST" and path == "/swap":
                         status, sbody = await self._swap(body)
                         writer.write(_http_response(status, sbody))
+                    elif method == b"POST" and path == "/profilez":
+                        from .. import profiling
+                        pstatus, payload = profiling.arm()
+                        writer.write(_http_response(
+                            pstatus, json.dumps(payload).encode()))
                     elif path in ("/healthz", "/readyz"):
                         hstatus, hbody = health_response(self.svc, path)
                         writer.write(_http_response(hstatus, hbody))
@@ -936,6 +986,9 @@ async def _teardown(aio: "AioService", server, mserver,
 async def serve(port: int = 3000, metrics_port: int = 30000,
                 svc: DetectorService | None = None,
                 ready: "asyncio.Future | None" = None):
+    flightrec.init_from_env(role="aio-front")
+    from .. import profiling
+    profiling.install_sigusr2()
     aio = AioService(svc)
     aio.batcher.start()
     # the stream limit must exceed the body contract limit: readexactly
@@ -1024,6 +1077,7 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
         if not (aio.recycling or aio.draining):
             raise  # external cancellation (tests, embedding callers)
     finally:
+        flightrec.emit_event("proc_exit", role="aio-front")
         watch.cancel()
         if shm is not None:
             # stop the scan thread before the loop dies: a leased frame

@@ -65,7 +65,7 @@ import threading
 import time
 import urllib.request
 
-from .. import faults, knobs, telemetry
+from .. import faults, flightrec, knobs, telemetry
 from ..locks import make_lock
 from .recycle import RECYCLE_EXIT_CODE
 from .supervisor import _forward_stop, _log
@@ -291,16 +291,84 @@ def _fleet_families(snap: dict) -> list:
     ]
 
 
-def _start_status_server(port: int, status: FleetStatus):
+def _member_slow_traces(metrics_port: int) -> list:
+    """One member's /debug/slow ring, [] when the scrape fails (a dead
+    or mid-restart member must not fail the whole merge)."""
+    try:
+        url = f"http://127.0.0.1:{metrics_port}/debug/slow"
+        with urllib.request.urlopen(url, timeout=2.0) as r:
+            return json.loads(r.read().decode()).get("traces") or []
+    except Exception:  # noqa: BLE001 - merge is best-effort per member
+        return []
+
+
+def _fleet_traces(snap: dict, flightrec_base: str | None) -> dict:
+    """The fleet-scoped /tracez merge: every live member's slow-trace
+    ring (scraped over its metrics port) joined with every recorder
+    ring file under LDT_FLIGHTREC_DIR, grouped by request id. One
+    request that crossed processes (HTTP front here, shm worker there)
+    renders as ONE entry whose `processes` list spans them."""
+    by_id: dict = {}
+
+    def _entry(rid: str) -> dict:
+        return by_id.setdefault(
+            rid, {"request_id": rid, "traces": [], "events": [],
+                  "processes": []})
+
+    def _saw(e: dict, proc: str) -> None:
+        if proc not in e["processes"]:
+            e["processes"].append(proc)
+
+    for mem in snap.get("members", ()):
+        port = int(mem.get("metrics_port") or 0)
+        if port <= 0:
+            continue
+        for tr in _member_slow_traces(port):
+            rid = tr.get("request_id")
+            if not rid:
+                continue
+            e = _entry(rid)
+            e["traces"].append(dict(tr, slot=mem.get("slot")))
+            _saw(e, f"pid:{mem.get('pid')}")
+    if flightrec_base:
+        import glob
+        try:
+            ring_files = sorted(glob.glob(
+                os.path.join(flightrec_base, "**", "flightrec-*.ring"),
+                recursive=True))
+        except OSError:
+            ring_files = []
+        for path in ring_files:
+            for ev in flightrec.request_events(path):
+                rid = ev.get("request_id")
+                if not rid:
+                    continue
+                e = _entry(rid)
+                e["events"].append(ev)
+                _saw(e, f"pid:{ev.get('pid')}")
+    entries = sorted(by_id.values(),
+                     key=lambda e: -(len(e["traces"]) + len(e["events"])))
+    return {"requests": entries, "count": len(entries)}
+
+
+def _start_status_server(port: int, status: FleetStatus,
+                         flightrec_base: str | None = None):
     """GET /fleetz (JSON control-plane view: per-member slot, pid,
-    generation, state — the chaos smoke picks its SIGKILL victim here)
-    and GET /metrics (ldt_fleet_* exposition) on a daemon thread."""
+    generation, state — the chaos smoke picks its SIGKILL victim here),
+    GET /tracez (fleet-scoped request-id merge across member slow rings
+    and recorder files) and GET /metrics (ldt_fleet_* exposition) on a
+    daemon thread."""
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             snap = status.read()
             if self.path.startswith("/fleetz"):
                 body = json.dumps(snap, indent=2).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/tracez"):
+                body = json.dumps(
+                    _fleet_traces(snap, flightrec_base),
+                    indent=2).encode()
                 ctype = "application/json"
             elif self.path.startswith("/metrics"):
                 fams = list(telemetry.REGISTRY.families())
@@ -353,6 +421,10 @@ def fleet_main(module: str) -> int:
     metrics_base = knobs.get_int("PROMETHEUS_PORT") or 0
     uds_base = knobs.get_str("LDT_UNIX_SOCKET")
     shm_base = knobs.get_str("LDT_SHM_DIR")
+    flightrec_base = knobs.get_str("LDT_FLIGHTREC_DIR")
+    # the fleet's own recorder lands directly under the base dir;
+    # members get per-slot subdirectories (see _member_env)
+    flightrec.init_from_env(role="fleet")
 
     control = FleetControl(
         loop_max=loop_max, loop_window=loop_window,
@@ -403,6 +475,16 @@ def fleet_main(module: str) -> int:
             except OSError:
                 pass
             env["LDT_SHM_DIR"] = shm_dir
+        if flightrec_base:
+            # per-member recorder directory, same pattern as the shm
+            # rings: the harvest path after a crash is deterministic —
+            # <base>/m<slot>/flightrec-<pid>.ring
+            fr_dir = os.path.join(flightrec_base, f"m{m.slot}")
+            try:
+                os.makedirs(fr_dir, exist_ok=True)
+            except OSError:
+                pass
+            env["LDT_FLIGHTREC_DIR"] = fr_dir
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         if swapped:
@@ -447,6 +529,9 @@ def fleet_main(module: str) -> int:
         m.ready_deadline = time.time() + 2 * swap_timeout
         telemetry.REGISTRY.counter_inc("ldt_fleet_spawn_total", 1,
                                        reason=reason)
+        flightrec.emit_event("fleet_member_state", slot=m.slot,
+                             state="spawning", reason=reason,
+                             pid=proc.pid)
         _log("fleet: member spawned", reason=reason, slot=m.slot,
              generation=generation, pid=proc.pid)
         return True
@@ -475,8 +560,10 @@ def fleet_main(module: str) -> int:
         signal.signal(signal.SIGHUP, _request_swap)
 
     status = FleetStatus()
-    status_srv = _start_status_server(status_port, status) \
+    status_srv = _start_status_server(status_port, status,
+                                      flightrec_base) \
         if status_port > 0 else None
+    postmortems: list = []  # newest-last, bounded below
 
     _log("fleet: starting", reason="fleet-start", workers=n,
          fleet_min=fmin, fleet_max=fmax, module=module)
@@ -489,12 +576,50 @@ def fleet_main(module: str) -> int:
                 backoff_max)
         return b * (0.5 + random.random())  # jitter: x0.5 - x1.5
 
+    def _harvest(m: FleetMember, pid: int | None, rc,
+                 reason: str) -> None:
+        """Pull the dead member's flight recorder into a postmortem:
+        the crash-safe ring outlives the process, so the last events
+        and the request ids still in flight survive a SIGKILL."""
+        if not flightrec_base or not pid:
+            return
+        path = flightrec.ring_path(
+            os.path.join(flightrec_base, f"m{m.slot}"), pid)
+        try:
+            pm = flightrec.harvest_postmortem(path, reason=reason,
+                                              rc=rc)
+        except (OSError, ValueError) as e:
+            telemetry.REGISTRY.counter_inc("ldt_postmortem_total",
+                                           result="missing")
+            _log("fleet: postmortem harvest failed — no readable "
+                 "recorder ring", reason="postmortem", slot=m.slot,
+                 pid=pid, error=repr(e))
+            return
+        pm["slot"] = m.slot
+        pm["generation"] = m.generation
+        telemetry.REGISTRY.counter_inc("ldt_postmortem_total",
+                                       result="harvested")
+        flightrec.emit_event("postmortem", slot=m.slot, pid=pid,
+                             rc=rc, reason=reason,
+                             events_total=pm.get("events_total"),
+                             inflight=len(
+                                 pm.get("inflight_request_ids") or ()))
+        _log("fleet: postmortem harvested", reason="postmortem",
+             slot=m.slot, pid=pid, rc=rc,
+             events_total=pm.get("events_total"),
+             events_held=pm.get("events_held"),
+             inflight_request_ids=pm.get("inflight_request_ids"))
+        postmortems.append(pm)
+        del postmortems[:-8]  # keep the newest 8 on /fleetz
+        flightrec.discard(path)  # consumed: a respawn starts clean
+
     def _reap() -> None:
         nonlocal probe_slot
         for m in list(members):
             if m.proc is None:
                 continue
             lost = False
+            dead_pid = m.proc.pid
             rc = m.proc.poll()
             if rc is None:
                 if faults.ACTIVE is not None:
@@ -537,6 +662,11 @@ def fleet_main(module: str) -> int:
                 continue
             # crash
             m.mark_dead()
+            crash_kind = "lost" if lost else "crash"
+            flightrec.emit_event("fleet_member_state", slot=m.slot,
+                                 state="dead", reason=crash_kind,
+                                 rc=rc)
+            _harvest(m, dead_pid, rc, crash_kind)
             accepting = _accepting_count()
             m.crash_times = [t for t in m.crash_times
                              if now - t <= loop_window]
@@ -544,7 +674,7 @@ def fleet_main(module: str) -> int:
             m.consec_crashes += 1
             telemetry.REGISTRY.counter_inc(
                 "ldt_fleet_worker_lost_total", 1,
-                reason="lost" if lost else "crash")
+                reason=crash_kind)
             if m.retiring:
                 # the scale-down victim crashed instead of draining:
                 # its slot is already surplus, so drop it
@@ -634,6 +764,8 @@ def fleet_main(module: str) -> int:
                         _log("fleet: probe member ready — circuit "
                              "closed", reason="fleet-circuit-close",
                              slot=m.slot)
+                    flightrec.emit_event("fleet_member_state",
+                                         slot=m.slot, state="ready")
                     _log("fleet: member ready", reason="ready",
                          slot=m.slot, generation=m.generation,
                          metrics_port=m.metrics_port)
@@ -673,6 +805,9 @@ def fleet_main(module: str) -> int:
             else:
                 m.fail_streak += 1
                 if m.fail_streak == degraded_fails:
+                    flightrec.emit_event("fleet_member_state",
+                                         slot=m.slot, state="degraded",
+                                         fails=m.fail_streak)
                     _log("fleet: member degraded — health scrapes "
                          "failing", reason="degraded", slot=m.slot,
                          fails=m.fail_streak)
@@ -842,6 +977,7 @@ def fleet_main(module: str) -> int:
             "accepting": _accepting_count(),
             "circuit": CIRCUIT_NAMES.get(control.circuit, "?"),
             "bootstrapped": control.bootstrapped,
+            "postmortems": list(postmortems),
         }
 
     def _drain_all() -> int:

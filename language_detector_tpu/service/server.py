@@ -32,7 +32,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
-from .. import faults, knobs, telemetry
+from .. import faults, flightrec, knobs, telemetry
 from ..locks import make_lock
 from . import wire
 from .admission import (BREAKER_OPEN, BREAKER_STATE_NAMES,
@@ -696,8 +696,13 @@ class Handler(BaseHTTPRequestHandler):
                 if not chunk:
                     break
                 remaining -= len(chunk)
+            hdrs = {"Connection": "close"}
+            rid = wire.clean_request_id(
+                self.headers.get(wire.REQUEST_ID_HEADER))
+            if rid:  # the id echoes even on a rejected request
+                hdrs[wire.REQUEST_ID_HEADER] = rid
             self._send_error_json("Request body exceeds 1MB limit", 413,
-                                  headers={"Connection": "close"})
+                                  headers=hdrs)
             return None
         return self.rfile.read(max(length, 0))
 
@@ -707,11 +712,18 @@ class Handler(BaseHTTPRequestHandler):
         telemetry.REGISTRY.counter_inc("ldt_http_requests_total",
                                        lane="tcp")
         trace = telemetry.Trace()
+        rid = wire.clean_request_id(
+            self.headers.get(wire.REQUEST_ID_HEADER)) \
+            or wire.gen_request_id()
+        trace.request_id = rid
+        echo = {wire.REQUEST_ID_HEADER: rid}
+        flightrec.emit_event("request_start", request_id=rid,
+                             lane="tcp")
         t = trace.t0
         pre, err = wire.parse_request(
             svc, self.headers.get("Content-Type"), body)
         if err is not None:
-            self._send_json(*err)
+            self._send_json(*err, headers=echo)
             telemetry.finish_request(
                 trace, meta={"front": "sync", "status": err[0]})
             return
@@ -729,7 +741,9 @@ class Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     admit.status,
                     json.dumps({"error": admit.message}).encode(),
-                    headers={"Retry-After": str(admit.retry_after)})
+                    headers=dict(
+                        echo, **{"Retry-After":
+                                 str(admit.retry_after)}))
                 telemetry.finish_request(
                     trace, meta={"front": "sync", "docs": len(texts),
                                  "status": admit.status,
@@ -751,7 +765,8 @@ class Handler(BaseHTTPRequestHandler):
         except DeadlineExceeded:
             svc.metrics.inc("augmentation_errors_logged_total")
             self._send_json(
-                504, b'{"error":"deadline expired before dispatch"}')
+                504, b'{"error":"deadline expired before dispatch"}',
+                headers=echo)
             telemetry.finish_request(
                 trace, meta={"front": "sync", "docs": len(texts),
                              "status": 504})
@@ -763,7 +778,8 @@ class Handler(BaseHTTPRequestHandler):
             # 3.10 concurrent.futures.TimeoutError is its own type;
             # 3.11+ aliases it to the builtin)
             svc.metrics.inc("augmentation_errors_logged_total")
-            self._send_json(504, b'{"error":"detection timed out"}')
+            self._send_json(504, b'{"error":"detection timed out"}',
+                            headers=echo)
             telemetry.finish_request(
                 trace, meta={"front": "sync", "docs": len(texts),
                              "status": 504, "timeout": "flush"})
@@ -774,7 +790,8 @@ class Handler(BaseHTTPRequestHandler):
             print(json.dumps({"msg": "detect failed",
                               "error": repr(e)}), flush=True)
             svc.metrics.inc("augmentation_errors_logged_total")
-            self._send_json(500, b'{"error":"internal error"}')
+            self._send_json(500, b'{"error":"internal error"}',
+                            headers=echo)
             telemetry.finish_request(
                 trace, meta={"front": "sync", "docs": len(texts),
                              "status": 500})
@@ -786,7 +803,7 @@ class Handler(BaseHTTPRequestHandler):
         status, buffers = wire.post_detect(
             svc, codes, slots, responses, status)
         telemetry.observe_stage("encode", t, trace=trace)
-        self._send_buffers(status, buffers)
+        self._send_buffers(status, buffers, headers=echo)
         telemetry.finish_request(
             trace, meta={"front": "sync", "docs": len(texts),
                          "status": status})
@@ -834,9 +851,15 @@ class MetricsHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         """POST /swap: in-process artifact hot swap (service/swap.py).
-        Body {"path": ...}, falling back to LDT_ARTIFACT_PATH. Lives on
-        the metrics port — an operator action, not client traffic."""
+        POST /profilez: arm one bounded jax.profiler window
+        (profiling.py). Both live on the metrics port — operator
+        actions, not client traffic."""
         path = self.path.split("?", 1)[0]
+        if path == "/profilez":
+            from .. import profiling
+            status, payload = profiling.arm()
+            self._answer(status, json.dumps(payload).encode())
+            return
         if path != "/swap":
             self._answer(404, b'{"error":"Not found"}')
             return
@@ -946,6 +969,7 @@ def main():
     import sys
 
     from .recycle import RECYCLE_EXIT_CODE
+    flightrec.init_from_env(role="sync-front")
     port = knobs.get_int("LISTEN_PORT") or 0
     metrics_port = knobs.get_int("PROMETHEUS_PORT") or 0
     httpd, metricsd, svc = make_server(port, metrics_port)
@@ -1001,11 +1025,14 @@ def main():
         signal.signal(signal.SIGTERM, _on_term)
     except ValueError:
         pass  # embedded in a non-main thread (tests)
+    from .. import profiling
+    profiling.install_sigusr2()
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        flightrec.emit_event("proc_exit", role="sync-front")
         planned = getattr(httpd, "_ldt_recycle", False) or \
             getattr(httpd, "_ldt_drain", False)
         drain_sec = knobs.get_float("LDT_RECYCLE_DRAIN_SEC") or 5.0

@@ -62,7 +62,7 @@ import threading
 import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 
-from .. import faults, knobs, telemetry
+from .. import faults, flightrec, knobs, telemetry
 from ..locks import make_lock
 from . import wire
 from .admission import DeadlineExceeded
@@ -292,8 +292,15 @@ class RingFile:
             self.mm, SLOT_HDR_OFF + i * SLOT_HDR_SIZE)
         return st, gen, pid, ts, ln, status
 
+    def slot_request_id(self, i: int) -> int:
+        """The slot's correlation-id word (the u32 the client stamped
+        on submit and the worker echoes on DONE); 0 = no id."""
+        return SLOT_HDR.unpack_from(
+            self.mm, SLOT_HDR_OFF + i * SLOT_HDR_SIZE)[3]
+
     def write_slot(self, i: int, state: int, gen: int, pid: int,
-                   ts: float, length: int, status: int) -> None:
+                   ts: float, length: int, status: int,
+                   reqid: int = 0) -> None:
         # publish order matters: the peer polls the state word, so every
         # other field must land BEFORE it. A single pack_into is a
         # forward memcpy — state first — and a reader could observe the
@@ -302,7 +309,7 @@ class RingFile:
         # 4-byte store, makes the state transition the publication
         # point.
         off = SLOT_HDR_OFF + i * SLOT_HDR_SIZE
-        rec = SLOT_HDR.pack(state, gen, pid, 0, ts, length, status)
+        rec = SLOT_HDR.pack(state, gen, pid, reqid, ts, length, status)
         self.mm[off + 4:off + SLOT_HDR.size] = rec[4:]
         self.mm[off:off + 4] = rec[:4]
 
@@ -354,6 +361,7 @@ class RingClient:
         self.rf = RingFile(self.path, create=True, slots=slots,
                            slot_bytes=slot_bytes)
         self.slots = [RingSlot(i) for i in range(self.rf.nslots)]
+        self.last_request_id: str | None = None  # echo of last wait()
 
     def _refresh(self, i: int) -> tuple:
         raw = self.rf.read_slot(i)
@@ -376,15 +384,29 @@ class RingClient:
                     f"no worker attached {self.path} within {timeout}s")
             time.sleep(0.001)
 
-    def submit(self, body: bytes) -> int | None:
+    def submit(self, body: bytes,
+               request_id: str | None = None) -> int | None:
         """Write one frame into a FREE slot -> slot index, or None when
         the ring is full (the caller drains with wait() first) or no
         worker has attached yet (a frame stamped with the pre-attach
-        generation would only be fenced)."""
+        generation would only be fenced). The shm lane's correlation
+        id is natively the slot header's u32: request_id must be its
+        1-8 hex-char rendering (the same shape server-generated ids
+        use on every lane); the worker echoes it on the DONE header."""
         if len(body) > self.rf.slot_bytes:
             raise ValueError(
                 f"frame of {len(body)} bytes exceeds slot capacity "
                 f"{self.rf.slot_bytes}")
+        reqid = 0
+        if request_id is not None:
+            try:
+                reqid = int(request_id, 16)
+            except ValueError:
+                reqid = -1
+            if not 0 < reqid <= 0xFFFFFFFF:
+                raise ValueError(
+                    "shm lane request_id must be 1-8 hex chars "
+                    f"(u32 slot-header carrier), got {request_id!r}")
         if not self.attached():
             return None
         for i, s in enumerate(self.slots):
@@ -396,11 +418,11 @@ class RingClient:
             now = time.time()          # worker restart mid-frame fences
             s.mark_writing()
             self.rf.write_slot(i, SLOT_WRITING, gen, os.getpid(), now,
-                               0, 0)
+                               0, 0, reqid=reqid)
             self.rf.write_payload(i, (body,))
             s.mark_ready()
             self.rf.write_slot(i, SLOT_READY, gen, os.getpid(), now,
-                               len(body), 0)
+                               len(body), 0, reqid=reqid)
             return i
         return None
 
@@ -420,6 +442,10 @@ class RingClient:
         while True:
             st, _gen, _pid, _ts, length, status = self._refresh(i)
             if self.slots[i].state == SLOT_DONE:
+                # surface the DONE header's echoed correlation id (the
+                # SPSC contract confines this attribute to the caller)
+                rq = self.rf.slot_request_id(i)
+                self.last_request_id = ("%08x" % rq) if rq else None
                 body = self.rf.read_payload(i, length)
                 self.slots[i].mark_free()
                 self.rf.write_slot(i, SLOT_FREE, 0, 0, 0.0, 0, 0)
@@ -435,11 +461,12 @@ class RingClient:
             time.sleep(nap)
             nap = min(nap * 2, 1e-3)
 
-    def request(self, body: bytes, timeout: float = 30.0) -> tuple:
+    def request(self, body: bytes, timeout: float = 30.0,
+                request_id: str | None = None) -> tuple:
         """submit + wait convenience for sequential callers."""
         deadline = time.monotonic() + timeout
         while True:
-            i = self.submit(body)
+            i = self.submit(body, request_id=request_id)
             if i is not None:
                 return self.wait(i, timeout=timeout)
             if time.monotonic() >= deadline:
@@ -611,6 +638,8 @@ class ShmRingServer:
             if self.quarantine.add(texts[0]):
                 telemetry.REGISTRY.counter_inc(
                     "ldt_quarantine_docs_total")
+                flightrec.emit_event("shm_ring_state",
+                                     state="quarantined")
             return ["un"]
         mid = len(texts) // 2
         out: list = []
@@ -719,6 +748,9 @@ class ShmRingServer:
         ring.generation = gen
         self._rings[path] = ring
         self._bad.pop(path, None)
+        flightrec.emit_event("shm_ring_state", state="attached",
+                             ring=os.path.basename(path),
+                             generation=gen)
         print(json.dumps({"msg": f"shm ring attached: {path} "
                                  f"(generation {gen})"}), flush=True)
 
@@ -727,6 +759,8 @@ class ShmRingServer:
         self._rings.pop(path, None)
         ring.close()
         if unlink:
+            flightrec.emit_event("shm_ring_state", state="unlinked",
+                                 ring=os.path.basename(path))
             try:
                 os.unlink(path)
             except OSError:
@@ -803,10 +837,11 @@ class ShmRingServer:
         status = 503 if reason == "fenced" else 413
         rf = ring.rf
         s = ring.mirrors[i]
+        reqid = rf.slot_request_id(i)  # error frames echo the id too
         s.mark_failed()
         rf.write_payload(i, (body,))
         rf.write_slot(i, SLOT_DONE, ring.generation, os.getpid(),
-                      time.time(), len(body), status)
+                      time.time(), len(body), status, reqid=reqid)
         telemetry.REGISTRY.counter_inc("ldt_shm_frames_total",
                                        result="fenced")
         telemetry.REGISTRY.counter_inc("ldt_shm_reclaimed_total",
@@ -833,10 +868,12 @@ class ShmRingServer:
         always resolves."""
         rf = ring.rf
         s = ring.mirrors[i]
+        reqid = rf.slot_request_id(i)
         try:
             status, buffers = wire.handle_frame(
                 self.svc, ring.pmaps[i], detect=self._detect,
-                nbytes=length, lane="shm")
+                nbytes=length, lane="shm",
+                request_id=("%08x" % reqid) if reqid else None)
         except Exception as e:  # noqa: BLE001 - typed 500, never a hang
             print(json.dumps({"msg": "shm frame failed",
                               "error": repr(e)}), flush=True)
@@ -852,7 +889,7 @@ class ShmRingServer:
         rf.write_payload(i, (resp,))
         s.mark_done()
         rf.write_slot(i, SLOT_DONE, ring.generation, os.getpid(),
-                      time.time(), blen, status)
+                      time.time(), blen, status, reqid=reqid)
         with self._stat_lock:
             self._frames += 1
         telemetry.REGISTRY.counter_inc(

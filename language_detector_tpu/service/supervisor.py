@@ -57,12 +57,43 @@ import sys
 import tempfile
 import time
 
-from .. import faults, knobs
+from .. import faults, flightrec, knobs, telemetry
 from .recycle import RECYCLE_EXIT_CODE
 
 
 def _log(msg: str, **fields):
     print(json.dumps({"msg": msg, **fields}), flush=True)
+
+
+def _harvest_crash(pid: int | None, rc) -> dict | None:
+    """Read the crashed worker's flight-recorder ring (it inherited
+    the supervisor's LDT_FLIGHTREC_DIR) into a postmortem log line.
+    Best-effort: no recorder dir / no ring file is not an error."""
+    base = knobs.get_str("LDT_FLIGHTREC_DIR")
+    if not base or not pid:
+        return None
+    path = flightrec.ring_path(base, pid)
+    try:
+        pm = flightrec.harvest_postmortem(path, reason="crash", rc=rc)
+    except (OSError, ValueError) as e:
+        telemetry.REGISTRY.counter_inc("ldt_postmortem_total",
+                                       result="missing")
+        _log("supervisor: postmortem harvest failed — no readable "
+             "recorder ring", reason="postmortem", pid=pid,
+             error=repr(e))
+        return None
+    telemetry.REGISTRY.counter_inc("ldt_postmortem_total",
+                                   result="harvested")
+    flightrec.emit_event("postmortem", pid=pid, rc=rc, reason="crash",
+                         events_total=pm.get("events_total"),
+                         inflight=len(
+                             pm.get("inflight_request_ids") or ()))
+    _log("supervisor: postmortem harvested", reason="postmortem",
+         pid=pid, rc=rc, events_total=pm.get("events_total"),
+         events_held=pm.get("events_held"),
+         inflight_request_ids=pm.get("inflight_request_ids"))
+    flightrec.discard(path)  # consumed: the respawn starts clean
+    return pm
 
 
 # Worker lifecycle states, declared in tools/lint/fsm_registry.py
@@ -108,6 +139,7 @@ def main() -> int:
         # autoscaling) — see service/fleet.py
         from .fleet import fleet_main
         return fleet_main(module)
+    flightrec.init_from_env(role="supervisor")
     restart_on_crash = knobs.get_bool("LDT_RESTART_ON_CRASH")
     backoff_base = knobs.get_float("LDT_CRASH_BACKOFF_BASE_SEC") or 0.5
     backoff_max = knobs.get_float("LDT_CRASH_BACKOFF_MAX_SEC") or 30.0
@@ -324,6 +356,7 @@ def main() -> int:
                  uptime_sec=uptime)
             return rc
         worker = WORKER_CRASHED
+        _harvest_crash(child.pid, rc)
         if not restart_on_crash:
             _log("supervisor: worker crashed — propagating "
                  "(LDT_RESTART_ON_CRASH not set)", reason="crash",

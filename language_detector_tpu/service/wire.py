@@ -378,33 +378,81 @@ FRAME_RESP_HEADER = struct.Struct("!IH")
 FRAME_V2_FLAG = 0x80000000
 FRAME_EXT_HEADER = struct.Struct("!BHI")   # flags, tenant_len, deadline_ms
 FRAME_PRIORITY = 0x01                      # flags bit0
+FRAME_REQID = 0x02                         # flags bit1: 1-byte id length
+#                                            + id bytes follow the tenant
+
+REQUEST_ID_HEADER = "X-LDT-Request-Id"
+_REQID_RE = re.compile(r"[A-Za-z0-9._\-]{1,64}\Z")
+
+
+def gen_request_id() -> str:
+    """Server-generated correlation id for a request that arrived
+    without one: 8 hex chars, the same shape a shm slot's u32 carrier
+    renders to, so every lane's ids look alike in /tracez."""
+    import os
+    return os.urandom(4).hex()
+
+
+def clean_request_id(raw) -> str | None:
+    """Validate a caller-supplied correlation id (header or frame
+    field): 1-64 chars of [A-Za-z0-9._-], else rejected to None so a
+    hostile id can't smuggle header/JSON syntax back out through the
+    echo."""
+    if not raw:
+        return None
+    if isinstance(raw, bytes):
+        try:
+            raw = raw.decode("ascii")
+        except UnicodeDecodeError:
+            return None
+    return raw if _REQID_RE.match(raw) else None
 
 
 def pack_frame(body: bytes, tenant: str | None = None,
                deadline_ms: int | None = None,
-               priority: bool = False) -> bytes:
+               priority: bool = False,
+               request_id: str | None = None) -> bytes:
     """Client-side frame builder. With no admission fields set this
     emits a plain v1 frame, so existing callers (and the parity tests'
-    baseline) are untouched; any field promotes the frame to v2."""
-    if tenant is None and deadline_ms is None and not priority:
+    baseline) are untouched; any field promotes the frame to v2. A
+    request_id rides as flags bit1 + 1-byte length + id bytes after
+    the tenant, and the server echoes it on the response frame."""
+    if tenant is None and deadline_ms is None and not priority \
+            and request_id is None:
         return FRAME_HEADER.pack(len(body)) + body
     tb = (tenant or "").encode("latin-1")
     flags = FRAME_PRIORITY if priority else 0
+    rb = b""
+    if request_id is not None:
+        rb = request_id.encode("ascii")
+        if len(rb) > 255:
+            raise ValueError("request_id exceeds 255 bytes")
+        flags |= FRAME_REQID
+        rb = bytes([len(rb)]) + rb
     ext = FRAME_EXT_HEADER.pack(flags, len(tb),
                                 min(deadline_ms or 0, 0xFFFFFFFF))
-    return FRAME_HEADER.pack(FRAME_V2_FLAG | len(body)) + ext + tb + body
+    return FRAME_HEADER.pack(FRAME_V2_FLAG | len(body)) \
+        + ext + tb + rb + body
 
 _IOV_BATCH = 512  # sendmsg segments per call, safely under IOV_MAX
 
 
-def send_frame(sock, status: int, buffers: list) -> None:
+def send_frame(sock, status: int, buffers: list,
+               request_id: str | None = None) -> None:
     """Emit one response frame writev-style: header + fragment buffers
     go to sendmsg as-is (no join); a short write re-enters with the
-    remaining tail."""
+    remaining tail. A request_id (echoed only when the CLIENT supplied
+    one, so v1 responses stay byte-identical) sets the length word's
+    MSB and prefixes the body with 1-byte id length + id bytes."""
     total = 0
     for b in buffers:
         total += len(b)
-    iov = [FRAME_RESP_HEADER.pack(total, status)]
+    if request_id is not None:
+        rb = request_id.encode("ascii")
+        iov = [FRAME_RESP_HEADER.pack(FRAME_V2_FLAG | total, status),
+               bytes([len(rb)]) + rb]
+    else:
+        iov = [FRAME_RESP_HEADER.pack(total, status)]
     iov += buffers
     i = 0
     while i < len(iov):
@@ -424,6 +472,31 @@ def send_frame(sock, status: int, buffers: list) -> None:
                 break
 
 
+def recv_response_frame(sock):
+    """Client-side response reader -> (status, request_id, body).
+    Understands both the plain response header and the id-echo form
+    (MSB of the length word set, 1-byte id length + id before the
+    body)."""
+    hdr = bytearray(FRAME_RESP_HEADER.size)
+    if not _recv_exact_into(sock, memoryview(hdr), len(hdr)):
+        raise ConnectionError("EOF reading response frame header")
+    length, status = FRAME_RESP_HEADER.unpack(hdr)
+    request_id = None
+    if length & FRAME_V2_FLAG:
+        length &= ~FRAME_V2_FLAG
+        one = bytearray(1)
+        if not _recv_exact_into(sock, memoryview(one), 1):
+            raise ConnectionError("EOF reading response id length")
+        rb = bytearray(one[0])
+        if rb and not _recv_exact_into(sock, memoryview(rb), len(rb)):
+            raise ConnectionError("EOF reading response id")
+        request_id = rb.decode("ascii")
+    body = bytearray(length)
+    if length and not _recv_exact_into(sock, memoryview(body), length):
+        raise ConnectionError("EOF reading response body")
+    return status, request_id, bytes(body)
+
+
 def _recv_exact_into(sock, view, n: int) -> bool:
     """Fill exactly n bytes of view from sock; False on EOF mid-read."""
     got = 0
@@ -436,18 +509,26 @@ def _recv_exact_into(sock, view, n: int) -> bool:
 
 
 def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
-                 tenant=None, deadline_ms=None, priority=False):
+                 tenant=None, deadline_ms=None, priority=False,
+                 request_id=None):
     """One UDS request body through the shared wire path ->
     (status, buffer list). Mirrors the HTTP fronts' POST flow
     (admission, degrade ladder, typed errors) minus header parsing;
-    tenant/deadline_ms/priority come from a v2 frame's ext header and
-    feed the same per-tenant quota, deadline, and brownout decisions
-    as the HTTP headers they mirror. The concatenated buffers are
-    identical to the TCP payload for the same batch."""
+    tenant/deadline_ms/priority/request_id come from a v2 frame's ext
+    header and feed the same per-tenant quota, deadline, brownout, and
+    correlation decisions as the HTTP headers they mirror. The
+    concatenated buffers are identical to the TCP payload for the same
+    batch."""
+    from .. import flightrec
     m = svc.metrics
     m.inc("augmentation_requests_total")
     telemetry.REGISTRY.counter_inc("ldt_http_requests_total", lane=lane)
     trace = telemetry.Trace()
+    # correlate even id-less callers: the recorder/trace id is server-
+    # generated then, just never echoed on the wire (v1 byte-compat)
+    trace.request_id = request_id or gen_request_id()
+    flightrec.emit_event("request_start", request_id=trace.request_id,
+                         lane=lane)
     t = trace.t0
     if detect is None:
         detect = svc.detect_codes
@@ -588,6 +669,7 @@ class UnixFrameServer:
                     tenant = None
                     deadline_ms = None
                     priority = False
+                    request_id = None
                     if length & FRAME_V2_FLAG:
                         length &= ~FRAME_V2_FLAG
                         if not _recv_exact_into(conn, eview, len(ext)):
@@ -602,6 +684,16 @@ class UnixFrameServer:
                                     conn, memoryview(tbuf), tlen):
                                 return
                             tenant = tbuf.decode("latin-1")
+                        if flags & FRAME_REQID:
+                            one = bytearray(1)
+                            if not _recv_exact_into(
+                                    conn, memoryview(one), 1):
+                                return
+                            rbuf = bytearray(one[0])
+                            if rbuf and not _recv_exact_into(
+                                    conn, memoryview(rbuf), len(rbuf)):
+                                return
+                            request_id = clean_request_id(bytes(rbuf))
                     if length > BODY_LIMIT_BYTES:
                         m = svc.metrics
                         m.inc("augmentation_requests_total")
@@ -609,7 +701,8 @@ class UnixFrameServer:
                         m.inc_object("unsuccessful")
                         telemetry.REGISTRY.counter_inc(
                             "ldt_http_requests_total", lane="uds")
-                        send_frame(conn, 413, [OVERSIZE_BODY])
+                        send_frame(conn, 413, [OVERSIZE_BODY],
+                                   request_id=request_id)
                         return
                     if length > len(buf):
                         buf = bytearray(length)
@@ -629,8 +722,9 @@ class UnixFrameServer:
                     status, buffers = handle_frame(
                         svc, buf, detect=self._detect, nbytes=length,
                         tenant=tenant, deadline_ms=deadline_ms,
-                        priority=priority)
-                    send_frame(conn, status, buffers)
+                        priority=priority, request_id=request_id)
+                    send_frame(conn, status, buffers,
+                               request_id=request_id)
                 finally:
                     with self._lock:
                         self._inflight -= 1

@@ -258,6 +258,32 @@ METRICS: dict = {
         "counter",
         "Bisection passes run while isolating poison docs from a "
         "killed batch (each pass re-scores the two halves)."),
+    "ldt_device_ms": (
+        "histogram",
+        "Per-flush device-vs-host wall time split (ms) from the engine "
+        "epilogue: phase=device is the device wait (fetch start to "
+        "rows on host), phase=host is the native epilogue."),
+    "ldt_error_traces_total": (
+        "counter",
+        "Span trees force-recorded into the slow ring because the "
+        "request answered 5xx (reason:error capture — recorded "
+        "regardless of LDT_SLOW_TRACE_MS)."),
+    "ldt_flightrec_events_total": (
+        "counter",
+        "Structured events written to the crash-safe flight recorder "
+        "(language_detector_tpu/flightrec.py, LDT_FLIGHTREC_DIR)."),
+    "ldt_flightrec_dropped_total": (
+        "counter",
+        "Flight-recorder events dropped because their payload "
+        "exceeded the ring's slot capacity."),
+    "ldt_postmortem_total": (
+        "counter",
+        "Dead-member flight recorders harvested into postmortem JSON "
+        "by the fleet/worker supervisor, by result=ok|empty|error."),
+    "ldt_profile_captures_total": (
+        "counter",
+        "On-demand device-profiler capture windows, by "
+        "result=ok|error|busy|unavailable (POST /profilez, SIGUSR2)."),
 }
 
 
@@ -347,7 +373,7 @@ class Trace:
     path."""
 
     __slots__ = ("t0", "t_wall", "spans", "deadline", "no_retry",
-                 "tenant")
+                 "tenant", "request_id")
 
     def __init__(self):
         self.t0 = _mono()
@@ -361,6 +387,12 @@ class Trace:
         self.deadline = None
         self.no_retry = False
         self.tenant = None
+        # end-to-end correlation id (X-LDT-Request-Id / UDS v2 ext /
+        # shm slot header): stamped by the front, echoed on the
+        # response, carried into slow traces and flight-recorder
+        # request events so /tracez can join one document's journey
+        # across processes
+        self.request_id = None
 
     def add(self, name: str, t0: float, t1: float, depth: int = 0):
         self.spans.append((name, depth, t0, t1))
@@ -400,7 +432,7 @@ class Trace:
                 meta: dict | None = None) -> dict:
         base = self.t0
         spans = sorted(self.spans, key=lambda sp: (sp[2], sp[1]))
-        return {
+        out = {
             "ts": self.t_wall,
             "total_ms": round(self.total_ms()
                               if total_ms is None else total_ms, 3),
@@ -410,6 +442,9 @@ class Trace:
                        "dur_ms": round((e - s) * 1e3, 3)}
                       for n, d, s, e in spans],
         }
+        if self.request_id is not None:
+            out["request_id"] = self.request_id
+        return out
 
 
 class CompileTracker:
@@ -463,11 +498,17 @@ class SlowTraceRing:
                      meta: dict | None = None) -> bool:
         if self.threshold_ms <= 0 or total_ms < self.threshold_ms:
             return False
+        self.record(trace, total_ms, meta=meta)
+        return True
+
+    def record(self, trace: Trace, total_ms: float,
+               meta: dict | None = None) -> None:
+        """Unconditional record — the error-capture path (5xx answers
+        keep their span tree regardless of the sampling threshold)."""
         d = trace.to_dict(total_ms=total_ms, meta=meta)
         with self._lock:
             self._ring.append(d)
             self.recorded += 1
-        return True
 
     def snapshot(self) -> list:
         with self._lock:
@@ -684,12 +725,37 @@ def observe_stage(stage: str, t0: float, t1: float | None = None,
 
 
 def finish_request(trace: Trace, meta: dict | None = None) -> float:
-    """End-of-request hook for both fronts: total latency into the
-    request histogram, span tree into the slow ring when over
-    threshold. Returns total ms."""
+    """End-of-request hook for both fronts and every ingest lane:
+    total latency into the request histogram, span tree into the slow
+    ring when over threshold — or unconditionally, tagged
+    reason:error, when the request answered 5xx (a failing request's
+    trace is exactly the one an operator needs, and sampling only
+    slow-but-successful requests would discard it). Also stamps the
+    request id into the meta and emits the flight-recorder
+    request_end event. Returns total ms."""
     total = trace.total_ms()
     REGISTRY.histogram("ldt_request_latency_ms").observe(total)
-    REGISTRY.slow.maybe_record(trace, total, meta=meta)
+    if meta is not None and trace.request_id is not None:
+        meta.setdefault("request_id", trace.request_id)
+    status = (meta or {}).get("status")
+    from . import flightrec
+    if isinstance(status, int) and status >= 500:
+        err_meta = dict(meta or {})
+        err_meta["reason"] = "error"
+        REGISTRY.slow.record(trace, total, meta=err_meta)
+        REGISTRY.counter_inc("ldt_error_traces_total")
+        flightrec.emit_event("slow_trace", request_id=trace.request_id,
+                             total_ms=round(total, 3), reason="error")
+    elif REGISTRY.slow.maybe_record(trace, total, meta=meta):
+        flightrec.emit_event("slow_trace", request_id=trace.request_id,
+                             total_ms=round(total, 3),
+                             reason="threshold")
+    flightrec.emit_event("request_end",
+                         request_id=trace.request_id,
+                         status=status,
+                         total_ms=round(total, 3),
+                         **({"front": meta["front"]}
+                            if meta and "front" in meta else {}))
     return total
 
 
@@ -769,4 +835,8 @@ def debug_vars(metrics=None) -> dict:
                         "capacity": REGISTRY.slow.capacity,
                         "recorded": REGISTRY.slow.recorded,
                         "held": len(REGISTRY.slow.snapshot())}
+    from . import flightrec
+    fr = flightrec.stats()
+    if fr is not None:
+        d["flightrec"] = fr
     return d

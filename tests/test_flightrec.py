@@ -1,0 +1,159 @@
+"""Crash-safe flight recorder (language_detector_tpu/flightrec.py):
+ring write/read roundtrip, wraparound accounting, torn-slot rejection,
+postmortem harvest, and the declared-event contract."""
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from language_detector_tpu import flightrec
+from language_detector_tpu.flightrec import (EVENTS, FILE_HDR,
+                                             SLOT_HDR, FlightRecorder)
+
+
+@pytest.fixture
+def ring(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "flightrec-1.ring"), slots=8,
+                         slot_bytes=256)
+    yield rec
+    rec.close()
+
+
+def test_roundtrip_and_order(ring):
+    for i in range(5):
+        assert ring.emit("request_start", {"request_id": f"r{i}",
+                                           "lane": "tcp"})
+    info = flightrec.read_ring(ring.path)
+    assert info["pid"] > 0
+    assert info["events_total"] == 5
+    assert [e["seq"] for e in info["events"]] == [1, 2, 3, 4, 5]
+    assert [e["request_id"] for e in info["events"]] == \
+        [f"r{i}" for i in range(5)]
+    assert all(e["ev"] == "request_start" for e in info["events"])
+    assert all(e["ts"] > 0 for e in info["events"])
+
+
+def test_wraparound_keeps_newest_and_total(ring):
+    for i in range(20):  # 8 slots: only the last 8 survive
+        ring.emit("request_end", {"status": 200, "n": i})
+    info = flightrec.read_ring(ring.path)
+    assert info["events_total"] == 20
+    assert len(info["events"]) == 8
+    assert [e["n"] for e in info["events"]] == list(range(12, 20))
+
+
+def test_oversize_payload_dropped_not_torn(ring):
+    assert not ring.emit("slow_trace", {"blob": "x" * 4096})
+    assert ring.emit("slow_trace", {"total_ms": 1.5})
+    st = ring.stats()
+    assert st["dropped"] == 1
+    assert st["events_total"] == 1
+    assert len(flightrec.read_ring(ring.path)["events"]) == 1
+
+
+def test_torn_slot_rejected_by_reader(ring):
+    """A committed seq word over a half-written payload (the one
+    record in flight at SIGKILL) must be skipped, not fatal."""
+    ring.emit("request_start", {"request_id": "ok"})
+    # forge slot 1: commit word present, payload garbage
+    off = FILE_HDR.size + 1 * ring.slot_bytes
+    ring.mm[off:off + SLOT_HDR.size] = SLOT_HDR.pack(2, 40, 123.0)
+    ring.mm[off + SLOT_HDR.size:off + SLOT_HDR.size + 40] = b"\xff" * 40
+    info = flightrec.read_ring(ring.path)
+    assert [e["request_id"] for e in info["events"]] == ["ok"]
+    # a rejected record contributes nothing, not a crash
+    assert info["events_total"] == 1
+
+
+def test_reader_rejects_foreign_files(tmp_path):
+    bad = tmp_path / "flightrec-9.ring"
+    bad.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError):
+        flightrec.read_ring(str(bad))
+    bad.write_bytes(b"\x00" * 4)
+    with pytest.raises(ValueError):
+        flightrec.read_ring(str(bad))
+
+
+def test_harvest_postmortem_inflight_ids(ring):
+    ring.emit("request_start", {"request_id": "done", "lane": "tcp"})
+    ring.emit("request_start", {"request_id": "stuck", "lane": "uds"})
+    ring.emit("request_end", {"request_id": "done", "status": 200})
+    pm = flightrec.harvest_postmortem(ring.path, reason="crash", rc=-9)
+    assert pm["reason"] == "crash"
+    assert pm["rc"] == -9
+    assert pm["clean_exit"] is False
+    assert pm["inflight_request_ids"] == ["stuck"]
+    assert pm["events_total"] == 3
+    assert pm["tail"][-1]["ev"] == "request_end"
+
+
+def test_harvest_sees_clean_exit(ring):
+    ring.emit("proc_start", {"role": "test"})
+    ring.emit("proc_exit", {"role": "test"})
+    pm = flightrec.harvest_postmortem(ring.path)
+    assert pm["clean_exit"] is True
+    assert pm["inflight_request_ids"] == []
+
+
+def test_request_events_tagged_with_pid(ring):
+    ring.emit("request_start", {"request_id": "ab12"})
+    ring.emit("breaker_state", {"state": "open"})  # no request id
+    evs = flightrec.request_events(ring.path)
+    assert [e["request_id"] for e in evs] == ["ab12"]
+    assert evs[0]["pid"] == flightrec.read_ring(ring.path)["pid"]
+    # unreadable path -> [] (merge is best-effort)
+    assert flightrec.request_events(ring.path + ".missing") == []
+
+
+def test_emit_event_requires_declaration(monkeypatch):
+    monkeypatch.setattr(flightrec, "RECORDER", None)
+    with pytest.raises(KeyError):
+        flightrec.emit_event("totally_rogue_event", x=1)
+    # disabled recorder: declared events are an all-but-free no-op
+    assert flightrec.emit_event("request_start", request_id="x") \
+        is False
+
+
+def test_events_registry_shape():
+    assert len(EVENTS) >= 13
+    for name, (category, doc) in EVENTS.items():
+        assert name.replace("_", "").isalnum() and name.islower()
+        assert category and doc
+
+
+def test_init_from_env_and_module_emit(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "RECORDER", None)
+    rec = flightrec.init_from_env(role="test-front")
+    try:
+        assert rec is not None
+        assert flightrec.emit_event("request_start",
+                                    request_id="deadbeef", lane="tcp",
+                                    none_dropped=None)
+        info = flightrec.read_ring(rec.path)
+        assert info["events"][0]["ev"] == "proc_start"
+        assert info["events"][0]["role"] == "test-front"
+        assert "none_dropped" not in info["events"][1]
+        assert flightrec.stats()["events_total"] == 2
+        # idempotent: a second init returns the same recorder
+        assert flightrec.init_from_env() is rec
+    finally:
+        rec.close()
+        monkeypatch.setattr(flightrec, "RECORDER", None)
+
+
+def test_publish_order_commit_word_last(ring):
+    """The wire contract the crash-safety argument rests on: zeroing
+    just the 4-byte commit word makes the record invisible even though
+    its payload bytes are intact."""
+    ring.emit("fault_fired", {"point": "accept"})
+    off = FILE_HDR.size
+    ring.mm[off:off + 4] = struct.pack("<I", 0)
+    assert flightrec.read_ring(ring.path)["events"] == []
+    payload = bytes(ring.mm[off + SLOT_HDR.size:
+                            off + SLOT_HDR.size + 64])
+    assert json.loads(payload[:payload.index(b"}") + 1])["ev"] \
+        == "fault_fired"

@@ -155,6 +155,28 @@ def test_metric_fixture_drift_both_directions():
     assert "ldt_fix_used_total" not in names
 
 
+# -- event registry ----------------------------------------------------------
+
+
+def test_event_fixture_drift_both_directions():
+    from tools.lint import event_registry
+    v, _ = event_registry.check(
+        root=REPO,
+        files=[f"{FIX}/events_use.py"],
+        flightrec_rel=f"{FIX}/events_mod.py",
+        docs_rel=f"{FIX}/events_docs.md")
+    rules = _rules(v)
+    assert rules["event-undeclared"] == 1       # fix_rogue
+    assert rules["event-unused"] == 1           # fix_unused
+    # declared-but-undocumented (fix_unused, fix_undoc) plus the stale
+    # doc row (fix_stale); the prose mention of fix_unused OUTSIDE the
+    # table markers must NOT count as documentation
+    assert rules["event-undocumented"] == 3
+    names = "\n".join(x.message for x in v)
+    assert "fix_stale" in names
+    assert "fix_used" not in names.replace("fix_unused", "")
+
+
 # -- fault registry ----------------------------------------------------------
 
 

@@ -1,0 +1,73 @@
+"""On-demand device profiling (language_detector_tpu/profiling.py):
+arm/stop lifecycle, the busy and unavailable refusals, and the window
+clamp — all against the real jax.profiler on the CPU backend."""
+from __future__ import annotations
+
+import glob
+import time
+
+import pytest
+
+from language_detector_tpu import profiling, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_window(monkeypatch):
+    monkeypatch.setattr(profiling, "_ACTIVE", None)
+    yield
+    # never leave a live trace behind for the next test
+    if profiling.active() is not None:
+        import contextlib
+
+        import jax
+        with contextlib.suppress(Exception):
+            jax.profiler.stop_trace()
+    monkeypatch.setattr(profiling, "_ACTIVE", None)
+
+
+def test_arm_unavailable_without_dir(monkeypatch):
+    monkeypatch.delenv("LDT_PROFILE_DIR", raising=False)
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_profile_captures_total", result="unavailable")
+    status, payload = profiling.arm()
+    assert status == 503
+    assert "LDT_PROFILE_DIR" in payload["error"]
+    assert profiling.active() is None
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_profile_captures_total",
+        result="unavailable") == before + 1
+
+
+def test_arm_window_and_busy_then_stop(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_PROFILE_DIR", str(tmp_path))
+    status, payload = profiling.arm(window_sec=0.001)  # clamps to 0.05
+    assert status == 200
+    assert payload["window_sec"] == 0.05
+    assert payload["dir"].startswith(str(tmp_path))
+    act = profiling.active()
+    assert act is not None and act["dir"] == payload["dir"]
+    # second arm while a window is live: typed 409, original untouched
+    status2, payload2 = profiling.arm()
+    assert status2 == 409
+    assert payload2["dir"] == payload["dir"]
+    # the watchdog stops the window on its own
+    deadline = time.time() + 10.0
+    while profiling.active() is not None and time.time() < deadline:
+        time.sleep(0.02)
+    assert profiling.active() is None, "watchdog never stopped it"
+    # the capture actually landed on disk
+    deadline = time.time() + 10.0
+    while not glob.glob(f"{payload['dir']}/**/*.xplane.pb",
+                        recursive=True) and time.time() < deadline:
+        time.sleep(0.05)
+    assert glob.glob(f"{payload['dir']}/**/*.xplane.pb", recursive=True)
+
+
+def test_install_sigusr2_reports_thread_context():
+    # pytest's main thread: installation succeeds and is undoable
+    import signal
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        assert profiling.install_sigusr2() is True
+    finally:
+        signal.signal(signal.SIGUSR2, old)

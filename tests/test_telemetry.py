@@ -143,6 +143,41 @@ def test_slow_ring_off_by_default():
     assert not ring.maybe_record(tr, 1e9)     # sampler disabled
 
 
+def test_error_trace_captured_despite_threshold():
+    """Regression for the error-capture gap: a 5xx answer keeps its
+    span tree (tagged reason:error) even when the sampler is off /
+    far above the request's latency — and a fast 2xx still records
+    nothing."""
+    slow = telemetry.REGISTRY.slow
+    old_thresh = slow.threshold_ms
+    slow.clear()
+    slow.threshold_ms = 0.0                  # sampler fully off
+    try:
+        ok = Trace()
+        ok.request_id = "fine-1"
+        telemetry.finish_request(ok, meta={"front": "sync",
+                                           "status": 200})
+        assert slow.snapshot() == []
+        before = telemetry.REGISTRY.counter_value(
+            "ldt_error_traces_total")
+        err = Trace()
+        err.request_id = "boom-1"
+        err.add("detect", err.t0, err.t0 + 0.001)
+        telemetry.finish_request(err, meta={"front": "sync",
+                                            "status": 500})
+        held = slow.snapshot()
+        assert len(held) == 1
+        assert held[0]["meta"]["reason"] == "error"
+        assert held[0]["meta"]["status"] == 500
+        assert held[0]["request_id"] == "boom-1"
+        assert [s["name"] for s in held[0]["spans"]] == ["detect"]
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_error_traces_total") == before + 1
+    finally:
+        slow.threshold_ms = old_thresh
+        slow.clear()
+
+
 # -- compile-event tracking --------------------------------------------------
 
 

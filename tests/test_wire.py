@@ -575,6 +575,236 @@ def test_uds_slow_loris_sync_408(sync_server, monkeypatch):
         uds.close()
 
 
+# -- request-id correlation: echo, error-path parity, /tracez merge ---------
+
+
+def _post_with_id(port: int, body: bytes, rid: str | None = None):
+    """(status, echoed X-LDT-Request-Id, payload) for POST /."""
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        if rid is not None:
+            hdrs[wire.REQUEST_ID_HEADER] = rid
+        conn.request("POST", "/", body, hdrs)
+        resp = conn.getresponse()
+        return (resp.status, resp.getheader(wire.REQUEST_ID_HEADER),
+                resp.read())
+    finally:
+        conn.close()
+
+
+def test_request_id_validation_and_generation():
+    assert wire.clean_request_id("abc-123._X") == "abc-123._X"
+    assert wire.clean_request_id(b"deadbeef") == "deadbeef"
+    assert wire.clean_request_id("") is None
+    assert wire.clean_request_id(None) is None
+    assert wire.clean_request_id("bad id") is None
+    assert wire.clean_request_id("x" * 65) is None
+    assert wire.clean_request_id(b"\xff\xfe") is None
+    rid = wire.gen_request_id()
+    assert len(rid) == 8
+    int(rid, 16)                 # 8 hex chars, the shm-carrier shape
+    assert wire.clean_request_id(rid) == rid
+
+
+def test_pack_frame_request_id_layout():
+    body = b'{"request": []}'
+    f = wire.pack_frame(body, request_id="r-1")
+    (word,) = wire.FRAME_HEADER.unpack(f[:4])
+    assert word & wire.FRAME_V2_FLAG
+    flags, tlen, dl = wire.FRAME_EXT_HEADER.unpack(
+        f[4:4 + wire.FRAME_EXT_HEADER.size])
+    assert flags & wire.FRAME_REQID and tlen == 0 and dl == 0
+    off = 4 + wire.FRAME_EXT_HEADER.size
+    assert f[off] == 3 and f[off + 1:off + 4] == b"r-1"
+    assert f[off + 4:] == body
+    with pytest.raises(ValueError):
+        wire.pack_frame(body, request_id="x" * 256)
+
+
+def test_http_request_id_echo_both_fronts(sync_server, aio_server):
+    """Success AND error responses carry the caller's id back; an
+    absent or hostile id is replaced by a server-generated 8-hex one,
+    never reflected."""
+    ok = b'{"request": [{"text": "hello correlation"}]}'
+    for port in (sync_server["port"], aio_server["port"]):
+        status, rid, _ = _post_with_id(port, ok, rid="client.id-1")
+        assert status < 400 and rid == "client.id-1"
+        status, rid, _ = _post_with_id(port, b"not json",
+                                       rid="err.id-2")
+        assert status == 400 and rid == "err.id-2"
+        status, rid, _ = _post_with_id(port, ok)
+        assert status < 400 and len(rid) == 8
+        int(rid, 16)
+        status, rid, _ = _post_with_id(port, ok, rid="bad id!")
+        assert status < 400 and rid != "bad id!" and len(rid) == 8
+
+
+def test_http_413_echoes_request_id(sync_server, aio_server):
+    big = b"x" * (wire.BODY_LIMIT_BYTES + 1)
+    for port in (sync_server["port"], aio_server["port"]):
+        status, rid, _ = _post_with_id(port, big, rid="too-big-1")
+        assert status == 413 and rid == "too-big-1"
+
+
+def _uds_oversize_reqid_frame() -> bytes:
+    """A v2 frame declaring an over-limit body, carrying an id and no
+    payload — exercises the 413-before-read echo."""
+    return wire.FRAME_HEADER.pack(
+        wire.FRAME_V2_FLAG | (wire.BODY_LIMIT_BYTES + 1)) \
+        + wire.FRAME_EXT_HEADER.pack(wire.FRAME_REQID, 0, 0) \
+        + bytes([4]) + b"big4"
+
+
+def _uds_echo_checks(connect):
+    body = b'{"request": [{"text": "uds correlation"}]}'
+    s = connect()
+    try:
+        # v2 with an id: response uses the echo form
+        s.sendall(wire.pack_frame(body, request_id="uds-id-7"))
+        status, rid, payload = wire.recv_response_frame(s)
+        assert status < 400 and rid == "uds-id-7"
+        # v1 on the SAME conn: plain header, same payload bytes
+        s.sendall(wire.pack_frame(body))
+        status1, rid1, payload1 = wire.recv_response_frame(s)
+        assert (status1, rid1) == (status, None)
+        assert payload1 == payload
+        # hostile frame id is cleaned away -> plain v1 response
+        s.sendall(wire.pack_frame(body, request_id="bad id"))
+        _, rid2, _ = wire.recv_response_frame(s)
+        assert rid2 is None
+        # error frames echo too
+        s.sendall(wire.pack_frame(b"not json", request_id="er-1"))
+        status, rid, _ = wire.recv_response_frame(s)
+        assert status == 400 and rid == "er-1"
+    finally:
+        s.close()
+    # oversize: 413 echo frame, then close
+    s = connect()
+    try:
+        s.sendall(_uds_oversize_reqid_frame())
+        status, rid, payload = wire.recv_response_frame(s)
+        assert (status, rid) == (413, "big4")
+        assert payload == wire.OVERSIZE_BODY
+        assert s.recv(1) == b""
+    finally:
+        s.close()
+
+
+def test_uds_request_id_echo_sync(sync_server):
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-wire-"), "r.sock")
+    uds = wire.UnixFrameServer(sync_server["svc"], path)
+    uds.start()
+    try:
+        def connect():
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(path)
+            return s
+        _uds_echo_checks(connect)
+    finally:
+        uds.close()
+
+
+def test_uds_request_id_echo_aio(aio_server):
+    def connect():
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(aio_server["uds_path"])
+        return s
+    _uds_echo_checks(connect)
+
+
+def test_shm_slot_header_reqid_roundtrip(tmp_path):
+    """The shm lane's id carrier is the slot header's u32: stamped on
+    submit, echoed on DONE, and invalid ids are refused up front."""
+    from language_detector_tpu.service import shmring
+    rf = shmring.RingFile(str(tmp_path / "ring"), create=True,
+                          slots=4, slot_bytes=4096)
+    try:
+        rf.write_slot(0, shmring.SLOT_READY, 1, os.getpid(), 1.0,
+                      10, 0, reqid=0xCAFEF00D)
+        assert rf.slot_request_id(0) == 0xCAFEF00D
+        assert "%08x" % rf.slot_request_id(0) == "cafef00d"
+        rf.write_slot(1, shmring.SLOT_READY, 1, os.getpid(), 1.0,
+                      10, 0)
+        assert rf.slot_request_id(1) == 0
+    finally:
+        rf.close()
+    ring = shmring.RingClient(str(tmp_path / "c"), slots=4,
+                              slot_bytes=4096)
+    try:
+        for bad in ("zz", "0", "123456789"):   # non-hex, zero, >u32
+            with pytest.raises(ValueError):
+                ring.submit(b"{}", request_id=bad)
+    finally:
+        ring.close()
+
+
+def test_tracez_merges_one_id_across_processes(tmp_path):
+    """The fleet /tracez merge: one request id written by two recorder
+    files (two pids, three lanes) renders as ONE entry whose processes
+    list spans both writers."""
+    from language_detector_tpu import flightrec
+    from language_detector_tpu.service import fleet
+    rid = "cafef00d"
+    lanes = {11111: ["tcp"], 22222: ["uds", "shm"]}
+    for fake_pid, sub in ((11111, "m0"), (22222, "m1")):
+        d = tmp_path / sub
+        d.mkdir()
+        p = d / f"flightrec-{fake_pid}.ring"
+        rec = flightrec.FlightRecorder(str(p), slots=8, slot_bytes=256)
+        for lane in lanes[fake_pid]:
+            rec.emit("request_start", {"request_id": rid, "lane": lane})
+        rec.emit("request_end", {"request_id": rid, "status": 200})
+        rec.emit("request_start", {"request_id": f"other-{fake_pid}"})
+        rec.close()
+        # both rings were written by THIS process: forge the header
+        # pid so the merge sees two distinct writers
+        data = bytearray(p.read_bytes())
+        struct.pack_into("<I", data, 16, fake_pid)
+        p.write_bytes(bytes(data))
+    doc = fleet._fleet_traces({"members": []}, str(tmp_path))
+    assert doc["count"] == 3             # cafef00d + the two others
+    top = doc["requests"][0]             # richest entry sorts first
+    assert top["request_id"] == rid
+    assert sorted(top["processes"]) == ["pid:11111", "pid:22222"]
+    assert {e["lane"] for e in top["events"] if "lane" in e} \
+        == {"tcp", "uds", "shm"}
+    assert len(top["events"]) == 5       # 3 starts + 2 ends
+
+
+def test_tracez_correlates_live_fronts(sync_server, aio_server,
+                                       tmp_path, monkeypatch):
+    """End-to-end correlation through real server code: the same id
+    sent over HTTP (sync front) and the UDS lane (aio front) lands in
+    the recorder and merges into one /tracez entry with both lanes."""
+    from language_detector_tpu import flightrec
+    from language_detector_tpu.service import fleet
+    rec = flightrec.FlightRecorder(
+        str(tmp_path / f"flightrec-{os.getpid()}.ring"))
+    monkeypatch.setattr(flightrec, "RECORDER", rec)
+    rid = "feedc0de"
+    body = b'{"request": [{"text": "cross lane"}]}'
+    try:
+        status, echoed, _ = _post_with_id(sync_server["port"], body,
+                                          rid=rid)
+        assert status < 400 and echoed == rid
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(aio_server["uds_path"])
+        s.sendall(wire.pack_frame(body, request_id=rid))
+        status, echoed, _ = wire.recv_response_frame(s)
+        assert status < 400 and echoed == rid
+        s.close()
+        doc = fleet._fleet_traces({"members": []}, str(tmp_path))
+        entry = next(e for e in doc["requests"]
+                     if e["request_id"] == rid)
+        assert len(entry["processes"]) == 1      # same test process
+        assert {e["lane"] for e in entry["events"]
+                if e["ev"] == "request_start"} == {"tcp", "uds"}
+    finally:
+        monkeypatch.setattr(flightrec, "RECORDER", None)
+        rec.close()
+
+
 def test_uds_slow_loris_aio_408(aio_server, monkeypatch):
     """Same stalled-client regression against the asyncio front."""
     monkeypatch.setenv("LDT_FRAME_READ_TIMEOUT_SEC", "0.2")

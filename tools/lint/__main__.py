@@ -1,7 +1,7 @@
 """CLI: python -m tools.lint [--rule r1,r2] [--changed]
 [--knob-table] [--write-knob-docs]
 
-Default run executes all nine analyzers over the live tree and exits
+Default run executes all ten analyzers over the live tree and exits
 non-zero on any violation — ci.sh runs exactly this before the test
 suite. ``--changed`` is the editor-loop mode: analyzers scope to the
 files git reports as modified (unstaged + staged + untracked), and the
@@ -15,9 +15,9 @@ import argparse
 import subprocess
 import sys
 
-from . import faults_registry, fsm_registry, future_resolution, \
-    jit_contract, knob_registry, lock_discipline, metric_registry, \
-    model_check, trace_safety
+from . import event_registry, faults_registry, fsm_registry, \
+    future_resolution, jit_contract, knob_registry, lock_discipline, \
+    metric_registry, model_check, trace_safety
 from .base import RULE_IDS, repo_root
 
 # analyzer -> the rule ids it can emit (every analyzer can additionally
@@ -32,6 +32,8 @@ ANALYZERS = (
      {"knob-direct-env", "knob-undeclared", "knob-docs-drift"}),
     ("metric-registry", metric_registry.check,
      {"metric-undeclared", "metric-undocumented", "metric-unused"}),
+    ("event-registry", event_registry.check,
+     {"event-undeclared", "event-undocumented", "event-unused"}),
     ("fault-registry", faults_registry.check,
      {"fault-undeclared", "fault-undocumented", "fault-unused"}),
     ("fsm-conformance", fsm_registry.check,
@@ -59,6 +61,7 @@ _FULL_RUN_TRIGGERS = (
     "language_detector_tpu/knobs.py",
     "language_detector_tpu/faults.py",
     "language_detector_tpu/telemetry.py",
+    "language_detector_tpu/flightrec.py",
     "language_detector_tpu/locks.py",
     "docs/OBSERVABILITY.md",
     "docs/STATIC_ANALYSIS.md",

@@ -32,6 +32,9 @@ RULE_IDS = frozenset({
     "metric-undeclared",
     "metric-undocumented",
     "metric-unused",
+    "event-undeclared",
+    "event-undocumented",
+    "event-unused",
     "fault-undeclared",
     "fault-undocumented",
     "fault-unused",

@@ -1,0 +1,139 @@
+"""Event-registry analyzer: every flight-recorder event declared,
+documented, and emitted.
+
+The declaration is flightrec.EVENTS (name -> (category, doc)); the
+docs contract is the event table between the
+`<!-- ldt-event-table:begin/end -->` markers in docs/OBSERVABILITY.md.
+Usage is extracted from the first string argument of emit_event()
+calls — the module-level entry every emit site goes through (the
+FlightRecorder.emit method only ever receives the already-validated
+name variable, never a literal).
+
+  event-undeclared    emitted in code but missing from EVENTS (the
+                      runtime raises KeyError at the call site; lint
+                      catches it before the first crash does)
+  event-unused        declared in EVENTS but never emitted (a
+                      postmortem reader greps for events that can
+                      never appear)
+  event-undocumented  drift between EVENTS and the docs event table,
+                      either direction
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .base import (Violation, apply_suppressions, first_str_arg,
+                   iter_package_files, load_source, repo_root)
+
+FLIGHTREC_REL = "language_detector_tpu/flightrec.py"
+DOCS_REL = "docs/OBSERVABILITY.md"
+
+EMIT_CALLS = frozenset({"emit_event"})
+
+MARK_BEGIN = "<!-- ldt-event-table:begin -->"
+MARK_END = "<!-- ldt-event-table:end -->"
+
+# first backticked cell of a table row: | `event_name` | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def declared_events(root: Path, flightrec_rel: str = FLIGHTREC_REL):
+    """{name: line} of EVENTS keys, by AST."""
+    sf = load_source(root / flightrec_rel, root)
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            is_events = any(isinstance(t, ast.Name)
+                            and t.id == "EVENTS"
+                            for t in node.targets)
+        elif isinstance(node, ast.AnnAssign):
+            is_events = (isinstance(node.target, ast.Name)
+                         and node.target.id == "EVENTS")
+        else:
+            continue
+        if is_events and isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+def used_events(sources):
+    """{name: (rel, line)} of event names passed as the first argument
+    of an emit_event() call."""
+    used: dict = {}
+    for sf in sources:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.attr \
+                if isinstance(node.func, ast.Attribute) \
+                else getattr(node.func, "id", None)
+            if fname not in EMIT_CALLS:
+                continue
+            name = first_str_arg(node)
+            if name:
+                used.setdefault(name, (sf.rel, node.lineno))
+    return used
+
+
+def doc_events(root: Path, docs_rel: str = DOCS_REL) -> set:
+    """Event names documented in the marked table. Outside the markers
+    nothing counts: prose may mention an event name without being the
+    contract."""
+    text = (root / docs_rel).read_text()
+    if MARK_BEGIN in text and MARK_END in text:
+        text = text.split(MARK_BEGIN, 1)[1].split(MARK_END, 1)[0]
+    return set(_DOC_ROW_RE.findall(text))
+
+
+def check(root: Path | None = None, files=None,
+          flightrec_rel: str = FLIGHTREC_REL,
+          docs_rel: str = DOCS_REL):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    declared = declared_events(root, flightrec_rel)
+    paths = list(iter_package_files(root)) if files is None else \
+        [root / f if not Path(f).is_absolute() else Path(f)
+         for f in files]
+    sources = [load_source(p, root) for p in paths]
+    used = used_events(sources)
+    in_docs = doc_events(root, docs_rel) \
+        if (root / docs_rel).exists() else set()
+
+    per_file: dict = {sf.rel: [] for sf in sources}
+    extra: list = []
+
+    for name, (rel, line) in sorted(used.items()):
+        if name not in declared:
+            per_file.setdefault(rel, []).append(Violation(
+                "event-undeclared", rel, line,
+                f"event {name} is emitted but not declared in "
+                f"flightrec.EVENTS (KeyError at the call site)"))
+    for name, line in sorted(declared.items()):
+        if name not in used:
+            extra.append(Violation(
+                "event-unused", flightrec_rel, line,
+                f"event {name} is declared in flightrec.EVENTS but "
+                f"never emitted"))
+        if name not in in_docs:
+            extra.append(Violation(
+                "event-undocumented", flightrec_rel, line,
+                f"event {name} is declared but missing from the event "
+                f"table in {docs_rel}"))
+    for name in sorted(in_docs):
+        if name not in declared:
+            extra.append(Violation(
+                "event-undocumented", docs_rel, 1,
+                f"{docs_rel} event table lists {name}, which is not "
+                f"declared in flightrec.EVENTS (stale docs)"))
+
+    violations: list = []
+    n_suppressed = 0
+    for sf in sources:
+        kept, ns = apply_suppressions(sf, per_file.get(sf.rel, []))
+        violations.extend(kept)
+        n_suppressed += ns
+    violations.extend(extra)
+    return violations, n_suppressed

@@ -1,0 +1,8 @@
+"""Fixture: a stand-in flightrec module for the event-registry
+analyzer (passed via flightrec_rel)."""
+
+EVENTS: dict = {
+    "fix_used": ("lifecycle", "emitted and documented"),
+    "fix_unused": ("lifecycle", "declared, never emitted"),
+    "fix_undoc": ("request", "emitted, absent from docs"),
+}

@@ -1,0 +1,7 @@
+"""Fixture: event emission sites for the event-registry analyzer."""
+
+
+def record(flightrec):
+    flightrec.emit_event("fix_used", role="test")
+    flightrec.emit_event("fix_undoc", role="test")
+    flightrec.emit_event("fix_rogue", role="test")  # never declared

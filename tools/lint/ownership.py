@@ -62,6 +62,17 @@ LOCK_OWNERSHIP: dict = {
         "TelemetryRegistry": _cl(
             lock="_lock", attrs=("_hists", "_counters")),
     },
+    "language_detector_tpu/flightrec.py": {
+        "FlightRecorder": _cl(
+            lock="_lock",
+            attrs=("_seq", "_dropped"),
+            lockfree={
+                "mm": "mmap assigned once at init (before the recorder "
+                      "is published via the module RECORDER binding); "
+                      "emit() mutates it only under _lock, close() runs "
+                      "after the owner stops emitting",
+            }),
+    },
     "language_detector_tpu/service/admission.py": {
         "BrownoutLadder": _cl(lock="_lock", attrs=("ema", "level")),
         "CircuitBreaker": _cl(
