@@ -366,6 +366,8 @@ def bench(batch_size: int = 16384, n_batches: int = 6,
             mixed_retry_offtier_docs=int(mixed_retry_offtier),
             pack_overlap_ratio=round(pack_overlap_ratio, 4),
             pipeline_depth=int(p1["depth"]),
+            kernel=p1["kernel"],
+            kernel_reason=p1["kernel_reason"],
             pipeline_donation_hits=int(
                 p1["donation_hits"] - p0["donation_hits"]),
             http_docs_sec=http_docs_sec,
@@ -382,6 +384,122 @@ def bench(batch_size: int = 16384, n_batches: int = 6,
     )
 
 
+
+
+def bench_kernel(n: int = 4096, reps: int = 10) -> dict:
+    """--kernel: the scoring-kernel A/B (ops/kernels.py) over real
+    packed wires, one bucket tier per corpus composition. Times the
+    device dispatch alone (block_until_ready fenced, reps averaged) for
+    each mode — the reference XLA program, the quantized fused program,
+    and the lax.scan oracle — plus the Pallas kernel where the backend
+    lowers it (interpret mode is timed on a tiny wire only, it is a
+    parity tool, not a serving mode). Engine-level docs/sec under the
+    two serving candidates and a scalar-engine sample anchor the
+    dispatch numbers to end-to-end throughput.
+
+    vs_baseline carries the acceptance ratio: fused-vs-xla dispatch
+    speedup on the service tier (the round-14 floor is 1.3x)."""
+    import numpy as np
+
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    from language_detector_tpu.ops import kernels
+    from language_detector_tpu.ops.score import score_chunks
+
+    eng = NgramBatchEngine()
+    sel = kernels.select_kernel()
+    modes = {
+        "xla": score_chunks,
+        "fused": kernels.score_chunks_fused,
+        "lax": kernels.score_chunks_lax,
+    }
+    if sel.mode == "pallas":          # TPU: time the real kernel too
+        modes["pallas"] = sel.score
+
+    corpora = [
+        ("service", make_corpus(n)),
+        ("mixed", make_mixed_corpus(n)),
+        ("longheavy", make_longheavy_corpus(max(n // 4, 1024))),
+    ]
+    tiers = {}
+    for tier, docs in corpora:
+        # copy out of the staging ring: the next tier's pack reuses the
+        # ring slots, and the A/B must time identical bytes
+        cb = eng._pack(docs)
+        wire = {k: np.array(v, copy=True) for k, v in cb.wire.items()}
+        G = int(np.prod(wire["cmeta"].shape))
+        K = int(wire["k_iota"].shape[0])
+        per = {}
+        for name, fn in modes.items():
+            fn(eng.dt, wire).block_until_ready()   # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(eng.dt, wire)
+            out.block_until_ready()
+            per[name] = (time.perf_counter() - t0) / reps * 1e3
+        tiers[tier] = dict(
+            grid_g=G, grid_k=K, n_docs=len(docs),
+            dispatch_ms={k: round(v, 2) for k, v in per.items()},
+            fused_vs_xla=round(per["xla"] / per["fused"], 3),
+            lax_vs_xla=round(per["xla"] / per["lax"], 3),
+        )
+
+    # Pallas interpret: one tiny dispatch, presence + parity cost only
+    # (the interpreter runs the kernel body in Python per grid tile)
+    pallas_interpret_ms = None
+    if kernels._HAVE_PALLAS and sel.mode != "pallas":
+        small = eng._pack(make_corpus(64))
+        wire = {k: np.array(v, copy=True) for k, v in small.wire.items()}
+        ps, _, _ = kernels._pallas_score_fns(interpret=True)
+        ps(eng.dt, wire).block_until_ready()
+        t0 = time.perf_counter()
+        ps(eng.dt, wire).block_until_ready()
+        pallas_interpret_ms = round((time.perf_counter() - t0) * 1e3, 1)
+
+    # engine-level docs/sec under the two serving candidates (same
+    # corpus, same engine config, only LDT_KERNEL differs) + scalar
+    import os
+
+    from language_detector_tpu.engine_scalar import detect_scalar
+    docs = make_corpus(n)
+    engine_docs_sec = {}
+    saved = os.environ.get("LDT_KERNEL")
+    try:
+        for mode in ("xla", "fused" if sel.mode != "pallas"
+                     else "pallas"):
+            os.environ["LDT_KERNEL"] = mode
+            e = NgramBatchEngine()
+            e.detect_batch(docs)                   # warm shapes
+            t0 = time.time()
+            e.detect_batch(docs)
+            engine_docs_sec[e.pipeline_stats()["kernel"]] = round(
+                n / (time.time() - t0), 1)
+    finally:
+        if saved is None:
+            os.environ.pop("LDT_KERNEL", None)
+        else:
+            os.environ["LDT_KERNEL"] = saved
+    t0 = time.time()
+    for t in docs[:256]:
+        detect_scalar(t, eng.tables, eng.reg)
+    scalar_docs_sec = round(256 / (time.time() - t0), 1)
+
+    ratio = tiers["service"]["fused_vs_xla"]
+    return dict(
+        metric="kernel_dispatch_speedup",
+        value=ratio,
+        unit="x (fused vs xla, service tier)",
+        vs_baseline=round(ratio / 1.3, 4),    # round-14 acceptance floor
+        detail=dict(
+            backend=__import__("jax").default_backend(),
+            kernel_selected=sel.mode,
+            kernel_reason=sel.reason,
+            tiers=tiers,
+            pallas_interpret_ms_small=pallas_interpret_ms,
+            engine_docs_sec=engine_docs_sec,
+            scalar_docs_sec=scalar_docs_sec,
+            reps=reps,
+        ),
+    )
 
 
 def make_longtail_corpus(n: int) -> list:
@@ -1033,6 +1151,13 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--shm":
         out = bench_shm()
         with open(REPO / "BENCH_r09.json", "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--kernel":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        out = bench_kernel(n)
+        with open(REPO / "BENCH_r10.json", "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
         print(json.dumps(out))

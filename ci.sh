@@ -78,6 +78,62 @@ print("pipeline:",
       "| donation_hits", d["pipeline_donation_hits"],
       "| longheavy_lane_speedup", d["longheavy_lane_speedup"],
       "| longheavy_split_docs", d["longheavy_split_docs"])
+# round-14 kernel selection: the smoke must say which scoring kernel
+# the engine resolved to and why (the same fields /debug/vars exports
+# under pipeline.kernel*) — a CPU host degrades pallas->fused with a
+# stated reason rather than silently falling back
+assert d["kernel"] in ("pallas", "pallas-interpret", "fused", "xla",
+                       "lax"), d["kernel"]
+assert d["kernel_reason"], "kernel fallback reason missing"
+print("kernel:", d["kernel"], "|", d["kernel_reason"])
+EOF
+
+echo "== kernel smoke =="
+# round-14 fused scoring kernel (docs/PERF.md): the parity subset must
+# hold bit-identical words under LDT_KERNEL=xla and LDT_KERNEL=pallas
+# (off-TPU the latter resolves to the fused XLA path — same program,
+# stated fallback reason), and two engines built under those modes
+# must answer byte-identically end-to-end
+LDT_KERNEL=xla python3 -m pytest tests/test_kernel_parity.py -q \
+    -k "empty_chunks or s1_clip_boundary or hint_window or each_script"
+LDT_KERNEL=pallas python3 -m pytest tests/test_kernel_parity.py -q \
+    -k "empty_chunks or s1_clip_boundary or hint_window or each_script"
+python3 - <<'EOF'
+import os
+
+texts = [
+    "hello world this is an english sentence about detection",
+    "bonjour le monde ceci est une phrase en francais",
+    "das ist ein deutscher satz uber die erkennung von sprachen",
+    "", "a",
+    "это русское предложение о языках и обнаружении",
+    "これは日本語の文章ですよろしくお願いします",
+] * 8
+
+
+def answers(mode):
+    os.environ["LDT_KERNEL"] = mode
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    eng = NgramBatchEngine()
+    stats = eng.pipeline_stats()
+    assert stats["kernel_requested"] == mode, stats
+    assert stats["kernel_reason"], stats
+    out = [(r.summary_lang, tuple(r.language3), tuple(r.percent3),
+            tuple(r.normalized_score3), r.is_reliable)
+           for r in eng.detect_batch(texts)]
+    return out, stats
+
+
+a, sa = answers("xla")
+b, sb = answers("pallas")
+assert a == b, "LDT_KERNEL=xla and =pallas engines disagree"
+assert sa["kernel"] == "xla", sa
+# CPU host: pallas degrades to the fused program with a stated reason
+assert sb["kernel"] in ("pallas", "fused"), sb
+os.environ.pop("LDT_KERNEL", None)
+print("kernel smoke:", len(texts), "docs byte-identical across modes;",
+      "xla ->", sa["kernel"], "| pallas ->", sb["kernel"],
+      f"({sb['kernel_reason']})")
 EOF
 
 echo "== telemetry smoke =="

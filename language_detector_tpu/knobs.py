@@ -279,6 +279,24 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Failover budget per batch: how many lane attempts (initial + "
        "re-dispatches) before the error surfaces to the batch's "
        "futures."),
+    # -- scoring kernel (ops/kernels.py) ------------------------------
+    _k("LDT_KERNEL", "str", "auto",
+       "Scoring-kernel selection for the engine's device program: "
+       "'pallas' (fused Pallas kernel — decode + tote + whack + top-2 "
+       "+ reliability in one tiled program; TPU only, degrades to the "
+       "fused XLA program elsewhere), 'fused' (the kernel's pure-XLA "
+       "fallback: single vectorized reduction with quantized u8/i16 "
+       "operands), 'xla' (the reference XLA program, ops/score.py), "
+       "'lax' (jax.lax.scan reference path — debugging/parity oracle, "
+       "not a serving mode), 'auto' (default: pallas on TPU, fused "
+       "elsewhere). Every mode is bit-identical "
+       "(tests/test_kernel_parity.py); the resolved mode and fallback "
+       "reason surface in /debug/vars under pipeline."),
+    _k("LDT_KERNEL_INTERPRET", "bool", False,
+       "With LDT_KERNEL=pallas on a non-TPU backend, run the Pallas "
+       "kernel body under the Pallas interpreter instead of degrading "
+       "to the fused XLA program. Orders of magnitude slower than any "
+       "compiled mode — parity tests and kernel debugging only."),
     # -- dispatch pipeline & long-doc lane (models/ngram.py) ----------
     _k("LDT_PIPELINE_DEPTH", "int", 2,
        "Dispatch-pipeline depth: max scheduler jobs in flight on the "

@@ -36,9 +36,9 @@ from ..engine_scalar import (FLAG_BEST_EFFORT, FLAG_FINISH, FLAG_REPEATS,
                              ScalarResult, detect_scalar,
                              result_from_epilogue_row as _result_from_row)
 from ..locks import make_lock
+from ..ops import kernels
 from ..ops.device_tables import DeviceTables
-from ..ops.score import (score_chunks, score_chunks_donated,
-                         unpack_chunks_out)
+from ..ops.score import unpack_chunks_out
 from ..registry import Registry, registry as default_registry
 from ..tables import ScoringTables, load_tables
 
@@ -120,14 +120,21 @@ class NgramBatchEngine:
                 pass
         self.dt = DeviceTables.from_host(self.tables, self.reg)
         self.mesh = mesh
+        # scoring-kernel selection (LDT_KERNEL, ops/kernels.py): the
+        # fused Pallas kernel on TPU, its quantized fused XLA fallback
+        # elsewhere, or the explicit reference programs — all
+        # bit-identical, resolved once per engine and surfaced in
+        # /debug/vars (pipeline.kernel / pipeline.kernel_reason)
+        self._kernel = kernels.select_kernel()
         if mesh is not None:
             from ..parallel.mesh import BATCH_AXIS, sharded_score_chunks_fn
             self._score_fn = sharded_score_chunks_fn(mesh)
+            self._kernel = kernels.mesh_selection(self._kernel)
             # wire shards over the batch axis only; any extra mesh axes
             # (e.g. a vestigial "model" axis) replicate
             self._mesh_size = mesh.shape[BATCH_AXIS]
         else:
-            self._score_fn = score_chunks
+            self._score_fn = self._kernel.score
             self._mesh_size = 1
         # fault-tolerant dispatch pool (parallel/pool.py): built only
         # when LDT_POOL_LANES is set; None = the direct single-lane
@@ -215,11 +222,13 @@ class NgramBatchEngine:
         # the non-donating scorer so the serial path stays the exact
         # pre-pipeline program
         self._donate = (self.pipeline_depth > 1 and
-                        self._score_fn is score_chunks)
+                        self._score_fn is self._kernel.score)
         if self._donate:
             import warnings
             # CPU backends warn that buffer donation is unimplemented
-            # and fall back to copying — expected on the simulator
+            # and fall back to copying — expected on the simulator.
+            # Matched by message, not module, so it covers every
+            # donated kernel variant (xla/fused/lax/pallas fallback)
             warnings.filterwarnings(
                 "ignore",
                 message="Some donated buffers were not usable")
@@ -260,6 +269,9 @@ class NgramBatchEngine:
         total = p["pack_ms_total"]
         return {
             "depth": self.pipeline_depth,
+            "kernel": self._kernel.mode,
+            "kernel_requested": self._kernel.requested,
+            "kernel_reason": self._kernel.reason,
             "overlap_ratio":
                 round(p["pack_ms_overlapped"] / total, 4) if total
                 else 0.0,
@@ -289,12 +301,12 @@ class NgramBatchEngine:
         compiles instead of hiding behind another lane's warm mark."""
         if score_fn is None:
             score_fn = self._score_fn
-        if self._donate and score_fn is score_chunks:
+        if self._donate and score_fn is self._kernel.score:
             # pipelined depth: donate the wire into the scorer so the
-            # device reuses the transferred buffers (ops/score.py); the
-            # host staging arrays are safe to reuse once the call
+            # device reuses the transferred buffers (ops/kernels.py);
+            # the host staging arrays are safe to reuse once the call
             # returns — jax copies numpy inputs synchronously
-            score_fn = score_chunks_donated
+            score_fn = self._kernel.donated
             with self._pipe_lock:
                 self._pipe["donation_hits"] += 1
             telemetry.REGISTRY.counter_inc(
@@ -447,7 +459,7 @@ class NgramBatchEngine:
         fallback / gate retry). Low-volume API path: no pipelining."""
         from .. import native
         from ..ops.device_tables import host_tables
-        from ..ops.score import score_chunks_full, unpack_chunks_out2
+        from ..ops.score import unpack_chunks_out2
         from ..result_vector import build_doc_records, chunks_for_doc
         out: list = []
         for chunk in self._slices(texts, 16384):
@@ -455,7 +467,7 @@ class NgramBatchEngine:
                 chunk, self.tables, self.reg, flags=self.flags,
                 l_doc=self.max_slots, c_doc=self.max_chunks,
                 want_ranges=True)
-            full = np.asarray(score_chunks_full(self.dt, cb.wire))
+            full = np.asarray(self._kernel.full(self.dt, cb.wire))
             rows = unpack_chunks_out(full[..., 0], cb.wire["cmeta"])
             rows2 = unpack_chunks_out2(full[..., 1])
             cnsl2 = cb.wire["cnsl"].astype(np.int64)

@@ -73,6 +73,11 @@ class HostTables:
 _host_tables_cache: list = []  # [(tables, reg, HostTables)] single slot
 
 
+def _pad128(n: int) -> int:
+    """Round a table row count up to the SIMD width (128 lanes)."""
+    return -(-n // 128) * 128
+
+
 def host_tables(t: ScoringTables, reg: Registry) -> HostTables:
     if _host_tables_cache and _host_tables_cache[0][0] is t \
             and _host_tables_cache[0][1] is reg:
@@ -151,6 +156,17 @@ class DeviceTables:
     kind_tbl: KindTables
     lg_prob3: jnp.ndarray          # [240, 3] uint8: 3-entry qprob decode
     expected_score: jnp.ndarray    # [614, 4] int32
+    # quantized/padded companions for the fused kernels (ops/kernels.py):
+    # SIMD-width (128-lane) padded so gathers vectorize without clips.
+    # lg_prob3_pad rows >= 240 REPLICATE the last real row — XLA clamps
+    # out-of-range gather indices, so padding with the clamp row keeps
+    # the padded decode bit-identical to the clipped one. close_set_pad
+    # / expected_score_pad pad with zeros: language ids come from
+    # plang_to_lang and are in-range by construction, the pad rows only
+    # square up the tile.
+    lg_prob3_pad: jnp.ndarray      # [256, 3] uint8
+    expected_score_pad: jnp.ndarray  # [640, 4] int32
+    close_set_pad: jnp.ndarray     # [640] int32
     plang_to_lang: jnp.ndarray     # [2, 256] int32 (latn, othr)
     lang_rtype_default: jnp.ndarray  # [102, 2] int32 (rtype, default lang)
     close_set: jnp.ndarray         # [614] int32 close-set id
@@ -185,6 +201,16 @@ class DeviceTables:
             figs[reg.code_to_lang[code]] = True
         rd = np.stack([reg.ulscript_rtype.astype(np.int32),
                        reg.ulscript_default_lang.astype(np.int32)], axis=1)
+
+        lg3 = np.asarray(t.lg_prob[:, 5:8], dtype=np.uint8)
+        lg3_pad = np.empty((256, 3), np.uint8)
+        lg3_pad[:len(lg3)] = lg3
+        lg3_pad[len(lg3):] = lg3[-1]               # the clamp row
+        exp = t.avg_delta_octa_score.astype(np.int32)
+        exp_pad = np.zeros((_pad128(exp.shape[0]), 4), np.int32)
+        exp_pad[:exp.shape[0]] = exp
+        close_pad = np.zeros(exp_pad.shape[0], np.int32)
+        close_pad[:len(close)] = close
         return cls(
             cat_buckets=jnp.asarray(cat_buckets),
             cat_ind=jnp.asarray(cat_ind),
@@ -193,6 +219,9 @@ class DeviceTables:
             lg_prob3=jnp.asarray(t.lg_prob[:, 5:8]),
             expected_score=jnp.asarray(
                 t.avg_delta_octa_score.astype(np.int32)),
+            lg_prob3_pad=jnp.asarray(lg3_pad),
+            expected_score_pad=jnp.asarray(exp_pad),
+            close_set_pad=jnp.asarray(close_pad),
             plang_to_lang=jnp.asarray(np.stack([
                 reg.plang_to_lang_latn.astype(np.int32),
                 reg.plang_to_lang_othr.astype(np.int32)])),
@@ -211,8 +240,21 @@ def _validate_qprobs(t: ScoringTables, cat_ind: np.ndarray) -> None:
     'Tote group in use' == 'some language in the group scored > 0'
     (ops/score.py stage 8). Holds for the reference tables and by
     construction for trained ones; a table violating it would silently
-    change top-2 tie-breaking, so fail loudly at load."""
+    change top-2 tie-breaking, so fail loudly at load.
+
+    Also enforces the fused kernels' int16 accumulator bound
+    (ops/kernels.py): a chunk tote for one language is at most
+    K(256) slots x 3 planes x qprob_max, which must stay below 2^15
+    for the quantized i16 accumulation to be lossless. Reference
+    tables sit at qprob_max = 12 (tote <= 9216); anything up to 42 is
+    safe, beyond that the quantized paths would silently wrap."""
     lg3 = np.asarray(t.lg_prob[:, 5:8])
+    qmax = int(lg3.max()) if lg3.size else 0
+    if 256 * 3 * qmax > 0x7FFF:
+        raise ValueError(
+            f"table qprob_max={qmax} breaks the fused kernels' int16 "
+            f"tote bound (256 slots x 3 planes x qprob must stay "
+            f"< 32768); retrain or rescale lg_prob")
     lps = np.unique(cat_ind)
     rows = lps & 0xFF
     ok_rows = rows < len(lg3)

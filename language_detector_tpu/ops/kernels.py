@@ -1,0 +1,424 @@
+"""Fused scoring kernels: the chunk grid in one pass, no per-ngram HBM.
+
+Round 14 (ROADMAP item 2). ops/score.py lowers the scorer through
+generic XLA ops — three one-hot reduce passes over [G, K, 256] int32
+with every intermediate eligible for an HBM round trip on a real
+backend. This module provides the fused alternatives behind one knob:
+
+  LDT_KERNEL=pallas   the Pallas kernel: langprob gather + 3-way qprob
+                      decode + chunk tote + whack mask + group-in-use
+                      top-2 + reliability as ONE tiled program over
+                      chunk rows (grid over G; the K slot axis and the
+                      256-language tote live in VMEM/registers). TPU
+                      only — a non-TPU backend has no Mosaic lowering,
+                      so the request degrades to the fused XLA program
+                      below (interpret mode is available for parity
+                      tests via LDT_KERNEL_INTERPRET).
+  LDT_KERNEL=fused    the kernel's pure-XLA fallback: the same fused
+                      math as a single vectorized reduction over the
+                      combined [G, 3K] plane with quantized operands
+                      (u8 compares, i16 accumulation, padded tables
+                      from ops/device_tables.py) — byte-identical to
+                      the reference program, ~1.5-2x faster on CPU.
+  LDT_KERNEL=xla      the reference XLA program (ops/score.py),
+                      unchanged — the conservative escape hatch.
+  LDT_KERNEL=lax      a jax.lax.scan reference path: one slot column
+                      per step, nothing wider than [G, 256] live.
+                      Debugging/parity oracle, not a serving mode.
+  LDT_KERNEL=auto     pallas on TPU, fused elsewhere (the default).
+
+Every mode is bit-identical to ops/score.py and to the scalar engine
+(tests/test_kernel_parity.py fuzzes adversarial grids; the
+batch-agreement suite pins end-to-end equality). Exactness of the
+quantized accumulators is an invariant, not luck: chunk totes are
+bounded by K(256) x 3 planes x qprob_max, and DeviceTables.from_host
+rejects tables whose qprob_max would let an int16 tote overflow
+(_validate_qprobs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from .. import knobs
+from .device_tables import DeviceTables
+from .score import (HINT_BASE, _chunk_out_word, _decode3, _lscript4,
+                    _reliability_delta, _reliability_expected,
+                    score_chunks, score_chunks_donated,
+                    score_chunks_full)
+
+_log = logging.getLogger(__name__)
+
+try:  # gate, don't require: CPU wheels without Pallas still serve
+    from jax.experimental import pallas as pl
+    _HAVE_PALLAS = True
+except Exception:  # noqa: BLE001 - any import failure means "no pallas"
+    pl = None
+    _HAVE_PALLAS = False
+
+# Pallas tile: chunk rows per program instance. 8 sublanes x 128 lanes
+# is the f32/i32 min tile; the kernel's widest live value is the
+# [TILE_G, 3K, 256] one-hot select (8 x 768 x 256 i16 = 3MB at K=256)
+# plus the [TILE_G, 256] tote — comfortably inside a 16MB VMEM budget
+# (docs/PERF.md round 14 carries the full math).
+TILE_G = 8
+
+
+def _gather_wire(dt: DeviceTables, p: dict):
+    """Shared wire prologue: the idx -> langprob gather and chunk-meta
+    decode, line-for-line the same math as score_chunks_impl
+    (ops/score.py) so every kernel mode scores the identical [G, K]
+    langprob grid. Returns (lp, cbytes, grams, side, real, script,
+    wmask-or-None); lp is zero outside each chunk's slot count."""
+    idxf = p["idx"].reshape(-1)
+    N = idxf.shape[0]
+    cnsl2 = p["cnsl"].astype(jnp.int32)            # [D, Gs]
+    cstart = (jnp.cumsum(cnsl2, axis=-1) - cnsl2).reshape(-1)
+    cnsl = cnsl2.reshape(-1)
+    cmeta = p["cmeta"].reshape(-1).astype(jnp.uint32)
+    K = p["k_iota"].shape[0]
+
+    ki = jnp.arange(K, dtype=jnp.int32)
+    valid = ki[None, :] < cnsl[:, None]
+    gidx = jnp.clip(cstart[:, None] + ki[None, :], 0, N - 1)
+    raw = idxf[gidx].astype(jnp.int32)
+    hint_lp = p["hint_lp"]
+    H = hint_lp.shape[0]
+    lp_tbl = dt.cat_ind2[jnp.clip(raw, 0, dt.cat_ind2.shape[0] - 1)]
+    lp_hint = hint_lp[jnp.clip(raw - HINT_BASE, 0, H - 1)]
+    lp = jnp.where(valid,
+                   jnp.where(raw >= HINT_BASE, lp_hint, lp_tbl), 0)
+
+    cbytes = (cmeta & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    grams = ((cmeta >> 16) & jnp.uint32(0xFFF)).astype(jnp.int32)
+    side = ((cmeta >> 28) & jnp.uint32(1)).astype(jnp.int32)
+    real = ((cmeta >> 29) & jnp.uint32(1)).astype(jnp.int32)
+    script = p["cscript"].reshape(-1).astype(jnp.int32)
+
+    if p["cwhack"].shape[-1] == 1:
+        wmask = None  # hint-free batch: the whack gather drops out
+    else:
+        cwhack = p["cwhack"].reshape(-1).astype(jnp.int32)
+        wmask = p["whack_tbl"][jnp.clip(cwhack, 0,
+                                        p["whack_tbl"].shape[0] - 1),
+                               side]
+    return lp, cbytes, grams, side, real, script, wmask
+
+
+# ---------------------------------------------------------------------------
+# Fused XLA path: the Pallas kernel's portable fallback.
+#
+# One reduction instead of three: the 3 pslang planes concatenate into
+# a single [G, 3K] plane, the one-hot compare runs on u8 (pslangs and
+# the lane iota both fit a byte), the select/accumulate runs on int16
+# (totes bounded < 2^15, enforced at table load), and the qprob decode
+# gathers from the 128-lane-padded lg_prob3_pad — no clip, rows >= 240
+# replicate the clamp row so out-of-range decodes match XLA's clamped
+# gather bit-for-bit.
+# ---------------------------------------------------------------------------
+
+
+def score_chunks_fused_impl(dt: DeviceTables, p: dict,
+                            full_out: bool = False):
+    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    G = lp.shape[0]
+    K = lp.shape[1]
+
+    lpu = lp.astype(jnp.uint32)
+    ps = jnp.stack([(lpu >> 8) & 0xFF, (lpu >> 16) & 0xFF,
+                    (lpu >> 24) & 0xFF], axis=-1).astype(jnp.uint8)
+    row = (lpu & 0xFF).astype(jnp.int32)
+    q = dt.lg_prob3_pad[row]                       # [G, K, 3] u8
+    contrib = jnp.where(ps > 0, q, 0)              # u8: qprob or nothing
+
+    psf = ps.reshape(G, 3 * K)
+    contribf = contrib.reshape(G, 3 * K).astype(jnp.int16)
+    iota256 = jnp.arange(256, dtype=jnp.uint8)
+    sel = jnp.where(psf[..., None] == iota256, contribf[..., None],
+                    jnp.int16(0))
+    scores = jnp.sum(sel, axis=1, dtype=jnp.int16).astype(jnp.int32)
+
+    if wmask is None:
+        whacked = scores
+    else:
+        whacked = jnp.where(wmask > 0, 0, scores)
+    return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
+                           script, group_scores=scores,
+                           full_out=full_out)
+
+
+score_chunks_fused = jax.jit(score_chunks_fused_impl)
+score_chunks_fused_full = jax.jit(
+    lambda dt, p: score_chunks_fused_impl(dt, p, full_out=True))
+# donated variant: same wire-donation contract as score_chunks_donated
+# (ops/score.py) — host numpy inputs copy synchronously, the staging
+# ring reuses its arrays once the launch returns
+score_chunks_fused_donated = jax.jit(score_chunks_fused_impl,
+                                     donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# lax reference path: one slot column per scan step. Nothing wider
+# than [G, 256] is ever live, which makes it the memory-floor oracle
+# the parity fuzz compares the wide paths against.
+# ---------------------------------------------------------------------------
+
+
+def score_chunks_lax_impl(dt: DeviceTables, p: dict,
+                          full_out: bool = False):
+    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    G = lp.shape[0]
+    iota256 = jnp.arange(256, dtype=jnp.int32)
+
+    def _tote_column(scores, lp_col):
+        ps, row = _decode3(lp_col)                 # [G, 3]
+        q = dt.lg_prob3[row].astype(jnp.int32)
+        for j in range(3):
+            contrib = jnp.where(ps[:, j] > 0, q[:, j], 0)
+            scores = scores + jnp.where(ps[:, j, None] == iota256,
+                                        contrib[:, None], 0)
+        return scores, None
+
+    scores, _ = jax.lax.scan(_tote_column,
+                             jnp.zeros((G, 256), jnp.int32), lp.T)
+    if wmask is None:
+        whacked = scores
+    else:
+        whacked = jnp.where(wmask > 0, 0, scores)
+    return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
+                           script, group_scores=scores,
+                           full_out=full_out)
+
+
+score_chunks_lax = jax.jit(score_chunks_lax_impl)
+score_chunks_lax_full = jax.jit(
+    lambda dt, p: score_chunks_lax_impl(dt, p, full_out=True))
+score_chunks_lax_donated = jax.jit(score_chunks_lax_impl,
+                                   donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: decode + tote + whack + top-2 + reliability fused in
+# one tiled program. The grid runs over chunk-row tiles of TILE_G; each
+# program instance holds its [TILE_G, K] langprob block, the small
+# quantized tables, and the [TILE_G, 256] tote entirely in VMEM, and
+# writes both packed output words — no intermediate tensor ever reaches
+# HBM. The idx -> langprob gather stays in XLA (two gathers over the
+# few-MB cat_ind2; a table that size is HBM-resident either way), so
+# the kernel's inputs are dense blocks with trivial index maps.
+# ---------------------------------------------------------------------------
+
+
+def _fused_tote_kernel(lp_ref, meta_ref, script_ref, wmask_ref,
+                       lg3_ref, exp_ref, p2l_ref, close_ref, out_ref):
+    """One [TILE_G, K] tile: tote + whack + top-2 + reliability."""
+    lp = lp_ref[...].astype(jnp.uint32)            # [TG, K]
+    tg = lp.shape[0]
+    ps = jnp.stack([(lp >> 8) & 0xFF, (lp >> 16) & 0xFF,
+                    (lp >> 24) & 0xFF], axis=-1).astype(jnp.uint8)
+    row = (lp & 0xFF).astype(jnp.int32)
+    q = jnp.take(lg3_ref[...], row.reshape(-1), axis=0) \
+        .reshape(ps.shape)                         # [TG, K, 3] u8
+    contrib = jnp.where(ps > 0, q, 0)
+
+    psf = ps.reshape(tg, -1)
+    contribf = contrib.reshape(tg, -1).astype(jnp.int16)
+    iota256 = jax.lax.broadcasted_iota(jnp.uint8, (1, 1, 256), 2)
+    sel = jnp.where(psf[..., None] == iota256, contribf[..., None],
+                    jnp.int16(0))
+    group_scores = jnp.sum(sel, axis=1,
+                           dtype=jnp.int16).astype(jnp.int32)
+    wmask = wmask_ref[...]
+    scores = jnp.where(wmask > 0, 0, group_scores)
+
+    # group-in-use top-2 (tote.cc semantics; see _chunk_out_word)
+    groups = jnp.any((group_scores > 0).reshape(tg, 64, 4), axis=-1)
+    slot_in_use = jnp.repeat(groups, 4, axis=-1)
+    iota_i = jax.lax.broadcasted_iota(jnp.int32, (tg, 256), 1)
+    sortkey = jnp.where(slot_in_use, scores * 256 + (255 - iota_i), -1)
+    k1 = jnp.argmax(sortkey, axis=-1)
+    top1 = jnp.take_along_axis(sortkey, k1[:, None], axis=-1)[:, 0]
+    sortkey2 = jnp.where(iota_i == k1[:, None], -1, sortkey)
+    k2 = jnp.argmax(sortkey2, axis=-1)
+    top2 = jnp.take_along_axis(sortkey2, k2[:, None], axis=-1)[:, 0]
+    s1 = jnp.where(top1 >= 0, top1 >> 8, 0)
+    s2 = jnp.where(top2 >= 0, top2 >> 8, 0)
+    k1 = jnp.where(top1 >= 0, k1, 0)
+    k2 = jnp.where(top2 >= 0, k2, 0)
+
+    meta = meta_ref[...]                           # [TG, 4] i32
+    cbytes, grams = meta[:, 0], meta[:, 1]
+    side, real = meta[:, 2], meta[:, 3]
+    script = script_ref[...][:, 0]
+
+    p2l = p2l_ref[...]
+    lang1 = p2l[side, k1]
+    lang2 = p2l[side, k2]
+    actual_kb = jnp.where(cbytes > 0,
+                          (s1 << 10) // jnp.maximum(cbytes, 1), 0)
+    expected_kb = exp_ref[...][lang1, _lscript4(script)]
+    rd = _reliability_delta(s1, s2, grams)
+    close = close_ref[...][:, 0]
+    same_set = (close[lang1] != 0) & (close[lang1] == close[lang2])
+    rd = jnp.where(same_set, 100, rd)
+    rs = _reliability_expected(actual_kb, expected_kb)
+    crel = jnp.minimum(rd, rs)
+
+    word1 = (lang1.astype(jnp.uint32) |
+             (jnp.clip(s1, 0, 0x3FFF).astype(jnp.uint32) << 10) |
+             (jnp.clip(crel, 0, 127).astype(jnp.uint32) << 24) |
+             (real.astype(jnp.uint32) << 31))
+    word2 = (lang2.astype(jnp.uint32) |
+             (jnp.clip(rd, 0, 127).astype(jnp.uint32) << 10) |
+             (jnp.clip(rs, 0, 127).astype(jnp.uint32) << 17))
+    out_ref[...] = jnp.stack([word1, word2], axis=-1)
+
+
+def _pallas_score_impl(dt: DeviceTables, p: dict, interpret: bool,
+                       full_out: bool = False):
+    """XLA prologue (gather) + the fused Pallas grid + output slice."""
+    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    G = lp.shape[0]
+    K = lp.shape[1]
+    if wmask is None:
+        # the kernel body is branch-free: an all-zero mask whacks
+        # nothing, matching the dropped gather exactly
+        wmask = jnp.zeros((G, 256), jnp.uint8)
+    meta = jnp.stack([cbytes, grams, side, real], axis=-1)  # [G, 4]
+    gp = max(TILE_G, -(-G // TILE_G) * TILE_G)
+    pad = gp - G
+    lp = jnp.pad(lp, ((0, pad), (0, 0)))
+    meta = jnp.pad(meta, ((0, pad), (0, 0)))
+    script2 = jnp.pad(script[:, None], ((0, pad), (0, 0)))
+    wmask = jnp.pad(wmask, ((0, pad), (0, 0)))
+
+    n_exp = dt.expected_score_pad.shape[0]
+    out = pl.pallas_call(
+        _fused_tote_kernel,
+        grid=(gp // TILE_G,),
+        in_specs=[
+            pl.BlockSpec((TILE_G, K), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_G, 4), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_G, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_G, 256), lambda i: (i, 0)),
+            pl.BlockSpec((256, 3), lambda i: (0, 0)),
+            pl.BlockSpec((n_exp, 4), lambda i: (0, 0)),
+            pl.BlockSpec((2, 256), lambda i: (0, 0)),
+            pl.BlockSpec((n_exp, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_G, 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((gp, 2), jnp.uint32),
+        interpret=interpret,
+    )(lp, meta, script2, wmask, dt.lg_prob3_pad,
+      dt.expected_score_pad, dt.plang_to_lang,
+      dt.close_set_pad[:, None])
+    word = out[:G]
+    if not full_out:
+        return word[:, 0]
+    return word
+
+
+_pallas_fns_cache: dict = {}
+
+
+def _pallas_score_fns(interpret: bool):
+    """(score, donated, full) jits for one interpret setting; cached so
+    repeated engine constructions reuse the XLA jit cache."""
+    if interpret not in _pallas_fns_cache:
+        def score_impl(dt, p):
+            return _pallas_score_impl(dt, p, interpret)
+
+        def score_full_impl(dt, p):
+            return _pallas_score_impl(dt, p, interpret, full_out=True)
+
+        _pallas_fns_cache[interpret] = (
+            jax.jit(score_impl),
+            jax.jit(score_impl, donate_argnums=(1,)),
+            jax.jit(score_full_impl),
+        )
+    return _pallas_fns_cache[interpret]
+
+
+# ---------------------------------------------------------------------------
+# Selection
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSelection:
+    """Resolved scoring-kernel choice: the three jitted entry points the
+    engine wires through _launch, plus what was asked for and why the
+    resolution differs (surfaced in /debug/vars under pipeline)."""
+    mode: str          # resolved: pallas | pallas-interpret | fused |
+    #                    xla | lax
+    requested: str     # the LDT_KERNEL value (or "auto")
+    reason: str        # selection / fallback explanation
+    score: object      # jit(dt, wire) -> [G] u32
+    donated: object    # same, wire donated (pipeline depth > 1)
+    full: object       # jit(dt, wire) -> [G, 2] u32
+
+
+_MODE_FNS = {
+    "xla": (score_chunks, score_chunks_donated, score_chunks_full),
+    "fused": (score_chunks_fused, score_chunks_fused_donated,
+              score_chunks_fused_full),
+    "lax": (score_chunks_lax, score_chunks_lax_donated,
+            score_chunks_lax_full),
+}
+
+_KNOWN = ("auto", "pallas", "fused", "xla", "lax")
+
+
+def select_kernel(backend: str | None = None) -> KernelSelection:
+    """Resolve LDT_KERNEL against the live backend. Never raises: an
+    unknown value logs loudly and behaves like auto (the knob contract),
+    and a pallas request off-TPU degrades to the fused XLA program with
+    the reason recorded rather than failing the engine."""
+    requested = (knobs.get_str("LDT_KERNEL") or "auto").lower()
+    if requested not in _KNOWN:
+        _log.warning("LDT_KERNEL=%r is not one of %s; using auto",
+                     requested, "|".join(_KNOWN))
+        requested = "auto"
+    if backend is None:
+        backend = jax.default_backend()
+
+    if requested in ("auto", "pallas"):
+        if backend == "tpu" and _HAVE_PALLAS:
+            score, donated, full = _pallas_score_fns(False)
+            return KernelSelection(
+                "pallas", requested, f"{backend} backend: fused Pallas "
+                "kernel (Mosaic)", score, donated, full)
+        if requested == "pallas" and _HAVE_PALLAS and \
+                knobs.get_bool("LDT_KERNEL_INTERPRET"):
+            score, donated, full = _pallas_score_fns(True)
+            return KernelSelection(
+                "pallas-interpret", requested,
+                f"{backend} backend + LDT_KERNEL_INTERPRET: Pallas "
+                "kernel body under the interpreter (parity/debug "
+                "only)", score, donated, full)
+        why = ("no Pallas in this jax install"
+               if not _HAVE_PALLAS else
+               f"{backend} backend has no Mosaic lowering")
+        score, donated, full = _MODE_FNS["fused"]
+        return KernelSelection(
+            "fused", requested,
+            f"{why}; quantized fused XLA fallback", score, donated,
+            full)
+
+    score, donated, full = _MODE_FNS[requested]
+    return KernelSelection(requested, requested,
+                           f"explicit LDT_KERNEL={requested}",
+                           score, donated, full)
+
+
+def mesh_selection(base: KernelSelection) -> KernelSelection:
+    """The sharded engine keeps its shard_map program for the main
+    scorer (LDT_KERNEL governs the single-lane paths: the result-vector
+    full-output dispatch still follows the knob)."""
+    return dataclasses.replace(
+        base, mode="xla",
+        reason="mesh engine: shard_map program scores the main lane "
+               f"(single-lane paths keep {base.mode})")

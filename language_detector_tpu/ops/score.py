@@ -218,12 +218,17 @@ def score_chunks_impl(dt: DeviceTables, p: dict, full_out: bool = False):
     ps, row = _decode3(lp)                                     # [G, K, 3]
     q = dt.lg_prob3[row].astype(jnp.int32)
     iota256 = jnp.arange(256, dtype=jnp.int32)
-    scores = jnp.zeros((G, 256), jnp.int32)
-    for j in range(3):
-        contrib = jnp.where(valid & (ps[..., j] > 0), q[..., j], 0)
-        scores = scores + jnp.sum(
-            jnp.where(ps[..., j, None] == iota256, contrib[..., None], 0),
-            axis=1)
+    # single vectorized reduction: the 3 pslang planes fold into one
+    # [G, 3K] plane so XLA emits one fused compare+select+reduce pass
+    # instead of three (integer adds commute, so this is bit-identical
+    # to the per-plane loop it replaced; ops/kernels.py quantizes the
+    # same shape further)
+    contrib = jnp.where(valid[..., None] & (ps > 0), q, 0)
+    psf = ps.reshape(G, -1)
+    contribf = contrib.reshape(G, -1)
+    scores = jnp.sum(
+        jnp.where(psf[..., None] == iota256, contribf[..., None], 0),
+        axis=1)
 
     cbytes = (cmeta & jnp.uint32(0xFFFF)).astype(jnp.int32)
     grams = ((cmeta >> CM2_GRAMS_SHIFT) & jnp.uint32(0xFFF)) \

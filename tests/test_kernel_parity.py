@@ -1,0 +1,282 @@
+"""Kernel-mode parity fuzz: every LDT_KERNEL path is bit-identical.
+
+ops/kernels.py ships four device programs for the same math — the
+reference XLA scorer (ops/score.py), the quantized fused XLA program,
+the lax.scan memory-floor oracle, and the Pallas kernel (exercised here
+under the interpreter; the Mosaic lowering runs the identical kernel
+body on TPU). The contract is BIT-identity of the packed output words,
+not approximate agreement, so the tests compare raw u32 outputs over
+adversarial synthetic grids the native packer would rarely emit: empty
+chunks, fully fat K=256 rows, hint-window slots at and above HINT_BASE,
+whack tables present and absent, every ULScript branch of _lscript4,
+decode rows at and past the 240-row clamp, and chunk totes pushed over
+the s1 = 0x3FFF clip (via a doctored qprob table — real tables cannot
+reach the clip, which is exactly why the boundary needs a fuzz).
+
+Engine-level closure: an engine constructed under each LDT_KERNEL value
+answers identically to the scalar oracle on real text.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from language_detector_tpu.ops import kernels
+from language_detector_tpu.ops.score import (HINT_BASE, score_chunks,
+                                             score_chunks_full)
+
+H_WINDOW = 64          # hint_lp window size for synthetic wires
+N_WHACK = 5            # whack table rows
+
+
+@pytest.fixture(scope="module")
+def eng():
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine()
+
+
+def _langprob(rng, n, hi_rows=True):
+    """Random langprob u32s: row byte spans the FULL 0..255 range when
+    hi_rows (rows >= 240 exercise the clamp-row replication in
+    lg_prob3_pad vs XLA's clipped gather), pslangs over 0..255 with a
+    bias toward 0 (the 'no language' plane terminator)."""
+    row = rng.integers(0, 256 if hi_rows else 240, n, dtype=np.uint32)
+    ps = rng.integers(0, 256, (n, 3), dtype=np.uint32)
+    ps[rng.random((n, 3)) < 0.3] = 0
+    return (row | (ps[:, 0] << 8) | (ps[:, 1] << 16)
+            | (ps[:, 2] << 24)).astype(np.uint32)
+
+
+def _wire(rng, G, K, hint_frac=0.0, whack=True, empty_frac=0.0,
+          scripts=(1, 3, 6, 9), cat_n=4096):
+    """Synthetic chunk-major flat wire (the pack_chunks_native layout)."""
+    cnsl = rng.integers(0, min(K, 255) + 1, G).astype(np.int64)
+    if empty_frac:
+        cnsl[rng.random(G) < empty_frac] = 0
+    N = max(1, int(cnsl.sum()))
+    idx = rng.integers(0, cat_n, N).astype(np.uint16)
+    if hint_frac:
+        hints = rng.random(N) < hint_frac
+        idx[hints] = (HINT_BASE
+                      + rng.integers(0, H_WINDOW, int(hints.sum()))
+                      ).astype(np.uint16)
+    cbytes = rng.integers(0, 1500, G).astype(np.uint32)
+    grams = rng.integers(0, 600, G).astype(np.uint32)
+    side = rng.integers(0, 2, G).astype(np.uint32)
+    real = rng.integers(0, 2, G).astype(np.uint32)
+    cmeta = (cbytes | (grams << 16) | (side << 28)
+             | (real << 29)).astype(np.uint32)
+    if whack:
+        cwhack = rng.integers(0, N_WHACK, G).astype(np.uint16)
+    else:
+        cwhack = np.zeros(1, np.uint16)     # the dropped-gather dummy
+    return {
+        "idx": idx,
+        "cnsl": cnsl.astype(np.uint8).reshape(1, G),
+        "cmeta": cmeta,
+        "cscript": rng.choice(np.array(scripts, np.uint8), G),
+        "cwhack": cwhack,
+        "hint_lp": _langprob(rng, H_WINDOW),
+        "whack_tbl": (rng.random((N_WHACK, 2, 256)) < 0.1
+                      ).astype(np.uint8),
+        "k_iota": np.arange(K, dtype=np.uint8),
+    }
+
+
+def _assert_all_modes_equal(dt, wire, interpret_pallas=True):
+    """word1 AND the full [G, 2] output byte-identical across modes."""
+    ref = np.asarray(score_chunks(dt, wire))
+    reff = np.asarray(score_chunks_full(dt, wire))
+    assert np.array_equal(reff[:, 0], ref)      # full embeds word1
+    modes = {
+        "fused": (kernels.score_chunks_fused,
+                  kernels.score_chunks_fused_full),
+        "lax": (kernels.score_chunks_lax,
+                kernels.score_chunks_lax_full),
+    }
+    if interpret_pallas:
+        ps, _, pf = kernels._pallas_score_fns(interpret=True)
+        modes["pallas-interpret"] = (ps, pf)
+    for name, (score, full) in modes.items():
+        got = np.asarray(score(dt, wire))
+        assert np.array_equal(got, ref), \
+            f"{name} word1 diverges at rows {np.flatnonzero(got != ref)[:8]}"
+        gotf = np.asarray(full(dt, wire))
+        assert np.array_equal(gotf, reff), \
+            f"{name} full output diverges"
+    return ref
+
+
+def test_parity_randomized_grids(eng):
+    """Mixed everything: hints, whacks, empties, all scripts, clamp
+    rows — several seeds, one bucket shape (keeps jit cache warm)."""
+    for seed in range(4):
+        rng = np.random.default_rng(20260805 + seed)
+        wire = _wire(rng, G=24, K=24, hint_frac=0.15, whack=True,
+                     empty_frac=0.1)
+        _assert_all_modes_equal(eng.dt, wire)
+
+
+def test_parity_empty_chunks(eng):
+    """All-empty grid: cnsl = 0 everywhere, idx a single pad slot."""
+    rng = np.random.default_rng(7)
+    wire = _wire(rng, G=16, K=8)
+    wire["cnsl"][:] = 0
+    wire["idx"] = wire["idx"][:1]
+    _assert_all_modes_equal(eng.dt, wire)
+
+
+def test_parity_fat_rows_k256(eng):
+    """The fattest legal rows: K = 256, every chunk at the 255-slot
+    cnsl ceiling (the widest tile the Pallas kernel ever sees)."""
+    rng = np.random.default_rng(11)
+    wire = _wire(rng, G=8, K=256, hint_frac=0.1, whack=True)
+    wire["cnsl"][:] = 255
+    wire["idx"] = rng.integers(0, 4096, 8 * 255).astype(np.uint16)
+    _assert_all_modes_equal(eng.dt, wire)
+
+
+def test_parity_hint_window(eng):
+    """Every slot addresses the hint window (idx >= HINT_BASE),
+    including the window's last element."""
+    rng = np.random.default_rng(13)
+    wire = _wire(rng, G=12, K=16, hint_frac=1.0, whack=False)
+    wire["idx"][0] = HINT_BASE + H_WINDOW - 1
+    _assert_all_modes_equal(eng.dt, wire)
+
+
+def test_parity_whack_absent_dummy(eng):
+    """Hint-free batches ship a 1-wide cwhack dummy: the whack gather
+    must drop out identically in every mode."""
+    rng = np.random.default_rng(17)
+    wire = _wire(rng, G=12, K=16, whack=False)
+    assert wire["cwhack"].shape == (1,)
+    _assert_all_modes_equal(eng.dt, wire)
+
+
+def test_parity_each_script(eng):
+    """One grid per ULScript branch of _lscript4 (Latn=1, Hani=3,
+    Arab=6, other=9): the expected-score column select."""
+    for script in (1, 3, 6, 9):
+        rng = np.random.default_rng(100 + script)
+        wire = _wire(rng, G=12, K=16, scripts=(script,), whack=True)
+        _assert_all_modes_equal(eng.dt, wire)
+
+
+def _doctored_dt(dt):
+    """A qprob table whose rows 100/101 carry qprobs 255/63 — enough to
+    push a chunk tote past the s1 clip (real tables max out at 12 and
+    can never reach it). Bypasses _validate_qprobs deliberately; the
+    i16 bound still holds (the test wires keep hits x 255 < 32767)."""
+    lg3 = np.asarray(dt.lg_prob3).copy()
+    lg3[100] = 255
+    lg3[101] = 63
+    pad = np.empty((256, 3), np.uint8)
+    pad[:len(lg3)] = lg3
+    pad[len(lg3):] = lg3[-1]
+    import jax.numpy as jnp
+    return dataclasses.replace(dt, lg_prob3=jnp.asarray(lg3),
+                               lg_prob3_pad=jnp.asarray(pad))
+
+
+def test_parity_s1_clip_boundary(eng):
+    """Chunk totes straddling s1's 14-bit clip: 25500 (clipped), 16383
+    (exactly 0x3FFF, unclipped), 16320 (under). All modes agree AND the
+    clip really engaged — guarding against a mode that clips early or
+    accumulates in a type that wraps before the clip."""
+    dt = _doctored_dt(eng.dt)
+    lang = 37
+    mk = lambda row, n: np.full(n, row | (lang << 8), np.uint32)  # noqa: E731
+    rows = [np.concatenate([mk(100, 100), np.zeros(28, np.uint32)]),
+            np.concatenate([mk(100, 64), mk(101, 1),
+                            np.zeros(63, np.uint32)]),
+            np.concatenate([mk(100, 64), np.zeros(64, np.uint32)])]
+    hint_lp = np.concatenate(rows)          # 3 x 128 crafted slots
+    G, K = 3, 128
+    wire = {
+        "idx": (HINT_BASE + np.arange(3 * K)).astype(np.uint16),
+        "cnsl": np.full((1, G), K, np.uint8).reshape(1, G),
+        "cmeta": np.full(G, 500 | (100 << 16) | (1 << 29), np.uint32),
+        "cscript": np.full(G, 1, np.uint8),
+        "cwhack": np.zeros(1, np.uint16),
+        "hint_lp": hint_lp,
+        "whack_tbl": np.zeros((1, 2, 256), np.uint8),
+        "k_iota": np.arange(K, dtype=np.uint8),
+    }
+    ref = _assert_all_modes_equal(dt, wire)
+    s1 = (ref >> 10) & 0x3FFF
+    assert list(s1) == [0x3FFF, 0x3FFF, 16320]
+
+
+# -- engine-level closure ----------------------------------------------------
+
+
+def _answers(engine, texts):
+    return [(r.summary_lang, tuple(r.language3), tuple(r.percent3),
+             tuple(r.normalized_score3), r.is_reliable)
+            for r in engine.detect_batch(texts)]
+
+
+def test_engine_modes_match_scalar(eng, monkeypatch):
+    """An engine built under each LDT_KERNEL value answers identically
+    to the scalar oracle; the resolved mode is surfaced in
+    pipeline_stats (the /debug/vars seam ci.sh asserts on)."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    texts = [
+        "hello world this is an english sentence about detection",
+        "bonjour le monde ceci est une phrase en francais",
+        "", "a",
+        "это русское предложение о языках и обнаружении",
+        "これは日本語の文章ですよろしくお願いします",
+    ]
+    want = [(r.summary_lang, tuple(r.language3), tuple(r.percent3),
+             tuple(r.normalized_score3), r.is_reliable)
+            for r in (detect_scalar(t, eng.tables, eng.reg)
+                      for t in texts)]
+    expect_mode = {"xla": "xla", "fused": "fused", "lax": "lax",
+                   "auto": ("pallas", "fused")}
+    for knob, resolved in expect_mode.items():
+        monkeypatch.setenv("LDT_KERNEL", knob)
+        e = NgramBatchEngine()
+        stats = e.pipeline_stats()
+        assert stats["kernel"] in (
+            resolved if isinstance(resolved, tuple) else (resolved,))
+        assert stats["kernel_requested"] == knob
+        assert stats["kernel_reason"]
+        assert _answers(e, texts) == want, f"LDT_KERNEL={knob}"
+
+
+def test_engine_pallas_interpret_matches_scalar(eng, monkeypatch):
+    """LDT_KERNEL=pallas off-TPU degrades to fused by default, and runs
+    the actual kernel body under LDT_KERNEL_INTERPRET=1 — both must
+    still answer like the scalar oracle."""
+    import jax
+
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    monkeypatch.setenv("LDT_KERNEL", "pallas")
+    e = NgramBatchEngine()
+    if jax.default_backend() == "tpu":
+        assert e.pipeline_stats()["kernel"] == "pallas"
+        base = _answers(e, ["hola mundo", "hello there"])
+        assert base == _answers(eng, ["hola mundo", "hello there"])
+        return
+    assert e.pipeline_stats()["kernel"] == "fused"
+    assert "Mosaic" in e.pipeline_stats()["kernel_reason"] or \
+        "no Pallas" in e.pipeline_stats()["kernel_reason"]
+    texts = ["hola mundo como estas hoy", "hello there my old friend"]
+    assert _answers(e, texts) == _answers(eng, texts)
+    if kernels._HAVE_PALLAS:
+        monkeypatch.setenv("LDT_KERNEL_INTERPRET", "1")
+        ei = NgramBatchEngine()
+        assert ei.pipeline_stats()["kernel"] == "pallas-interpret"
+        assert _answers(ei, texts) == _answers(eng, texts)
+
+
+def test_unknown_kernel_value_degrades_to_auto(monkeypatch, caplog):
+    monkeypatch.setenv("LDT_KERNEL", "warp-drive")
+    sel = kernels.select_kernel()
+    assert sel.requested == "auto"
+    assert sel.mode in ("pallas", "fused")

@@ -366,6 +366,38 @@ def test_jit_live_device_path_is_clean():
     assert v == []
 
 
+# -- pallas entry points -----------------------------------------------------
+
+
+def test_pallas_bad_fixture_trips():
+    # the kernel body passed to pl.pallas_call traces under the same
+    # rules as a jit entry, and input_output_aliases keys are donated
+    # positions
+    v, _ = trace_safety.check(root=REPO,
+                              files=[f"{FIX}/pallas_bad.py"])
+    rules = _rules(v)
+    assert rules["trace-host-sync"] == 2        # float(), np.asarray
+    assert rules["trace-python-branch"] == 1    # if v.sum() > 0:
+    assert sum(rules.values()) == 3
+    v, _ = jit_contract.check(root=REPO, files=[f"{FIX}/pallas_bad.py"])
+    rules = _rules(v)
+    assert rules["jit-donated-read"] == 1       # wire after aliased call
+    assert sum(rules.values()) == 1
+
+
+def test_pallas_good_fixture_is_clean():
+    # shape reads, static range loops, jnp.where in the kernel body;
+    # ring-slot reuse only after the aliased call's future resolves
+    v, ns = trace_safety.check(root=REPO,
+                               files=[f"{FIX}/pallas_good.py"])
+    assert v == []
+    assert ns == 0
+    v, ns = jit_contract.check(root=REPO,
+                               files=[f"{FIX}/pallas_good.py"])
+    assert v == []
+    assert ns == 0
+
+
 # -- incremental (--changed) mode --------------------------------------------
 
 

@@ -1,0 +1,24 @@
+"""Fixture: Pallas entry-point violations — host syncs and a Python
+branch inside a pallas_call kernel body, and a read of a buffer that
+was aliased into the outputs via input_output_aliases (the Pallas
+spelling of donation)."""
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _score_kernel(wire_ref, out_ref):
+    v = wire_ref[...]
+    if v.sum() > 0:             # trace-python-branch
+        pass
+    x = float(v[0, 0])          # trace-host-sync (host cast)
+    host = np.asarray(v)        # trace-host-sync (np materialize)
+    out_ref[...] = v + x + host.sum()
+
+
+score_fused = pl.pallas_call(_score_kernel, out_shape=None,
+                             input_output_aliases={0: 0})
+
+
+def launch_then_touch(wire):
+    out = score_fused(wire)     # wire's buffer aliased into `out`
+    return out + wire           # jit-donated-read

@@ -1,0 +1,29 @@
+"""Clean twin of pallas_bad.py: the idioms a Pallas kernel body and
+its launch site are allowed — shape reads, static range loops,
+jnp.where for data-dependent selection, and ring-slot reuse only
+AFTER the aliased call's result future resolves."""
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _score_kernel(wire_ref, lg3_ref, out_ref):
+    v = wire_ref[...]
+    rows = v.astype(jnp.int32)
+    for plane in range(3):      # static iteration count: legal
+        rows = rows + lg3_ref[...][:, plane]
+    if v.shape[0] > 1:          # shape read: trace-static, legal
+        rows = rows * 2
+    out_ref[...] = jnp.where(v > 0, rows, 0)
+
+
+score_fused = pl.pallas_call(_score_kernel, out_shape=None,
+                             input_output_aliases={0: 0})
+
+
+def fetch_then_reuse(wire, ring):
+    fut = score_fused(wire)
+    rows = np.asarray(fut)      # resolution settles the dispatch
+    meta = wire.sum()           # legal: ring-slot reuse after resolve
+    ring.release(wire)
+    return rows, meta

@@ -48,9 +48,25 @@ _BUILTINS = frozenset(dir(builtins))
 
 
 def _donated_positions(call: ast.Call):
-    """donate_argnums positions of a jit(...) call, or None."""
+    """Donated input positions of a jit(...) call (donate_argnums) or
+    a pl.pallas_call(...) (input_output_aliases keys — an aliased
+    input's buffer becomes an output and is equally dead at the call
+    site), or None."""
     fname = call.func.attr if isinstance(call.func, ast.Attribute) \
         else getattr(call.func, "id", None)
+    if fname == "pallas_call":
+        for kw in call.keywords:
+            if kw.arg != "input_output_aliases":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Dict):
+                out = set()
+                for k in v.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, int):
+                        out.add(k.value)
+                return out
+        return None
     if fname not in ("jit", "pjit"):
         return None
     for kw in call.keywords:

@@ -33,6 +33,7 @@ from .base import Violation, apply_suppressions, load_source, repo_root
 
 SCAN_FILES = (
     "language_detector_tpu/ops/score.py",
+    "language_detector_tpu/ops/kernels.py",
     "language_detector_tpu/ops/device_tables.py",
     "language_detector_tpu/models/ngram.py",
     "language_detector_tpu/preprocess/pack.py",
@@ -248,10 +249,14 @@ def _collect_entries_and_jitted(sources) -> tuple:
     """(entry function names, jitted callable names).
 
     Entries are the functions jax traces: direct jit(f) arguments,
-    functions called inside jit(lambda ...) bodies, and the first
-    argument of shard_map(f, ...) when the wrapped result is jitted.
-    Jitted names are module-level `X = jax.jit(...)` bindings — the
-    callables whose call sites the shape-source rule audits."""
+    functions called inside jit(lambda ...) bodies, the first
+    argument of shard_map(f, ...) when the wrapped result is jitted,
+    and the first argument of pl.pallas_call(kernel, ...) — Pallas
+    kernel bodies trace under the same rules (a host sync inside one
+    is a Mosaic lowering error on TPU, a silent serialization in
+    interpret mode). Jitted names are module-level `X = jax.jit(...)`
+    bindings — the callables whose call sites the shape-source rule
+    audits."""
     entries: set = set()
     jitted: set = set()
     for sf in sources:
@@ -269,6 +274,10 @@ def _collect_entries_and_jitted(sources) -> tuple:
                     fname = child.func.attr \
                         if isinstance(child.func, ast.Attribute) \
                         else getattr(child.func, "id", None)
+                    if fname == "pallas_call" and child.args and \
+                            isinstance(child.args[0], ast.Name):
+                        entries.add(child.args[0].id)
+                        continue
                     if fname not in ("jit", "pjit") or not child.args:
                         continue
                     arg = child.args[0]
