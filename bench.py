@@ -7,6 +7,7 @@ target is the BASELINE.json north star (1M docs/sec on v5e-8 = 125K
 docs/sec/chip at ~200-byte service documents).
 """
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -30,6 +31,12 @@ LINT_BUDGET_MS = 30_000
 # loaded CI host while still catching an accidental fsync, lock
 # convoy, or O(n) scan creeping into the per-request path.
 TELEM_BUDGET_NS = 50_000
+
+# Integrity scrub overhead ceiling: one full scrub+canary cycle
+# (ops/kernels.table_digest fold + the 8-doc golden pack, per lane)
+# amortized over LDT_SCRUB_INTERVAL_SEC must stay under 1% of serving
+# capacity — corruption detection rides the data plane for free.
+SCRUB_BUDGET_FRAC = 0.01
 
 # Self-contained corpus: service-sized snippets in several scripts; padded
 # with index salt so quad repeat filters see realistic variety.
@@ -1196,6 +1203,27 @@ if __name__ == "__main__":
         out = bench(batch_size=2048, n_batches=2, http_bench=False)
         out["detail"]["lint_ms"] = lint_ms
         out["detail"].update(telem)
+        # integrity scrub overhead gate: one scrub+canary cycle,
+        # amortized over the scrub interval, must cost under 1% of
+        # serving capacity — the data-plane guard must stay invisible
+        # in docs/sec
+        os.environ.update({"LDT_POOL_LANES": "2",
+                           "LDT_SCRUB_INTERVAL_SEC": "30",
+                           "LDT_CANARY_DOCS": "8"})
+        from language_detector_tpu import integrity
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        scrub = integrity.bench_scrub_overhead(NgramBatchEngine())
+        if scrub is None:
+            sys.exit("bench --smoke: integrity monitor failed to "
+                     "build (LDT_SCRUB_INTERVAL_SEC set but no "
+                     "monitor)")
+        if scrub["overhead_frac"] > SCRUB_BUDGET_FRAC:
+            sys.exit(f"bench --smoke: scrub overhead "
+                     f"{scrub['overhead_frac']:.4f} of capacity "
+                     f"(budget {SCRUB_BUDGET_FRAC}); cycle "
+                     f"{scrub['scrub_cycle_ms']}ms per "
+                     f"{scrub['interval_ms']:.0f}ms interval")
+        out["detail"]["scrub"] = scrub
         print(json.dumps(out))
     else:
         print(json.dumps(bench()))

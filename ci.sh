@@ -695,6 +695,90 @@ print("pool chaos:", len(statuses), "requests all 2xx,",
       "re-admissions — lanes_active recovered to 2")
 EOF
 
+echo "== scrub chaos smoke =="
+# data-plane integrity under table corruption (integrity.py): a
+# two-lane engine with the on-device scrub cadence armed, one lane's
+# device tables bit-flipped mid-burst through the table_upload corrupt
+# seam. The invariants: the scrub detects the flip (digest mismatch ->
+# CORRUPT), heals it (fresh upload -> PROBING), the lane re-admits
+# through a served batch, ldt_integrity_detected_total and
+# ldt_integrity_healed_total both advance, and post-heal answers are
+# byte-identical to the pre-corruption baseline — zero wrong answers
+# after heal.
+JAX_PLATFORMS=cpu LDT_POOL_LANES=2 LDT_SCRUB_INTERVAL_SEC=0.01 \
+LDT_CANARY_DOCS=8 LDT_LOCK_DEBUG=1 python3 - <<'EOF'
+import time
+
+from language_detector_tpu import faults, telemetry
+from language_detector_tpu.models.ngram import NgramBatchEngine
+from language_detector_tpu.parallel.pool import (LANE_ACTIVE,
+                                                 LANE_STATE_NAMES)
+
+
+def series(prefix):
+    text = telemetry.render_exposition(telemetry.REGISTRY.families())
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+eng = NgramBatchEngine()
+mon = eng.integrity
+assert mon is not None, "integrity monitor did not build"
+assert len(eng.pool.lanes) == 2, "expected two pool lanes"
+
+docs = [f"the quick brown fox jumps over the lazy dog document {i}"
+        for i in range(40)]
+docs += [f"le gouvernement a annoncé de nouvelles mesures {i}"
+         for i in range(20)]
+
+
+def burst():
+    return [eng.reg.code(r.summary_lang)
+            for r in eng.detect_batch(docs)]
+
+
+baseline = burst()
+mon.scrub_pass()   # warm + prove a clean scrub passes canary
+assert mon.stats["detected"] == 0, "clean tables flagged corrupt"
+
+# one seeded bit-flip in one lane's device tables on the next scrub
+faults.configure("table_upload:corrupt:seed=7:once")
+try:
+    time.sleep(0.02)           # scrub cadence due
+    burst()                    # epilogue scrub fires mid-traffic
+    deadline = time.time() + 60
+    while mon.stats["healed"] < 1:
+        assert time.time() < deadline, \
+            f"corruption never detected+healed: {mon.stats}"
+        time.sleep(0.02)
+        burst()
+finally:
+    faults.configure(None)
+
+detected = series("ldt_integrity_detected_total")
+healed = series("ldt_integrity_healed_total")
+assert detected >= 1, f"ldt_integrity_detected_total = {detected}"
+assert healed >= 1, f"ldt_integrity_healed_total = {healed}"
+
+# the healed lane re-admits through served batches (PROBING -> ACTIVE)
+deadline = time.time() + 60
+while not all(ln.state() == LANE_ACTIVE for ln in eng.pool.lanes):
+    assert time.time() < deadline, "healed lane never re-admitted: " \
+        + str([LANE_STATE_NAMES[ln.state()] for ln in eng.pool.lanes])
+    burst()
+    time.sleep(0.01)
+
+after = burst()
+assert after == baseline, \
+    "post-heal answers diverge from the pre-corruption baseline"
+print("scrub chaos:", int(series('ldt_integrity_scrub_total')),
+      "scrubs,", int(detected), "detected,", int(healed),
+      "healed — lanes active, post-heal answers match baseline")
+EOF
+
 echo "== swap-drill smoke =="
 # blue/green hot swap under live traffic (docs/ROBUSTNESS.md): a
 # SUPERVISED asyncio front with LDT_REUSEPORT + warmup-gated readiness,

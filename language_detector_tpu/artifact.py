@@ -12,7 +12,8 @@ Layout (all little-endian):
   0   u32  magic "LDTA" (0x4154444C)
   4   u32  format version
   8   u32  n_arrays
-  12  u32  reserved (0)
+  12  u32  flags (bit 0: digest footer present; was reserved=0, so
+             pre-footer artifacts read as flags=0 and still load)
   16  u64  header_bytes (end of the descriptor table)
   24  u64  total_bytes  (file size; load-time truncation check)
   32  n_arrays x 108-byte packed descriptors:
@@ -22,6 +23,10 @@ Layout (all little-endian):
       4xu64 shape (unused dims 0)
       u64  offset (file-relative), u64 nbytes
   blobs: each 64-byte aligned.
+  footer (when flags bit 0 is set, included in total_bytes):
+      u32  footer magic "LDTD" (0x4454444C)
+      u32  n_arrays (must match the header)
+      n_arrays x u32  zlib.crc32 of each blob, descriptor order
 
 The fixed flat layout is deliberately C-parsable so a native host can
 mmap the same file (the cgo seam's table story).
@@ -31,6 +36,7 @@ from __future__ import annotations
 import mmap
 import os
 import struct
+import zlib
 from pathlib import Path
 
 import numpy as np
@@ -38,10 +44,13 @@ import numpy as np
 from . import faults
 
 MAGIC = 0x4154444C  # "LDTA"
+FOOT_MAGIC = 0x4454444C  # "LDTD"
 VERSION = 1
 ALIGN = 64
+FLAG_DIGESTS = 0x1
 _HDR = struct.Struct("<IIII QQ")
 _DESC = struct.Struct("<48s8sI 4Q QQ")
+_FOOT = struct.Struct("<II")
 
 
 class ArtifactError(ValueError):
@@ -50,6 +59,14 @@ class ArtifactError(ValueError):
     still catches it; new code should catch ArtifactError and let the
     message (which names the file, the failure, and the fix) reach the
     operator — startup fails loud and /readyz stays false."""
+
+
+class ArtifactIntegrityError(ArtifactError):
+    """A structurally valid artifact whose blob bytes do not match the
+    digest footer: bit rot, a torn copy, or deliberate tampering. Kept
+    distinct from ArtifactError so the swap path can refuse a corrupt
+    standby (ldt_swap_total{result="integrity_refused"}) while still
+    treating structural damage as a plain abort."""
 
 
 def write_artifact(arrays: dict, path: str | Path) -> None:
@@ -77,17 +94,23 @@ def write_artifact(arrays: dict, path: str | Path) -> None:
         descs.append((name.encode(), a.dtype.str.encode(), a.ndim,
                       shape, pos, a.nbytes))
         pos += -(-max(a.nbytes, 1) // ALIGN) * ALIGN
-    total = pos
+    foot_off = pos
+    total = pos + _FOOT.size + 4 * len(items)
 
     with open(path, "wb") as f:
-        f.write(_HDR.pack(MAGIC, VERSION, len(items), 0, header_bytes,
-                          total))
+        f.write(_HDR.pack(MAGIC, VERSION, len(items), FLAG_DIGESTS,
+                          header_bytes, total))
         for (name, dt, ndim, shape, off, nb) in descs:
             f.write(_DESC.pack(name, dt, ndim, *shape, off, nb))
+        crcs = []
         for (name, a, buf), (_, _, _, _, off, nb) in zip(items, descs):
             f.seek(off)
             # buf is C-contiguous: its buffer writes zero-copy
             f.write(buf.data if buf.size else b"")
+            crcs.append(zlib.crc32(buf.data) if buf.size else 0)
+        f.seek(foot_off)
+        f.write(_FOOT.pack(FOOT_MAGIC, len(items)))
+        f.write(struct.pack(f"<{len(crcs)}I", *crcs) if crcs else b"")
         f.truncate(total)
 
 
@@ -142,7 +165,8 @@ def load_artifact(path: str | Path) -> dict:
             raise ArtifactError(
                 f"{path}: not an LDTA artifact (file shorter than the "
                 "header) — re-pack it with tools/artifact_tool.py --pack")
-        magic, version, n, _, header_bytes, total = _HDR.unpack_from(mm, 0)
+        magic, version, n, flags, header_bytes, total = \
+            _HDR.unpack_from(mm, 0)
         if magic != MAGIC:
             raise ArtifactError(
                 f"{path}: bad magic {magic:#x} (want {MAGIC:#x} 'LDTA') "
@@ -168,6 +192,23 @@ def load_artifact(path: str | Path) -> dict:
                 f"with {n} descriptors (corrupt header) — re-pack with "
                 "tools/artifact_tool.py --pack")
         data_start = -(-header_bytes // ALIGN) * ALIGN
+        crcs = None
+        if flags & FLAG_DIGESTS:
+            foot_off = total - (_FOOT.size + 4 * n)
+            if foot_off < data_start:
+                raise ArtifactError(
+                    f"{path}: digest footer overlaps the data region "
+                    "(corrupt header) — re-pack with "
+                    "tools/artifact_tool.py --pack")
+            fmagic, fn = _FOOT.unpack_from(mm, foot_off)
+            if fmagic != FOOT_MAGIC or fn != n:
+                raise ArtifactIntegrityError(
+                    f"{path}: digest footer corrupt (magic {fmagic:#x}"
+                    f", {fn} entries for {n} arrays) — restore the "
+                    "file from source or re-pack with "
+                    "tools/artifact_tool.py --pack")
+            crcs = struct.unpack_from(f"<{n}I", mm,
+                                      foot_off + _FOOT.size)
         out: dict = {}
         buf = memoryview(mm)
         for i in range(n):
@@ -198,6 +239,13 @@ def load_artifact(path: str | Path) -> dict:
                     f"shape {shape} x itemsize {dtype.itemsize} "
                     "disagrees (corrupt descriptor) — re-pack with "
                     "tools/artifact_tool.py --pack")
+            if crcs is not None and \
+                    zlib.crc32(buf[off:off + nb]) != crcs[i]:
+                raise ArtifactIntegrityError(
+                    f"{path}: array {name!r} fails its digest "
+                    "(bit rot, a torn copy, or tampering) — restore "
+                    "the file from source or re-pack with "
+                    "tools/artifact_tool.py --pack")
             a = np.frombuffer(buf[off:off + nb], dtype=dtype)
             out[name] = a.reshape(shape)
     except BaseException:
@@ -219,4 +267,48 @@ def load_artifact(path: str | Path) -> dict:
         except BufferError:  # an export still alive: GC reclaims later
             pass
         raise
+    if faults.ACTIVE is not None and out:
+        # chaos seam: a seeded bit-flip in one loaded array models
+        # memory corruption AFTER the digest check passed (the scrub
+        # and canary layers are what must catch it downstream)
+        seed = faults.corruption("artifact_load")
+        if seed is not None:
+            name = sorted(out)[seed % len(out)]
+            out[name] = faults.corrupt_buffer(out[name], seed)
     return out
+
+
+def artifact_digest(path: str | Path) -> str | None:
+    """Cheap whole-artifact identity: the hex crc32 of the digest
+    footer bytes (header-only reads — no blob I/O). None for a
+    pre-footer artifact. The result-cache epoch and swap telemetry use
+    this as the artifact generation key."""
+    try:
+        with open(path, "rb") as f:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return None
+            magic, _ver, n, flags, _hb, total = _HDR.unpack(hdr)
+            if magic != MAGIC or not flags & FLAG_DIGESTS:
+                return None
+            foot_size = _FOOT.size + 4 * n
+            if total < foot_size:
+                return None
+            f.seek(total - foot_size)
+            foot = f.read(foot_size)
+            if len(foot) < foot_size:
+                return None
+            return "%08x" % zlib.crc32(foot)
+    except OSError:
+        return None
+
+
+def verify_artifact(path: str | Path) -> str | None:
+    """Full read-only verification: structural checks plus every blob
+    digest (load_artifact does both). Returns the artifact digest (or
+    None for a pre-footer file); raises ArtifactIntegrityError on a
+    digest mismatch, ArtifactError on structural damage. The swap path
+    runs this against a standby artifact BEFORE cutover."""
+    arrays = load_artifact(path)
+    del arrays  # views drop -> the mapping closes with them
+    return artifact_digest(path)

@@ -15,8 +15,12 @@ what tests use):
 
 Rule grammar:  point:action[:p=F][:seed=N][:once][:after=N]
 
-    action     `error` (raise FaultInjected at the seam) or
-               `delay_ms=<float>` (sleep that long at the seam)
+    action     `error` (raise FaultInjected at the seam),
+               `delay_ms=<float>` (sleep that long at the seam), or
+               `corrupt` (deterministic seeded bit-flip on the seam's
+               named buffer — only the corruption seams honor it, via
+               corruption()/corrupt_buffer(); error/delay seams skip
+               corrupt rules without consuming their schedule)
     p=F        fire with probability F per arrival (default 1.0),
                drawn from a per-rule random.Random(seed) — the schedule
                is a pure function of (seed, arrival index), so chaos
@@ -47,7 +51,18 @@ from .locks import make_lock
 # point -> where the seam lives (the docs table in docs/ROBUSTNESS.md
 # carries the operator-facing description; lint checks both directions)
 FAULT_POINTS: dict = {
-    "artifact_load": "artifact.load_artifact, before the mmap/verify",
+    "artifact_load": "artifact.load_artifact: error/delay fire before "
+                     "the mmap/verify; a corrupt rule bit-flips one "
+                     "loaded array AFTER the digest check (models "
+                     "host-memory rot the scrub/canary layers catch)",
+    "table_upload": "integrity scrub pass, per scanned pool lane (a "
+                    "corrupt rule bit-flips one plane of that lane's "
+                    "device tables — models HBM corruption; the scrub "
+                    "digest or canary detects it and the lane heals)",
+    "frame_payload": "wire/shm ingest, per received frame body before "
+                     "the CRC check (a corrupt rule bit-flips one "
+                     "payload byte; with LDT_WIRE_CRC the frame is "
+                     "refused instead of parsed)",
     "device_flush": "models/ngram._epilogue, the device result fetch",
     "scorer_launch": "models/ngram._launch, every jitted-scorer launch",
     "compile": "models/ngram._launch, first-execution (compiling) "
@@ -109,15 +124,16 @@ class _Rule:
     """One parsed LDT_FAULTS rule; mutable schedule state (calls,
     done, rng) is owned by the module _lock."""
 
-    __slots__ = ("action", "delay_ms", "p", "rng", "once", "after",
-                 "calls", "done")
+    __slots__ = ("action", "delay_ms", "p", "rng", "seed", "once",
+                 "after", "calls", "done")
 
     def __init__(self, action: str, delay_ms: float, p: float,
                  seed: int, once: bool, after: int):
-        self.action = action        # "error" | "delay"
+        self.action = action        # "error" | "delay" | "corrupt"
         self.delay_ms = delay_ms
         self.p = p
         self.rng = random.Random(seed)
+        self.seed = seed            # corrupt rules derive flip seeds
         self.once = once
         self.after = after
         self.calls = 0
@@ -152,15 +168,15 @@ def _parse(spec: str) -> dict:
                 f"points: {', '.join(sorted(FAULT_POINTS))}")
         action = fields[1].strip()
         delay_ms = 0.0
-        if action == "error":
-            kind = "error"
+        if action in ("error", "corrupt"):
+            kind = action
         elif action.startswith("delay_ms="):
             kind = "delay"
             delay_ms = float(action[len("delay_ms="):])
         else:
             raise ValueError(
-                f"LDT_FAULTS rule {part!r}: action must be 'error' or "
-                f"'delay_ms=<float>', got {action!r}")
+                f"LDT_FAULTS rule {part!r}: action must be 'error', "
+                f"'corrupt' or 'delay_ms=<float>', got {action!r}")
         p, seed, once, after = 1.0, 0, False, 0
         for opt in fields[2:]:
             opt = opt.strip()
@@ -209,6 +225,10 @@ def evaluate(point: str) -> tuple:
     fired = 0
     with _lock:
         for r in rules:
+            if r.action == "corrupt":
+                # corruption() owns these schedules: an error/delay
+                # seam must not consume a corrupt rule's arrivals
+                continue
             r.calls += 1
             if r.done or r.calls <= r.after:
                 continue
@@ -228,6 +248,60 @@ def evaluate(point: str) -> tuple:
         flightrec.emit_event("fault_fired", point=point, fired=fired,
                              action="error" if err else "delay")
     return delay, err
+
+
+def corruption(point: str) -> int | None:
+    """Advance every `corrupt` rule targeting `point` by one arrival.
+    Returns a deterministic flip seed when one fires (derived from the
+    rule's seed and arrival index, so a chaos run corrupts the same
+    bit every time), or None. Non-corrupt rules at the same point are
+    untouched — evaluate() owns their schedules. The caller passes the
+    seed to corrupt_buffer() against its named buffer."""
+    if point not in FAULT_POINTS:
+        raise KeyError(f"undeclared fault point {point!r}; declare it "
+                       "in language_detector_tpu/faults.py")
+    active = ACTIVE
+    if active is None:
+        return None
+    rules = active.get(point)
+    if not rules:
+        return None
+    flip_seed = None
+    with _lock:
+        for r in rules:
+            if r.action != "corrupt":
+                continue
+            r.calls += 1
+            if r.done or r.calls <= r.after:
+                continue
+            if r.p < 1.0 and r.rng.random() >= r.p:
+                continue
+            if r.once:
+                r.done = True
+            flip_seed = r.seed + r.calls - 1
+            break
+    if flip_seed is not None:
+        telemetry.REGISTRY.counter_inc("ldt_fault_injected_total",
+                                       point=point)
+        from . import flightrec
+        flightrec.emit_event("fault_fired", point=point, fired=1,
+                             action="corrupt")
+    return flip_seed
+
+
+def corrupt_buffer(arr, seed: int):
+    """Deterministic single-bit flip: copy `arr`, flip one bit chosen
+    by random.Random(seed) over the flat byte view, return the copy
+    (same dtype/shape). The input is never mutated — artifact views
+    are read-only mmaps and device tables re-upload from it."""
+    import numpy as np
+    a = np.asarray(arr)
+    raw = bytearray(a.tobytes())
+    if raw:
+        rng = random.Random(seed)
+        byte = rng.randrange(len(raw))
+        raw[byte] ^= 1 << rng.randrange(8)
+    return np.frombuffer(bytes(raw), dtype=a.dtype).reshape(a.shape)
 
 
 def hit(point: str) -> None:

@@ -102,6 +102,16 @@ EVENTS: dict = {
         "fault",
         "An injected fault actually fired at a seam "
         "(language_detector_tpu/faults.py)."),
+    "integrity_detected": (
+        "fault",
+        "Data corruption detected: a lane's device-table digest or "
+        "canary deviated, or a frame payload failed its CRC "
+        "(integrity.py; kind + lane/request attribution)."),
+    "integrity_healed": (
+        "transition",
+        "A quarantined CORRUPT lane healed: fresh tables re-uploaded "
+        "and verified, lane re-admitted as a half-open probe "
+        "(integrity.py)."),
     "postmortem": (
         "lifecycle",
         "A dead member's recorder was harvested into postmortem JSON "

@@ -279,6 +279,29 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Failover budget per batch: how many lane attempts (initial + "
        "re-dispatches) before the error surfaces to the batch's "
        "futures."),
+    # -- data-plane integrity (integrity.py) --------------------------
+    _k("LDT_SCRUB_INTERVAL_SEC", "float", 0.0,
+       "On-device table-scrub cadence for pooled engines: between "
+       "flushes, each pool lane's device table planes fold to a "
+       "digest on device and compare against the fingerprint recorded "
+       "at upload; a mismatch (or a canary deviation) quarantines the "
+       "lane CORRUPT, re-uploads fresh tables from the host mmap, and "
+       "re-admits it through the half-open probe flow. 0 (default) "
+       "disables scrubbing entirely — the epilogue hook is a single "
+       "attribute test."),
+    _k("LDT_CANARY_DOCS", "int", 8,
+       "Golden-query canary pack size per scrub pass (first N of the "
+       "pinned 8-doc pack, expected codes baked into model.ldta at "
+       "pack time): each lane scores the pack and any code deviation "
+       "quarantines the lane — catching compute faults a table digest "
+       "can't see. 0 disables the canary (digest scrub still runs)."),
+    _k("LDT_WIRE_CRC", "bool", False,
+       "End-to-end frame payload CRC32 on the wire lanes: UDS v2 "
+       "frames carry a CRC ext-flag + trailer word and shm slots "
+       "carry a CRC header word; the server verifies before parsing "
+       "and refuses a mismatched frame with a typed 400 instead of "
+       "scoring flipped bytes (ldt_integrity_crc_total). Both sides "
+       "of the shm lane must agree on this knob."),
     # -- scoring kernel (ops/kernels.py) ------------------------------
     _k("LDT_KERNEL", "str", "auto",
        "Scoring-kernel selection for the engine's device program: "

@@ -240,6 +240,16 @@ class NgramBatchEngine:
                       "donation_hits": 0, "longdoc_chunks": 0}
         self._inflight = 0
         self._pipe_lock = make_lock("engine.pipe")
+        # -- data-plane integrity (integrity.py) ----------------------
+        # simulated (single-device) pool lanes each carry their own
+        # table reference so the integrity monitor can quarantine and
+        # re-upload one lane without touching the others; mesh lanes
+        # keep dt=None (their sharded programs own table placement)
+        if self.pool is not None and mesh is None:
+            for ln in self.pool.lanes:
+                ln.dt = self.dt
+        from .. import integrity as integrity_mod
+        self.integrity = integrity_mod.build_from_env(self)
 
     def stats_snapshot(self) -> dict:
         """Copy of the running stats under the stats lock — the only
@@ -288,7 +298,8 @@ class NgramBatchEngine:
 
     # -- device dispatch ----------------------------------------------------
 
-    def _launch_raw(self, cb, lane: str = "main", score_fn=None):
+    def _launch_raw(self, cb, lane: str = "main", score_fn=None,
+                    dt=None):
         """Launch a jitted scorer over a packed wire, metering compile
         events: the first execution of a new padded wire shape on a lane
         increments ldt_xla_compiles_total{lane=} and records the launch
@@ -298,9 +309,14 @@ class NgramBatchEngine:
         not timed at all — the hot path stays one set lookup).
         score_fn: the pool passes each lane's own program; the compile
         key carries its identity so per-lane first compiles meter as
-        compiles instead of hiding behind another lane's warm mark."""
+        compiles instead of hiding behind another lane's warm mark.
+        dt: the pool passes each lane's own device tables (integrity
+        quarantine re-uploads per lane); None = the engine's shared
+        upload — identical buffers, identical program."""
         if score_fn is None:
             score_fn = self._score_fn
+        if dt is None:
+            dt = self.dt
         if self._donate and score_fn is self._kernel.score:
             # pipelined depth: donate the wire into the scorer so the
             # device reuses the transferred buffers (ops/kernels.py);
@@ -320,11 +336,11 @@ class NgramBatchEngine:
                tuple(sorted((k, tuple(np.shape(v)))
                             for k, v in cb.wire.items())))
         if not telemetry.REGISTRY.compiles.first_seen(lane, key):
-            return score_fn(self.dt, cb.wire)
+            return score_fn(dt, cb.wire)
         if faults.ACTIVE is not None:
             faults.hit("compile")
         t0 = _time.monotonic()
-        fut = score_fn(self.dt, cb.wire)
+        fut = score_fn(dt, cb.wire)
         telemetry.REGISTRY.counter_inc("ldt_xla_compiles_total",
                                        lane=lane)
         telemetry.REGISTRY.histogram("ldt_xla_compile_ms", lane=lane) \
@@ -345,7 +361,8 @@ class NgramBatchEngine:
             if self.pool is None:
                 return self._launch_raw(cb, lane)
             return self.pool.launch(
-                lambda pl: self._launch_raw(cb, lane, pl.score_fn),
+                lambda pl: self._launch_raw(cb, lane, pl.score_fn,
+                                            pl.dt),
                 trace=trace)
         except BaseException:
             # failed launch: the flush errors as a unit (the batcher
@@ -1235,6 +1252,12 @@ class NgramBatchEngine:
             self.stats["batches"] += 1
             self.stats["device_dispatches"] += 1
             self.stats["fallback_docs"] += int(cb.fallback[:B].sum())
+        if self.integrity is not None:
+            # between-flush scrub cadence (integrity.py): cheap clock
+            # check when not due; a due pass digests each lane's device
+            # tables and heals any quarantined lane before the next
+            # flush can land on it. Never raises.
+            self.integrity.maybe_scrub()
         patches: dict[int, ScalarResult] = {}
         need = np.flatnonzero(ep[:B, 12])
         if not need.size:

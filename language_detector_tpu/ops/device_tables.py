@@ -234,6 +234,44 @@ class DeviceTables:
         )
 
 
+def digest_arrays(dt: "DeviceTables") -> list:
+    """The dt planes the integrity scrub digests, in a deterministic
+    order: the pytree leaves (registered-dataclass field order, nested
+    KindTables included; static geometry fields are treedef, not
+    leaves). Host fingerprint() and the jitted device fold in
+    ops/kernels.py both iterate THIS list, so index i always names the
+    same plane on both sides."""
+    return jax.tree_util.tree_leaves(dt)
+
+
+def fold_host(a) -> int:
+    """Host (numpy) twin of the device digest fold in ops/kernels.py:
+    normalize the plane to u32 words, weight each word by its position
+    (mod-65521 stride so a swap of equal words still changes the sum),
+    and wrap-sum mod 2^32. Must stay bit-identical to kernels._fold —
+    the scrub compares the two."""
+    v = np.asarray(a)
+    if v.dtype == bool:
+        v = v.astype(np.uint8)
+    if v.dtype.itemsize == 1:
+        w = v.astype(np.uint32)
+    elif v.dtype.itemsize == 2:
+        w = v.view(np.uint16).astype(np.uint32)
+    else:
+        w = v.view(np.uint32)
+    w = w.ravel()
+    weights = (np.arange(w.size, dtype=np.uint32) % 65521) + 1
+    return int((w * weights).sum(dtype=np.uint32))
+
+
+def fingerprint(dt: "DeviceTables") -> tuple:
+    """Per-plane digest tuple of an uploaded table set — the lane's
+    expected fingerprint, recorded at upload time (np.asarray reads
+    back the actual device bytes, so the reference covers the upload
+    itself, not just the host source)."""
+    return tuple(fold_host(a) for a in digest_arrays(dt))
+
+
 def _validate_qprobs(t: ScoringTables, cat_ind: np.ndarray) -> None:
     """Assert the group-in-use invariant the device scorer relies on:
     every packed langprob with a nonzero pslang decodes to qprob >= 1, so

@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import knobs
-from .device_tables import DeviceTables
+from .device_tables import DeviceTables, digest_arrays
 from .score import (HINT_BASE, _chunk_out_word, _decode3, _lscript4,
                     _reliability_delta, _reliability_expected,
                     score_chunks, score_chunks_donated,
@@ -340,6 +340,43 @@ def _pallas_score_fns(interpret: bool):
             jax.jit(score_full_impl),
         )
     return _pallas_fns_cache[interpret]
+
+
+# ---------------------------------------------------------------------------
+# Integrity scrub fold
+# ---------------------------------------------------------------------------
+
+
+def _fold(a: jnp.ndarray) -> jnp.ndarray:
+    """Device twin of device_tables.fold_host: one table plane ->
+    scalar u32 digest via a position-weighted wrap-sum. Pure XLA (a
+    gather-free reduction runs on every backend the scorer does — the
+    same reduce machinery the fused tote uses), and bit-identical to
+    the numpy fold by construction: both normalize to u32 words and
+    wrap mod 2^32."""
+    v = a
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.uint8)
+    if v.dtype.itemsize == 1:
+        w = v.astype(jnp.uint32)
+    elif v.dtype.itemsize == 2:
+        w = jax.lax.bitcast_convert_type(v, jnp.uint16).astype(
+            jnp.uint32)
+    else:
+        w = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    w = w.reshape(-1)
+    weights = (jnp.arange(w.size, dtype=jnp.uint32) % 65521) + 1
+    return jnp.sum(w * weights, dtype=jnp.uint32)
+
+
+def table_digest_impl(dt: DeviceTables) -> jnp.ndarray:
+    """All dt planes folded on-device -> [n_planes] u32, index-aligned
+    with device_tables.fingerprint(). The scrub pass compares this
+    against the lane's recorded upload fingerprint."""
+    return jnp.stack([_fold(a) for a in digest_arrays(dt)])  # ldt-lint: disable=trace-python-branch -- digest_arrays is a static tuple of planes, not a traced value; the loop unrolls at trace time
+
+
+table_digest = jax.jit(table_digest_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -51,11 +51,17 @@ from ..locks import make_lock
 
 # lane states: ACTIVE lanes are in rotation; EVICTED lanes sit out
 # until their probe cooldown elapses; PROBING lanes carry exactly one
-# half-open probe batch whose outcome decides re-admission
+# half-open probe batch whose outcome decides re-admission. CORRUPT is
+# the integrity quarantine (language_detector_tpu/integrity.py): a
+# scrub-digest or canary mismatch parks the lane until fresh tables
+# re-upload, then it re-enters through the PROBING flow — a CORRUPT
+# lane is NEVER drafted, even when every other lane is out (wrong
+# answers are worse than a typed refusal)
 LANE_ACTIVE = 0
 LANE_EVICTED = 1
 LANE_PROBING = 2
-LANE_STATE_NAMES = ("active", "evicted", "probing")
+LANE_CORRUPT = 3
+LANE_STATE_NAMES = ("active", "evicted", "probing", "corrupt")
 
 # minimum completed fetches before a lane's p95 is trusted enough to
 # hedge against (a cold lane's first samples are compile-dominated)
@@ -83,6 +89,10 @@ class Lane:
         self.name = f"lane{idx}"
         self.score_fn = score_fn
         self.mesh = mesh
+        # per-lane device tables (models/ngram.py assigns after upload;
+        # None = the lane scores with the engine's shared dt). The
+        # integrity monitor swaps this on heal re-upload.
+        self.dt = None
         self._lock = make_lock("pool.lane")
         self._state = LANE_ACTIVE
         self._ewma_ms = 0.0
@@ -172,6 +182,34 @@ class Lane:
             if now - self._evicted_at < cooldown_sec:
                 return False
             self._state = LANE_PROBING
+            return True
+
+    def mark_corrupt(self, now: float) -> bool:
+        """ACTIVE -> CORRUPT: the integrity monitor detected a table
+        digest or canary mismatch on this lane. Returns False when the
+        lane is already out of rotation (evicted/probing lanes heal
+        through their own flow; a double detection is a no-op)."""
+        with self._lock:
+            if self._state != LANE_ACTIVE:
+                return False
+            self._state = LANE_CORRUPT
+            self._evicted_at = now
+            return True
+
+    def mark_healed(self, now: float) -> bool:
+        """CORRUPT -> EVICTED with the probe cooldown already elapsed,
+        after fresh tables re-uploaded and their fingerprint verified.
+        The lane re-enters rotation through the ordinary half-open
+        flow (_pick_lane's try_begin_probe admits it on the next
+        rotation pass — PROBING stays owned by exactly one dispatch),
+        so re-admission still requires one healthy served batch."""
+        with self._lock:
+            if self._state != LANE_CORRUPT:
+                return False
+            self._state = LANE_EVICTED
+            # fresh, verified tables: no reason to serve a cooldown —
+            # the next dispatch rotation admits the probe immediately
+            self._evicted_at = float("-inf")
             return True
 
     def p95_ms(self) -> float | None:
@@ -314,7 +352,11 @@ class DevicePool:
         When every lane is out of rotation the least-recently-evicted
         lane is drafted anyway — work must go SOMEWHERE, and a fully
         evicted pool behaves like the breaker-open path (errors surface
-        typed, the ladder sheds load upstream)."""
+        typed, the ladder sheds load upstream). The one exception is
+        CORRUPT: a quarantined lane would serve WRONG answers, not slow
+        ones, so the draft skips it and an all-corrupt pool raises
+        typed instead (the scrub pass heals synchronously, so that
+        state lasts one scrub interval at most)."""
         now = self._now()
         with self._lock:
             n = len(self.lanes)
@@ -327,12 +369,17 @@ class DevicePool:
                     return lane
                 if lane.try_begin_probe(now, self.probe_cooldown_sec):
                     return lane
-            lane = self.lanes[self._rr % n]
-            self._rr += 1
-            if lane is exclude and n > 1:
-                lane = self.lanes[self._rr % n]
-                self._rr += 1
-            return lane
+            for skip_exclude in (True, False):
+                for _ in range(n):
+                    lane = self.lanes[self._rr % n]
+                    self._rr += 1
+                    if skip_exclude and lane is exclude and n > 1:
+                        continue
+                    if lane.state() != LANE_CORRUPT:
+                        return lane
+            raise PoolExhausted(
+                "every pool lane is quarantined CORRUPT; refusing to "
+                "serve from corrupt tables")
 
     def _lane_failed(self, lane: Lane) -> None:
         if lane.record_failure(self._now(), self.evict_failures):
@@ -518,9 +565,11 @@ class DevicePool:
 
     def capacity(self) -> tuple[int, int]:
         """(lanes in rotation, lanes total); PROBING counts as in
-        rotation — it is carrying work."""
+        rotation — it is carrying work. EVICTED and CORRUPT lanes are
+        out (a quarantined lane sheds load upstream exactly like an
+        evicted one)."""
         active = sum(1 for ln in self.lanes
-                     if ln.state() != LANE_EVICTED)
+                     if ln.state() not in (LANE_EVICTED, LANE_CORRUPT))
         return active, len(self.lanes)
 
     def capacity_load(self) -> float:

@@ -312,6 +312,11 @@ class AioService:
             # the sync Batcher (just closed, if any) registered its own
             # unused cache; the gauges must read the live one
             self.svc.metrics.cache_stats = self.batcher.cache_stats
+            # register with the service so swap_artifact can flush this
+            # front-level cache on an artifact rebind (staleness guard)
+            self.svc._result_caches = list(
+                getattr(self.svc, "_result_caches", ())) \
+                + [self.batcher._cache]
         self._usage = json.dumps(USAGE).encode()
         self.recycling = False  # set by _recycle_watch; read by serve()
         self.draining = False   # set by the SIGTERM handler (swap

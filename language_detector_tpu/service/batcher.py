@@ -90,9 +90,27 @@ class ResultCache:
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        # artifact epoch: results are only valid against the tables
+        # that produced them, so every key is namespaced by the serving
+        # artifact's generation and a swap flushes the lot (set_epoch
+        # in service/swap.py) — a hit can never be a stale answer from
+        # the pre-swap model
+        self._epoch = None
+
+    def set_epoch(self, epoch) -> None:
+        """Namespace the cache to a new artifact generation, dropping
+        every entry produced under the old one. Called by swap_artifact
+        after the rebind commits; idempotent for a repeated epoch."""
+        with self._lock:
+            if epoch == self._epoch:
+                return
+            self._epoch = epoch
+            self._d.clear()
+            self.bytes = 0
 
     def get(self, key):
         """Returns the cached value or the module's _MISS sentinel."""
+        key = (self._epoch,) + key
         with self._lock:
             ent = self._d.get(key)
             if ent is None:
@@ -103,6 +121,7 @@ class ResultCache:
             return ent[0]
 
     def put(self, key, value, text: str):
+        key = (self._epoch,) + key
         nbytes = (len(text.encode("utf-8", "surrogatepass")) +
                   _value_nbytes(value) + self.ENTRY_OVERHEAD)
         if nbytes > self.max_bytes:

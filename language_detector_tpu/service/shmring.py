@@ -40,7 +40,9 @@ each slot payload can be mapped at offset 0 of its own mmap):
                       u64 slot_bytes
   64+i*64  slot i header: u32 state, u32 generation, u32 owner_pid,
                       u32 reserved, f64 lease_ts, u32 length,
-                      u32 status
+                      u32 status; at byte 32 of the 64-byte header
+                      region, u32 crc32(payload) when LDT_WIRE_CRC
+                      is set on both sides (zero otherwise)
   4096+i*slot_bytes  slot i payload (request body in READY, response
                       body in DONE — same JSON contract as the UDS
                       frame lane, byte-identical responses)
@@ -57,9 +59,11 @@ import hashlib
 import json
 import mmap
 import os
+import random
 import struct
 import threading
 import time
+import zlib
 from concurrent.futures import TimeoutError as FuturesTimeout
 
 from .. import faults, flightrec, knobs, telemetry
@@ -313,6 +317,19 @@ class RingFile:
         self.mm[off + 4:off + SLOT_HDR.size] = rec[4:]
         self.mm[off:off + 4] = rec[:4]
 
+    def write_crc(self, i: int, crc: int) -> None:
+        """Stamp the slot's payload-guard word (u32 crc32 at byte 32 of
+        the header region). Written BEFORE the READY publish so a
+        reader that observes READY sees a settled crc."""
+        struct.pack_into("<I", self.mm,
+                         SLOT_HDR_OFF + i * SLOT_HDR_SIZE
+                         + SLOT_HDR.size, crc)
+
+    def read_crc(self, i: int) -> int:
+        return struct.unpack_from(
+            "<I", self.mm,
+            SLOT_HDR_OFF + i * SLOT_HDR_SIZE + SLOT_HDR.size)[0]
+
     def payload_off(self, i: int) -> int:
         return HEADER_PAGE + i * self.slot_bytes
 
@@ -420,6 +437,10 @@ class RingClient:
             self.rf.write_slot(i, SLOT_WRITING, gen, os.getpid(), now,
                                0, 0, reqid=reqid)
             self.rf.write_payload(i, (body,))
+            if knobs.get_bool("LDT_WIRE_CRC"):
+                # guard word must settle before the READY publish:
+                # the worker reads it only after observing READY
+                self.rf.write_crc(i, zlib.crc32(body))
             s.mark_ready()
             self.rf.write_slot(i, SLOT_READY, gen, os.getpid(), now,
                                len(body), 0, reqid=reqid)
@@ -869,6 +890,35 @@ class ShmRingServer:
         rf = ring.rf
         s = ring.mirrors[i]
         reqid = rf.slot_request_id(i)
+        if knobs.get_bool("LDT_WIRE_CRC"):
+            if faults.ACTIVE is not None and length:
+                # chaos seam: seeded single-bit flip in the shared
+                # payload — exactly the corruption the guard word
+                # must catch before the frame reaches the parser
+                seed = faults.corruption("frame_payload")
+                if seed is not None:
+                    off = rf.payload_off(i)
+                    rng = random.Random(seed)
+                    b = rng.randrange(length)
+                    rf.mm[off + b] ^= 1 << rng.randrange(8)
+            ok = zlib.crc32(rf.read_payload(i, length)) \
+                == rf.read_crc(i)
+            telemetry.REGISTRY.counter_inc(
+                "ldt_integrity_crc_total", lane="shm",
+                result="ok" if ok else "mismatch")
+            if not ok:
+                telemetry.REGISTRY.counter_inc(
+                    "ldt_integrity_detected_total",
+                    kind="frame_crc", lane="shm")
+                body = wire.CRC_ERROR_BODY
+                s.mark_done()
+                rf.write_payload(i, (body,))
+                rf.write_slot(i, SLOT_DONE, ring.generation,
+                              os.getpid(), time.time(), len(body),
+                              400, reqid=reqid)
+                telemetry.REGISTRY.counter_inc(
+                    "ldt_shm_frames_total", result="error")
+                return
         try:
             status, buffers = wire.handle_frame(
                 self.svc, ring.pmaps[i], detect=self._detect,

@@ -28,7 +28,7 @@ import os
 import time
 from pathlib import Path
 
-from .. import faults, knobs, telemetry
+from .. import artifact, faults, knobs, telemetry
 from .admission import BREAKER_OPEN
 
 
@@ -85,6 +85,24 @@ def swap_artifact(svc, path) -> dict:
                                            result="error")
             raise SwapError("swap refused: device circuit breaker is "
                             "open; retry once it closes")
+        # verify the standby artifact's digest footer BEFORE any
+        # rebind work: a bit-flipped standby must never replace
+        # serving tables (the old artifact keeps serving)
+        try:
+            digest = artifact.verify_artifact(path)
+        except artifact.ArtifactIntegrityError as e:
+            swap = SWAP_REFUSED
+            telemetry.REGISTRY.counter_inc(
+                "ldt_swap_total", result="integrity_refused")
+            raise SwapError(
+                f"swap refused: standby artifact failed integrity "
+                f"verification ({e}); old tables keep serving") from e
+        except (OSError, artifact.ArtifactError) as e:
+            swap = SWAP_REFUSED
+            telemetry.REGISTRY.counter_inc("ldt_swap_total",
+                                           result="error")
+            raise SwapError(f"swap refused: cannot read standby "
+                            f"artifact ({e})") from e
         t0 = time.monotonic()
         swap = SWAP_LOADING
         try:
@@ -109,6 +127,17 @@ def swap_artifact(svc, path) -> dict:
         svc._artifact_path = path
         svc._swap_count += 1
         count = svc._swap_count
+        # the rebind invalidates every cached result: namespace the
+        # result caches (sync Batcher's + any front-registered one)
+        # to the new artifact's generation so a post-swap request can
+        # never be served a pre-swap answer
+        epoch = digest or f"swap-{count}"
+        caches = [getattr(getattr(svc, "batcher", None), "_cache",
+                          None)]
+        caches.extend(getattr(svc, "_result_caches", ()))
+        for c in caches:
+            if c is not None:
+                c.set_epoch(epoch)
         telemetry.REGISTRY.counter_inc("ldt_swap_total", result="ok")
         ms = (time.monotonic() - t0) * 1e3
     print(json.dumps({"msg": "artifact swap complete",

@@ -33,9 +33,12 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from concurrent.futures import TimeoutError as FuturesTimeout
 
-from .. import knobs, telemetry
+import numpy as np
+
+from .. import faults, knobs, telemetry
 from ..locks import make_lock
 from .admission import DeadlineExceeded
 
@@ -51,6 +54,8 @@ OVERSIZE_BODY = json.dumps(
 TIMEOUT_BODY = json.dumps(
     {"error": "Frame read timed out "
               "(LDT_FRAME_READ_TIMEOUT_SEC)"}).encode()
+CRC_ERROR_BODY = json.dumps(
+    {"error": "Frame body failed CRC32 integrity check"}).encode()
 _MISSING_TEXT_FRAG = b'{"error": "Missing text key"}'
 
 RESP_OPEN = b'{"response": ['
@@ -380,6 +385,9 @@ FRAME_EXT_HEADER = struct.Struct("!BHI")   # flags, tenant_len, deadline_ms
 FRAME_PRIORITY = 0x01                      # flags bit0
 FRAME_REQID = 0x02                         # flags bit1: 1-byte id length
 #                                            + id bytes follow the tenant
+FRAME_CRC = 0x04                           # flags bit2: u32 crc32(body)
+#                                            follows the reqid bytes
+FRAME_CRC_WORD = struct.Struct("!I")
 
 REQUEST_ID_HEADER = "X-LDT-Request-Id"
 _REQID_RE = re.compile(r"[A-Za-z0-9._\-]{1,64}\Z")
@@ -411,14 +419,20 @@ def clean_request_id(raw) -> str | None:
 def pack_frame(body: bytes, tenant: str | None = None,
                deadline_ms: int | None = None,
                priority: bool = False,
-               request_id: str | None = None) -> bytes:
+               request_id: str | None = None,
+               crc: bool | None = None) -> bytes:
     """Client-side frame builder. With no admission fields set this
     emits a plain v1 frame, so existing callers (and the parity tests'
     baseline) are untouched; any field promotes the frame to v2. A
     request_id rides as flags bit1 + 1-byte length + id bytes after
-    the tenant, and the server echoes it on the response frame."""
+    the tenant, and the server echoes it on the response frame. crc
+    (default: the LDT_WIRE_CRC knob) appends a u32 crc32(body) guard
+    word after the reqid bytes; the server refuses a frame whose body
+    arrives not matching it with a 400 instead of parsing garbage."""
+    if crc is None:
+        crc = bool(knobs.get_bool("LDT_WIRE_CRC"))
     if tenant is None and deadline_ms is None and not priority \
-            and request_id is None:
+            and request_id is None and not crc:
         return FRAME_HEADER.pack(len(body)) + body
     tb = (tenant or "").encode("latin-1")
     flags = FRAME_PRIORITY if priority else 0
@@ -429,10 +443,14 @@ def pack_frame(body: bytes, tenant: str | None = None,
             raise ValueError("request_id exceeds 255 bytes")
         flags |= FRAME_REQID
         rb = bytes([len(rb)]) + rb
+    cb = b""
+    if crc:
+        flags |= FRAME_CRC
+        cb = FRAME_CRC_WORD.pack(zlib.crc32(body))
     ext = FRAME_EXT_HEADER.pack(flags, len(tb),
                                 min(deadline_ms or 0, 0xFFFFFFFF))
     return FRAME_HEADER.pack(FRAME_V2_FLAG | len(body)) \
-        + ext + tb + rb + body
+        + ext + tb + rb + cb + body
 
 _IOV_BATCH = 512  # sendmsg segments per call, safely under IOV_MAX
 
@@ -670,6 +688,7 @@ class UnixFrameServer:
                     deadline_ms = None
                     priority = False
                     request_id = None
+                    crc = None
                     if length & FRAME_V2_FLAG:
                         length &= ~FRAME_V2_FLAG
                         if not _recv_exact_into(conn, eview, len(ext)):
@@ -694,6 +713,12 @@ class UnixFrameServer:
                                     conn, memoryview(rbuf), len(rbuf)):
                                 return
                             request_id = clean_request_id(bytes(rbuf))
+                        if flags & FRAME_CRC:
+                            cw = bytearray(FRAME_CRC_WORD.size)
+                            if not _recv_exact_into(
+                                    conn, memoryview(cw), len(cw)):
+                                return
+                            (crc,) = FRAME_CRC_WORD.unpack(cw)
                     if length > BODY_LIMIT_BYTES:
                         m = svc.metrics
                         m.inc("augmentation_requests_total")
@@ -716,6 +741,36 @@ class UnixFrameServer:
                     return
                 if tmo:
                     conn.settimeout(None)
+                if crc is not None:
+                    if faults.ACTIVE is not None:
+                        seed = faults.corruption("frame_payload")
+                        if seed is not None and length:
+                            bad = faults.corrupt_buffer(
+                                np.frombuffer(
+                                    bytes(buf[:length]),
+                                    dtype=np.uint8), seed)
+                            buf[:length] = bad.tobytes()
+                    ok = zlib.crc32(
+                        memoryview(buf)[:length]) == crc
+                    telemetry.REGISTRY.counter_inc(
+                        "ldt_integrity_crc_total", lane="uds",
+                        result="ok" if ok else "mismatch")
+                    if not ok:
+                        # the full body was consumed, so the stream
+                        # is still framed: refuse THIS frame and keep
+                        # the connection — never parse garbage
+                        telemetry.REGISTRY.counter_inc(
+                            "ldt_integrity_detected_total",
+                            kind="frame_crc", lane="uds")
+                        m = svc.metrics
+                        m.inc("augmentation_requests_total")
+                        m.inc("augmentation_invalid_requests_total")
+                        m.inc_object("unsuccessful")
+                        telemetry.REGISTRY.counter_inc(
+                            "ldt_http_requests_total", lane="uds")
+                        send_frame(conn, 400, [CRC_ERROR_BODY],
+                                   request_id=request_id)
+                        continue
                 with self._lock:
                     self._inflight += 1
                 try:

@@ -123,11 +123,30 @@ class ScoringTables:
         arrays = load_artifact(path)
         z = {k[2:]: v for k, v in arrays.items() if k.startswith("c/")}
         qz = {k[2:]: v for k, v in arrays.items() if k.startswith("q/")}
-        return cls._build(z, qz or None, quad_warning=None if qz else (
+        st = cls._build(z, qz or None, quad_warning=None if qz else (
             f"{path} was packed without quad tables: quadgram scoring "
             "disabled, so most Latin/Cyrillic/Greek-script languages "
             "will detect as unknown. Re-pack with tools/artifact_tool.py "
             "--pack after training quad_tables.npz."))
+        # integrity identity: the artifact's digest-footer fingerprint
+        # (None for a legacy footerless pack) names the serving
+        # generation — result-cache epochs and /debug/vars use it
+        from .artifact import artifact_digest
+        st.artifact_digest = artifact_digest(path)
+        # golden-canary pack baked at artifact build time (the g/
+        # arrays, tools/artifact_tool.py --pack): pinned docs and their
+        # expected codes for integrity.py's per-lane canary check
+        gd, go = arrays.get("g/docs_u8"), arrays.get("g/docs_off")
+        cd, co = arrays.get("g/codes_u8"), arrays.get("g/codes_off")
+        if gd is not None and go is not None and cd is not None \
+                and co is not None:
+            st.canary_docs = tuple(
+                bytes(gd[go[i]:go[i + 1]]).decode("utf-8")
+                for i in range(len(go) - 1))
+            st.canary_codes = tuple(
+                bytes(cd[co[i]:co[i + 1]]).decode("ascii")
+                for i in range(len(co) - 1))
+        return st
 
     @classmethod
     def _build(cls, z, qz, quad_warning: str | None = None

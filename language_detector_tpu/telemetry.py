@@ -124,8 +124,31 @@ METRICS: dict = {
         "counter",
         "Artifact hot swaps by result: ok (new tables serving — "
         "counted by a standby generation once ready, or by "
-        "service/swap.py after an in-process rebind) or error "
-        "(aborted; the old tables keep serving)."),
+        "service/swap.py after an in-process rebind), error (aborted; "
+        "the old tables keep serving), or integrity_refused (the "
+        "standby artifact failed its digest footer; the old tables "
+        "keep serving)."),
+    "ldt_integrity_scrub_total": (
+        "counter",
+        "Integrity scrub passes per pool lane by result: ok, mismatch "
+        "(digest or canary deviation — the lane quarantined), or "
+        "error (the scrub itself failed; the lane keeps serving and "
+        "the next pass retries)."),
+    "ldt_integrity_detected_total": (
+        "counter",
+        "Corruption detections by kind (scrub = device table digest "
+        "mismatch, canary = golden-query deviation, frame_crc = "
+        "wire/shm payload CRC mismatch) and lane."),
+    "ldt_integrity_healed_total": (
+        "counter",
+        "Quarantined lanes healed: fresh tables re-uploaded from the "
+        "host mmap, fingerprint re-verified, lane re-admitted through "
+        "the half-open probe flow."),
+    "ldt_integrity_crc_total": (
+        "counter",
+        "Frame payload CRC32 checks by ingest lane and result "
+        "(LDT_WIRE_CRC; a mismatch refuses the frame with a typed 400 "
+        "before any parse)."),
     "ldt_warmup_ms": (
         "gauge",
         "Startup bucket-ladder warmup duration (LDT_WARMUP); 0 until "

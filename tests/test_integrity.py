@@ -1,0 +1,509 @@
+"""Data-plane integrity contract (integrity.py + the seams it guards):
+artifact digest footers, the host/device digest-fold parity the scrub
+depends on, the IntegrityMonitor quarantine/heal state machine against
+the REAL DevicePool, the `corrupt` fault-rule grammar, wire/shm frame
+CRC guards, and the epoch-namespaced ResultCache.
+
+The exhaustive interleaving proof ("scrub-heal") runs with the other
+model-check products in tests/test_model_check.py; ci.sh drives the
+live detect -> quarantine -> re-upload -> re-admit cycle as a chaos
+smoke on a real 2-lane engine.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import tempfile
+import zlib
+
+import numpy as np
+import pytest
+
+from language_detector_tpu import artifact, faults, integrity, telemetry
+from language_detector_tpu.service import shmring, wire
+from language_detector_tpu.service.batcher import _MISS, ResultCache
+
+# -- artifact digest footer --------------------------------------------------
+
+
+def _small_arrays():
+    return {
+        "a/ints": np.arange(50, dtype=np.int32),
+        "b/floats": np.linspace(0.0, 1.0, 33, dtype=np.float32),
+        "c/bytes": np.frombuffer(b"hello artifact", dtype=np.uint8),
+    }
+
+
+def test_footer_roundtrip_and_digest(tmp_path):
+    path = str(tmp_path / "m.ldta")
+    arrays = _small_arrays()
+    artifact.write_artifact(arrays, path)
+    loaded = artifact.load_artifact(path)
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(np.asarray(loaded[k]), v)
+    dig = artifact.artifact_digest(path)
+    assert dig is not None and len(dig) == 8
+    int(dig, 16)  # stable hex token
+    assert artifact.verify_artifact(path) == dig
+    # identity: same content -> same digest, different -> different
+    artifact.write_artifact(arrays, str(tmp_path / "m2.ldta"))
+    assert artifact.artifact_digest(str(tmp_path / "m2.ldta")) == dig
+    arrays["a/ints"] = arrays["a/ints"] + 1
+    artifact.write_artifact(arrays, str(tmp_path / "m3.ldta"))
+    assert artifact.artifact_digest(str(tmp_path / "m3.ldta")) != dig
+
+
+def _first_blob_offset(raw: bytes) -> int:
+    """Data offset of the first array blob (descriptor field 8)."""
+    fields = artifact._DESC.unpack_from(raw, artifact._HDR.size)
+    return fields[7]
+
+
+def test_payload_bitflip_raises_integrity_error(tmp_path):
+    path = str(tmp_path / "m.ldta")
+    artifact.write_artifact(_small_arrays(), path)
+    raw = bytearray(open(path, "rb").read())
+    raw[_first_blob_offset(raw)] ^= 0x01
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(artifact.ArtifactIntegrityError):
+        artifact.load_artifact(path)
+    with pytest.raises(artifact.ArtifactIntegrityError):
+        artifact.verify_artifact(path)
+    # the typed subclass keeps every existing ArtifactError handler
+    assert issubclass(artifact.ArtifactIntegrityError,
+                      artifact.ArtifactError)
+
+
+def test_descriptor_corruption_still_typed(tmp_path):
+    """A flip in the descriptor table (not digest-covered) must still
+    fail LOUD with the base typed error, never load garbage."""
+    path = str(tmp_path / "m.ldta")
+    artifact.write_artifact(_small_arrays(), path)
+    raw = bytearray(open(path, "rb").read())
+    raw[artifact._HDR.size + 56] = 0xFF  # descriptor 0's ndim word
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(artifact.ArtifactError):
+        artifact.load_artifact(path)
+
+
+def test_legacy_footerless_artifact_loads(tmp_path):
+    """A pre-footer artifact (flags=0, no digest table) loads
+    unchanged; digest helpers answer None instead of raising."""
+    path = str(tmp_path / "legacy.ldta")
+    arrays = _small_arrays()
+    artifact.write_artifact(arrays, path)
+    raw = bytearray(open(path, "rb").read())
+    magic, ver, n, flags, hb, total = artifact._HDR.unpack_from(raw, 0)
+    assert flags & artifact.FLAG_DIGESTS
+    foot = artifact._FOOT.size + 4 * n
+    artifact._HDR.pack_into(raw, 0, magic, ver, n, 0, hb, total - foot)
+    open(path, "wb").write(bytes(raw[:total - foot]))
+    loaded = artifact.load_artifact(path)
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(np.asarray(loaded[k]), v)
+    assert artifact.artifact_digest(path) is None
+    assert artifact.verify_artifact(path) is None
+
+
+# -- host/device digest-fold parity ------------------------------------------
+
+
+@pytest.mark.parametrize("arr", [
+    np.zeros(0, dtype=np.uint8),
+    np.arange(257, dtype=np.uint8),
+    np.array([True, False, True, True]),
+    (np.arange(1000) % 7 == 0),
+    np.arange(-300, 300, dtype=np.int16),
+    (np.arange(70000, dtype=np.uint64) * 2654435761
+     % (2 ** 32)).astype(np.uint32),
+    np.linspace(-1.0, 1.0, 513, dtype=np.float32),
+    np.arange(24, dtype=np.int32).reshape(2, 3, 4),
+], ids=["empty", "u8", "bool", "bool-long", "i16", "u32", "f32", "3d"])
+def test_fold_parity_host_vs_device(arr):
+    """The scrub's whole detection premise: the numpy fold and the
+    jitted device fold agree bit-for-bit on every plane dtype."""
+    import jax.numpy as jnp
+
+    from language_detector_tpu.ops import kernels
+    from language_detector_tpu.ops.device_tables import fold_host
+
+    host = fold_host(arr)
+    dev = int(np.asarray(kernels._fold(jnp.asarray(arr))))
+    assert host == dev
+    assert 0 <= host < 2 ** 32
+
+
+def test_fold_is_position_sensitive():
+    from language_detector_tpu.ops.device_tables import fold_host
+    a = np.array([1, 2, 3, 4], dtype=np.uint32)
+    b = np.array([2, 1, 3, 4], dtype=np.uint32)
+    assert fold_host(a) != fold_host(b)  # equal-sum swap still detected
+
+
+# -- IntegrityMonitor against the real pool ----------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+_GOOD = ("good",)
+_BAD = ("bad",)
+
+
+def _monitor(n_lanes=2, canary_fn=None, interval=0.0):
+    """IntegrityMonitor over REAL pool lanes, digests faked through
+    the same closure seam build_from_env uses (the model-check
+    harness pattern)."""
+    from language_detector_tpu.parallel.pool import DevicePool, Lane
+
+    clock = _Clock()
+    lanes = [Lane(i, None) for i in range(n_lanes)]
+    pool = DevicePool(lanes, hedge_factor=0.0, hedge_min_ms=0.0,
+                      evict_failures=1, probe_cooldown_sec=100.0,
+                      max_redispatch=1, clock=clock)
+    st = {"corrupt": [False] * n_lanes, "host_bad": False,
+          "raw": np.zeros(1, dtype=np.int32)}
+
+    def digest_fn(lane):
+        return _BAD if st["corrupt"][lane.idx] else _GOOD
+
+    def reupload_fn(lane):
+        if not st["host_bad"]:
+            st["corrupt"][lane.idx] = False
+        return _GOOD
+
+    mon = integrity.IntegrityMonitor(
+        lanes, {i: _GOOD for i in range(n_lanes)}, digest_fn,
+        reupload_fn, canary_fn=canary_fn, interval_sec=interval,
+        clock=clock)
+    return clock, pool, mon, st
+
+
+def _serve(pool, st, n=1):
+    for _ in range(n):
+        pf = pool.launch(lambda lane: st["raw"])
+        pool._fetch_on(pf.lane, pf.raw)
+        yield pf.lane
+
+
+def test_detect_quarantines_and_excludes_capacity():
+    from language_detector_tpu.parallel.pool import LANE_CORRUPT
+    clock, pool, mon, st = _monitor()
+    assert pool.capacity() == (2, 2)
+    st["corrupt"][0] = True
+    d0 = mon.stats["detected"]
+    assert mon.detect(pool.lanes[0], "scrub") is True
+    assert pool.lanes[0].state() == LANE_CORRUPT
+    assert pool.capacity() == (1, 2)
+    assert mon.stats["detected"] == d0 + 1
+    # idempotent: a second detection of the same lane never double-counts
+    assert mon.detect(pool.lanes[0], "canary") is False
+    assert mon.stats["detected"] == d0 + 1
+    # the quarantined lane is never drafted
+    assert all(ln.idx == 1 for ln in _serve(pool, st, n=8))
+
+
+def test_all_corrupt_raises_instead_of_serving():
+    from language_detector_tpu.parallel.pool import PoolExhausted
+    clock, pool, mon, st = _monitor()
+    for ln in pool.lanes:
+        st["corrupt"][ln.idx] = True
+        mon.detect(ln, "scrub")
+    with pytest.raises(PoolExhausted):
+        list(_serve(pool, st, n=1))
+
+
+def test_scrub_detects_heals_and_readmits():
+    from language_detector_tpu.parallel.pool import (LANE_ACTIVE,
+                                                     LANE_EVICTED)
+    clock, pool, mon, st = _monitor()
+    h0 = telemetry.REGISTRY.counter_value("ldt_integrity_healed_total",
+                                          lane=pool.lanes[0].name)
+    st["corrupt"][0] = True
+    assert mon.scrub_lane(pool.lanes[0]) == "mismatch"
+    # healed: fresh tables verified, probe immediately due — but the
+    # lane still owes one healthy served batch before it is ACTIVE
+    assert pool.lanes[0].state() == LANE_EVICTED
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_integrity_healed_total",
+        lane=pool.lanes[0].name) == h0 + 1
+    served = set()
+    for _ in range(4):
+        served.update(ln.idx for ln in _serve(pool, st, n=1))
+        if all(ln.state() == LANE_ACTIVE for ln in pool.lanes):
+            break
+    assert all(ln.state() == LANE_ACTIVE for ln in pool.lanes)
+    assert 0 in served  # re-admission went THROUGH a served probe
+    assert pool.capacity() == (2, 2)
+    assert mon.scrub_lane(pool.lanes[0]) == "ok"
+
+
+def test_bad_heal_source_keeps_quarantine_and_retries():
+    from language_detector_tpu.parallel.pool import (LANE_CORRUPT,
+                                                     LANE_EVICTED)
+    clock, pool, mon, st = _monitor()
+    st["corrupt"][0] = True
+    st["host_bad"] = True
+    assert mon.scrub_lane(pool.lanes[0]) == "mismatch"
+    assert pool.lanes[0].state() == LANE_CORRUPT  # heal failed: stays out
+    assert mon.stats["healed"] == 0
+    # next scrub retries the heal even though detect() is a no-op now
+    st["host_bad"] = False
+    assert mon.scrub_lane(pool.lanes[0]) == "mismatch"
+    assert pool.lanes[0].state() == LANE_EVICTED
+    assert mon.stats["healed"] == 1
+
+
+def test_canary_mismatch_detects():
+    from language_detector_tpu.parallel.pool import LANE_EVICTED
+    verdict = {"ok": True}
+    clock, pool, mon, st = _monitor(canary_fn=lambda lane:
+                                    verdict["ok"])
+    assert mon.scrub_lane(pool.lanes[0]) == "ok"
+    verdict["ok"] = False
+    d0 = mon.stats["detected"]
+    assert mon.scrub_lane(pool.lanes[0]) == "mismatch"
+    assert mon.stats["detected"] == d0 + 1
+    # table digests were clean, so the re-upload "heals" immediately
+    assert pool.lanes[0].state() == LANE_EVICTED
+
+
+def test_scrub_pass_contains_lane_errors():
+    clock, pool, mon, st = _monitor()
+    boom = {0: True}
+
+    def digest_fn(lane):
+        if boom.get(lane.idx):
+            raise RuntimeError("digest launch died")
+        return _GOOD
+
+    mon.digest_fn = digest_fn
+    e0 = telemetry.REGISTRY.counter_value(
+        "ldt_integrity_scrub_total", lane=pool.lanes[0].name,
+        result="error")
+    mon.scrub_pass()  # must not raise
+    assert mon.stats["scrubs"] == 1
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_integrity_scrub_total", lane=pool.lanes[0].name,
+        result="error") == e0 + 1
+
+
+def test_maybe_scrub_cadence():
+    clock, pool, mon, st = _monitor(interval=10.0)
+    assert mon.maybe_scrub() is False      # not due yet
+    clock.t = 11.0
+    assert mon.maybe_scrub() is True
+    assert mon.stats["scrubs"] == 1
+    assert mon.maybe_scrub() is False      # gated until the next window
+    clock.t = 22.0
+    assert mon.maybe_scrub() is True
+    mon.interval_sec = 0.0
+    clock.t = 1e9
+    assert mon.maybe_scrub() is False      # interval 0 = scrubbing off
+
+
+# -- the `corrupt` fault action ----------------------------------------------
+
+
+def test_corrupt_rule_schedule_and_isolation():
+    faults.configure("table_upload:corrupt:seed=5")
+    try:
+        # evaluate() (error/delay seams) must not consume the schedule
+        assert faults.evaluate("table_upload") == (0.0, False)
+        assert faults.corruption("table_upload") == 5
+        assert faults.corruption("table_upload") == 6  # arrival-indexed
+        assert faults.corruption("frame_payload") is None
+    finally:
+        faults.configure(None)
+    assert faults.corruption("table_upload") is None  # disarmed
+
+
+def test_corrupt_rule_once_fires_once():
+    faults.configure("table_upload:corrupt:seed=9:once")
+    try:
+        assert faults.corruption("table_upload") == 9
+        assert faults.corruption("table_upload") is None
+    finally:
+        faults.configure(None)
+
+
+def test_corrupt_buffer_is_deterministic_single_bit():
+    a = np.arange(64, dtype=np.uint8)
+    b1 = faults.corrupt_buffer(a, 7)
+    b2 = faults.corrupt_buffer(a, 7)
+    np.testing.assert_array_equal(b1, b2)
+    diff = np.bitwise_xor(a, b1)
+    assert np.count_nonzero(diff) == 1
+    assert bin(int(diff[diff != 0][0])).count("1") == 1
+    assert not np.array_equal(faults.corrupt_buffer(a, 8), b1)
+    np.testing.assert_array_equal(a, np.arange(64, dtype=np.uint8))
+
+
+def test_corrupt_tables_flips_one_plane():
+    import jax.numpy as jnp
+
+    # a plain tuple is a pytree, so it stands in for DeviceTables here
+    dt = (jnp.arange(16, dtype=jnp.uint32), jnp.ones(8, jnp.uint8))
+    bad = integrity.corrupt_tables(dt, seed=3)
+    changed = [not np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(dt, bad)]
+    assert sum(changed) == 1
+
+
+# -- wire-frame CRC guard ----------------------------------------------------
+
+
+def test_pack_frame_crc_layout_and_default_off(monkeypatch):
+    monkeypatch.delenv("LDT_WIRE_CRC", raising=False)
+    body = b'{"request": [{"text": "hi"}]}'
+    # knob unset: v1 frames stay byte-identical (zero adoption risk)
+    assert wire.pack_frame(body) == struct.pack("!I", len(body)) + body
+    f = wire.pack_frame(body, crc=True)
+    (lw,) = struct.unpack_from("!I", f)
+    assert lw & wire.FRAME_V2_FLAG and (lw ^ wire.FRAME_V2_FLAG) == \
+        len(body)
+    assert f[4] & wire.FRAME_CRC  # ext header leads with the flag byte
+    (crc,) = wire.FRAME_CRC_WORD.unpack_from(f, len(f) - len(body) - 4)
+    assert crc == zlib.crc32(body)
+    # knob on: pack_frame defaults to guarded frames
+    monkeypatch.setenv("LDT_WIRE_CRC", "1")
+    assert wire.pack_frame(body) == f
+
+
+def _read_frame(sock):
+    hdr = b""
+    while len(hdr) < 6:
+        chunk = sock.recv(6 - len(hdr))
+        assert chunk, "connection closed mid-header"
+        hdr += chunk
+    length, status = struct.unpack("!IH", hdr)
+    payload = b""
+    while len(payload) < length:
+        payload += sock.recv(length - len(payload))
+    return status, payload
+
+
+@pytest.fixture(scope="module")
+def scalar_svc():
+    from language_detector_tpu.service.server import DetectorService
+    svc = DetectorService(use_device=False, max_delay_ms=1.0)
+    yield svc
+    svc.batcher.close()
+
+
+def test_uds_crc_mismatch_answers_400_and_conn_survives(scalar_svc):
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-crc-"), "c.sock")
+    uds = wire.UnixFrameServer(scalar_svc, path)
+    uds.start()
+    body = b'{"request": [{"text": "a plain english sentence"}]}'
+    ok0 = telemetry.REGISTRY.counter_value(
+        "ldt_integrity_crc_total", lane="uds", result="ok")
+    bad0 = telemetry.REGISTRY.counter_value(
+        "ldt_integrity_crc_total", lane="uds", result="mismatch")
+    det0 = telemetry.REGISTRY.counter_value(
+        "ldt_integrity_detected_total", kind="frame_crc", lane="uds")
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(wire.pack_frame(body, crc=True))
+        status, payload = _read_frame(s)
+        assert status == 200 and b"iso6391code" in payload
+        # tamper: flip one body byte AFTER the crc was computed
+        frame = bytearray(wire.pack_frame(body, crc=True))
+        frame[-1] ^= 0x01
+        s.sendall(bytes(frame))
+        status, payload = _read_frame(s)
+        assert status == 400
+        assert payload == wire.CRC_ERROR_BODY
+        assert "CRC32" in json.loads(payload)["error"]
+        # the stream stayed framed: the SAME connection keeps serving
+        s.sendall(wire.pack_frame(body, crc=True))
+        status, payload = _read_frame(s)
+        assert status == 200 and b"iso6391code" in payload
+        s.close()
+    finally:
+        uds.close()
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_integrity_crc_total", lane="uds", result="ok") == ok0 + 2
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_integrity_crc_total", lane="uds",
+        result="mismatch") == bad0 + 1
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_integrity_detected_total", kind="frame_crc",
+        lane="uds") == det0 + 1
+
+
+def test_uds_frame_payload_fault_drives_crc_refusal(scalar_svc):
+    """The frame_payload chaos seam: an armed corrupt rule bit-flips
+    the received body and the CRC guard must catch it."""
+    path = os.path.join(tempfile.mkdtemp(prefix="ldt-crc-"), "f.sock")
+    uds = wire.UnixFrameServer(scalar_svc, path)
+    uds.start()
+    body = b'{"request": [{"text": "a plain english sentence"}]}'
+    faults.configure("frame_payload:corrupt:seed=11:once")
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(wire.pack_frame(body, crc=True))
+        status, payload = _read_frame(s)
+        assert status == 400 and payload == wire.CRC_ERROR_BODY
+        # rule was :once — the next frame parses clean
+        s.sendall(wire.pack_frame(body, crc=True))
+        status, payload = _read_frame(s)
+        assert status == 200 and b"iso6391code" in payload
+        s.close()
+    finally:
+        faults.configure(None)
+        uds.close()
+
+
+# -- shm slot CRC word -------------------------------------------------------
+
+
+def test_ring_crc_word_roundtrip(tmp_path):
+    rf = shmring.RingFile(str(tmp_path / "r.ring"), create=True,
+                          slots=4)
+    try:
+        payload = b"x" * 100
+        rf.write_payload(1, (payload,))
+        rf.write_slot(1, shmring.SLOT_READY, 0, os.getpid(), 1.0,
+                      len(payload), 0, reqid=0xAB12)
+        rf.write_crc(1, zlib.crc32(payload))
+        assert rf.read_crc(1) == zlib.crc32(payload)
+        assert rf.read_crc(0) == 0  # per-slot: neighbours untouched
+        # the crc word lives OUTSIDE the packed slot header: stamping it
+        # never perturbs the published state/length/reqid
+        st, gen, pid, ts, ln, status = rf.read_slot(1)
+        assert (st, ln) == (shmring.SLOT_READY, len(payload))
+        assert rf.slot_request_id(1) == 0xAB12
+        assert zlib.crc32(rf.read_payload(1, ln)) == rf.read_crc(1)
+    finally:
+        rf.close()
+
+
+# -- epoch-namespaced ResultCache --------------------------------------------
+
+
+def test_result_cache_epoch_flush_and_namespace():
+    c = ResultCache(1 << 20)
+    key = (None, "hello world")
+    c.put(key, {"lang": "en"}, "hello world")
+    assert c.get(key) == {"lang": "en"}
+    c.set_epoch("digest-A")
+    # the swap regression this PR fixes: a hit can never be a stale
+    # answer produced by the pre-swap tables
+    assert c.get(key) is _MISS
+    assert c.bytes == 0
+    c.put(key, {"lang": "fr"}, "hello world")
+    assert c.get(key) == {"lang": "fr"}
+    c.set_epoch("digest-A")  # idempotent: same epoch keeps entries
+    assert c.get(key) == {"lang": "fr"}
+    c.set_epoch("digest-B")
+    assert c.get(key) is _MISS

@@ -83,6 +83,57 @@ def test_swap_corrupt_artifact_aborts(scalar_svc, tmp_path):
         "ldt_swap_total", result="error") == err0 + 1
 
 
+def test_swap_refuses_standby_failing_digest_footer(scalar_svc,
+                                                    artifact_copy):
+    """Integrity refusal: a standby whose payload fails its digest
+    footer must be refused BEFORE any serving state is touched — a
+    distinct result label from the generic abort."""
+    from language_detector_tpu import artifact
+    svc = scalar_svc
+    raw = bytearray(open(artifact_copy, "rb").read())
+    raw[len(raw) // 2] ^= 0x01  # one bit of payload rot
+    open(artifact_copy, "wb").write(bytes(raw))
+    with pytest.raises(artifact.ArtifactIntegrityError):
+        artifact.verify_artifact(artifact_copy)
+    old_tables = svc._tables
+    ref0 = telemetry.REGISTRY.counter_value(
+        "ldt_swap_total", result="integrity_refused")
+    with pytest.raises(SwapError, match="integrity"):
+        swap_artifact(svc, artifact_copy)
+    assert svc._tables is old_tables and svc._swap_count == 0
+    assert _detect(svc, [EN]) == ["en"]  # old tables keep serving
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_swap_total", result="integrity_refused") == ref0 + 1
+
+
+def test_swap_flushes_result_cache_epoch(scalar_svc, artifact_copy):
+    """The staleness regression this PR fixes: a cached result must
+    never survive a swap (same key, new tables -> recompute)."""
+    from language_detector_tpu import artifact
+    from language_detector_tpu.service.batcher import (_MISS,
+                                                       ResultCache)
+    svc = scalar_svc
+    cache = ResultCache(1 << 20)
+    old_cache, svc.batcher._cache = svc.batcher._cache, cache
+    # front-registered caches (the aio front's) flush through the same
+    # hook
+    front = ResultCache(1 << 20)
+    svc._result_caches = [front]
+    try:
+        key = (None, "a cached doc")
+        cache.put(key, {"pin": 1}, "a cached doc")
+        front.put(key, {"pin": 2}, "a cached doc")
+        assert cache.get(key) == {"pin": 1}
+        assert swap_artifact(svc, artifact_copy)["swapped"]
+        assert cache.get(key) is _MISS   # flushed at the rebind
+        assert front.get(key) is _MISS
+        assert cache._epoch == artifact.artifact_digest(artifact_copy)
+        assert front._epoch == cache._epoch
+    finally:
+        svc.batcher._cache = old_cache
+        del svc._result_caches
+
+
 def test_swap_cutover_fault_aborts(scalar_svc, artifact_copy):
     svc = scalar_svc
     old_tables = svc._tables

@@ -207,6 +207,10 @@ def cmd_verify() -> int:
             # reverse direction: arrays the npz no longer carries (or a
             # deleted quad_tables.npz) must not survive in the pack
             for pk in sorted(set(packed) - expected_keys):
+                if pk.startswith("g/"):
+                    # golden-canary namespace: --pack derives these
+                    # itself (no npz source), exempt from the check
+                    continue
                 errors.append(f"model.ldta: {pk} no longer in the npz "
                               "sources (stale pack — rerun --pack)")
     if errors:
@@ -225,6 +229,32 @@ def _npz_sources():
         yield name, prefix, DATA / name
 
 
+def _canary_arrays(npz: dict) -> dict:
+    """Golden-query canary pack baked into the artifact (g/ namespace):
+    the pinned integrity.CANARY_DOCS plus the codes the tables being
+    packed ACTUALLY detect for them (scalar oracle — the device twin is
+    bit-parity-pinned against it). integrity.py's per-lane canary check
+    compares live device results against these at scrub time."""
+    from language_detector_tpu.engine_scalar import detect_scalar
+    from language_detector_tpu.integrity import CANARY_DOCS
+    from language_detector_tpu.registry import registry as reg
+    from language_detector_tpu.tables import ScoringTables
+
+    tables = ScoringTables._build(npz["c/"], npz.get("q/"))
+    codes = [reg.code(detect_scalar(d, tables, reg).summary_lang)
+             for d in CANARY_DOCS]
+
+    def concat(chunks):
+        off = np.zeros(len(chunks) + 1, dtype=np.int64)
+        off[1:] = np.cumsum([len(b) for b in chunks])
+        return (np.frombuffer(b"".join(chunks), dtype=np.uint8), off)
+
+    du8, doff = concat([d.encode("utf-8") for d in CANARY_DOCS])
+    cu8, coff = concat([c.encode("ascii") for c in codes])
+    return {"g/docs_u8": du8, "g/docs_off": doff,
+            "g/codes_u8": cu8, "g/codes_off": coff}
+
+
 def cmd_pack() -> int:
     """npz pair -> single-file mmap artifact (data/model.ldta) with an
     immediate round-trip verification: every array loaded back through
@@ -232,6 +262,7 @@ def cmd_pack() -> int:
     from language_detector_tpu.artifact import load_artifact, write_artifact
 
     arrays: dict = {}
+    npz: dict = {}
     for name, prefix, path in _npz_sources():
         if not path.exists():
             if name == "quad_tables.npz":
@@ -239,8 +270,10 @@ def cmd_pack() -> int:
             print(f"PACK FAIL: {name} missing")
             return 1
         z = np.load(path, allow_pickle=False)
+        npz[prefix] = {k: z[k] for k in z.files}
         for k in z.files:
             arrays[prefix + k] = z[k]
+    arrays.update(_canary_arrays(npz))
     out = DATA / "model.ldta"
     write_artifact(arrays, out)
     back = load_artifact(out)

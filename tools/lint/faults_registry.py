@@ -5,8 +5,9 @@ The declaration is language_detector_tpu/faults.py's FAULT_POINTS
 (name -> where the seam lives); the docs contract is the fault-point
 table in docs/ROBUSTNESS.md between the ldt-fault-table markers (first
 backticked token of each table row). Usage is extracted from the first
-string argument of faults.hit / faults.hit_async / faults.evaluate
-calls — the same first-literal-argument discipline the metric-registry
+string argument of faults.hit / faults.hit_async / faults.evaluate /
+faults.corruption calls — the same first-literal-argument discipline
+the metric-registry
 analyzer uses, so a seam wired through a variable name is invisible to
 the operator docs and the analyzer alike (don't do that).
 
@@ -29,7 +30,7 @@ from .base import (Violation, apply_suppressions, first_str_arg,
 FAULTS_REL = "language_detector_tpu/faults.py"
 DOCS_REL = "docs/ROBUSTNESS.md"
 
-HIT_CALLS = frozenset({"hit", "hit_async", "evaluate"})
+HIT_CALLS = frozenset({"hit", "hit_async", "evaluate", "corruption"})
 
 MARK_BEGIN = "<!-- ldt-fault-table:begin -->"
 MARK_END = "<!-- ldt-fault-table:end -->"

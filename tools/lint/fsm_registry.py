@@ -106,13 +106,17 @@ MACHINES = (
         scope=("class", "Lane"),
         kind="attr",
         var="_state",
-        states={"LANE_ACTIVE": 0, "LANE_EVICTED": 1, "LANE_PROBING": 2},
+        states={"LANE_ACTIVE": 0, "LANE_EVICTED": 1, "LANE_PROBING": 2,
+                "LANE_CORRUPT": 3},
         initial="LANE_ACTIVE",
         transitions=frozenset({
             ("LANE_ACTIVE", "LANE_EVICTED"),   # consecutive failures
             ("LANE_EVICTED", "LANE_PROBING"),  # cooldown probe admitted
             ("LANE_PROBING", "LANE_ACTIVE"),   # probe succeeded
             ("LANE_PROBING", "LANE_EVICTED"),  # probe failed
+            ("LANE_ACTIVE", "LANE_CORRUPT"),   # scrub/canary mismatch
+            ("LANE_CORRUPT", "LANE_EVICTED"),  # healed: fresh tables,
+            #                                    probe immediately due
         }),
     ),
     Machine(

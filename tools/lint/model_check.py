@@ -923,6 +923,154 @@ def _r5_inv_fenced_fail_explicitly(r):
 
 
 # ---------------------------------------------------------------------
+# product 6: integrity scrub x pool lane quarantine/heal
+#
+# The real IntegrityMonitor drives the real Lane/DevicePool edges;
+# only the digest closures are fake (a mutable corrupt flag per lane
+# stands in for the device fold). `host_bad` models the heal source
+# itself failing verification — re-uploaded tables still hash wrong —
+# which is the only way a lane can stay CORRUPT past a scrub.
+
+_P6_COOLDOWN = 10.0
+_P6_GOOD = ("good",)
+_P6_BAD = ("bad",)
+
+
+def _p6_build():
+    import numpy as np
+
+    from language_detector_tpu.integrity import IntegrityMonitor
+    from language_detector_tpu.parallel.pool import DevicePool, Lane
+
+    clock = FakeClock()
+    raw = np.zeros(1, dtype=np.int32)
+    lanes = [Lane(0, None), Lane(1, None)]
+    pool = DevicePool(lanes, hedge_factor=0.0, hedge_min_ms=0.0,
+                      evict_failures=1,
+                      probe_cooldown_sec=_P6_COOLDOWN,
+                      max_redispatch=1, clock=clock)
+    st = {"corrupt": [False, False], "host_bad": False, "raw": raw}
+
+    def digest_fn(lane):
+        return _P6_BAD if st["corrupt"][lane.idx] else _P6_GOOD
+
+    def reupload_fn(lane):
+        if not st["host_bad"]:
+            st["corrupt"][lane.idx] = False
+        return _P6_GOOD
+
+    mon = IntegrityMonitor(lanes, {0: _P6_GOOD, 1: _P6_GOOD},
+                           digest_fn, reupload_fn,
+                           interval_sec=1.0, clock=clock)
+    return clock, pool, mon, st
+
+
+def _p6_corrupt(i):
+    def ev(clock, pool, mon, st):
+        if st["corrupt"][i]:
+            return False        # already corrupt: prune the branch
+        st["corrupt"][i] = True
+    return ev
+
+
+def _p6_scrub(clock, pool, mon, st):
+    """One full scrub pass over both lanes: mismatch -> detect
+    (ACTIVE -> CORRUPT) -> heal attempt (re-upload; CORRUPT ->
+    EVICTED with the probe due, unless the host source is bad)."""
+    mon.scrub_pass()
+
+
+def _p6_ok(clock, pool, mon, st):
+    """One successful dispatch + fetch; a PROBING lane's success
+    re-admits it. An all-corrupt pool refuses typed instead."""
+    from language_detector_tpu.parallel.pool import PoolExhausted
+    try:
+        pf = pool.launch(lambda lane: st["raw"])
+    except PoolExhausted:
+        return
+    pool._fetch_on(pf.lane, pf.raw)
+
+
+_P6_EVENTS = {
+    "corrupt0": _p6_corrupt(0),
+    "corrupt1": _p6_corrupt(1),
+    "host_bad": lambda c, p, m, st: (
+        False if st["host_bad"] else st.__setitem__("host_bad", True)),
+    "host_ok": lambda c, p, m, st: (
+        False if not st["host_bad"]
+        else st.__setitem__("host_bad", False)),
+    "scrub": _p6_scrub,
+    "ok": _p6_ok,
+    "advance": lambda c, p, m, st: c.advance(_P6_COOLDOWN + 0.1),
+}
+
+
+def _p6_key(clock, pool, mon, st):
+    lanes = tuple(
+        (ln._state, min(ln._consecutive, 1),
+         ln.probe_due(clock(), pool.probe_cooldown_sec))
+        for ln in pool.lanes)
+    return (lanes, pool._rr % len(pool.lanes),
+            tuple(st["corrupt"]), st["host_bad"])
+
+
+def _p6_inv_never_serve_corrupt(clock, pool, mon, st):
+    """THE integrity property: no reachable state lets the pool draft
+    a CORRUPT lane — and when every lane is quarantined, launch
+    refuses with the typed PoolExhausted, never a silent wrong-answer
+    dispatch."""
+    from language_detector_tpu.parallel.pool import (LANE_CORRUPT,
+                                                     PoolExhausted)
+    states = [ln.state() for ln in pool.lanes]
+    if LANE_CORRUPT not in states:
+        return None
+    if all(s == LANE_CORRUPT for s in states):
+        try:
+            pool.launch(lambda lane: st["raw"])
+        except PoolExhausted:
+            return None
+        return ("every lane quarantined CORRUPT but launch still "
+                "dispatched instead of raising PoolExhausted")
+    for _ in range(4 * len(pool.lanes)):
+        pf = pool.launch(lambda lane: st["raw"])
+        if pf.lane.state() == LANE_CORRUPT:
+            return (f"pool drafted quarantined lane {pf.lane.idx} "
+                    f"(state CORRUPT) for a dispatch")
+        pool._fetch_on(pf.lane, pf.raw)
+    return None
+
+
+def _p6_inv_corrupt_converges_active(clock, pool, mon, st):
+    """From any state with a quarantined lane: once the heal source is
+    good again, one scrub re-uploads + hands the lane back to the
+    half-open flow with its probe due, and served batches complete the
+    probes back to ACTIVE — full capacity restored."""
+    from language_detector_tpu.parallel.pool import (LANE_ACTIVE,
+                                                     LANE_CORRUPT)
+    if not any(ln.state() == LANE_CORRUPT for ln in pool.lanes):
+        return None
+    st["host_bad"] = False
+    mon.scrub_pass()
+    for ln in pool.lanes:
+        if ln.state() == LANE_CORRUPT:
+            return (f"lane {ln.idx} still CORRUPT after a scrub with "
+                    f"a healthy heal source — heal never retried")
+    for _ in range(4 * len(pool.lanes)):
+        if all(ln.state() == LANE_ACTIVE for ln in pool.lanes):
+            break
+        pf = pool.launch(lambda lane: st["raw"])
+        pool._fetch_on(pf.lane, pf.raw)
+    for ln in pool.lanes:
+        if ln.state() != LANE_ACTIVE:
+            return (f"healed lane {ln.idx} did not re-admit to ACTIVE "
+                    f"through served probe batches (state "
+                    f"{ln.state()})")
+    if pool.capacity()[0] < len(pool.lanes):
+        return "heal converged but capacity was not fully restored"
+    return None
+
+
+# ---------------------------------------------------------------------
 # analyzer entry point
 
 PRODUCTS = (
@@ -954,6 +1102,11 @@ PRODUCTS = (
          "ring-every-slot-recovers": _r5_inv_recovers,
          "ring-no-premature-reclaim": _r5_inv_no_premature_reclaim,
          "ring-fenced-fail-explicitly": _r5_inv_fenced_fail_explicitly,
+     }),
+    ("scrub-heal", "language_detector_tpu/integrity.py",
+     _p6_build, _P6_EVENTS, _p6_key, {
+         "never-serve-while-corrupt": _p6_inv_never_serve_corrupt,
+         "corrupt-converges-active": _p6_inv_corrupt_converges_active,
      }),
 )
 
