@@ -1093,6 +1093,290 @@ def bench_shm(total_docs: int = 8192, docs_per_request: int = 64) -> dict:
         log.close()
 
 
+_COLDSTART_CHILD = """\
+import json, sys, time
+t0 = time.perf_counter()
+from language_detector_tpu.models.ngram import NgramBatchEngine
+t1 = time.perf_counter()
+docs = json.load(open(sys.argv[1]))
+eng = NgramBatchEngine()
+codes = eng.detect_codes(docs, batch_size=4096)
+t2 = time.perf_counter()
+st = eng._aot.stats() if getattr(eng, "_aot", None) is not None else None
+json.dump({"import_ms": round((t1 - t0) * 1e3, 1),
+           "cold_to_ready_ms": round((t2 - t1) * 1e3, 1),
+           "dispatches": eng.stats["device_dispatches"],
+           "aot": st, "codes": codes},
+          open(sys.argv[2], "w"))
+"""
+
+
+def bench_coldstart(fleet_workers: int = 2, unique_docs: int = 256,
+                    requests: int = 256) -> dict:
+    """--coldstart: the round-16 boot-hot A/B (BENCH_r11.json).
+
+    Part 1 — cold-to-ready ladder, one fresh subprocess per mode:
+    engine construction + first full detect over a service corpus with
+    (a) nothing cached, (b) a warm persistent compile cache
+    (LDT_COMPILE_CACHE_DIR), (c) the warm compile cache plus an AOT
+    executable bundle (LDT_AOT_DIR). The AOT leg must load, not
+    compile, and all three modes must answer bit-identically.
+
+    Part 2 — duplicate-heavy fleet pass: a REUSEPORT fleet with the
+    shm result tier armed serves a corpus where every member sees the
+    same documents, against a private-cache fleet on the same corpus.
+    A member's own fills live in its L1 and never reach the shm probe,
+    so the shared-cache hit counters scraped from each member's
+    /debug/vars count *cross-process* reuse by construction.
+    """
+    import http.client
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    work = tempfile.mkdtemp(prefix="ldt-coldstart-")
+    docs = make_corpus(unique_docs)
+    docs_file = os.path.join(work, "docs.json")
+    with open(docs_file, "w") as f:
+        json.dump(docs, f)
+    cc_dir = os.path.join(work, "compile-cache")
+    aot_dir = os.path.join(work, "aot-bundle")
+
+    def run_child(tag: str, env_extra: dict) -> dict:
+        out = os.path.join(work, f"{tag}.json")
+        env = os.environ.copy()
+        env.pop("LDT_COMPILE_CACHE_DIR", None)
+        env.pop("LDT_AOT_DIR", None)
+        env.update(env_extra)
+        r = subprocess.run(
+            [sys.executable, "-c", _COLDSTART_CHILD, docs_file, out],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=900)
+        assert r.returncode == 0, f"{tag} child: {r.stderr[-4000:]}"
+        with open(out) as f:
+            return json.load(f)
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def fleet_pass(shm_mb: float) -> dict:
+        port, sport, mbase = free_port(), free_port(), free_port()
+        env = os.environ.copy()
+        env.update({
+            "LISTEN_PORT": str(port),
+            "PROMETHEUS_PORT": str(mbase),
+            "LDT_FLEET_WORKERS": str(fleet_workers),
+            "LDT_FLEET_STATUS_PORT": str(sport),
+            # boot-hot members: the part-1 prep child warmed both
+            "LDT_COMPILE_CACHE_DIR": cc_dir,
+            "LDT_AOT_DIR": aot_dir,
+            # the shm tier rides the per-worker cache — L1 must be on
+            "LDT_RESULT_CACHE_MB": "64",
+        })
+        env.pop("LDT_RESULT_CACHE_SHM_MB", None)
+        if shm_mb:
+            env["LDT_RESULT_CACHE_SHM_MB"] = str(shm_mb)
+        log = open(os.path.join(work, f"fleet-{shm_mb}.log"), "w")
+        sup = subprocess.Popen(
+            [sys.executable, "-m",
+             "language_detector_tpu.service.supervisor",
+             "language_detector_tpu.service.aioserver"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+
+        def fleetz():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{sport}/fleetz",
+                    timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        try:
+            deadline = time.time() + 300
+            while True:
+                try:
+                    if fleetz()["ready"] == fleet_workers:
+                        break
+                except Exception:  # noqa: BLE001 - still booting
+                    pass
+                if sup.poll() is not None:
+                    raise RuntimeError(f"fleet died rc={sup.poll()}")
+                if time.time() > deadline:
+                    raise RuntimeError("fleet never became ready")
+                time.sleep(0.2)
+
+            # duplicate-heavy: every request carries the SAME corpus,
+            # so whichever member answers first publishes and the rest
+            # can only reuse across the process boundary
+            payload = json.dumps(
+                {"request": [{"text": d} for d in docs]}).encode()
+            lock = threading.Lock()
+            state = {"left": requests, "docs": 0, "drops": 0}
+
+            def drive():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=120)
+                while True:
+                    with lock:
+                        if state["left"] <= 0:
+                            break
+                        state["left"] -= 1
+                    try:
+                        conn.request(
+                            "POST", "/", payload,
+                            {"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        body = resp.read()
+                    except Exception:  # noqa: BLE001 - counted as drop
+                        with lock:
+                            state["drops"] += 1
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=120)
+                        continue
+                    with lock:
+                        if resp.status in (200, 203):
+                            state["docs"] += body.count(
+                                b'"iso6391code"')
+                        else:
+                            state["drops"] += 1
+                conn.close()
+
+            def run_pass(n: int) -> float:
+                with lock:
+                    state["left"] = n
+                threads = [threading.Thread(target=drive)
+                           for _ in range(8)]
+                t0 = time.time()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return time.time() - t0
+
+            # warm pass, SEQUENTIAL with a fresh connection each time:
+            # REUSEPORT hops connections across members, so the first
+            # member to serve publishes into the shm tier and the
+            # others take their first exposure as cross-process hits.
+            # (A concurrent warm would race every member through its
+            # private miss path in the same instant and the L1s would
+            # absorb all the duplicates before the tier is ever probed
+            # again — first exposure is exactly what the tier exists
+            # for, so it is what the bench serializes.)
+            for _ in range(4 * fleet_workers):
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=120)
+                conn.request("POST", "/", payload,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status in (200, 203), resp.status
+                conn.close()
+            with lock:
+                state["docs"] = 0
+                state["drops"] = 0
+            took = run_pass(requests)
+            assert state["drops"] == 0, \
+                f"{state['drops']} drops — the pass must be zero-drop"
+            assert state["docs"] > 0, "nothing served in the timed pass"
+
+            shared = []
+            for m in fleetz()["members"]:
+                mp = int(m.get("metrics_port") or 0)
+                if mp <= 0:
+                    continue
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mp}/debug/vars",
+                        timeout=10) as resp:
+                    dv = json.loads(resp.read().decode())
+                sc = dv.get("shared_cache")
+                if sc:
+                    shared.append({"slot": m["slot"],
+                                   "hits": sc["hits"],
+                                   "misses": sc["misses"],
+                                   "hit_rate": sc["hit_rate"]})
+            sup.send_signal(signal.SIGINT)
+            rc = sup.wait(timeout=120)
+            assert rc == 0, f"fleet exit {rc}"
+            return {"docs_sec": round(state["docs"] / took, 1),
+                    "total_docs": state["docs"],
+                    "took_sec": round(took, 2),
+                    "members_with_shared_stats": shared}
+        finally:
+            try:
+                os.killpg(sup.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            sup.wait(timeout=30)
+            log.close()
+
+    try:
+        prep = run_child("prep", {"LDT_COMPILE_CACHE_DIR": cc_dir,
+                                  "LDT_AOT_DIR": aot_dir})
+        assert prep["dispatches"] > 0, "corpus never dispatched"
+        assert prep["aot"]["exports"] > 0, prep["aot"]
+        no_cache = run_child("no_cache", {})
+        compile_cache = run_child("compile_cache",
+                                  {"LDT_COMPILE_CACHE_DIR": cc_dir})
+        aot = run_child("aot", {"LDT_COMPILE_CACHE_DIR": cc_dir,
+                                "LDT_AOT_DIR": aot_dir})
+        assert aot["aot"]["loads"] > 0, aot["aot"]
+        assert aot["aot"]["refusals"] == 0, aot["aot"]
+        assert aot["codes"] == compile_cache["codes"] == \
+            no_cache["codes"], \
+            "cold-start modes must answer bit-identically"
+
+        shared_fleet = fleet_pass(8.0)
+        cross_hits = sum(m["hits"] for m in
+                         shared_fleet["members_with_shared_stats"])
+        assert cross_hits > 0, \
+            "duplicate-heavy pass produced no cross-member hits: " \
+            + json.dumps(shared_fleet)
+        private_fleet = fleet_pass(0.0)
+        assert not private_fleet["members_with_shared_stats"], \
+            "private baseline must not attach a shared tier"
+
+        ratio = aot["cold_to_ready_ms"] \
+            / max(compile_cache["cold_to_ready_ms"], 1e-9)
+        result = {
+            "bench": "coldstart",
+            "unique_docs": unique_docs,
+            "fleet_workers": fleet_workers,
+            "no_cache": {k: no_cache[k] for k in
+                         ("import_ms", "cold_to_ready_ms")},
+            "compile_cache": {k: compile_cache[k] for k in
+                              ("import_ms", "cold_to_ready_ms")},
+            "aot": {"import_ms": aot["import_ms"],
+                    "cold_to_ready_ms": aot["cold_to_ready_ms"],
+                    "loads": aot["aot"]["loads"]},
+            "aot_vs_compile_cache": round(ratio, 3),
+            "bit_identical": True,
+            "duplicate_heavy_fleet": {
+                "shared": shared_fleet,
+                "private_baseline": private_fleet,
+                "cross_member_hits": cross_hits,
+                "shared_vs_private": round(
+                    shared_fleet["docs_sec"]
+                    / max(private_fleet["docs_sec"], 1e-9), 3),
+            },
+        }
+        # the 0.5x gate from the round-16 acceptance list — loud here,
+        # held again (cheaper) by the ci.sh boot-hot smoke
+        assert ratio <= 0.5, \
+            f"AOT cold-to-ready {aot['cold_to_ready_ms']}ms is " \
+            f"{ratio:.2f}x the compile-cache path — gate is 0.5x"
+        return result
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_telemetry_overhead(n: int = 20_000) -> dict:
     """ns per flight-recorder event and per trace span, measured on
     the real code paths (armed recorder into a temp ring, module-level
@@ -1140,6 +1424,8 @@ if __name__ == "__main__":
     # --longdoc [N]: span-parallel lane A/B over a fat-tail corpus
     # --fleet [N]: N-worker front-tier saturation vs 1-worker baseline
     # --shm: shared-memory ring lane vs the UDS lane, one sync worker
+    # --coldstart [N]: boot-hot A/B — no-cache vs compile-cache vs AOT
+    #   cold-to-ready, plus the duplicate-heavy N-member fleet pass
     if len(sys.argv) > 1 and sys.argv[1] == "--longdoc":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 256
         print(json.dumps(bench_longdoc(n)))
@@ -1165,6 +1451,13 @@ if __name__ == "__main__":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
         out = bench_kernel(n)
         with open(REPO / "BENCH_r10.json", "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--coldstart":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+        out = bench_coldstart(fleet_workers=n)
+        with open(REPO / "BENCH_r11.json", "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
         print(json.dumps(out))

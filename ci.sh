@@ -1466,4 +1466,209 @@ print("shm chaos:", served, "docs served under lease faults",
       int(frames or 0), "frames total — all-FREE, clean exit")
 EOF
 
+echo "== boot-hot smoke =="
+# the boot-hot fleet (docs/PERF.md round 16): a supervised 2-member
+# front tier with LDT_AOT_DIR + LDT_COMPILE_CACHE_DIR pointing at
+# FRESH dirs, warmup gating /readyz. Generation 1 compiles for real
+# and AOT-exports every ladder tier it touched; a SIGHUP roll then
+# boots generation 2 against the bundle. The invariants: gen-2 members
+# deserialize executables instead of compiling (ldt_aot_loads_total
+# > 0, zero refusals) and warm up in < 0.5x their slot's gen-1 wall
+# time; a duplicate-heavy sequential burst over fresh connections
+# (SO_REUSEPORT hops members) lands cross-member hits in the
+# shm-backed shared result-cache tier; SIGINT drains and exits 0.
+# Runs under the lock-order watchdog like the rest of CI.
+python3 - <<'EOF'
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+PORT, MBASE, SPORT = 3187, 31870, 31879
+TMP = tempfile.mkdtemp(prefix="ldt_boothot_")
+env = dict(os.environ)
+env.pop("LDT_AOT_DIR", None)             # fresh dirs: gen-1 must pay
+env.pop("LDT_COMPILE_CACHE_DIR", None)   # the real compiles
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MBASE),
+    "LDT_FLEET_WORKERS": "2",
+    "LDT_FLEET_STATUS_PORT": str(SPORT),
+    "LDT_WARMUP": "1",
+    "LDT_AOT_DIR": os.path.join(TMP, "aot"),
+    "LDT_COMPILE_CACHE_DIR": os.path.join(TMP, "cc"),
+    # the shm tier rides the per-worker ResultCache, so the private
+    # L1 knob must be armed too (docs/OBSERVABILITY.md)
+    "LDT_RESULT_CACHE_MB": "64",
+    "LDT_RESULT_CACHE_SHM_MB": "8",
+    "LDT_CRASH_BACKOFF_BASE_SEC": "0.2",
+    "LDT_CRASH_BACKOFF_MAX_SEC": "1.0",
+    "LDT_SWAP_TIMEOUT_SEC": "150",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_boothot_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+
+def fleetz():
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{SPORT}/fleetz", timeout=10) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def wait_fleet(pred, what, deadline_sec):
+    deadline = time.time() + deadline_sec
+    while True:
+        snap = fleetz()
+        if snap is not None and pred(snap):
+            return snap
+        assert time.time() < deadline, \
+            f"fleet never reached: {what} — last: {snap}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.2)
+
+
+def series(text, name):
+    """Sum every sample of a metric family in a /metrics scrape
+    (labelled or not); None when the family is absent."""
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else None
+
+
+def member_scrape(port, generation, deadline_sec=60):
+    """Scrape a member's /metrics, retrying until the scrape comes
+    from the expected worker generation (a roll hands the metrics
+    port from the old process to its replacement)."""
+    deadline = time.time() + deadline_sec
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                last = r.read().decode()
+            if series(last, "ldt_worker_generation") == generation:
+                return last
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise AssertionError(
+        f"member metrics on :{port} never showed generation "
+        f"{generation} — last scrape: {(last or '')[:400]}")
+
+
+def debug_vars(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/vars", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def shared_hits(snap):
+    total = 0.0
+    for m in snap["members"]:
+        sc = debug_vars(m["metrics_port"]).get("shared_cache")
+        assert sc, f"shared_cache block missing on slot {m['slot']}"
+        total += sc["hits"]
+    return total
+
+
+try:
+    snap = wait_fleet(
+        lambda s: s["ready"] == 2 and s["circuit"] == "closed",
+        "2 READY members", 300)
+    gen1 = max(m["generation"] for m in snap["members"])
+
+    # gen-1 baseline: real compiles, and the bundle got written
+    warm1, exports1 = {}, 0.0
+    for m in snap["members"]:
+        text = member_scrape(m["metrics_port"], m["generation"])
+        w = series(text, "ldt_warmup_ms")
+        assert w and w > 0, f"slot {m['slot']} never warmed: {w}"
+        warm1[m["slot"]] = w
+        exports1 += series(text, "ldt_aot_exports_total") or 0.0
+    assert exports1 > 0, "generation 1 exported nothing to the bundle"
+
+    os.kill(sup.pid, signal.SIGHUP)          # roll onto the bundle
+
+    snap = wait_fleet(
+        lambda s: (s["ready"] == 2 and s["circuit"] == "closed"
+                   and min(m["generation"] for m in s["members"])
+                   > gen1),
+        "2 READY post-roll", 420)
+
+    # gen-2: executables deserialize instead of compiling
+    for m in snap["members"]:
+        text = member_scrape(m["metrics_port"], m["generation"])
+        w2 = series(text, "ldt_warmup_ms")
+        loads = series(text, "ldt_aot_loads_total") or 0.0
+        refused = series(text, "ldt_aot_refused_total") or 0.0
+        w1 = warm1[m["slot"]]
+        assert loads > 0, f"slot {m['slot']} loaded no AOT executable"
+        assert refused == 0, \
+            f"slot {m['slot']} refused {refused} bundle entries"
+        assert w2 and w2 < 0.5 * w1, \
+            (f"slot {m['slot']} gen-2 warmup {w2:.0f}ms not < 0.5x "
+             f"gen-1 {w1:.0f}ms")
+
+    # duplicate-heavy burst: the SAME 8 docs, 16 sequential requests,
+    # each on a fresh connection so SO_REUSEPORT hops members. A
+    # member's own fills live in its private L1, so every shared-tier
+    # hit below is cross-member by construction. (Sequential on
+    # purpose: a concurrent burst would race both members through
+    # their private miss paths in the same instant.)
+    hits0 = shared_hits(snap)
+    body = json.dumps({"request": [
+        {"text": f"el veloz murcielago hindu comia feliz cardillo {i}"}
+        for i in range(8)
+    ]}).encode()
+    for _ in range(16):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{PORT}/", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read().decode())
+        assert len(out["response"]) == 8, out
+    hits1 = shared_hits(snap)
+    assert hits1 > hits0, \
+        (f"no cross-member shared-cache hits during the burst "
+         f"({hits0} -> {hits1})")
+
+    sup.send_signal(signal.SIGINT)           # drain both members
+    rc = sup.wait(timeout=120)
+    assert rc == 0, f"fleet exit {rc}"
+finally:
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+    shutil.rmtree(TMP, ignore_errors=True)
+
+suplog = open("/tmp/ldt_boothot_smoke.log").read()
+assert "rolling swap complete" in suplog, "the roll never completed"
+assert "swap-abort" not in suplog, "roll aborted:\n" + suplog
+
+g1 = max(warm1.values())
+print("boot-hot:", f"{exports1:.0f} executables exported by gen-1,",
+      f"gen-1 warmup {g1:.0f}ms -> gen-2 loaded the bundle in",
+      "< 0.5x per slot,", f"{hits1 - hits0:.0f} cross-member",
+      "shared-cache hits on the duplicate burst, clean exit")
+EOF
+
 echo "CI OK"

@@ -1,0 +1,505 @@
+"""AOT-exported bucket-ladder executables (LDT_AOT_DIR).
+
+Round 16 (ROADMAP item 2a). Every fresh worker generation — a restart,
+a blue/green standby, an autoscaled fleet member — used to pay the full
+per-process compile ladder before /readyz opened; the persistent XLA
+compile cache (LDT_COMPILE_CACHE_DIR) removes the XLA backend compile
+but still re-traces and re-lowers every tier shape through jax. This
+module ships the *finished* executables alongside the model artifact
+instead, the compile-once-serve-many discipline of the pjit/TPUv4
+serving stack and the portable-compiled-artifact framing in PAPERS.md:
+
+  - After the engine compiles a ladder tier (a new padded wire shape on
+    the serving scorer), the compiled program is serialized into a
+    sidecar bundle entry under LDT_AOT_DIR (write-back, one file per
+    tier shape).
+  - A later process (generation N+1, a standby, a new fleet member)
+    finds the entry at dispatch time and deserializes the executable in
+    milliseconds — no trace, no lower, no XLA compile.
+
+Each entry carries TWO payloads:
+
+  - the ``jax.export`` serialized module (portable StableHLO + calling
+    convention, the versioned interchange format); loading it costs one
+    XLA compile but no Python trace, and it survives jaxlib updates
+    that keep the export calling convention;
+  - the loaded-executable payload (``jax.experimental
+    .serialize_executable``): the exact compiled program, pinned to
+    (jax version, backend) — the boot-hot fast path, preferred at load.
+
+Refusal is loud, never silent: every entry is keyed and cross-checked
+against (table digest, jax version, backend, kernel mode, tier shape)
+plus a whole-entry CRC, and a mismatched or corrupt bundle counts
+``ldt_aot_refused_total{reason=}``, logs a structured line, and falls
+back to a fresh compile (or raises the typed ``AotError`` under
+LDT_AOT_REQUIRE=1 — the deploy guard for fleets that must boot hot).
+A refused entry is overwritten by the compile path's write-back, so a
+stale bundle self-heals on the first generation that serves through it.
+
+The bundle directory is created if missing (with a structured log —
+a nonexistent dir must enable the feature, not silently disable it),
+and entries are written atomically (tmp + rename) so a crashed writer
+can only ever leave a torn tmp file, which readers never open.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import zlib
+
+from . import faults, knobs, telemetry
+from .locks import make_lock
+
+MAGIC = b"LDTAOT1\n"
+_LEN = struct.Struct("<Q")
+_CRC = struct.Struct("<I")
+
+# memo sentinel: the bundle has no (usable) entry for this shape — the
+# compile path owns it now and will write one back
+_ABSENT = object()
+
+
+class AotError(RuntimeError):
+    """A refused AOT bundle entry: stale key (digest / jax version /
+    backend / kernel mode), corrupt bytes, or an undeserializable
+    payload. Raised out of dispatch only under LDT_AOT_REQUIRE=1;
+    otherwise the engine logs, counts the refusal, and compiles."""
+
+
+# -- jax.export pytree serialization registration ----------------------
+# Exported.serialize() refuses pytrees with unregistered custom nodes;
+# the scorer signature is (DeviceTables, wire dict), so the dataclass
+# nodes register once per process. Auxdata is the registered
+# dataclass's static-field tuple (Quad2Static geometry + the quad2
+# flag for DeviceTables, empty for KindTables) — JSON round-trips it.
+
+_export_registered = False
+
+
+def _ensure_export_registered() -> None:
+    global _export_registered
+    if _export_registered:
+        return
+    from jax import export as jexport
+
+    from .ops.device_tables import DeviceTables, KindTables, Quad2Static
+
+    def _ser_dt_aux(aux) -> bytes:
+        q2, enabled = aux
+        return json.dumps([dataclasses.asdict(q2), enabled]).encode()
+
+    def _des_dt_aux(data: bytes):
+        q2, enabled = json.loads(bytes(data).decode())
+        return (Quad2Static(**q2), enabled)
+
+    def _ser_empty(aux) -> bytes:
+        return b"[]"
+
+    def _des_empty(data: bytes):
+        return ()
+
+    try:
+        jexport.register_pytree_node_serialization(
+            DeviceTables, serialized_name="ldt.DeviceTables",
+            serialize_auxdata=_ser_dt_aux,
+            deserialize_auxdata=_des_dt_aux)
+        jexport.register_pytree_node_serialization(
+            KindTables, serialized_name="ldt.KindTables",
+            serialize_auxdata=_ser_empty,
+            deserialize_auxdata=_des_empty)
+    except ValueError:
+        pass  # already registered (another engine in this process)
+    _export_registered = True
+
+
+# -- keys --------------------------------------------------------------
+
+
+def shape_signature(wire: dict) -> tuple:
+    """Canonical tier-shape signature of a packed wire: sorted
+    (name, shape, dtype) triples. This is the same shape identity the
+    compile meter keys on — one bundle entry per bucket-ladder tier."""
+    import numpy as np
+    return tuple(sorted((k, tuple(int(s) for s in np.shape(v)),
+                         str(np.asarray(v).dtype))
+                        for k, v in wire.items()))
+
+
+def table_digest_hex(dt) -> str:
+    """Content digest of the serving tables: the per-plane host
+    fingerprint (ops/device_tables.py) folded to hex. Artifact-derived
+    by construction — two artifacts with identical table bytes share
+    executables, any retrain changes the key."""
+    from .ops.device_tables import fingerprint
+    return hashlib.sha256(
+        repr(fingerprint(dt)).encode()).hexdigest()[:16]
+
+
+def entry_name(kernel_mode: str, sig: tuple) -> str:
+    h = hashlib.sha256(json.dumps(sig).encode()).hexdigest()[:20]
+    return f"{kernel_mode}-{h}.ldtx"
+
+
+def _log(msg: str, **fields) -> None:
+    print(json.dumps({"msg": msg, **fields}), flush=True)
+
+
+def _refuse(reason: str, path: str, detail: str, require: bool):
+    telemetry.REGISTRY.counter_inc("ldt_aot_refused_total",
+                                   reason=reason)
+    _log("aot bundle entry refused", reason=reason, path=path,
+         detail=detail, require=require)
+    if require:
+        raise AotError(f"AOT entry refused ({reason}): {path}: "
+                       f"{detail} — unset LDT_AOT_REQUIRE to fall "
+                       "back to a fresh compile")
+    return None
+
+
+# -- entry file format -------------------------------------------------
+
+
+def _pack_entry(meta: dict, hlo: bytes, xc: bytes) -> bytes:
+    mb = json.dumps(meta, sort_keys=True).encode()
+    body = _LEN.pack(len(mb)) + mb + _LEN.pack(len(hlo)) + hlo \
+        + _LEN.pack(len(xc)) + xc
+    return MAGIC + body + _CRC.pack(zlib.crc32(body))
+
+
+def _unpack_entry(raw: bytes):
+    """(meta, hlo, xc) or raises AotError naming what is wrong."""
+    if len(raw) < len(MAGIC) + _LEN.size + _CRC.size or \
+            raw[:len(MAGIC)] != MAGIC:
+        raise AotError("not an LDTX AOT entry (bad magic or truncated)")
+    body, crc = raw[len(MAGIC):-_CRC.size], raw[-_CRC.size:]
+    if zlib.crc32(body) != _CRC.unpack(crc)[0]:
+        raise AotError("entry CRC mismatch (torn or corrupt bytes)")
+    off = 0
+
+    def take(n):
+        nonlocal off
+        if off + n > len(body):
+            raise AotError("entry truncated inside a section")
+        out = body[off:off + n]
+        off += n
+        return out
+
+    mlen = _LEN.unpack(take(_LEN.size))[0]
+    meta = json.loads(take(mlen).decode())
+    hlen = _LEN.unpack(take(_LEN.size))[0]
+    hlo = take(hlen)
+    xlen = _LEN.unpack(take(_LEN.size))[0]
+    xc = take(xlen)
+    return meta, bytes(hlo), bytes(xc)
+
+
+# -- the store ---------------------------------------------------------
+
+
+class AotStore:
+    """Per-engine view of one AOT bundle directory: lookup-first
+    dispatch support plus compile write-back. Thread-safe (flush
+    workers race on first-shape dispatches)."""
+
+    def __init__(self, directory: str, digest: str, backend: str,
+                 kernel_mode: str, require: bool):
+        self.dir = directory
+        self.digest = digest
+        self.backend = backend
+        self.kernel_mode = kernel_mode
+        self.require = require
+        self._lock = make_lock("engine.aot")
+        self._entries: dict = {}  # sig -> callable | _ABSENT
+        self._exported: set = set()  # sigs this store already wrote
+        self.loads = 0
+        self.exports = 0
+        self.refusals = 0
+
+    # -- load path ----------------------------------------------------
+
+    def lookup(self, wire: dict):
+        """The deserialized executable for this wire's tier shape, or
+        None (absent/refused — compile, then offer()). Never raises
+        unless LDT_AOT_REQUIRE is set."""
+        sig = shape_signature(wire)
+        with self._lock:
+            hit = self._entries.get(sig)
+        if hit is not None:
+            return None if hit is _ABSENT else hit
+        fn = self._load(sig)
+        with self._lock:
+            # first loader wins; a racing loader's identical fn is fine
+            cur = self._entries.setdefault(
+                sig, fn if fn is not None else _ABSENT)
+        return None if cur is _ABSENT else cur
+
+    def _load(self, sig: tuple):
+        path = os.path.join(self.dir, entry_name(self.kernel_mode, sig))
+        if faults.ACTIVE is not None:
+            faults.hit("aot_load")
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            if self.require:
+                with self._lock:
+                    self.refusals += 1
+                return _refuse("missing", path,
+                               "no bundle entry for this tier shape",
+                               True)
+            return None
+        except OSError as e:
+            with self._lock:
+                self.refusals += 1
+            return _refuse("io_error", path, repr(e), self.require)
+        if faults.ACTIVE is not None:
+            seed = faults.corruption("aot_load")
+            if seed is not None:
+                # chaos seam: a seeded bit-flip models bit rot in the
+                # bundle — the CRC must refuse it, never deserialize
+                b = bytearray(raw)
+                b[seed % len(b)] ^= 1 << (seed % 8)
+                raw = bytes(b)
+        t0 = time.monotonic()
+        try:
+            meta, hlo, xc = _unpack_entry(raw)
+        except AotError as e:
+            with self._lock:
+                self.refusals += 1
+            return _refuse("corrupt", path, str(e), self.require)
+        want = {"digest": self.digest, "jax": _jax_version(),
+                "backend": self.backend, "kernel": self.kernel_mode}
+        for field, expect in want.items():
+            got = meta.get(field)
+            if got != expect:
+                with self._lock:
+                    self.refusals += 1
+                return _refuse(
+                    f"{field}_mismatch", path,
+                    f"entry {field}={got!r}, this process wants "
+                    f"{expect!r}", self.require)
+        try:
+            meta_sig = tuple((k, tuple(s), d)
+                             for k, s, d in meta.get("shapes", ()))
+        except (TypeError, ValueError):
+            meta_sig = ()
+        if meta_sig != tuple((k, tuple(s), d) for k, s, d in sig):
+            with self._lock:
+                self.refusals += 1
+            return _refuse("shape_mismatch", path,
+                           "entry shapes disagree with the wire",
+                           self.require)
+        fn = self._deserialize(path, hlo, xc)
+        if fn is not None:
+            with self._lock:
+                self.loads += 1
+            telemetry.REGISTRY.counter_inc("ldt_aot_loads_total")
+            _log("aot executable loaded", path=path,
+                 kernel=self.kernel_mode,
+                 ms=round((time.monotonic() - t0) * 1e3, 1))
+        return fn
+
+    def _deserialize(self, path: str, hlo: bytes, xc: bytes):
+        """Native executable first (zero-compile), exported-module
+        fallback (one XLA compile, no trace). Both are the compiled
+        path bit-for-bit — tests/test_aot.py pins it."""
+        if xc:
+            try:
+                from jax.experimental import serialize_executable as se
+                payload, in_tree, out_tree = pickle.loads(xc)
+                return se.deserialize_and_load(payload, in_tree,
+                                               out_tree)
+            except Exception as e:  # noqa: BLE001 - fall to the hlo payload
+                _log("aot native payload unusable — trying the "
+                     "exported module", path=path, error=repr(e))
+        if hlo:
+            try:
+                import jax
+                from jax import export as jexport
+                _ensure_export_registered()
+                exported = jexport.deserialize(hlo)
+                return jax.jit(exported.call)
+            except Exception as e:  # noqa: BLE001 - typed refusal below
+                with self._lock:
+                    self.refusals += 1
+                return _refuse("undeserializable", path, repr(e),
+                               self.require)
+        with self._lock:
+            self.refusals += 1
+        return _refuse("empty", path, "entry carries no payload",
+                       self.require)
+
+    # -- write-back path ----------------------------------------------
+
+    def offer(self, wire: dict, jit_fn, dt) -> bool:
+        """Export the compiled scorer for this wire's tier shape into
+        the bundle (write-back after a compiling launch). Best-effort:
+        a failed export logs and counts, it never fails the dispatch
+        that triggered it."""
+        sig = shape_signature(wire)
+        with self._lock:
+            known = self._entries.get(sig)
+            if sig in self._exported:
+                return False  # this store already wrote the entry
+        if known is not None and known is not _ABSENT:
+            return False  # loaded from the bundle: nothing to write
+        path = os.path.join(self.dir, entry_name(self.kernel_mode, sig))
+        try:
+            if faults.ACTIVE is not None:
+                faults.hit("aot_export")
+            import jax
+            specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in wire.items()}
+            t0 = time.monotonic()
+            # The export compile must BYPASS the persistent compile
+            # cache (LDT_COMPILE_CACHE_DIR): an executable XLA
+            # deserialized from that cache serializes without its
+            # jit-compiled symbol definitions, and the bundle entry
+            # then refuses with "Symbols not found" in every fresh
+            # process. One genuine compile per shape per exporting
+            # generation is the price of a loadable bundle; later
+            # generations load it and never compile at all. (A
+            # concurrent compile on another flush worker misses the
+            # persistent cache during this window — slower once,
+            # never wrong.)
+            cache_dir = getattr(jax.config, "jax_compilation_cache_dir",
+                                None)
+            if cache_dir:
+                jax.config.update("jax_compilation_cache_dir", None)
+            try:
+                lowered = jit_fn.lower(dt, specs)
+                compiled = lowered.compile()
+            finally:
+                if cache_dir:
+                    jax.config.update("jax_compilation_cache_dir",
+                                      cache_dir)
+            try:
+                from jax.experimental import serialize_executable as se
+                xc = pickle.dumps(se.serialize(compiled))
+            except Exception as e:  # noqa: BLE001 - hlo payload still ships
+                _log("aot native serialization unavailable",
+                     path=path, error=repr(e))
+                xc = b""
+            try:
+                from jax import export as jexport
+                _ensure_export_registered()
+                hlo = jexport.export(jit_fn)(dt, specs).serialize()
+            except Exception as e:  # noqa: BLE001 - native payload still ships
+                _log("aot export serialization unavailable",
+                     path=path, error=repr(e))
+                hlo = b""
+            if not hlo and not xc:
+                raise AotError("neither payload serialized")
+            meta = {"digest": self.digest, "jax": _jax_version(),
+                    "backend": self.backend,
+                    "kernel": self.kernel_mode,
+                    "shapes": [list(s) for s in sig]}
+            blob = _pack_entry(meta, hlo, xc)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 - write-back is best-effort
+            _log("aot export failed", path=path, error=repr(e))
+            return False
+        with self._lock:
+            self.exports += 1
+            self._exported.add(sig)
+        telemetry.REGISTRY.counter_inc("ldt_aot_exports_total")
+        _log("aot executable exported", path=path,
+             kernel=self.kernel_mode, bytes=len(blob),
+             ms=round((time.monotonic() - t0) * 1e3, 1))
+        return True
+
+    # -- eager preload ------------------------------------------------
+
+    def preload(self) -> int:
+        """Deserialize every matching bundle entry up front (the
+        startup_ready_task hook): warmup then dispatches straight into
+        loaded executables instead of paying per-shape lazy loads
+        between batches. Returns the number of entries now live."""
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return 0
+        live = 0
+        prefix = f"{self.kernel_mode}-"
+        for name in names:
+            if not name.startswith(prefix) or \
+                    not name.endswith(".ldtx"):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path, "rb") as f:
+                    meta, hlo, xc = _unpack_entry(f.read())
+            except (OSError, AotError):
+                continue  # lookup() refuses it loudly if dispatched
+            try:
+                sig = tuple((k, tuple(s), d)
+                            for k, s, d in meta.get("shapes", ()))
+            except (TypeError, ValueError):
+                continue
+            with self._lock:
+                if self._entries.get(sig) is not None:
+                    continue
+            fake_wire = {k: _SpecView(s, d) for k, s, d in sig}
+            if self.lookup(fake_wire) is not None:
+                live += 1
+        return live
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"dir": self.dir, "kernel": self.kernel_mode,
+                    "digest": self.digest, "loads": self.loads,
+                    "exports": self.exports,
+                    "refusals": self.refusals,
+                    "entries": sum(1 for v in self._entries.values()
+                                   if v is not _ABSENT)}
+
+
+class _SpecView:
+    """Shape/dtype-only stand-in so preload can drive lookup() through
+    shape_signature without materializing arrays."""
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __array__(self):  # np.asarray(...).dtype in shape_signature
+        import numpy as np
+        return np.empty(self.shape, dtype=self.dtype)
+
+
+def _jax_version() -> str:
+    import jax
+    return jax.__version__
+
+
+def build_from_env(kernel_mode: str, dt) -> AotStore | None:
+    """The engine's AOT store per LDT_AOT_DIR, or None when the knob is
+    unset. Creates the bundle dir if missing — loudly: a deploy that
+    points at a not-yet-existing dir gets an armed (empty) bundle and a
+    structured log, never a silently disabled feature."""
+    directory = knobs.get_str("LDT_AOT_DIR")
+    if not directory:
+        return None
+    if not os.path.isdir(directory):
+        try:
+            os.makedirs(directory, exist_ok=True)
+            _log("aot bundle dir created", dir=directory)
+        except OSError as e:
+            _log("aot bundle dir unusable — AOT disabled",
+                 dir=directory, error=repr(e))
+            return None
+    import jax
+    # pre-touch so a scrape shows the series at 0 before any dispatch
+    telemetry.REGISTRY.counter_inc("ldt_aot_loads_total", 0)
+    telemetry.REGISTRY.counter_inc("ldt_aot_exports_total", 0)
+    return AotStore(directory, table_digest_hex(dt),
+                    jax.default_backend(), kernel_mode,
+                    knobs.get_bool("LDT_AOT_REQUIRE"))

@@ -110,6 +110,13 @@ FAULT_POINTS: dict = {
                   "the poison marker (an error models a doc that "
                   "deterministically kills its scorer batch and "
                   "exercises bisection + quarantine)",
+    "aot_load": "aot.AotStore._load, before a bundle entry is read (a "
+                "corrupt rule bit-flips one entry byte — the CRC must "
+                "refuse the entry, never deserialize it; error/delay "
+                "model a slow or failing bundle volume)",
+    "aot_export": "aot.AotStore.offer, before the compiled scorer is "
+                  "serialized (an error fails the write-back; the "
+                  "dispatch that triggered it is unaffected)",
 }
 
 

@@ -254,7 +254,36 @@ _DECLARATIONS: tuple[Knob, ...] = (
     _k("LDT_COMPILE_CACHE_DIR", "str", None,
        "Directory for JAX's persistent compilation cache "
        "(jax_compilation_cache_dir), set at engine init so restarted "
-       "or standby worker generations start warm."),
+       "or standby worker generations start warm. Created (with a "
+       "structured log) if missing."),
+    # -- AOT executable bundles (aot.py, models/ngram.py) -------------
+    _k("LDT_AOT_DIR", "str", None,
+       "Directory of AOT-exported bucket-ladder executables (aot.py): "
+       "engine init and warmup try to deserialize each ladder tier's "
+       "compiled scorer from here before compiling, and write back "
+       "entries they had to compile. The supervisor/fleet default it "
+       "to a shared per-supervisor dir so spawned and standby "
+       "generations boot hot. Created (with a structured log) if "
+       "missing. Unset under no supervisor = AOT off."),
+    _k("LDT_AOT_REQUIRE", "bool", False,
+       "Strict AOT mode: a missing, stale, or corrupt bundle entry "
+       "raises AotError out of the dispatch instead of falling back "
+       "to a fresh compile (deploy guard: a fleet that must boot hot "
+       "fails loud when it cannot)."),
+    _k("LDT_RESULT_CACHE_SHM_MB", "float", 0.0,
+       "Budget in MB for the shm-backed fleet-shared result-cache "
+       "tier (service/sharedcache.py): a fixed-slot open-addressed "
+       "mmap table under LDT_SHM_DIR (or /dev/shm) that every "
+       "SO_REUSEPORT fleet member reads and writes, so duplicate "
+       "docs hit across workers. 0/unset disables the shared tier "
+       "(the per-worker LRU is unaffected). The tier rides the "
+       "per-worker ResultCache, so LDT_RESULT_CACHE_MB must also be "
+       "> 0 for it to see any traffic."),
+    _k("LDT_SHARED_CACHE_FILE", "str", None,
+       "Explicit path of the fleet-shared result-cache mmap file. "
+       "Unset = <LDT_SHM_DIR or /dev/shm>/ldt-shared-cache.bin; the "
+       "fleet pins a per-fleet path here because its members get "
+       "per-slot LDT_SHM_DIR values and must still share ONE table."),
     # -- device-pool scheduler (parallel/pool.py) ---------------------
     _k("LDT_POOL_LANES", "int", None,
        "Dispatch-lane count for the fault-tolerant device pool. On a "

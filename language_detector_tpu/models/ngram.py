@@ -102,6 +102,24 @@ class NgramBatchEngine:
         # jax without the option just compiles as before.
         cache_dir = knobs.get_str("LDT_COMPILE_CACHE_DIR")
         if cache_dir:
+            # a nonexistent dir used to silently disable the cache (jax
+            # skips unwritable cache dirs without a peep) — create it
+            # and say so, a deploy that points at a fresh path gets a
+            # working cache, not a cold fleet
+            import json as _json
+            import os as _os
+            if not _os.path.isdir(cache_dir):
+                try:
+                    _os.makedirs(cache_dir, exist_ok=True)
+                    print(_json.dumps(
+                        {"msg": "compile cache dir created",
+                         "dir": cache_dir}), flush=True)
+                except OSError as e:
+                    print(_json.dumps(
+                        {"msg": "compile cache dir unusable — "
+                                "persistent compile cache disabled",
+                         "dir": cache_dir, "error": repr(e)}),
+                        flush=True)
             try:
                 import jax
                 jax.config.update("jax_compilation_cache_dir",
@@ -248,6 +266,17 @@ class NgramBatchEngine:
         if self.pool is not None and mesh is None:
             for ln in self.pool.lanes:
                 ln.dt = self.dt
+        # -- AOT executable bundle (aot.py, round 16) -----------------
+        # lookup-first dispatch + compile write-back for the plain
+        # single-device scorer (the sharded mesh programs keep their
+        # own jit — their executables embed mesh topology and are not
+        # portable across fleet shapes). Simulated pool lanes share the
+        # scorer/table identity, so they ride the same bundle; a
+        # quarantine-healed lane carries a fresh dt and the identity
+        # guard in _launch_raw routes it back to the compile path.
+        from .. import aot as aot_mod
+        self._aot = aot_mod.build_from_env(self._kernel.mode, self.dt) \
+            if mesh is None else None
         from .. import integrity as integrity_mod
         self.integrity = integrity_mod.build_from_env(self)
 
@@ -317,6 +346,21 @@ class NgramBatchEngine:
             score_fn = self._score_fn
         if dt is None:
             dt = self.dt
+        # AOT bundle lookup (aot.py): only the canonical scorer over
+        # the engine's own tables can match a serialized executable —
+        # donated rewires, per-lane healed tables, and sharded programs
+        # all fall through to the compile path below
+        aot = self._aot if (score_fn is self._kernel.score and
+                            dt is self.dt) else None
+        if aot is not None:
+            loaded = aot.lookup(cb.wire)
+            if loaded is not None:
+                # a deserialized executable is not a compile: skip the
+                # first_seen meter and the donation rewire (the bundle
+                # program was exported non-donating) and dispatch
+                if faults.ACTIVE is not None:
+                    faults.hit("scorer_launch")
+                return loaded(dt, cb.wire)
         if self._donate and score_fn is self._kernel.score:
             # pipelined depth: donate the wire into the scorer so the
             # device reuses the transferred buffers (ops/kernels.py);
@@ -336,7 +380,15 @@ class NgramBatchEngine:
                tuple(sorted((k, tuple(np.shape(v)))
                             for k, v in cb.wire.items())))
         if not telemetry.REGISTRY.compiles.first_seen(lane, key):
-            return score_fn(dt, cb.wire)
+            fut = score_fn(dt, cb.wire)
+            if aot is not None:
+                # warm shape, no usable bundle entry (refused or never
+                # written by this process's meter — another engine in
+                # the process warmed the registry first): still write
+                # back so a stale bundle self-heals. offer() memoizes
+                # per shape, so steady state pays one set probe.
+                aot.offer(cb.wire, self._kernel.score, dt)
+            return fut
         if faults.ACTIVE is not None:
             faults.hit("compile")
         t0 = _time.monotonic()
@@ -345,6 +397,12 @@ class NgramBatchEngine:
                                        lane=lane)
         telemetry.REGISTRY.histogram("ldt_xla_compile_ms", lane=lane) \
             .observe((_time.monotonic() - t0) * 1e3)
+        if aot is not None:
+            # write-back: export the canonical (non-donated) scorer for
+            # this tier shape so the next generation loads instead of
+            # compiling; re-lowering here is served by the persistent
+            # compile cache and happens once per shape per process
+            aot.offer(cb.wire, self._kernel.score, dt)
         return fut
 
     def _launch(self, cb, lane: str = "main", trace=None):

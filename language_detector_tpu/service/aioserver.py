@@ -317,6 +317,20 @@ class AioService:
             self.svc._result_caches = list(
                 getattr(self.svc, "_result_caches", ())) \
                 + [self.batcher._cache]
+            # same boot-epoch contract as the sync Batcher's cache: the
+            # shared tier namespaces by artifact content digest from
+            # the first request, so a rolling fleet can never cross
+            # artifacts (server.py has the full rationale)
+            cache = self.batcher._cache
+            if self.svc._artifact_path:
+                from .. import artifact as artifact_mod
+                boot_epoch = artifact_mod.artifact_digest(
+                    self.svc._artifact_path)
+                if boot_epoch:
+                    cache.set_epoch(boot_epoch)
+            if cache._shared is not None:
+                self.svc.metrics.shared_cache_stats = \
+                    cache._shared.stats
         self._usage = json.dumps(USAGE).encode()
         self.recycling = False  # set by _recycle_watch; read by serve()
         self.draining = False   # set by the SIGTERM handler (swap

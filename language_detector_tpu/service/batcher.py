@@ -32,6 +32,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from .. import faults, knobs, telemetry
 from ..locks import make_lock
+from . import sharedcache
 from .admission import (DeadlineExceeded, FairScheduler,
                         note_deadline_expired)
 
@@ -83,13 +84,23 @@ class ResultCache:
 
     ENTRY_OVERHEAD = 96  # dict slot + key tuple + bookkeeping, amortized
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, shared=None):
         self.max_bytes = max_bytes
         self._d: OrderedDict = OrderedDict()  # key -> (value, nbytes)
         self._lock = make_lock("batcher.result_cache")
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        # single-flight pending map: a key some in-flight flush is
+        # already computing. A second flush carrying the same doc waits
+        # on the Event instead of dispatching the duplicate (claim /
+        # resolve below)
+        self._pending: dict = {}
+        # fleet-shared L2 (service/sharedcache.py): probed on an L1
+        # miss, written through on fill. None unless
+        # LDT_RESULT_CACHE_SHM_MB is set; `shared` overrides for tests
+        self._shared = shared if shared is not None \
+            else sharedcache.shared_tier()
         # artifact epoch: results are only valid against the tables
         # that produced them, so every key is namespaced by the serving
         # artifact's generation and a swap flushes the lot (set_epoch
@@ -107,40 +118,96 @@ class ResultCache:
             self._epoch = epoch
             self._d.clear()
             self.bytes = 0
+            # wake every single-flight waiter: the answer its owner is
+            # computing belongs to the old artifact — waiters re-probe,
+            # miss, and dispatch against the new tables themselves
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for ev in pending:
+            ev.set()
+        if self._shared is not None:
+            self._shared.set_epoch(epoch)
 
     def get(self, key):
         """Returns the cached value or the module's _MISS sentinel."""
-        key = (self._epoch,) + key
+        ekey = (self._epoch,) + key
         with self._lock:
-            ent = self._d.get(key)
-            if ent is None:
-                self.misses += 1
-                return _MISS
-            self._d.move_to_end(key)
-            self.hits += 1
-            return ent[0]
+            ent = self._d.get(ekey)
+            if ent is not None:
+                self._d.move_to_end(ekey)
+                self.hits += 1
+                return ent[0]
+        # L1 miss: probe the fleet-shared tier (outside the L1 lock —
+        # the mmap protocol is lock-free) and promote a hit so the hot
+        # doc answers from the dict next time
+        if self._shared is not None:
+            v = self._shared.get(key)
+            if v is not None:
+                self._put_local(ekey, v, key[-1])
+                with self._lock:
+                    self.hits += 1
+                return v
+        with self._lock:
+            self.misses += 1
+        return _MISS
 
-    def put(self, key, value, text: str):
-        key = (self._epoch,) + key
+    def claim(self, key):
+        """Single-flight a freshly probed _MISS: returns None when the
+        caller becomes the key's owner (it MUST resolve() after its
+        dispatch fills — or fails to fill — the cache), else the
+        threading.Event the owning flush will set. Waiters re-probe
+        get() after the wait and dispatch themselves on a still-miss
+        (owner failed, epoch rolled, or the wait timed out)."""
+        ekey = (self._epoch,) + key
+        with self._lock:
+            ev = self._pending.get(ekey)
+            if ev is not None:
+                return ev
+            self._pending[ekey] = threading.Event()
+            return None
+
+    def resolve(self, key) -> None:
+        """Owner's release of a claimed key, success or failure: wakes
+        every flush waiting on it. Idempotent (an epoch roll may have
+        already swept the claim)."""
+        ekey = (self._epoch,) + key
+        with self._lock:
+            ev = self._pending.pop(ekey, None)
+        if ev is not None:
+            ev.set()
+
+    def _put_local(self, ekey, value, text: str):
         nbytes = (len(text.encode("utf-8", "surrogatepass")) +
                   _value_nbytes(value) + self.ENTRY_OVERHEAD)
         if nbytes > self.max_bytes:
             return  # a single oversized doc must not wipe the cache
         with self._lock:
-            if key in self._d:
+            if ekey in self._d:
                 return
-            self._d[key] = (value, nbytes)
+            self._d[ekey] = (value, nbytes)
             self.bytes += nbytes
             while self.bytes > self.max_bytes and self._d:
                 _, (_, nb) = self._d.popitem(last=False)
                 self.bytes -= nb
 
+    def put(self, key, value, text: str):
+        self._put_local((self._epoch,) + key, value, text)
+        # write-through: only the code-string production values travel
+        # to the shared tier (its slots pack utf-8 fragments; richer
+        # result objects stay per-worker)
+        if self._shared is not None and isinstance(value, str):
+            self._shared.put(key, value)
+
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
-            return {"hits": self.hits, "misses": self.misses,
-                    "bytes": self.bytes, "entries": len(self._d),
-                    "hit_rate": self.hits / total if total else 0.0}
+            out = {"hits": self.hits, "misses": self.misses,
+                   "bytes": self.bytes, "entries": len(self._d),
+                   "pending": len(self._pending),
+                   "hit_rate": self.hits / total if total else 0.0}
+        if self._shared is not None:
+            out["shared"] = self._shared.stats()
+        return out
 
 
 class Batcher:
@@ -391,10 +458,15 @@ class Batcher:
                     i += len(ts)
                 return
             # cached flush: probe per item, detect only the misses, fill
-            # the cache, then assemble each request's results in order
+            # the cache, then assemble each request's results in order.
+            # Misses another in-flight flush already owns (single-flight
+            # claim) are not re-dispatched: this flush parks them and
+            # adopts the owner's fill after its own detect returns.
             plans: list = []       # one value list per request
             miss_texts: list = []
-            miss_refs: list = []   # (plan, slot, key, text)
+            miss_refs: list = []   # (plan, slot, key, text) — ours
+            waits: list = []       # (plan, slot, key, text, event)
+            owned: list = []       # keys we must resolve() no matter what
             for ts, hk, _, _ in pending:
                 plan = []
                 for t in ts:
@@ -402,18 +474,58 @@ class Batcher:
                     v = self._cache.get(key)
                     plan.append(v)
                     if v is _MISS:
-                        miss_refs.append((plan, len(plan) - 1, key, t))
-                        miss_texts.append(t)
+                        ev = self._cache.claim(key)
+                        if ev is None:
+                            owned.append(key)
+                            miss_refs.append(
+                                (plan, len(plan) - 1, key, t))
+                            miss_texts.append(t)
+                        else:
+                            waits.append(
+                                (plan, len(plan) - 1, key, t, ev))
                 plans.append(plan)
             try:
                 miss_results = self._run_detect(miss_texts, ftrace) \
                     if miss_texts else []
             except Exception as e:  # noqa: BLE001 - fail every waiter
+                for key in owned:
+                    self._cache.resolve(key)  # wake waiters to retry
                 self._fail(pending, e)
                 return
             for (plan, slot, key, t), v in zip(miss_refs, miss_results):
                 plan[slot] = v
                 self._cache.put(key, v, t)
+            for key in owned:
+                self._cache.resolve(key)
+            if waits:
+                # our own claims are resolved above, so a same-flush
+                # duplicate's event is already set — only genuinely
+                # cross-flush waits block here, for as long as the
+                # owning flush's device dispatch can take
+                import time as _t
+                leftover = []   # (plan, slot, key, text)
+                deadline = _t.monotonic() + 30.0
+                for plan, slot, key, t, ev in waits:
+                    ev.wait(timeout=max(0.0,
+                                        deadline - _t.monotonic()))
+                    v = self._cache.get(key)
+                    if v is _MISS:
+                        leftover.append((plan, slot, key, t))
+                    else:
+                        plan[slot] = v
+                if leftover:
+                    # the owner failed, timed out, or an epoch roll
+                    # swept its claim: score the stragglers ourselves
+                    # (no re-claim — a second wait could livelock)
+                    try:
+                        vals = self._run_detect(
+                            [t for _, _, _, t in leftover], ftrace)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail(pending, e)
+                        return
+                    for (plan, slot, key, t), v in zip(leftover, vals):
+                        plan[slot] = v
+                        self._cache.put(key, v, t)
             for (ts, _, tr, fut), plan in zip(pending, plans):
                 if not fut.cancelled():
                     self._graft(tr, ftrace)

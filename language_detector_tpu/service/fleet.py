@@ -443,6 +443,19 @@ def fleet_main(module: str) -> int:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
         cache_dir = None
+    # one AOT bundle dir for the whole fleet (aot.py): the first member
+    # to compile a ladder tier exports it, every other member — and
+    # every later generation, including rolling-swap standbys — loads
+    # it. SHARED across slots on purpose, unlike the per-slot shm/
+    # flightrec dirs: executables are content-keyed, not owner-keyed
+    aot_dir = knobs.get_str("LDT_AOT_DIR")
+    if not aot_dir:
+        aot_dir = os.path.join(
+            tempfile.gettempdir(), f"ldt-aot-{os.getpid()}")
+    try:
+        os.makedirs(aot_dir, exist_ok=True)
+    except OSError:
+        aot_dir = None
 
     members: list = [FleetMember(slot) for slot in range(n)]
     desired = n
@@ -487,6 +500,15 @@ def fleet_main(module: str) -> int:
             env["LDT_FLIGHTREC_DIR"] = fr_dir
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
+        if aot_dir:
+            env["LDT_AOT_DIR"] = aot_dir
+        # the fleet-shared result cache must be ONE file for every
+        # member, but LDT_SHM_DIR above is per-slot — pin the path
+        # explicitly so members actually share (operator value wins)
+        if not knobs.get_str("LDT_SHARED_CACHE_FILE"):
+            env["LDT_SHARED_CACHE_FILE"] = os.path.join(
+                shm_base or tempfile.gettempdir(),
+                f"ldt-shared-cache-{os.getpid()}.bin")
         if swapped:
             env["LDT_SWAPPED"] = "1"
         if artifact:

@@ -107,6 +107,10 @@ class Metrics:
         # stats dict or None (lane disabled — the gauges render 0)
         self.shm_stats = lambda: None
         self.quarantine_stats = lambda: None
+        # fleet-shared result-cache source (set when the shared tier
+        # attaches): () -> sharedcache.SharedResultCache.stats() dict
+        # or None (tier disabled)
+        self.shared_cache_stats = lambda: None
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -343,6 +347,21 @@ class DetectorService:
             if start_batcher else None
         if self.batcher is not None and self.batcher._cache is not None:
             self.metrics.cache_stats = self.batcher.cache_stats
+            cache = self.batcher._cache
+            # namespace the caches to the serving artifact's content
+            # digest FROM BOOT, not just after the first swap: during a
+            # fleet roll, members booted on the new artifact and
+            # members swapped onto it must land in the same shared-
+            # cache epoch — and members still on the old artifact in a
+            # different one (zero cross-artifact hits by construction)
+            if self._artifact_path:
+                from .. import artifact as artifact_mod
+                boot_epoch = artifact_mod.artifact_digest(
+                    self._artifact_path)
+                if boot_epoch:
+                    cache.set_epoch(boot_epoch)
+            if cache._shared is not None:
+                self.metrics.shared_cache_stats = cache._shared.stats
 
     def _load_tables(self):
         """Initial table load honoring LDT_ARTIFACT_PATH. An explicit

@@ -162,6 +162,19 @@ def main() -> int:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
         cache_dir = None
+    # AOT-bundle continuity (aot.py): same contract one level up the
+    # boot-hot ladder — every generation shares one bundle dir, so
+    # generation 2+ deserializes generation 1's exported executables
+    # (no trace, no lower, no compile) instead of replaying even the
+    # cached compiles
+    aot_dir = knobs.get_str("LDT_AOT_DIR")
+    if not aot_dir:
+        aot_dir = os.path.join(
+            tempfile.gettempdir(), f"ldt-aot-{os.getpid()}")
+    try:
+        os.makedirs(aot_dir, exist_ok=True)
+    except OSError:
+        aot_dir = None
 
     generation = 0
     consec_crashes = 0
@@ -234,6 +247,8 @@ def main() -> int:
         env["LDT_READY_FILE"] = ready_file
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
+        if aot_dir:
+            env["LDT_AOT_DIR"] = aot_dir
         if artifact:
             env["LDT_ARTIFACT_PATH"] = artifact
         standby = subprocess.Popen([sys.executable, "-m", module],
@@ -309,6 +324,8 @@ def main() -> int:
         env["LDT_WORKER_GENERATION"] = str(generation)
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
+        if aot_dir:
+            env["LDT_AOT_DIR"] = aot_dir
         child = subprocess.Popen([sys.executable, "-m", module], env=env)
         worker = WORKER_RUNNING
         if stopping:  # signal raced the spawn: stop the new worker too

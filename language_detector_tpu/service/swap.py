@@ -153,6 +153,25 @@ def startup_ready_task(svc, ports) -> None:
     gates on it), then drop the LDT_READY_FILE handshake the
     supervisor's swap drill polls for. Never raises — a warmup failure
     leaves readiness not-ok, which IS the signal."""
+    # AOT bundle preload (aot.py): deserialize every matching exported
+    # executable BEFORE the warmup batch runs, so warmup's dispatches
+    # (and the first real traffic) land on loaded programs instead of
+    # paying lazy per-shape loads between batches. Best-effort unless
+    # LDT_AOT_REQUIRE, in which case a refused entry fails warmup and
+    # readiness stays closed — the supervisor keeps the old generation.
+    store = getattr(getattr(svc, "_engine", None), "_aot", None)
+    if store is not None:
+        try:
+            n = store.preload()
+            if n:
+                print(json.dumps({"msg": "aot bundle preloaded",
+                                  "entries": n, "dir": store.dir}),
+                      flush=True)
+        except Exception as e:
+            print(json.dumps({"msg": "aot preload failed",
+                              "error": repr(e)}), flush=True)
+            if knobs.get_bool("LDT_AOT_REQUIRE"):
+                return
     if knobs.get_bool("LDT_WARMUP"):
         try:
             svc.warm()

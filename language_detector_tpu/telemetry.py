@@ -307,6 +307,41 @@ METRICS: dict = {
         "counter",
         "On-demand device-profiler capture windows, by "
         "result=ok|error|busy|unavailable (POST /profilez, SIGUSR2)."),
+    "ldt_aot_loads_total": (
+        "counter",
+        "Bucket-ladder executables deserialized from the AOT bundle "
+        "(LDT_AOT_DIR, aot.py) instead of compiled — the boot-hot "
+        "path; one per ladder tier per process."),
+    "ldt_aot_exports_total": (
+        "counter",
+        "Compiled scorers serialized into the AOT bundle (write-back "
+        "after a compiling launch); the next generation loads these."),
+    "ldt_aot_refused_total": (
+        "counter",
+        "AOT bundle entries refused by reason=missing|corrupt|"
+        "digest_mismatch|jax_mismatch|backend_mismatch|kernel_mismatch"
+        "|shape_mismatch|undeserializable|io_error|empty — each "
+        "refusal falls back to a fresh compile (or raises under "
+        "LDT_AOT_REQUIRE) and is overwritten by write-back."),
+    "ldt_shared_cache_hits_total": (
+        "counter",
+        "Fleet-shared result-cache hits (LDT_RESULT_CACHE_SHM_MB, "
+        "service/sharedcache.py): a doc answered from another "
+        "worker's published result."),
+    "ldt_shared_cache_misses_total": (
+        "counter",
+        "Fleet-shared result-cache lookups that found no live entry "
+        "(absent, epoch-stale, torn, or CRC-refused slots all count "
+        "here — the read path never distinguishes, it just misses)."),
+    "ldt_shared_cache_evictions_total": (
+        "counter",
+        "Shared-cache slots overwritten by a new key whose probe "
+        "window was full (deterministic displacement eviction)."),
+    "ldt_shared_cache_epoch_flush_total": (
+        "counter",
+        "Shared-cache entries invalidated by an artifact-swap epoch "
+        "sweep (stale-epoch slots freed so a new artifact can never "
+        "serve the old artifact's results)."),
 }
 
 
@@ -846,6 +881,11 @@ def debug_vars(metrics=None) -> dict:
             qs = quar_fn()
             if qs:
                 d["quarantine"] = qs
+        shc_fn = getattr(metrics, "shared_cache_stats", None)
+        if shc_fn is not None:
+            sc = shc_fn()
+            if sc:
+                d["shared_cache"] = sc
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

@@ -1,0 +1,257 @@
+"""AOT bundle tests (round 16, language_detector_tpu/aot.py).
+
+Covers the boot-hot contract end to end: a compiling process exports
+every dispatched tier into the bundle, a FRESH process loads the
+executables (no compile) and answers bit-identically; every identity
+field (table digest, jax version, backend, kernel mode, tier shape)
+refuses loudly on mismatch; a corrupt bundle entry is refused by the
+CRC (driven through the `aot_load` fault seam's `corrupt` rule); and
+LDT_AOT_REQUIRE escalates refusal to the typed AotError. Also pins the
+satellite: LDT_COMPILE_CACHE_DIR and LDT_AOT_DIR are *created* (with a
+structured log), never silently disabled, when they don't exist yet.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from language_detector_tpu import aot, faults, native
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native packer unavailable")
+
+# TINY_BATCH_C_PATH (=64) sends small flag-less batches down the all-C
+# pipeline without ever dispatching — AOT needs real device dispatches,
+# so the corpus is >64 docs and non-ASCII (the C scalar path would
+# otherwise still answer everything before a wire is packed).
+_SAMPLES = [
+    "Привет, как дела? Это тестовый документ на русском языке "
+    "про погоду в Москве и планы на выходные дни.",
+    "Καλημέρα σας, αυτό είναι ένα δοκιμαστικό έγγραφο στα ελληνικά "
+    "για τον καιρό και τις διακοπές του καλοκαιριού.",
+    "こんにちは、これは日本語のテスト文書です。今日の天気と週末の"
+    "予定について話しましょう。",
+    "Bonjour, ceci est un document de test en français à propos de "
+    "la météo et des vacances d'été à la montagne.",
+    "Hallo, dies ist ein deutsches Testdokument über das Wetter und "
+    "den bevorstehenden Urlaub an der Ostsee.",
+]
+
+
+def _docs(n=200):
+    return [_SAMPLES[i % len(_SAMPLES)] for i in range(n)]
+
+
+def _engine(env: dict):
+    """Engine constructed under `env` (knobs read the environment at
+    construction, so env must bracket the constructor)."""
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        from language_detector_tpu.models.ngram import NgramBatchEngine
+        return NgramBatchEngine()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# child for the fresh-process tests: detect the corpus, dump codes and
+# the AOT store's counters. The persistent jit cache keeps the compile
+# leg of the comparison fast; the AOT leg must not compile at all.
+_CHILD = """
+import json, sys
+from language_detector_tpu import enable_jit_cache
+enable_jit_cache()
+from language_detector_tpu.models.ngram import NgramBatchEngine
+docs = json.load(open(sys.argv[1]))
+eng = NgramBatchEngine()
+codes = eng.detect_codes(docs, batch_size=4096)
+out = {"codes": codes,
+       "dispatches": eng.stats["device_dispatches"],
+       "aot": eng._aot.stats() if eng._aot is not None else None}
+json.dump(out, open(sys.argv[2], "w"))
+"""
+
+
+def _run_child(docs, bundle_dir, tmp_path, tag):
+    docs_file = tmp_path / f"docs-{tag}.json"
+    out_file = tmp_path / f"out-{tag}.json"
+    docs_file.write_text(json.dumps(docs))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LDT_AOT_DIR=str(bundle_dir))
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(docs_file), str(out_file)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-4000:]
+    return json.loads(out_file.read_text())
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    """A populated bundle: an in-process engine compiles + exports the
+    corpus's tier shapes. Returns (dir, engine) for store-level tests."""
+    d = tmp_path_factory.mktemp("aot-bundle")
+    eng = _engine({"LDT_AOT_DIR": str(d)})
+    assert eng._aot is not None
+    eng.detect_codes(_docs(), batch_size=4096)
+    assert eng.stats["device_dispatches"] > 0, \
+        "corpus never dispatched — AOT has nothing to export"
+    assert eng._aot.stats()["exports"] > 0
+    assert list(Path(d).glob("*.ldtx"))
+    return d, eng
+
+
+def _fresh_store(bundle, **overrides):
+    d, eng = bundle
+    st = eng._aot
+    kw = {"directory": str(d), "digest": st.digest,
+          "backend": st.backend, "kernel_mode": st.kernel_mode,
+          "require": False}
+    kw.update(overrides)
+    return aot.AotStore(**kw)
+
+
+# -- happy path --------------------------------------------------------------
+
+
+def test_fresh_process_export_then_load_bit_identity(tmp_path):
+    docs = _docs()
+    bundle_dir = tmp_path / "bundle"
+    first = _run_child(docs, bundle_dir, tmp_path, "export")
+    assert first["dispatches"] > 0
+    assert first["aot"]["exports"] > 0, first["aot"]
+    second = _run_child(docs, bundle_dir, tmp_path, "load")
+    assert second["aot"]["loads"] > 0, second["aot"]
+    assert second["aot"]["refusals"] == 0, second["aot"]
+    # the AOT-loaded executables answer bit-identically to the
+    # compile-path process that wrote them
+    assert second["codes"] == first["codes"]
+
+
+def test_same_process_second_engine_loads(bundle):
+    d, eng = bundle
+    eng2 = _engine({"LDT_AOT_DIR": str(d)})
+    codes = eng2.detect_codes(_docs(), batch_size=4096)
+    st = eng2._aot.stats()
+    assert st["loads"] > 0 and st["refusals"] == 0, st
+    assert codes == eng.detect_codes(_docs(), batch_size=4096)
+
+
+def test_preload_deserializes_every_entry(bundle):
+    store = _fresh_store(bundle)
+    live = store.preload()
+    assert live == len(list(Path(bundle[0]).glob("*.ldtx")))
+    assert store.stats()["loads"] == live
+    # a second preload is a no-op: everything is already memoized
+    assert store.preload() == 0
+
+
+# -- refusal matrix ----------------------------------------------------------
+
+
+def test_digest_mismatch_refused(bundle):
+    store = _fresh_store(bundle, digest="0" * 16)
+    assert store.preload() == 0
+    assert store.stats()["refusals"] > 0
+
+
+def test_backend_mismatch_refused(bundle):
+    store = _fresh_store(bundle, backend="tpu-v9")
+    assert store.preload() == 0
+    assert store.stats()["refusals"] > 0
+
+
+def test_jax_version_mismatch_refused(bundle, monkeypatch):
+    monkeypatch.setattr(aot, "_jax_version", lambda: "0.0.0-test")
+    store = _fresh_store(bundle)
+    assert store.preload() == 0
+    assert store.stats()["refusals"] > 0
+
+
+def test_kernel_mismatch_refused(bundle, tmp_path):
+    # repack one entry with a lying kernel field (valid CRC, so only
+    # the meta cross-check can catch it) under the original filename
+    d, eng = bundle
+    src = sorted(Path(d).glob("*.ldtx"))[0]
+    meta, hlo, xc = aot._unpack_entry(src.read_bytes())
+    meta["kernel"] = "definitely-not-" + meta["kernel"]
+    clone = tmp_path / src.name
+    clone.write_bytes(aot._pack_entry(meta, hlo, xc))
+    store = _fresh_store(bundle, directory=str(tmp_path))
+    assert store.preload() == 0
+    assert store.stats()["refusals"] > 0
+
+
+def test_corrupt_entry_refused_via_fault_seam(bundle):
+    faults.configure("aot_load:corrupt:seed=5")
+    try:
+        store = _fresh_store(bundle)
+        assert store.preload() == 0
+        assert store.stats()["refusals"] > 0
+    finally:
+        faults.configure(None)
+
+
+def test_require_escalates_to_typed_error(bundle):
+    store = _fresh_store(bundle, digest="f" * 16, require=True)
+    with pytest.raises(aot.AotError):
+        store.preload()
+
+
+def test_refused_entry_falls_back_and_self_heals(bundle, tmp_path):
+    # an engine pointed at a stale bundle (wrong digest in every entry)
+    # must refuse, compile, and overwrite the entries with good ones
+    d, _ = bundle
+    stale = tmp_path / "stale"
+    stale.mkdir()
+    for src in Path(d).glob("*.ldtx"):
+        meta, hlo, xc = aot._unpack_entry(src.read_bytes())
+        meta["digest"] = "0" * 16
+        (stale / src.name).write_bytes(aot._pack_entry(meta, hlo, xc))
+    eng = _engine({"LDT_AOT_DIR": str(stale)})
+    codes = eng.detect_codes(_docs(), batch_size=4096)
+    assert codes == bundle[1].detect_codes(_docs(), batch_size=4096)
+    st = eng._aot.stats()
+    assert st["refusals"] > 0 and st["exports"] > 0, st
+    # the overwritten entries now carry the live digest
+    meta, _, _ = aot._unpack_entry(
+        sorted(stale.glob("*.ldtx"))[0].read_bytes())
+    assert meta["digest"] == eng._aot.digest
+
+
+# -- satellite: cache/bundle dirs are created, never silently dropped --------
+
+
+def test_compile_cache_dir_created_when_missing(monkeypatch, tmp_path):
+    import jax
+    target = tmp_path / "nested" / "compile-cache"
+    assert not target.exists()
+    old = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("LDT_COMPILE_CACHE_DIR", str(target))
+    try:
+        _engine({})
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_aot_dir_created_when_missing(bundle, tmp_path):
+    target = tmp_path / "nested" / "aot-bundle"
+    assert not target.exists()
+    eng = _engine({"LDT_AOT_DIR": str(target)})
+    assert eng._aot is not None
+    assert target.is_dir()
+
+
+def test_aot_off_without_knob():
+    eng = _engine({})
+    assert getattr(eng, "_aot", None) is None

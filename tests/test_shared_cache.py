@@ -1,0 +1,327 @@
+"""Fleet-shared result cache tests (round 16, service/sharedcache.py).
+
+The shared tier's whole contract is "can lose entries, can never serve
+a wrong or stale one" — so besides the happy path this file drives the
+chaos cases: a writer killed mid-slot (odd seqlock word), a torn/
+corrupt payload, an artifact-epoch roll mid-traffic, and displacement
+eviction adopting dead slots. Plus the per-worker ResultCache
+integration: L2 write-through/promote and the single-flight claim/
+resolve protocol that collapses duplicate dispatches.
+"""
+import os
+import struct
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from language_detector_tpu.service import sharedcache as sc
+from language_detector_tpu.service.batcher import _MISS, ResultCache
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def cache_path(tmp_path):
+    return str(tmp_path / "shared.bin")
+
+
+def _cache(path, mb=1.0):
+    return sc.SharedResultCache(path, int(mb * 1024 * 1024))
+
+
+def _slot_off(cache, key, probe=0):
+    kh = sc._key_hash(key)
+    base = int.from_bytes(kh[:8], "little") % cache.slot_count
+    return cache._off((base + probe) % cache.slot_count)
+
+
+# -- basic protocol ----------------------------------------------------------
+
+
+def test_put_get_roundtrip(cache_path):
+    c = _cache(cache_path)
+    c.set_epoch("digest-1")
+    key = (None, "bonjour tout le monde")
+    assert c.get(key) is None
+    c.put(key, "fr")
+    assert c.get(key) == "fr"
+    st = c.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    c.close()
+
+
+def test_two_attached_views_share_entries(cache_path):
+    a, b = _cache(cache_path), _cache(cache_path)
+    a.set_epoch("d1")
+    b.set_epoch("d1")
+    a.put((None, "hola"), "es")
+    assert b.get((None, "hola")) == "es"
+    # geometry comes from the header, not the attacher's knob
+    c = _cache(cache_path, mb=4.0)
+    assert c.slot_count == a.slot_count
+    for x in (a, b, c):
+        x.close()
+
+
+def test_incompatible_layout_refused(cache_path):
+    c = _cache(cache_path)
+    c.close()
+    with open(cache_path, "r+b") as f:
+        f.write(sc._HEADER.pack(sc.MAGIC, sc.VERSION + 9,
+                                c.slot_count, sc.SLOT_BYTES))
+    with pytest.raises(RuntimeError, match="incompatible layout"):
+        _cache(cache_path)
+
+
+def test_oversized_value_never_published(cache_path):
+    c = _cache(cache_path)
+    c.set_epoch("d1")
+    c.put((None, "big"), "x" * (sc.PAYLOAD_CAP + 1))
+    assert c.get((None, "big")) is None
+    c.close()
+
+
+# -- cross-process -----------------------------------------------------------
+
+
+def _child(path, body):
+    code = ("import sys, os, struct\n"
+            "from language_detector_tpu.service import sharedcache as sc\n"
+            f"c = sc.SharedResultCache(sys.argv[1], 1 << 20)\n"
+            f"c.set_epoch('E1')\n" + body)
+    return subprocess.run([sys.executable, "-c", code, path], cwd=REPO,
+                          env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_cross_process_hits(cache_path):
+    r = _child(cache_path,
+               "for i in range(20):\n"
+               "    c.put((None, f'doc-{i}'), 'fr')\n")
+    assert r.returncode == 0, r.stderr
+    c = _cache(cache_path)
+    c.set_epoch("E1")
+    assert all(c.get((None, f"doc-{i}")) == "fr" for i in range(20))
+    c.close()
+
+
+def test_writer_killed_mid_slot_never_serves_and_stays_live(cache_path):
+    # the child claims a slot (seq -> odd) and dies there: exactly what
+    # a SIGKILL between the claim and the publish leaves behind
+    r = _child(cache_path,
+               "key = (None, 'victim-doc')\n"
+               "kh = sc._key_hash(key)\n"
+               "base = int.from_bytes(kh[:8], 'little') % c.slot_count\n"
+               "off = c._off(base)\n"
+               "struct.pack_into('<I', c._mm, off, c._seq(off) + 1)\n"
+               "os._exit(9)\n")
+    assert r.returncode == 9
+    c = _cache(cache_path)
+    c.set_epoch("E1")
+    key = (None, "victim-doc")
+    off = _slot_off(c, key)
+    assert c._seq(off) & 1, "child should have left an odd seq behind"
+    # the dead slot reads as a miss, never garbage
+    assert c.get(key) is None
+    # ...and the table stays writable: put() probes past the dead slot
+    c.put(key, "de")
+    assert c.get(key) == "de"
+    c.close()
+
+
+# -- chaos: torn entries, dead-slot adoption, eviction -----------------------
+
+
+def test_torn_payload_refused_by_crc(cache_path):
+    c = _cache(cache_path)
+    c.set_epoch("d1")
+    key = (None, "torn-doc")
+    c.put(key, "ru")
+    # find the published slot and flip one payload byte under it
+    for i in range(sc.PROBE_WINDOW):
+        off = _slot_off(c, key, probe=i)
+        _, _, _, skey, vlen, _ = sc._SLOT_HDR.unpack_from(c._mm, off)
+        if skey == sc._key_hash(key) and vlen:
+            p = off + sc.SLOT_HDR_BYTES
+            c._mm[p] ^= 0x40
+            break
+    else:
+        pytest.fail("published slot not found in the probe window")
+    assert c.get(key) is None  # CRC refuses; a miss, not a wrong answer
+    c.close()
+
+
+def test_displacement_adopts_dead_slots(cache_path):
+    c = _cache(cache_path)
+    c.set_epoch("d1")
+    key = (None, "heal-me")
+    # leave every slot in the key's probe window with a dead writer
+    for i in range(sc.PROBE_WINDOW):
+        off = _slot_off(c, key, probe=i)
+        s = c._seq(off)
+        if not s & 1:
+            struct.pack_into("<I", c._mm, off, s + 1)
+    assert c.get(key) is None
+    # the displacement path adopts the odd seq as its claim: the slot
+    # heals on this overwrite instead of leaking forever
+    c.put(key, "ja")
+    assert c.get(key) == "ja"
+    victim = _slot_off(c, key, probe=sc._key_hash(key)[8]
+                       % sc.PROBE_WINDOW)
+    assert not c._seq(victim) & 1
+    c.close()
+
+
+def test_eviction_on_full_window(tmp_path):
+    # tiny table (minimum geometry = one probe window) so distinct keys
+    # must displace each other
+    c = sc.SharedResultCache(str(tmp_path / "tiny.bin"), 0)
+    assert c.slot_count == sc.PROBE_WINDOW
+    c.set_epoch("d1")
+    for i in range(4 * sc.PROBE_WINDOW):
+        c.put((None, f"k-{i}"), "en")
+    assert c.stats()["evictions"] > 0
+    # displaced or not, reads stay coherent: every hit is a real value
+    alive = sum(1 for i in range(4 * sc.PROBE_WINDOW)
+                if c.get((None, f"k-{i}")) == "en")
+    assert 0 < alive <= sc.PROBE_WINDOW
+    c.close()
+
+
+# -- epoch discipline --------------------------------------------------------
+
+
+def test_epoch_roll_flushes_and_refuses_stale(cache_path):
+    a, b = _cache(cache_path), _cache(cache_path)
+    a.set_epoch("digest-old")
+    b.set_epoch("digest-old")
+    for i in range(10):
+        a.put((None, f"doc-{i}"), "fr")
+    assert b.get((None, "doc-0")) == "fr"
+    # one member swaps to a new artifact: its reads refuse instantly
+    # and the sweep frees the old generation's slots
+    b.set_epoch("digest-new")
+    assert b.get((None, "doc-0")) is None
+    assert b.stats()["epoch_flushes"] >= 10
+    # the not-yet-swapped member now misses too (entries are gone) but
+    # never sees a value from the wrong generation
+    assert a.get((None, "doc-0")) is None
+    # re-rolling to the same epoch is a no-op
+    before = b.stats()["epoch_flushes"]
+    b.set_epoch("digest-new")
+    assert b.stats()["epoch_flushes"] == before
+    for x in (a, b):
+        x.close()
+
+
+def test_put_under_new_epoch_reclaims_stale_slots(cache_path):
+    c = _cache(cache_path)
+    c.set_epoch("e1")
+    key = (None, "reused")
+    c.put(key, "fr")
+    c2 = _cache(cache_path)  # fresh view still on the default epoch
+    c2.set_epoch("e2")
+    c2.put(key, "de")
+    assert c2.get(key) == "de"
+    assert c.get(key) is None  # e1 view refuses the e2 entry
+    for x in (c, c2):
+        x.close()
+
+
+# -- ResultCache integration: L2 + single-flight -----------------------------
+
+
+def test_result_cache_writes_through_and_promotes(cache_path):
+    shared = _cache(cache_path)
+    a = ResultCache(1 << 20, shared=shared)
+    b = ResultCache(1 << 20, shared=shared)
+    a.set_epoch("d1")
+    b.set_epoch("d1")
+    key = (None, "hola amigos")
+    a.put(key, "es", key[-1])
+    # b's L1 is empty: the hit comes from the shared tier and promotes
+    assert b.get(key) == "es"
+    assert b.stats()["hits"] == 1
+    assert b.get(key) == "es"  # second read answers from L1
+    assert shared.stats()["hits"] == 1  # the shm tier was probed once
+    shared.close()
+
+
+def test_result_cache_rich_values_stay_private(cache_path):
+    shared = _cache(cache_path)
+    a = ResultCache(1 << 20, shared=shared)
+    b = ResultCache(1 << 20, shared=shared)
+    a.set_epoch("d1")
+    b.set_epoch("d1")
+    key = (None, "rich result")
+    a.put(key, {"lang": "en", "scores": [1, 2, 3]}, key[-1])
+    assert a.get(key) == {"lang": "en", "scores": [1, 2, 3]}
+    # only code-string production values travel through the shm slots
+    assert b.get(key) is _MISS
+    shared.close()
+
+
+def test_result_cache_epoch_forwarded_to_shared(cache_path):
+    shared = _cache(cache_path)
+    a = ResultCache(1 << 20, shared=shared)
+    a.set_epoch("d1")
+    a.put((None, "x"), "en", "x")
+    a.set_epoch("d2")
+    assert a.get((None, "x")) is _MISS
+    assert shared.stats()["epoch_flushes"] >= 1
+    shared.close()
+
+
+def test_single_flight_claim_resolve():
+    cache = ResultCache(1 << 20)
+    key = (None, "dup doc")
+    assert cache.claim(key) is None  # first claimer owns the key
+    ev = cache.claim(key)
+    assert isinstance(ev, threading.Event) and not ev.is_set()
+    cache.resolve(key)
+    assert ev.is_set()
+    # resolved: the key is claimable again
+    assert cache.claim(key) is None
+    cache.resolve(key)
+
+
+def test_single_flight_epoch_roll_wakes_waiters():
+    cache = ResultCache(1 << 20)
+    key = (None, "swapped away")
+    assert cache.claim(key) is None
+    ev = cache.claim(key)
+    cache.set_epoch("new-digest")
+    assert ev.is_set()  # waiters re-probe and dispatch themselves
+    # the old owner's late resolve is a harmless no-op
+    cache.resolve(key)
+    assert cache.claim(key) is None
+
+
+def test_single_flight_collapses_concurrent_fills():
+    import time
+    cache = ResultCache(1 << 20)
+    key = (None, "hot doc")
+    assert cache.claim(key) is None  # main thread is the slow owner
+    results = []
+
+    def waiter():
+        v = cache.get(key)
+        if v is _MISS:
+            ev = cache.claim(key)
+            assert ev is not None  # the owner still holds the key
+            assert ev.wait(5.0)
+            v = cache.get(key)
+        results.append(v)
+
+    threads = [threading.Thread(target=waiter) for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # let the stampede park on the event
+    cache.put(key, "en", key[-1])
+    cache.resolve(key)
+    for t in threads:
+        t.join()
+    assert results == ["en"] * 8

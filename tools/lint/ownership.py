@@ -124,6 +124,9 @@ LOCK_OWNERSHIP: dict = {
                                     "ShmRingServer.start single-"
                                     "assignment contract; the callee "
                                     "locks its own state",
+                "shared_cache_stats": "callable reference, same single-"
+                                      "assignment-at-init contract; "
+                                      "the callee locks its own state",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
@@ -210,10 +213,47 @@ LOCK_OWNERSHIP: dict = {
                 "mesh": "Mesh reference, init-assigned read-only",
             }),
     },
+    "language_detector_tpu/aot.py": {
+        "AotStore": _cl(
+            lock="_lock",
+            attrs=("_entries", "_exported", "loads", "exports",
+                   "refusals"),
+            lockfree={
+                "dir": "str assigned once at init, read-only",
+                "digest": "str assigned once at init, read-only",
+                "backend": "str assigned once at init, read-only",
+                "kernel_mode": "str assigned once at init, read-only",
+                "require": "bool assigned once at init, read-only",
+            }),
+    },
     "language_detector_tpu/service/batcher.py": {
         "ResultCache": _cl(
             lock="_lock",
-            attrs=("_d", "bytes", "hits", "misses")),
+            attrs=("_d", "bytes", "hits", "misses", "_pending"),
+            lockfree={
+                "_shared": "SharedResultCache reference assigned once "
+                           "at init; the shared table is lock-free by "
+                           "protocol (seqlock slots) and its stats "
+                           "take their own lock",
+            }),
+    },
+    "language_detector_tpu/service/sharedcache.py": {
+        "SharedResultCache": _cl(
+            lock="_lock",
+            attrs=("hits", "misses", "evictions", "epoch_flushes"),
+            lockfree={
+                "_mm": "mmap assigned once at init; slot access is "
+                       "coordinated by the seqlock protocol, not a "
+                       "process lock (cross-process sharing is the "
+                       "point)",
+                "path": "str assigned once at init, read-only",
+                "slot_count": "int assigned once at init, read-only",
+                "_epoch_word": "u64 rebound only by set_epoch (the "
+                               "swap path, serialized by the service "
+                               "swap lock); readers take ONE "
+                               "GIL-atomic load and either epoch's "
+                               "view is self-consistent",
+            }),
     },
     "language_detector_tpu/service/fleet.py": {
         "FleetStatus": _cl(lock="_lock", attrs=("_snap",)),
