@@ -1671,4 +1671,208 @@ print("boot-hot:", f"{exports1:.0f} executables exported by gen-1,",
       "shared-cache hits on the duplicate burst, clean exit")
 EOF
 
+echo "== capture/replay + SLO smoke =="
+# the traffic capture plane + per-tenant SLO engine (PR 17,
+# docs/OBSERVABILITY.md): a 2-member fleet under the lock-order
+# watchdog with LDT_CAPTURE_DIR, LDT_SLO (8 s fast window so the drill
+# recovers on CI timescales, and a deliberately unmeetable 1 ms
+# latency target so every drill request burns budget — the drill
+# must be deterministic, not timing-dependent), and a tight
+# per-tenant doc quota. The invariants: the burn-rate alert FIRES on
+# /sloz under the burning drill and RECOVERS once the fast window
+# ages out (slo_breach + slo_recovered land in the flight recorder);
+# a throttled tenant's sheds show as per-tenant SLIs on /fleetz while
+# the other tenant keeps serving; every completed request (sheds
+# included) lands in the per-member capture rings; and `bench.py
+# --replay --speedup 4` re-drives the merged capture against a fresh
+# fleet with zero drops.
+python3 - <<'EOF'
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+PORT, MBASE, SPORT = 3189, 31890, 31899
+TMP = tempfile.mkdtemp(prefix="ldt_capslo_")
+CAP = os.path.join(TMP, "capture")
+FREC = os.path.join(TMP, "flightrec")
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MBASE),
+    "LDT_FLEET_WORKERS": "2",
+    "LDT_FLEET_STATUS_PORT": str(SPORT),
+    "LDT_CAPTURE_DIR": CAP,
+    "LDT_FLIGHTREC_DIR": FREC,
+    # 1 ms target: every served request overshoots it, so the drill
+    # burns budget deterministically — no fault timing to race
+    "LDT_SLO": "p99_ms=1,err_pct=2,window_sec=8",
+    "LDT_TENANT_QUOTA_DOCS": "8",
+    "LDT_CRASH_BACKOFF_BASE_SEC": "0.2",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_capslo_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+
+def get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def wait_for(pred, what, deadline_sec, url=f"http://127.0.0.1:{SPORT}"):
+    deadline = time.time() + deadline_sec
+    while True:
+        doc = get(url + "/sloz") if "slo" in what else get(url + "/fleetz")
+        if doc is not None and pred(doc):
+            return doc
+        assert time.time() < deadline, \
+            f"never reached: {what} — last: {json.dumps(doc)[:4000]}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.25)
+
+
+def detect(tenant, docs=4, timeout=60):
+    body = json.dumps({"request": [
+        {"text": f"the quick brown fox jumps over the lazy dog {i}"}
+        for i in range(docs)
+    ]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{PORT}/", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-LDT-Tenant": tenant})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+try:
+    wait_for(lambda s: s["ready"] == 2, "2 READY members", 300)
+
+    # -- burn-rate alert drill: every request misses the 1 ms target -
+    for _ in range(12):          # fresh connections hop both members
+        st = detect("base", docs=4)
+        assert st == 200, f"drill request answered {st}"
+    slo = wait_for(lambda s: s.get("alert") == "breach",
+                   "slo alert breach", 60)
+    assert slo["enabled"] and slo["spec"]["target_ms"] == 1.0, slo
+    assert "base" in slo["tenants"], slo["tenants"].keys()
+
+    # -- recovery: the 8 s fast window ages out, nothing else burns --
+    wait_for(lambda s: s.get("alert") == "ok", "slo alert recovered",
+             120)
+
+    # -- throttled tenant: quota sheds show per-tenant, others serve -
+    results = {"hot": [], "base": []}
+    lock = threading.Lock()
+
+    def burst(tenant, n):
+        for _ in range(n):
+            st = detect(tenant, docs=8, timeout=120)
+            with lock:
+                results[tenant].append(st)
+
+    threads = [threading.Thread(target=burst, args=("hot", 4))
+               for _ in range(12)]
+    threads.append(threading.Thread(target=burst, args=("base", 12)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(s == 200 for s in results["base"]), \
+        f"throttle bled across tenants: {results['base']}"
+    hot_shed = sum(1 for s in results["hot"] if s == 429)
+    hot_ok = sum(1 for s in results["hot"] if s == 200)
+    assert hot_shed > 0, f"quota never shed: {results['hot']}"
+    assert hot_ok > 0, f"hot tenant fully starved: {results['hot']}"
+
+    # per-tenant SLIs ride a rolling 8 s fast window, so this poll
+    # runs right after the burst while its sheds are still in-window
+    fz = wait_for(
+        lambda s: (s.get("slo", {}).get("tenants", {})
+                   .get("hot", {}).get("shed", 0)) >= 1
+        and "base" in s.get("slo", {}).get("tenants", {}),
+        "per-tenant SLIs on /fleetz", 30)
+    t_hot = fz["slo"]["tenants"]["hot"]
+    assert t_hot["count"] >= t_hot["shed"] > 0, t_hot
+
+    sup.send_signal(signal.SIGINT)           # drain both members
+    rc = sup.wait(timeout=120)
+    assert rc == 0, f"fleet exit {rc}"
+finally:
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+# -- the capture holds every completed request, sheds included -------
+sys.path.insert(0, os.getcwd())
+from language_detector_tpu import capture, flightrec  # noqa: E402
+
+member_dirs = sorted(glob.glob(os.path.join(CAP, "m*")))
+assert len(member_dirs) == 2, f"per-member capture dirs: {member_dirs}"
+records = capture.merge_captures(CAP)
+total_reqs = 12 + 12 * 4 + 12
+assert len(records) == total_reqs, \
+    f"captured {len(records)} records, served {total_reqs} requests"
+sheds = sum(1 for r in records if r["shed"])
+assert sheds == hot_shed, f"capture sheds {sheds} != {hot_shed} (429s)"
+tenants = {r["tenant"] for r in records}
+assert len(tenants) == 2, f"tenants in capture: {tenants}"
+arrivals = [r["arrival_ns"] for r in records]
+assert arrivals == sorted(arrivals), "merge not arrival-ordered"
+
+evs = []
+for ring in glob.glob(os.path.join(FREC, "**", "flightrec-*.ring"),
+                      recursive=True):
+    evs += [e["ev"] for e in flightrec.read_ring(ring)["events"]]
+assert "slo_breach" in evs, "no slo_breach event recorded"
+assert "slo_recovered" in evs, "no slo_recovered event recorded"
+
+# -- replay the capture at 4x against a fresh fleet: zero drops ------
+renv = dict(os.environ)
+for k in ("LDT_FAULTS", "LDT_SLO", "LDT_CAPTURE_DIR",
+          "LDT_FLIGHTREC_DIR", "LDT_TENANT_QUOTA_DOCS"):
+    renv.pop(k, None)
+renv["LDT_LOCK_DEBUG"] = "1"
+r = subprocess.run(
+    [sys.executable, "bench.py", "--replay", CAP, "--speedup", "4"],
+    env=renv, capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, \
+    f"bench --replay failed:\n{r.stdout}\n{r.stderr}"
+out = json.loads(open("BENCH_replay.json").read())
+d = out["detail"]
+assert d["requests"] == total_reqs, d["requests"]
+assert d["completed"] == d["requests"], \
+    f"replay completed {d['completed']}/{d['requests']}"
+assert d["counts"]["drop"] == 0, f"replay drops: {d['counts']}"
+
+shutil.rmtree(TMP, ignore_errors=True)
+print("capture/replay + SLO:", f"{len(records)} records captured",
+      f"({sheds} sheds) across 2 members,",
+      "burn-rate alert fired under the burning drill and recovered,",
+      f"replay at 4x re-drove {d['completed']} requests",
+      f"with 0 drops (p95 skew {d['schedule']['p95_skew_ms']}ms)")
+EOF
+
 echo "CI OK"

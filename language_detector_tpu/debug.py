@@ -302,6 +302,96 @@ def format_admission(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def format_slo(doc: dict) -> str:
+    """Human-readable render of a /sloz document (slo.sloz, or the
+    fleet merge's "slo" block): declared targets, fleet-wide and
+    per-tenant windowed SLIs, budget burn rates, and the alert state —
+    the operator's answer to "are we meeting the SLO, and for whom
+    not"."""
+    if not doc.get("enabled"):
+        return ("(SLO engine off: " +
+                doc.get("hint", "set LDT_SLO on the fronts") + ")")
+    # the fleet merge has a different shape (aggregated tenants, no
+    # window pairs) — render it with the member count it carries
+    if "members" in doc and "fleet" not in doc:
+        lines = [f"fleet SLO: alert={doc.get('alert', 'ok')} "
+                 f"members={len(doc.get('members', []))}"]
+        spec = doc.get("spec") or {}
+        if spec:
+            lines.append(
+                f"targets      p{spec.get('percentile', 99):g}"
+                f"<={spec.get('target_ms')}ms "
+                f"err<={spec.get('err_pct')}% "
+                f"window={spec.get('window_sec')}s")
+        for t, agg in sorted((doc.get("tenants") or {}).items()):
+            lines.append(
+                f"  {t:<20} count={agg.get('count', 0)} "
+                f"bad={agg.get('bad', 0)} shed={agg.get('shed', 0)} "
+                f"worst_burn={agg.get('burn_rate_max', 0.0)}")
+        return "\n".join(lines)
+    spec = doc.get("spec", {})
+    alert = doc.get("alert", {})
+    lines = [
+        f"targets      p{spec.get('percentile', 99):g}"
+        f"<={spec.get('target_ms')}ms err<={spec.get('err_pct')}% "
+        f"windows={spec.get('window_sec')}s/"
+        f"{spec.get('slow_window_sec')}s",
+        f"alert        {alert.get('state', 'ok')}"
+        + (f" since={alert.get('since_sec')}s"
+           if alert.get("since_sec") is not None else "")
+        + f" breaches_total={alert.get('breaches_total', 0)}",
+        f"observed     {doc.get('observed', 0)} requests",
+    ]
+
+    def _scope(name: str, view: dict) -> None:
+        for label in ("fast", "slow"):
+            w = view.get(label) or {}
+            pq = next((v for k, v in w.items()
+                       if k.startswith("p") and k.endswith("_ms")
+                       and k != "p50_ms"), None)
+            lines.append(
+                f"  {name:<18} {label:<4} count={w.get('count', 0)} "
+                f"err={w.get('err_ratio', 0.0)} "
+                f"shed={w.get('shed', 0)} p50={w.get('p50_ms')}ms "
+                f"pX={pq}ms burn={w.get('burn_rate', 0.0)}")
+
+    lines.append("fleet-wide")
+    _scope("(all tenants)", doc.get("fleet") or {})
+    tenants = doc.get("tenants") or {}
+    if tenants:
+        lines.append("per-tenant")
+        for t, view in sorted(tenants.items()):
+            _scope(t, view)
+    return "\n".join(lines)
+
+
+def format_capture_summary(doc: dict) -> str:
+    """Human-readable render of capture.summarize(dir): segment/record
+    volumes, the capture's time span, and the tenant/lane/status mix —
+    the sanity check before pointing bench.py --replay at it."""
+    lines = [
+        f"capture {doc.get('dir', '?')}",
+        f"records      {doc.get('records', 0)} across "
+        f"{doc.get('segments', 0)} sealed segment(s) + "
+        f"{doc.get('rings', 0)} live/abandoned ring(s)",
+        f"span         {doc.get('span_sec', 0.0)}s",
+        f"tenants      {doc.get('tenants', 0)} distinct "
+        f"(sheds={doc.get('sheds', 0)})",
+    ]
+    for row in doc.get("top_tenants", []):
+        lines.append(f"  {row.get('tenant', '?'):<20} "
+                     f"{row.get('records', 0)} record(s)")
+    lanes = doc.get("lanes") or {}
+    if lanes:
+        lines.append("lanes        " + " ".join(
+            f"{k}={v}" for k, v in sorted(lanes.items())))
+    statuses = doc.get("statuses") or {}
+    if statuses:
+        lines.append("statuses     " + " ".join(
+            f"{k}={v}" for k, v in sorted(statuses.items())))
+    return "\n".join(lines)
+
+
 def _read_slow_source(src: str) -> dict:
     """--slow-traces input: an http(s) URL (a running front's
     GET /debug/slow), a JSON file path, or '-' for stdin."""
@@ -356,6 +446,16 @@ def _main(argv=None):
                          "SRC is the fleet status port's GET /tracez "
                          "URL, a JSON file, or '-' for stdin (requires "
                          "LDT_FLEET_STATUS_PORT on the fleet)")
+    ap.add_argument("--slo", metavar="SRC",
+                    help="pretty-print SLO targets, windowed SLIs, "
+                         "budget burn rates, and alert state: SRC is a "
+                         "metrics-port /sloz URL (front or fleet "
+                         "status port), a JSON file, or '-' for stdin "
+                         "(requires LDT_SLO set on the server)")
+    ap.add_argument("--capture-summary", metavar="DIR",
+                    help="summarize a traffic-capture directory tree "
+                         "(LDT_CAPTURE_DIR): segment/record counts, "
+                         "time span, tenant/lane/status mix")
     ap.add_argument("--admission", metavar="SRC",
                     help="pretty-print admission-control state "
                          "(queue occupancy, brownout level, breaker, "
@@ -369,6 +469,14 @@ def _main(argv=None):
     if args.fleet_traces:
         print(format_fleet_traces(
             _read_slow_source(args.fleet_traces)))
+        return 0
+    if args.slo:
+        print(format_slo(_read_slow_source(args.slo)))
+        return 0
+    if args.capture_summary:
+        from . import capture
+        print(format_capture_summary(
+            capture.summarize(args.capture_summary)))
         return 0
     if args.admission:
         print(format_admission(_read_slow_source(args.admission)))

@@ -112,6 +112,15 @@ EVENTS: dict = {
         "A quarantined CORRUPT lane healed: fresh tables re-uploaded "
         "and verified, lane re-admitted as a half-open probe "
         "(integrity.py)."),
+    "slo_breach": (
+        "transition",
+        "An SLO error-budget burn-rate alert fired: both the fast and "
+        "slow windows are burning budget faster than allowed "
+        "(slo.py; scope fleet or tenant, burn rates attached)."),
+    "slo_recovered": (
+        "transition",
+        "A firing SLO burn-rate alert cleared: the fast window's burn "
+        "rate dropped back under 1.0 (slo.py)."),
     "postmortem": (
         "lifecycle",
         "A dead member's recorder was harvested into postmortem JSON "

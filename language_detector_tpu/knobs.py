@@ -409,6 +409,35 @@ _DECLARATIONS: tuple[Knob, ...] = (
     _k("LDT_PROFILE_WINDOW_SEC", "float", 5.0,
        "Capture window for an on-demand profile: the trace stops "
        "itself this many seconds after it was armed.", bound=True),
+    # -- traffic capture & SLO engine (capture.py, slo.py) ------------
+    _k("LDT_CAPTURE_DIR", "str", None,
+       "Directory for the traffic-capture plane: each front writes "
+       "one fixed-width anonymized record per completed request into "
+       "capture-<pid>.ring (mmap'd, commit-word-published, readable "
+       "after SIGKILL), sealing full rings into segment-*.cap files. "
+       "The fleet gives each member its own m<slot>/ subdirectory. "
+       "bench.py --replay re-drives a capture; see "
+       "docs/OBSERVABILITY.md. Unset: capture off, zero-cost."),
+    _k("LDT_CAPTURE_SAMPLE", "float", 1.0,
+       "Fraction of completed requests recorded by the capture plane "
+       "(probabilistic per-request sampling; 1.0 keeps everything, "
+       "0.01 keeps ~1%)."),
+    _k("LDT_CAPTURE_RING_RECORDS", "int", 4096,
+       "Records per capture ring before it is sealed into an "
+       "immutable segment file and restarted."),
+    _k("LDT_CAPTURE_MAX_SEGMENTS", "int", 64,
+       "Sealed capture segments kept per writer; the oldest are "
+       "unlinked first, bounding on-disk capture size."),
+    _k("LDT_SLO", "str", None,
+       "SLO spec armed at front startup, e.g. "
+       "'p99_ms=50,err_pct=0.5,window_sec=300': latency-percentile "
+       "target, error-budget percentage, and fast-window seconds "
+       "(the slow window is 12x). Drives per-tenant + fleet SLIs, "
+       "multi-window burn rates, /sloz, and slo_breach / "
+       "slo_recovered flight-recorder events. Unset: SLO engine off."),
+    _k("LDT_SLO_MIN_EVENTS", "int", 4,
+       "Minimum fast-window events before a burn-rate breach may "
+       "fire; suppresses alerts on near-idle traffic."),
     # -- debug / CI ---------------------------------------------------
     _k("LDT_LOCK_DEBUG", "bool", False,
        "Build order-checking debug locks (language_detector_tpu/locks)"

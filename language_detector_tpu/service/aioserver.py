@@ -20,7 +20,7 @@ import json
 import os
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import faults, flightrec, knobs, telemetry
+from .. import capture, faults, flightrec, knobs, slo, telemetry
 from . import wire
 from .admission import (DeadlineExceeded, FairScheduler,
                         degraded_detect)
@@ -491,6 +491,10 @@ class AioService:
                 headers.get(b"x-ldt-request-id")) \
                 or wire.gen_request_id()
             trace.request_id = rid
+            # request shape for the capture plane (size bucket +
+            # priority flag ride the completion meta)
+            meta["bytes"] = len(body)
+            meta["priority"] = headers.get(b"x-ldt-priority") is not None
             eh = ((b"X-LDT-Request-Id", rid.encode("ascii")),)
             flightrec.emit_event("request_start", request_id=rid,
                                  lane="tcp")
@@ -514,6 +518,10 @@ class AioService:
                     priority=headers.get(b"x-ldt-priority") is not None,
                     tenant=tenant_h.decode("latin-1")
                     if tenant_h else None)
+                # tenant lands on the trace before the shed branch: a
+                # throttled tenant's sheds must show under ITS SLO/
+                # capture identity, not "default"
+                trace.tenant = admit.tenant
                 if admit.shed:
                     m.inc("augmentation_errors_logged_total")
                     meta["status"] = admit.status
@@ -526,7 +534,6 @@ class AioService:
                              str(admit.retry_after).encode()),))
                 trace.deadline = adm.deadline_from_header(
                     headers.get(b"x-ldt-deadline-ms"))
-                trace.tenant = admit.tenant
                 if admit.level >= 1 and not admit.probe:
                     # pool probe vehicles keep retry rights: a lost
                     # probe batch must fail over, not 500
@@ -754,7 +761,8 @@ class AioService:
         flightrec.emit_event("request_start",
                              request_id=trace.request_id, lane="uds")
         t = trace.t0
-        meta: dict = {"front": "uds"}
+        meta: dict = {"front": "uds", "bytes": len(body),
+                      "priority": bool(priority)}
         try:
             pre, err = wire.parse_request(svc, "application/json",
                                           body)
@@ -769,6 +777,9 @@ class AioService:
             if texts:
                 admit = adm.try_admit(texts, priority=priority,
                                       tenant=tenant)
+                # tenant before the shed branch: sheds must carry the
+                # throttled tenant's identity into SLO/capture
+                trace.tenant = admit.tenant
                 if admit.shed:
                     m.inc("augmentation_errors_logged_total")
                     meta["status"] = admit.status
@@ -776,7 +787,6 @@ class AioService:
                     return admit.status, [json.dumps(
                         {"error": admit.message}).encode()]
                 trace.deadline = adm.deadline_from_header(deadline_ms)
-                trace.tenant = admit.tenant
                 if admit.level >= 1 and not admit.probe:
                     trace.no_retry = True
             try:
@@ -867,6 +877,10 @@ class AioService:
                     elif path == "/debug/vars":
                         body = json.dumps(telemetry.debug_vars(
                             self.svc.metrics), indent=2).encode()
+                        writer.write(_http_response(200, body))
+                    elif path == "/sloz":
+                        body = json.dumps(slo.sloz(),
+                                          indent=2).encode()
                         writer.write(_http_response(200, body))
                     elif path == "/debug/slow":
                         ring = telemetry.REGISTRY.slow
@@ -1006,6 +1020,8 @@ async def serve(port: int = 3000, metrics_port: int = 30000,
                 svc: DetectorService | None = None,
                 ready: "asyncio.Future | None" = None):
     flightrec.init_from_env(role="aio-front")
+    capture.init_from_env()
+    slo.init_from_env()
     from .. import profiling
     profiling.install_sigusr2()
     aio = AioService(svc)

@@ -302,6 +302,58 @@ def _member_slow_traces(metrics_port: int) -> list:
         return []
 
 
+def _member_sloz(metrics_port: int) -> "dict | None":
+    """One member's /sloz document, None when the scrape fails (a dead
+    or mid-restart member must not fail the fleet merge)."""
+    try:
+        url = f"http://127.0.0.1:{metrics_port}/sloz"
+        with urllib.request.urlopen(url, timeout=2.0) as r:
+            return json.loads(r.read().decode())
+    except Exception:  # noqa: BLE001 - merge is best-effort per member
+        return None
+
+
+def _fleet_slo(snap: dict) -> dict:
+    """The fleet-scoped SLO merge for /fleetz and /sloz: every live
+    member's /sloz joined; per-tenant SLIs aggregate as summed counts
+    and WORST (max) burn rate across members — one hot member breaching
+    a tenant's budget is a breach, averaging would hide it."""
+    members: list = []
+    tenants: dict = {}
+    alert = False
+    enabled = False
+    spec = None
+    for mem in snap.get("members", ()):
+        port = int(mem.get("metrics_port") or 0)
+        if port <= 0:
+            continue
+        sz = _member_sloz(port)
+        if not sz:
+            continue
+        members.append({"slot": mem.get("slot"),
+                        "pid": mem.get("pid"), "sloz": sz})
+        if not sz.get("enabled"):
+            continue
+        enabled = True
+        spec = spec or sz.get("spec")
+        if (sz.get("alert") or {}).get("state") == "breach":
+            alert = True
+        for tenant, view in (sz.get("tenants") or {}).items():
+            fast = view.get("fast") or {}
+            agg = tenants.setdefault(
+                tenant, {"count": 0, "bad": 0, "shed": 0,
+                         "burn_rate_max": 0.0, "members": 0})
+            agg["count"] += fast.get("count", 0)
+            agg["bad"] += fast.get("bad", 0)
+            agg["shed"] += fast.get("shed", 0)
+            agg["burn_rate_max"] = max(agg["burn_rate_max"],
+                                       fast.get("burn_rate", 0.0))
+            agg["members"] += 1
+    return {"enabled": enabled, "spec": spec,
+            "alert": "breach" if alert else "ok",
+            "tenants": tenants, "members": members}
+
+
 def _fleet_traces(snap: dict, flightrec_base: str | None) -> dict:
     """The fleet-scoped /tracez merge: every live member's slow-trace
     ring (scraped over its metrics port) joined with every recorder
@@ -363,7 +415,11 @@ def _start_status_server(port: int, status: FleetStatus,
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             snap = status.read()
             if self.path.startswith("/fleetz"):
-                body = json.dumps(snap, indent=2).encode()
+                body = json.dumps(dict(snap, slo=_fleet_slo(snap)),
+                                  indent=2).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/sloz"):
+                body = json.dumps(_fleet_slo(snap), indent=2).encode()
                 ctype = "application/json"
             elif self.path.startswith("/tracez"):
                 body = json.dumps(
@@ -422,6 +478,7 @@ def fleet_main(module: str) -> int:
     uds_base = knobs.get_str("LDT_UNIX_SOCKET")
     shm_base = knobs.get_str("LDT_SHM_DIR")
     flightrec_base = knobs.get_str("LDT_FLIGHTREC_DIR")
+    capture_base = knobs.get_str("LDT_CAPTURE_DIR")
     # the fleet's own recorder lands directly under the base dir;
     # members get per-slot subdirectories (see _member_env)
     flightrec.init_from_env(role="fleet")
@@ -498,6 +555,15 @@ def fleet_main(module: str) -> int:
             except OSError:
                 pass
             env["LDT_FLIGHTREC_DIR"] = fr_dir
+        if capture_base:
+            # per-member capture directory (same pattern): the merged
+            # replay input is <base>/m<slot>/{segment-*.cap,*.ring}
+            cap_dir = os.path.join(capture_base, f"m{m.slot}")
+            try:
+                os.makedirs(cap_dir, exist_ok=True)
+            except OSError:
+                pass
+            env["LDT_CAPTURE_DIR"] = cap_dir
         if cache_dir:
             env["LDT_COMPILE_CACHE_DIR"] = cache_dir
         if aot_dir:

@@ -32,7 +32,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
-from .. import faults, flightrec, knobs, telemetry
+from .. import capture, faults, flightrec, knobs, slo, telemetry
 from ..locks import make_lock
 from . import wire
 from .admission import (BREAKER_OPEN, BREAKER_STATE_NAMES,
@@ -111,6 +111,11 @@ class Metrics:
         # attaches): () -> sharedcache.SharedResultCache.stats() dict
         # or None (tier disabled)
         self.shared_cache_stats = lambda: None
+        # SLO engine + traffic-capture sources (module-level singletons
+        # in slo.py / capture.py — armed by LDT_SLO / LDT_CAPTURE_DIR;
+        # disabled -> None and the gauges render 0)
+        self.slo_stats = slo.stats
+        self.capture_stats = capture.stats
 
     def inc(self, name: str, amount: float = 1):
         with self._lock:
@@ -274,6 +279,24 @@ class Metrics:
                         rd.get("warmup_ms", 0) if rd else 0))
         fams.append(one("ldt_worker_generation",
                         knobs.get_int("LDT_WORKER_GENERATION") or 0))
+        # SLO engine (slo.py; ldt_slo_events_total and
+        # ldt_slo_breaches_total are registry counters and render with
+        # the families below)
+        sl = self.slo_stats() or {}
+        fams.append(one("ldt_slo_alert",
+                        1 if sl.get("alert") else 0))
+        fams.append(fam("ldt_slo_burn_rate",
+                        [("ldt_slo_burn_rate", {"window": "fast"},
+                          sl.get("burn_fast", 0.0)),
+                         ("ldt_slo_burn_rate", {"window": "slow"},
+                          sl.get("burn_slow", 0.0))]))
+        fams.append(one("ldt_slo_budget_remaining",
+                        sl.get("budget_remaining", 1.0)))
+        # traffic-capture plane (capture.py) — the *_total series are
+        # registry counters; ring occupancy is the live gauge here
+        cp = self.capture_stats() or {}
+        fams.append(one("ldt_capture_ring_occupancy",
+                        cp.get("ring_occupancy", 0)))
         # shared telemetry registry: stage/request histograms + compile
         # counters (both fronts render the same registry)
         fams.extend(telemetry.REGISTRY.families())
@@ -738,13 +761,19 @@ class Handler(BaseHTTPRequestHandler):
         echo = {wire.REQUEST_ID_HEADER: rid}
         flightrec.emit_event("request_start", request_id=rid,
                              lane="tcp")
+        # completion-meta base shared by every finish_request exit on
+        # this handler: the capture plane records request shape
+        # (bytes -> size bucket, priority flag) alongside the outcome
+        base = {"front": "sync", "bytes": len(body),
+                "priority":
+                    self.headers.get("X-LDT-Priority") is not None}
         t = trace.t0
         pre, err = wire.parse_request(
             svc, self.headers.get("Content-Type"), body)
         if err is not None:
             self._send_json(*err, headers=echo)
             telemetry.finish_request(
-                trace, meta={"front": "sync", "status": err[0]})
+                trace, meta=dict(base, status=err[0]))
             return
         t = telemetry.observe_stage("parse", t, trace=trace)
         texts, slots, responses, status = pre
@@ -755,6 +784,9 @@ class Handler(BaseHTTPRequestHandler):
                 texts,
                 priority=self.headers.get("X-LDT-Priority") is not None,
                 tenant=self.headers.get("X-LDT-Tenant"))
+            # tenant before the shed branch: sheds must carry the
+            # throttled tenant's identity into SLO/capture
+            trace.tenant = admit.tenant
             if admit.shed:
                 svc.metrics.inc("augmentation_errors_logged_total")
                 self._send_json(
@@ -764,13 +796,12 @@ class Handler(BaseHTTPRequestHandler):
                         echo, **{"Retry-After":
                                  str(admit.retry_after)}))
                 telemetry.finish_request(
-                    trace, meta={"front": "sync", "docs": len(texts),
-                                 "status": admit.status,
-                                 "shed": admit.reason})
+                    trace, meta=dict(base, docs=len(texts),
+                                     status=admit.status,
+                                     shed=admit.reason))
                 return
             trace.deadline = adm.deadline_from_header(
                 self.headers.get("X-LDT-Deadline-Ms"))
-            trace.tenant = admit.tenant
             if admit.level >= 1 and not admit.probe:
                 # pool probe vehicles keep retry rights: a lost probe
                 # batch must fail over, not 500 (admission.Admit.probe)
@@ -787,8 +818,7 @@ class Handler(BaseHTTPRequestHandler):
                 504, b'{"error":"deadline expired before dispatch"}',
                 headers=echo)
             telemetry.finish_request(
-                trace, meta={"front": "sync", "docs": len(texts),
-                             "status": 504})
+                trace, meta=dict(base, docs=len(texts), status=504))
             return
         except (TimeoutError, FuturesTimeout):
             # flush future timed out (LDT_FLUSH_TIMEOUT_SEC): the
@@ -800,8 +830,8 @@ class Handler(BaseHTTPRequestHandler):
             self._send_json(504, b'{"error":"detection timed out"}',
                             headers=echo)
             telemetry.finish_request(
-                trace, meta={"front": "sync", "docs": len(texts),
-                             "status": 504, "timeout": "flush"})
+                trace, meta=dict(base, docs=len(texts), status=504,
+                                 timeout="flush"))
             return
         except Exception as e:  # noqa: BLE001 - every doc resolves
             # the chaos invariant: an injected (or real) batcher/engine
@@ -812,8 +842,7 @@ class Handler(BaseHTTPRequestHandler):
             self._send_json(500, b'{"error":"internal error"}',
                             headers=echo)
             telemetry.finish_request(
-                trace, meta={"front": "sync", "docs": len(texts),
-                             "status": 500})
+                trace, meta=dict(base, docs=len(texts), status=500))
             return
         finally:
             if admit is not None:
@@ -824,8 +853,7 @@ class Handler(BaseHTTPRequestHandler):
         telemetry.observe_stage("encode", t, trace=trace)
         self._send_buffers(status, buffers, headers=echo)
         telemetry.finish_request(
-            trace, meta={"front": "sync", "docs": len(texts),
-                         "status": status})
+            trace, meta=dict(base, docs=len(texts), status=status))
 
 
 # shared contract logic (parse_post_body / pre_detect / post_detect /
@@ -850,6 +878,9 @@ class MetricsHandler(BaseHTTPRequestHandler):
             body = json.dumps(
                 telemetry.debug_vars(self.service.metrics),
                 indent=2).encode()
+            ctype = "application/json; charset=utf-8"
+        elif path == "/sloz":
+            body = json.dumps(slo.sloz(), indent=2).encode()
             ctype = "application/json; charset=utf-8"
         elif path == "/debug/slow":
             ring = telemetry.REGISTRY.slow
@@ -989,6 +1020,8 @@ def main():
 
     from .recycle import RECYCLE_EXIT_CODE
     flightrec.init_from_env(role="sync-front")
+    capture.init_from_env()
+    slo.init_from_env()
     port = knobs.get_int("LISTEN_PORT") or 0
     metrics_port = knobs.get_int("PROMETHEUS_PORT") or 0
     httpd, metricsd, svc = make_server(port, metrics_port)

@@ -550,10 +550,15 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
     t = trace.t0
     if detect is None:
         detect = svc.detect_codes
+    # completion-meta base shared by every finish_request exit: the
+    # capture plane records request shape (bytes -> size bucket,
+    # priority flag) alongside the outcome
+    base = {"front": lane,
+            "bytes": int(nbytes) if nbytes is not None else len(body),
+            "priority": bool(priority)}
     pre, err = parse_request(svc, "application/json", body, nbytes=nbytes)
     if err is not None:
-        telemetry.finish_request(
-            trace, meta={"front": lane, "status": err[0]})
+        telemetry.finish_request(trace, meta=dict(base, status=err[0]))
         return err[0], [err[1]]
     t = telemetry.observe_stage("parse", t, trace=trace)
     texts, slots, responses, status = pre
@@ -561,16 +566,18 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
     admit = None
     if texts:
         admit = adm.try_admit(texts, priority=priority, tenant=tenant)
+        # tenant before the shed branch: sheds must carry the
+        # throttled tenant's identity into SLO/capture
+        trace.tenant = admit.tenant
         if admit.shed:
             m.inc("augmentation_errors_logged_total")
             telemetry.finish_request(
-                trace, meta={"front": lane, "docs": len(texts),
-                             "status": admit.status,
-                             "shed": admit.reason})
+                trace, meta=dict(base, docs=len(texts),
+                                 status=admit.status,
+                                 shed=admit.reason))
             return admit.status, [json.dumps(
                 {"error": admit.message}).encode()]
         trace.deadline = adm.deadline_from_header(deadline_ms)
-        trace.tenant = admit.tenant
         if admit.level >= 1 and not admit.probe:
             trace.no_retry = True
     try:
@@ -581,22 +588,20 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
     except DeadlineExceeded:
         m.inc("augmentation_errors_logged_total")
         telemetry.finish_request(
-            trace, meta={"front": lane, "docs": len(texts),
-                         "status": 504})
+            trace, meta=dict(base, docs=len(texts), status=504))
         return 504, [b'{"error":"deadline expired before dispatch"}']
     except (TimeoutError, FuturesTimeout):
         m.inc("augmentation_errors_logged_total")
         telemetry.finish_request(
-            trace, meta={"front": lane, "docs": len(texts),
-                         "status": 504, "timeout": "flush"})
+            trace, meta=dict(base, docs=len(texts), status=504,
+                             timeout="flush"))
         return 504, [b'{"error":"detection timed out"}']
     except Exception as e:  # noqa: BLE001 — typed 500, never a cut frame
         print(json.dumps({"msg": "detect failed",
                           "error": repr(e)}), flush=True)
         m.inc("augmentation_errors_logged_total")
         telemetry.finish_request(
-            trace, meta={"front": lane, "docs": len(texts),
-                         "status": 500})
+            trace, meta=dict(base, docs=len(texts), status=500))
         return 500, [b'{"error":"internal error"}']
     finally:
         if admit is not None:
@@ -605,8 +610,7 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
     status, buffers = post_detect(svc, codes, slots, responses, status)
     telemetry.observe_stage("encode", t, trace=trace)
     telemetry.finish_request(
-        trace, meta={"front": lane, "docs": len(texts),
-                     "status": status})
+        trace, meta=dict(base, docs=len(texts), status=status))
     return status, buffers
 
 

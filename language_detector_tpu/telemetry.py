@@ -342,6 +342,48 @@ METRICS: dict = {
         "Shared-cache entries invalidated by an artifact-swap epoch "
         "sweep (stale-epoch slots freed so a new artifact can never "
         "serve the old artifact's results)."),
+    # -- traffic capture plane (capture.py) ---------------------------
+    "ldt_capture_records_total": (
+        "counter",
+        "Requests recorded by the traffic-capture plane (committed "
+        "into the capture ring; excludes sampled-out requests)."),
+    "ldt_capture_sampled_out_total": (
+        "counter",
+        "Requests skipped by LDT_CAPTURE_SAMPLE probabilistic "
+        "sampling (capture armed but the coin came up tails)."),
+    "ldt_capture_ring_occupancy": (
+        "gauge",
+        "Committed records currently in this process's active capture "
+        "ring (seals into a segment at LDT_CAPTURE_RING_RECORDS)."),
+    "ldt_capture_segments_total": (
+        "counter",
+        "Capture rings sealed into immutable segment files (size-"
+        "bounded rotation; oldest segments pruned past "
+        "LDT_CAPTURE_MAX_SEGMENTS)."),
+    # -- SLO engine (slo.py) ------------------------------------------
+    "ldt_slo_events_total": (
+        "counter",
+        "Completed requests scored by the SLO engine, labelled by "
+        "result: good, bad (error or over-target latency), or shed "
+        "(load-shedding rejections burn error budget separately)."),
+    "ldt_slo_breaches_total": (
+        "counter",
+        "SLO burn-rate alerts fired (fleet scope and per-tenant "
+        "scopes both count; see slo_breach flight-recorder events "
+        "for the attribution)."),
+    "ldt_slo_alert": (
+        "gauge",
+        "1 while a fleet-scope SLO burn-rate alert is firing, else 0 "
+        "(per-tenant alert states are on /sloz)."),
+    "ldt_slo_burn_rate": (
+        "gauge",
+        "Fleet-scope error-budget burn rate per window (window=fast|"
+        "slow): 1.0 burns exactly the declared budget; sustained "
+        ">1.0 in both windows fires the alert."),
+    "ldt_slo_budget_remaining": (
+        "gauge",
+        "Fraction of the fleet-scope error budget left in the slow "
+        "window (1.0 = untouched, 0 = fully burned)."),
 }
 
 
@@ -431,7 +473,7 @@ class Trace:
     path."""
 
     __slots__ = ("t0", "t_wall", "spans", "deadline", "no_retry",
-                 "tenant", "request_id")
+                 "tenant", "request_id", "finished")
 
     def __init__(self):
         self.t0 = _mono()
@@ -451,6 +493,12 @@ class Trace:
         # request events so /tracez can join one document's journey
         # across processes
         self.request_id = None
+        # completion latch: finish_request() is the single
+        # authoritative completion path and flips this exactly once,
+        # so telemetry, capture, and SLO can never double-count a
+        # request whose handler unwinds through two finish sites
+        # (e.g. a 504-after-shed)
+        self.finished = False
 
     def add(self, name: str, t0: float, t1: float, depth: int = 0):
         self.spans.append((name, depth, t0, t1))
@@ -790,8 +838,15 @@ def finish_request(trace: Trace, meta: dict | None = None) -> float:
     trace is exactly the one an operator needs, and sampling only
     slow-but-successful requests would discard it). Also stamps the
     request id into the meta and emits the flight-recorder
-    request_end event. Returns total ms."""
+    request_end event, then feeds the capture plane and SLO engine —
+    this is the single authoritative completion path, and the trace's
+    `finished` latch makes it idempotent: a handler that unwinds
+    through two finish sites (504-after-shed) counts exactly once in
+    telemetry, capture, and SLO alike. Returns total ms."""
     total = trace.total_ms()
+    if getattr(trace, "finished", False):
+        return total
+    trace.finished = True
     REGISTRY.histogram("ldt_request_latency_ms").observe(total)
     if meta is not None and trace.request_id is not None:
         meta.setdefault("request_id", trace.request_id)
@@ -814,6 +869,13 @@ def finish_request(trace: Trace, meta: dict | None = None) -> float:
                          total_ms=round(total, 3),
                          **({"front": meta["front"]}
                             if meta and "front" in meta else {}))
+    # capture plane + SLO engine ride the same completion edge (both
+    # are a single None check when their knob is unset); lazy imports
+    # keep module-load order acyclic
+    from . import capture as _capture
+    from . import slo as _slo
+    _capture.observe(trace, meta, total)
+    _slo.observe(trace, meta, total)
     return total
 
 
@@ -886,6 +948,16 @@ def debug_vars(metrics=None) -> dict:
             sc = shc_fn()
             if sc:
                 d["shared_cache"] = sc
+        slo_fn = getattr(metrics, "slo_stats", None)
+        if slo_fn is not None:
+            sl = slo_fn()
+            if sl:
+                d["slo"] = sl
+        cap_fn = getattr(metrics, "capture_stats", None)
+        if cap_fn is not None:
+            cp = cap_fn()
+            if cp:
+                d["capture"] = cp
     rh = REGISTRY.histogram("ldt_request_latency_ms")
     _, rsum, rcount, rmax = rh.snapshot()
     d["requests"] = {"count": rcount,

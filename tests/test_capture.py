@@ -1,0 +1,399 @@
+"""Traffic capture plane (round 17): pinned on-disk struct layout,
+commit-word crash safety (a torn/uncommitted slot is skipped, never
+fatal), deterministic seeded sampling, size-bounded rotation with
+segment pruning, the cross-member merge readers, the finish_request
+once-only completion latch, and replay schedule fidelity against a
+local HTTP stub.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from language_detector_tpu import capture, telemetry
+from language_detector_tpu.capture import (COMMIT, FILE_HDR, RECORD,
+                                           SLOT_BYTES, CaptureWriter,
+                                           merge_captures, read_capture,
+                                           record_from, size_bucket,
+                                           tenant_hash)
+from language_detector_tpu.telemetry import Trace
+
+
+# -- format pins -------------------------------------------------------------
+
+
+def test_struct_sizes_pinned():
+    """The on-disk format cannot drift silently: these numbers are the
+    wire contract every sealed segment on every machine depends on."""
+    assert FILE_HDR.size == 36
+    assert COMMIT.size == 4
+    assert RECORD.size == 54
+    assert SLOT_BYTES == 58
+    assert capture.VERSION == 1
+
+
+def test_tenant_hash_stable_and_anonymous():
+    h = tenant_hash("acme")
+    assert h == tenant_hash("acme")          # stable across calls
+    assert h != tenant_hash("acme2")
+    assert tenant_hash(None) == tenant_hash("default")
+    assert 0 < h < 2 ** 64
+    # the raw tenant string is not recoverable from the record
+    assert "acme" not in f"t{h:016x}"
+
+
+def test_size_bucket_log2():
+    assert size_bucket(0) == 0
+    assert size_bucket(1) == 1
+    assert size_bucket(900) == 10            # 512 < 900 <= 1024
+    assert size_bucket(1 << 20) == 21
+
+
+# -- record round-trip -------------------------------------------------------
+
+
+def _trace(tenant="acme"):
+    tr = Trace()
+    tr.tenant = tenant
+    tr.add("parse", tr.t0, tr.t0 + 0.001)
+    tr.add("detect", tr.t0 + 0.001, tr.t0 + 0.005)
+    tr.add("encode", tr.t0 + 0.005, tr.t0 + 0.006)
+    return tr
+
+
+def test_record_roundtrip(tmp_path):
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0)
+    try:
+        tr = _trace()
+        meta = {"front": "sync", "status": 200, "docs": 3,
+                "bytes": 900, "priority": True, "cache_bits": 0b101}
+        assert w.append(record_from(tr, meta, 6.25))
+        recs = read_capture(str(tmp_path))
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["tenant"] == f"t{tenant_hash('acme'):016x}"
+        assert r["docs"] == 3
+        assert r["size_bucket"] == 10
+        assert r["approx_bytes"] == 512
+        assert r["lane"] == "tcp"
+        assert r["verdict"] == "ok"
+        assert r["status"] == 200
+        assert r["priority"] and not r["shed"]
+        assert r["cache_bits"] == 0b101
+        assert r["total_ms"] == pytest.approx(6.25, abs=0.01)
+        assert r["parse_ms"] == pytest.approx(1.0, abs=0.1)
+        assert r["detect_ms"] == pytest.approx(4.0, abs=0.1)
+    finally:
+        w.close()
+
+
+def test_verdict_and_lane_mapping(tmp_path):
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0)
+    try:
+        cases = [
+            ({"front": "uds", "status": 429, "shed": True}, "uds",
+             "shed"),
+            ({"front": "shm", "status": 500}, "shm", "error"),
+            ({"front": "aio", "status": 504, "timeout": True}, "tcp",
+             "timeout"),
+            ({"front": "sync", "status": 400}, "tcp", "invalid"),
+        ]
+        for meta, _, _ in cases:
+            w.append(record_from(_trace(), meta, 1.0))
+        recs = read_capture(str(tmp_path))
+        assert [(r["lane"], r["verdict"]) for r in recs] == \
+            [(lane, verdict) for _, lane, verdict in cases]
+        assert recs[0]["shed"]
+    finally:
+        w.close()
+
+
+# -- crash safety ------------------------------------------------------------
+
+
+def test_torn_commit_word_skips_one_slot(tmp_path):
+    """The crash-safety contract: zeroing (or garbling) one slot's
+    commit word makes exactly that record invisible — the payload
+    bytes still sitting in the map never surface."""
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0)
+    try:
+        for i in range(3):
+            w.append(record_from(_trace(f"t{i}"), {"front": "sync",
+                                                   "status": 200}, 1.0))
+        off = FILE_HDR.size + 1 * SLOT_BYTES
+        w.mm[off:off + COMMIT.size] = struct.pack("<I", 0)   # torn
+        recs = read_capture(str(tmp_path))
+        assert len(recs) == 2
+        assert {r["tenant_hash"] for r in recs} == \
+            {tenant_hash("t0"), tenant_hash("t2")}
+        # a wrong (stale-generation) commit value is equally invisible
+        w.mm[off:off + COMMIT.size] = struct.pack("<I", 99)
+        assert len(read_capture(str(tmp_path))) == 2
+    finally:
+        w.close()
+
+
+def test_abandoned_ring_is_readable(tmp_path):
+    """A SIGKILLed writer leaves only its ring file; the committed
+    records in it are harvested without any shutdown handshake."""
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0)
+    for i in range(5):
+        w.append(record_from(_trace(), {"front": "sync",
+                                        "status": 200}, 1.0))
+    # no close(), no seal: read straight from the abandoned file
+    assert len(read_capture(str(tmp_path))) == 5
+    w.close()
+
+
+def test_reader_rejects_bad_files(tmp_path):
+    (tmp_path / "segment-1-000001.cap").write_bytes(b"junkjunkjunk")
+    bad_ver = FILE_HDR.pack(capture.RING_MAGIC, 99, 16, RECORD.size,
+                            1, 0.0, 0)
+    (tmp_path / "capture-2.ring").write_bytes(bad_ver)
+    with pytest.raises(ValueError):
+        capture._read_file(str(tmp_path / "segment-1-000001.cap"))
+    with pytest.raises(ValueError):
+        capture._read_file(str(tmp_path / "capture-2.ring"))
+    # the directory readers skip what they cannot parse
+    assert read_capture(str(tmp_path)) == []
+    assert merge_captures(str(tmp_path)) == []
+
+
+# -- sampling ----------------------------------------------------------------
+
+
+def test_sampling_deterministic_under_seed(tmp_path):
+    """LDT_CAPTURE_SAMPLE keeps a seeded-RNG-deterministic subset: two
+    writers with the same seed keep exactly the same records."""
+    masks = []
+    for sub in ("a", "b"):
+        w = CaptureWriter(str(tmp_path / sub), ring_records=256,
+                          sample=0.5, seed=7)
+        try:
+            mask = [w.append(record_from(_trace(), {"front": "sync",
+                                                    "status": 200},
+                                         1.0))
+                    for _ in range(100)]
+        finally:
+            w.close()
+        masks.append(mask)
+    assert masks[0] == masks[1]
+    kept = sum(masks[0])
+    assert 0 < kept < 100                    # it actually sampled
+    assert masks[0].count(False) == 100 - kept
+
+
+def test_sample_one_keeps_everything(tmp_path):
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0,
+                      seed=3)
+    try:
+        assert all(w.append(record_from(_trace(), {"front": "sync",
+                                                   "status": 200}, 1.0))
+                   for _ in range(20))
+        assert w.stats()["sampled_out"] == 0
+    finally:
+        w.close()
+
+
+# -- rotation ----------------------------------------------------------------
+
+
+def test_rotation_seals_and_prunes(tmp_path):
+    w = CaptureWriter(str(tmp_path), ring_records=16, sample=1.0,
+                      max_segments=2)
+    try:
+        for i in range(16 * 4 + 5):          # 4 seals + 5 in the ring
+            w.append(record_from(_trace(f"t{i}"), {"front": "sync",
+                                                   "status": 200}, 1.0))
+        st = w.stats()
+        assert st["segments_sealed"] == 4
+        assert st["ring_occupancy"] == 5
+        assert st["records_total"] == 16 * 4 + 5
+        segs = sorted(tmp_path.glob("segment-*.cap"))
+        assert len(segs) == 2                # pruned to max_segments
+        # the kept segments are the newest two
+        assert [s.name.split("-")[-1] for s in segs] == \
+            ["000003.cap", "000004.cap"]
+        # no tmp litter from the tmp+rename publication
+        assert list(tmp_path.glob("*.tmp.*")) == []
+        # readable total: 2 kept segments + the live ring
+        assert len(read_capture(str(tmp_path))) == 16 * 2 + 5
+    finally:
+        w.close()
+
+
+def test_merge_captures_orders_across_members(tmp_path):
+    """The fleet writes each member under m<slot>/; the merge joins
+    them into one arrival-ordered stream via the anchor pair."""
+    writers = []
+    for slot in (0, 1):
+        w = CaptureWriter(str(tmp_path / f"m{slot}"), ring_records=64,
+                          sample=1.0)
+        writers.append(w)
+    try:
+        # interleave arrivals across members by nudging trace.t0
+        for i in range(10):
+            tr = _trace(f"t{i}")
+            tr.t0 = tr.t0 + i * 0.010        # strictly increasing
+            writers[i % 2].append(record_from(tr, {"front": "sync",
+                                                   "status": 200}, 1.0))
+        merged = merge_captures(str(tmp_path))
+        assert len(merged) == 10
+        arrivals = [r["arrival_ns"] for r in merged]
+        assert arrivals == sorted(arrivals)
+        tenants = [r["tenant_hash"] for r in merged]
+        assert tenants == [tenant_hash(f"t{i}") for i in range(10)]
+    finally:
+        for w in writers:
+            w.close()
+
+
+def test_summarize(tmp_path):
+    w = CaptureWriter(str(tmp_path / "m0"), ring_records=64, sample=1.0)
+    try:
+        for i in range(6):
+            w.append(record_from(
+                _trace("hot" if i < 4 else "cold"),
+                {"front": "sync", "status": 200 if i else 429,
+                 "shed": i == 0}, 1.0))
+        s = capture.summarize(str(tmp_path))
+        assert s["records"] == 6 and s["rings"] == 1
+        assert s["tenants"] == 2 and s["sheds"] == 1
+        assert s["top_tenants"][0]["records"] == 4
+        assert s["lanes"] == {"tcp": 6}
+        assert s["statuses"] == {"200": 5, "429": 1}
+    finally:
+        w.close()
+
+
+# -- module hook & counters --------------------------------------------------
+
+
+def test_observe_counters_and_segment_inc(tmp_path, monkeypatch):
+    telemetry.REGISTRY.reset()
+    w = CaptureWriter(str(tmp_path), ring_records=16, sample=1.0)
+    monkeypatch.setattr(capture, "WRITER", w)
+    try:
+        for _ in range(17):                  # crosses one seal
+            capture.observe(_trace(), {"front": "sync", "status": 200},
+                            1.0)
+        reg = telemetry.REGISTRY
+        assert reg.counter_value("ldt_capture_records_total") == 17
+        assert reg.counter_value("ldt_capture_segments_total") == 1
+        assert reg.counter_value("ldt_capture_sampled_out_total") == 0
+        assert capture.stats()["segments_sealed"] == 1
+    finally:
+        monkeypatch.setattr(capture, "WRITER", None)
+        w.close()
+        telemetry.REGISTRY.reset()
+
+
+def test_init_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_CAPTURE_DIR", str(tmp_path))
+    monkeypatch.setattr(capture, "WRITER", None)
+    try:
+        w = capture.init_from_env()
+        assert w is not None
+        assert capture.init_from_env() is w  # idempotent
+        import os
+        assert os.path.isfile(w.path)
+        assert w.path.startswith(str(tmp_path))
+    finally:
+        capture.reset_for_tests()
+
+
+def test_finish_request_counts_exactly_once(tmp_path, monkeypatch):
+    """Regression: a handler that unwinds through two finish sites
+    (shed answered 429, then the outer 504 path fires again on the
+    same trace) must count ONCE in the histogram, the capture plane,
+    and the SLO engine — the trace's completion latch is the single
+    authoritative completion path."""
+    from language_detector_tpu import slo
+    telemetry.REGISTRY.reset()
+    w = CaptureWriter(str(tmp_path), ring_records=64, sample=1.0)
+    eng = slo.SloEngine(slo.parse_spec("p99_ms=1000,err_pct=1"),
+                        min_events=1)
+    monkeypatch.setattr(capture, "WRITER", w)
+    monkeypatch.setattr(slo, "ENGINE", eng)
+    try:
+        tr = _trace()
+        telemetry.finish_request(tr, meta={"front": "sync",
+                                           "status": 429, "shed": True})
+        # the second unwind path fires on the SAME trace
+        telemetry.finish_request(tr, meta={"front": "sync",
+                                           "status": 504})
+        h = telemetry.REGISTRY.histogram("ldt_request_latency_ms")
+        assert h.snapshot()[2] == 1          # histogram count
+        assert w.stats()["records_total"] == 1
+        assert eng.stats()["observed"] == 1
+        # the FIRST completion wins: the record says shed/429, not 504
+        recs = read_capture(str(tmp_path))
+        assert len(recs) == 1
+        assert recs[0]["status"] == 429 and recs[0]["verdict"] == "shed"
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_slo_events_total", result="shed") == 1
+    finally:
+        monkeypatch.setattr(capture, "WRITER", None)
+        monkeypatch.setattr(slo, "ENGINE", None)
+        w.close()
+        telemetry.REGISTRY.reset()
+
+
+# -- replay fidelity ---------------------------------------------------------
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        body = json.dumps({"ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_replay_reproduces_schedule():
+    """A 200-request synthetic burst replayed against a trivial local
+    stub lands its p95 send-time skew within 10% of the recorded span
+    — the acceptance bound `bench.py --replay` gates on."""
+    import bench
+    records = bench.synth_capture_records(n=200, tenants=8,
+                                          rate_rps=150.0, seed=11)
+    assert len(records) == 200
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        out = bench.replay_records(records, srv.server_address[1],
+                                   speedup=1.0, clients=8)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert out["requests"] == 200
+    assert out["completed"] == 200
+    assert out["counts"]["drop"] == 0
+    assert out["counts"]["ok"] == 200
+    assert out["schedule"]["skew_frac_p95"] <= 0.10
+    # the zipf skew showed up: the hottest tenant dominates
+    top = max(out["tenants"].values(), key=lambda d: d["requests"])
+    assert top["requests"] > 200 / 8
+
+
+def test_replay_synth_payloads_deterministic():
+    import bench
+    a = bench._synth_replay_text(12345, 3, 256)
+    b = bench._synth_replay_text(12345, 3, 256)
+    c = bench._synth_replay_text(12345, 4, 256)
+    assert a == b
+    assert len(a.encode()) >= 256
+    # seq cycles mod dup_modulo: seq 3 and 3+16 are the same document
+    assert bench._synth_replay_text(12345, 3 + 16, 256) == a
+    assert c != a

@@ -1,0 +1,293 @@
+"""SLO engine (round 17): spec parsing, rolling-window SLI math
+checked against a scalar oracle, fake-clock window aging, the
+multi-window burn-rate alert state machine (fires under sustained
+burn, stays quiet on blips and near-empty windows, clears on
+recovery, announces through the flight recorder), tenant-table
+bounding, and the /sloz document shape.
+"""
+from __future__ import annotations
+
+import pytest
+
+from language_detector_tpu import flightrec, slo, telemetry
+from language_detector_tpu.slo import (BREACH_BURN, MAX_TENANTS,
+                                       OVERFLOW_TENANT, SLOW_FACTOR,
+                                       SloEngine, parse_spec)
+
+
+class FakeClock:
+    """Injectable monotonic clock: window expiry and alert transitions
+    run against controlled time."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, sec):
+        self.t += sec
+
+
+def _engine(spec="p99_ms=50,err_pct=1,window_sec=100", clock=None,
+            min_events=1):
+    return SloEngine(parse_spec(spec), clock=clock or FakeClock(),
+                     min_events=min_events)
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def test_parse_spec_full():
+    s = parse_spec("p99_ms=50,err_pct=0.5,window_sec=300")
+    assert s.percentile == 99.0
+    assert s.target_ms == 50.0
+    assert s.err_pct == 0.5
+    assert s.window_sec == 300.0
+    assert s.as_dict()["slow_window_sec"] == 300.0 * SLOW_FACTOR
+
+
+def test_parse_spec_percentile_variants():
+    assert parse_spec("p95_ms=20").percentile == 95.0
+    assert parse_spec("p50_ms=5").target_ms == 5.0
+    assert parse_spec("p99.9_ms=80").percentile == 99.9
+    # error-budget-only spec: no latency target
+    s = parse_spec("err_pct=2")
+    assert s.target_ms is None and s.err_pct == 2.0
+
+
+def test_parse_spec_malformed_entries_skipped(caplog):
+    s = parse_spec("p99_ms=50,bogus,xyz=1,err_pct=nope,window_sec=-5")
+    assert s is not None                     # the valid entry survives
+    assert s.target_ms == 50.0
+    assert s.err_pct == 1.0                  # default kept
+    assert s.window_sec == 300.0             # negative rejected
+
+
+def test_parse_spec_disabled():
+    assert parse_spec(None) is None
+    assert parse_spec("") is None
+    assert parse_spec("   ") is None
+    # a spec with no valid entry disables rather than defaulting
+    assert parse_spec("garbage,more=junk") is None
+
+
+# -- window math vs scalar oracle --------------------------------------------
+
+
+def test_window_slis_match_scalar_oracle():
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=10,window_sec=100", clock=clk)
+    lats = [1.0, 2.0, 5.0, 10.0, 20.0, 40.0, 60.0, 80.0, 3.0, 7.0]
+    statuses = [200] * 8 + [500, 200]
+    for lat, st in zip(lats, statuses):
+        eng.observe("acme", st, lat)
+        clk.advance(0.5)
+    snap = eng.snapshot()
+    fast = snap["fleet"]["fast"]
+    # oracle: bad = 5xx or latency over the 50ms target (not shed)
+    bad = sum(1 for lat, st in zip(lats, statuses)
+              if st >= 500 or lat > 50.0)
+    assert fast["count"] == len(lats)
+    assert fast["bad"] == bad == 3
+    assert fast["err_ratio"] == pytest.approx(bad / len(lats), abs=1e-6)
+    assert fast["mean_ms"] == pytest.approx(sum(lats) / len(lats),
+                                            abs=0.01)
+    # percentile estimates land inside their log-bucket neighborhood
+    assert fast["p50_ms"] == pytest.approx(7.0, rel=1.0)
+    assert 40.0 <= fast["p99_ms"] <= 160.0
+    # burn = err_ratio / (err_pct/100)
+    assert fast["burn_rate"] == pytest.approx((bad / len(lats)) / 0.10,
+                                              abs=1e-3)
+    # the tenant window saw the same traffic
+    assert snap["tenants"]["acme"]["fast"]["count"] == len(lats)
+    assert snap["observed"] == len(lats)
+
+
+def test_shed_tracked_but_does_not_burn():
+    clk = FakeClock()
+    eng = _engine("p99_ms=10,err_pct=1,window_sec=100", clock=clk)
+    telemetry.REGISTRY.reset()
+    try:
+        # a shed answered 429 in 500ms: way over target, but sheds are
+        # overload protection working — they never burn budget
+        eng.observe("acme", 429, 500.0, shed=True)
+        eng.observe("acme", 200, 1.0)
+        snap = eng.snapshot()["fleet"]["fast"]
+        assert snap["count"] == 2
+        assert snap["shed"] == 1
+        assert snap["bad"] == 0
+        assert eng.stats()["burn_fast"] == 0.0
+        reg = telemetry.REGISTRY
+        assert reg.counter_value("ldt_slo_events_total",
+                                 result="shed") == 1
+        assert reg.counter_value("ldt_slo_events_total",
+                                 result="good") == 1
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+def test_window_ages_out_on_fake_clock():
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk)
+    for _ in range(10):
+        eng.observe("acme", 200, 1.0)
+    assert eng.snapshot()["fleet"]["fast"]["count"] == 10
+    clk.advance(101.0)                       # past the fast window
+    snap = eng.snapshot()["fleet"]
+    assert snap["fast"]["count"] == 0
+    # the 12x slow window still holds the history
+    assert snap["slow"]["count"] == 10
+    clk.advance(100.0 * SLOW_FACTOR)
+    assert eng.snapshot()["fleet"]["slow"]["count"] == 0
+
+
+# -- burn-rate alert state machine -------------------------------------------
+
+
+def test_alert_fires_and_clears(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "RECORDER", None)
+    rec = flightrec.init_from_env(role="slo-test")
+    telemetry.REGISTRY.reset()
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk,
+                  min_events=4)
+    try:
+        # sustained 100% errors: both windows burn far over 1.0
+        for _ in range(8):
+            eng.observe("acme", 500, 1.0)
+            clk.advance(1.0)
+        st = eng.stats()
+        assert st["alert"] == 1
+        assert st["breaches_total"] == 1
+        assert st["burn_fast"] >= BREACH_BURN
+        assert st["burn_slow"] >= BREACH_BURN
+        assert st["budget_remaining"] == 0.0
+        assert telemetry.REGISTRY.counter_value(
+            "ldt_slo_breaches_total") == 1
+        snap = eng.snapshot()
+        assert snap["alert"]["state"] == "breach"
+        assert snap["alert"]["since_sec"] >= 0
+        # recovery: the error traffic stops and the fast window ages
+        clk.advance(101.0)
+        for _ in range(8):
+            eng.observe("acme", 200, 1.0)
+        st = eng.stats()
+        assert st["alert"] == 0
+        assert st["breaches_total"] == 1     # no re-fire
+        assert eng.snapshot()["alert"]["state"] == "ok"
+        evs = [e["ev"] for e in flightrec.read_ring(rec.path)["events"]]
+        assert "slo_breach" in evs
+        assert "slo_recovered" in evs
+        assert evs.index("slo_breach") < evs.index("slo_recovered")
+    finally:
+        rec.close()
+        monkeypatch.setattr(flightrec, "RECORDER", None)
+        telemetry.REGISTRY.reset()
+
+
+def test_alert_needs_min_events():
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk,
+                  min_events=10)
+    for _ in range(9):                       # one short of the floor
+        eng.observe("acme", 500, 1.0)
+    assert eng.stats()["alert"] == 0
+    eng.observe("acme", 500, 1.0)            # the 10th event
+    assert eng.stats()["alert"] == 1
+
+
+def test_blip_does_not_fire_without_slow_burn():
+    """The multi-window rule: a brand-new error burst whose slow
+    window is still diluted by hours of good traffic cannot page."""
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk,
+                  min_events=1)
+    # a long healthy history fills the slow window
+    for _ in range(600):
+        eng.observe("acme", 200, 1.0)
+        clk.advance(1.0)
+    # a short blip: fast window burns, slow window barely moves
+    for _ in range(3):
+        eng.observe("acme", 500, 1.0)
+    st = eng.stats()
+    assert st["burn_fast"] >= BREACH_BURN
+    assert st["burn_slow"] < BREACH_BURN
+    assert st["alert"] == 0
+
+
+def test_recovery_visible_without_traffic():
+    """stats() runs the state machine too: after the fast window ages
+    out, the alert clears even though no new request arrived."""
+    telemetry.REGISTRY.reset()
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk,
+                  min_events=1)
+    try:
+        for _ in range(4):
+            eng.observe("acme", 500, 1.0)
+        assert eng.stats()["alert"] == 1
+        clk.advance(101.0)                   # fast window empties
+        assert eng.stats()["alert"] == 0
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+# -- tenant bounding ---------------------------------------------------------
+
+
+def test_tenant_table_bounded():
+    clk = FakeClock()
+    eng = _engine(clock=clk)
+    for i in range(MAX_TENANTS + 20):
+        eng.observe(f"tenant-{i}", 200, 1.0)
+    snap = eng.snapshot()
+    assert len(snap["tenants"]) == MAX_TENANTS + 1
+    assert OVERFLOW_TENANT in snap["tenants"]
+    assert snap["tenants"][OVERFLOW_TENANT]["fast"]["count"] == 20
+
+
+def test_default_tenant():
+    eng = _engine(clock=FakeClock())
+    eng.observe(None, 200, 1.0)
+    assert "default" in eng.snapshot()["tenants"]
+
+
+# -- module wiring -----------------------------------------------------------
+
+
+def test_init_from_env_and_sloz(monkeypatch):
+    monkeypatch.setattr(slo, "ENGINE", None)
+    monkeypatch.setenv("LDT_SLO", "")
+    assert slo.init_from_env() is None
+    doc = slo.sloz()
+    assert doc["enabled"] is False and "hint" in doc
+    assert slo.stats() is None
+    monkeypatch.setenv("LDT_SLO", "p99_ms=50,err_pct=0.5")
+    try:
+        eng = slo.init_from_env()
+        assert eng is not None
+        assert slo.init_from_env() is eng    # idempotent
+        doc = slo.sloz()
+        assert doc["enabled"] is True
+        assert doc["spec"]["target_ms"] == 50.0
+        assert doc["alert"]["state"] == "ok"
+    finally:
+        slo.reset_for_tests()
+
+
+def test_module_observe_reads_trace(monkeypatch):
+    telemetry.REGISTRY.reset()
+    clk = FakeClock()
+    eng = _engine("p99_ms=50,err_pct=1,window_sec=100", clock=clk)
+    monkeypatch.setattr(slo, "ENGINE", eng)
+    try:
+        tr = telemetry.Trace()
+        tr.tenant = "acme"
+        slo.observe(tr, {"status": 200}, 3.0)
+        snap = eng.snapshot()
+        assert snap["tenants"]["acme"]["fast"]["count"] == 1
+    finally:
+        monkeypatch.setattr(slo, "ENGINE", None)
+        telemetry.REGISTRY.reset()

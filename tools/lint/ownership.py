@@ -73,6 +73,33 @@ LOCK_OWNERSHIP: dict = {
                       "after the owner stops emitting",
             }),
     },
+    "language_detector_tpu/capture.py": {
+        "CaptureWriter": _cl(
+            lock="_lock",
+            attrs=("_seq", "_segments", "_records_total",
+                   "_sampled_out"),
+            held=("_seal_locked", "_prune_locked"),
+            lockfree={
+                "mm": "mmap assigned once at init (before the writer "
+                      "is published via the module WRITER binding); "
+                      "append() mutates it only under _lock, close() "
+                      "runs after the owner stops appending",
+                "_rng": "sampling RNG touched only by append(), which "
+                        "every caller reaches through the single "
+                        "module-level observe() hot path; a racing "
+                        "draw could only reorder samples, never "
+                        "corrupt the ring (commit word publishes "
+                        "records, not the RNG)",
+            }),
+    },
+    "language_detector_tpu/slo.py": {
+        "SloEngine": _cl(
+            lock="_lock",
+            attrs=("_fleet", "_tenants", "_alert", "_alert_since",
+                   "_breaches", "_observed"),
+            held=("_burns_locked", "_evaluate_locked",
+                  "_window_view")),
+    },
     "language_detector_tpu/service/admission.py": {
         "BrownoutLadder": _cl(lock="_lock", attrs=("ema", "level")),
         "CircuitBreaker": _cl(
@@ -127,6 +154,12 @@ LOCK_OWNERSHIP: dict = {
                 "shared_cache_stats": "callable reference, same single-"
                                       "assignment-at-init contract; "
                                       "the callee locks its own state",
+                "slo_stats": "callable reference (module-level "
+                             "slo.stats), assigned once at init; the "
+                             "engine locks its own windows",
+                "capture_stats": "callable reference (module-level "
+                                 "capture.stats), assigned once at "
+                                 "init; the writer locks its own ring",
             }),
         "DetectorService": _cl(
             lock="_log_lock",
