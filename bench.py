@@ -1809,6 +1809,37 @@ if __name__ == "__main__":
             workers = int(sys.argv[sys.argv.index("--workers") + 1])
         print(json.dumps(bench_replay(synth=stream, speedup=speedup,
                                       workers=workers)))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--eval":
+        # accuracy scorecard (evalsuite.py): batch the bundled labeled
+        # corpus through the engine, compare against the scalar oracle
+        # doc-for-doc, and publish the vectorized scorecard as the next
+        # ACC_rNN.json round (schema: docs/ACCURACY.md). --quick runs
+        # the strided subset the ci accuracy smoke uses and only
+        # prints the card (no round file — CI cadence must not
+        # accrete artifacts). Exits nonzero when top-1 agreement
+        # drops below the pinned floor.
+        from language_detector_tpu import evalsuite
+        quick = "--quick" in sys.argv
+        try:
+            from language_detector_tpu.models.ngram import \
+                NgramBatchEngine
+            eng = NgramBatchEngine()
+        except (ImportError, RuntimeError):
+            eng = None
+        card = evalsuite.run_eval(engine=eng, quick=quick)
+        if not quick:
+            existing = sorted(REPO.glob("ACC_r*.json"))
+            nxt = 1
+            if existing:
+                import re as _re
+                m = _re.search(r"ACC_r(\d+)", existing[-1].name)
+                nxt = int(m.group(1)) + 1 if m else 1
+            card["round"] = nxt
+            with open(REPO / f"ACC_r{nxt:02d}.json", "w") as f:
+                json.dump(card, f, indent=2)
+                f.write("\n")
+        print(json.dumps(card))
+        evalsuite.check_floor(card)
     elif len(sys.argv) > 1 and sys.argv[1] == "--profile":
         if len(sys.argv) < 3:
             sys.exit("usage: bench.py [--profile TRACE_DIR | --smoke]")

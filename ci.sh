@@ -1875,4 +1875,33 @@ print("capture/replay + SLO:", f"{len(records)} records captured",
       f"with 0 drops (p95 skew {d['schedule']['p95_skew_ms']}ms)")
 EOF
 
+echo "== accuracy smoke =="
+# the evalsuite scorecard (docs/ACCURACY.md): score the bundled corpus
+# through the device engine, pin device-vs-scalar-oracle agreement at
+# the evalsuite floor (check_floor exits non-zero below it), and pin
+# the documented hint-flip demo. --quick strides the corpus 3x for CI
+# cadence; the full run publishes the same schema. The ACC_r*.json the
+# run publishes must also render through the postmortem CLI.
+JAX_PLATFORMS=cpu python3 bench.py --eval --quick \
+    | tee /tmp/ldt_acc_smoke.out
+python3 - <<'EOF'
+import json
+
+card = json.loads([ln for ln in open("/tmp/ldt_acc_smoke.out")
+                   if ln.startswith("{")][-1])
+ag = card["agreement"]
+assert ag["top1"] >= ag["floor"], \
+    f"top-1 agreement {ag['top1']} under the {ag['floor']} floor"
+assert ag["top3"] >= ag["floor"], \
+    f"top-3 agreement {ag['top3']} under the {ag['floor']} floor"
+assert card["hint_flip"]["flipped"], \
+    f"the documented hint flip regressed: {card['hint_flip']}"
+print("accuracy:", "top1", ag["top1"], "| top3", ag["top3"],
+      "| label", card["label_accuracy"]["top1"],
+      "| hint flip", card["hint_flip"]["before"], "->",
+      card["hint_flip"]["after"])
+EOF
+JAX_PLATFORMS=cpu python3 -m language_detector_tpu.debug --eval \
+    > /dev/null
+
 echo "CI OK"

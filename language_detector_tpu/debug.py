@@ -407,6 +407,78 @@ def _read_slow_source(src: str) -> dict:
     return json.loads(Path(src).read_text())
 
 
+def format_scorecard(card: dict) -> str:
+    """Render an ACC_r*.json accuracy scorecard (bench.py --eval /
+    evalsuite.run_eval) for postmortem reading: agreement vs the scalar
+    oracle, label accuracy, the top per-script confusions, reliability
+    calibration, and the documented hint-flip demo."""
+    out = [f"accuracy scorecard — round {card.get('round', '?')}"
+           f" ({card['corpus_docs']} docs, {card['languages']} languages"
+           f"{', quick' if card.get('quick') else ''},"
+           f" engine={card.get('engine', '?')})"]
+    ag = card["agreement"]
+    out.append(f"  device-vs-oracle agreement: "
+               f"top-1 {ag['top1']:.4f}  top-3 {ag['top3']:.4f}  "
+               f"(floor {ag['floor']})")
+    la = card["label_accuracy"]
+    out.append(f"  label accuracy: top-1 {la['top1']:.4f}  "
+               f"top-3 {la['top3']:.4f}")
+    scripts = card.get("per_script") or {}
+    if scripts:
+        out.append("  per-script label accuracy (confusions "
+                   "label->got xN):")
+        for name in sorted(scripts):
+            row = scripts[name]
+            conf = "  ".join(f"{l}->{g} x{n}"
+                             for l, g, n in row.get("confusions", []))
+            out.append(f"    {name:4s} docs={row['docs']:<4d} "
+                       f"top-1 {row['label_top1']:.3f}"
+                       + (f"  ({conf})" if conf else ""))
+    cal = card.get("calibration") or []
+    if cal:
+        out.append("  calibration (reported pct -> observed accuracy):")
+        for b in cal:
+            rng = f"{b['pct_lo']}-{b['pct_hi']}"
+            out.append(f"    {rng:>7s}  n={b['docs']:<4d} "
+                       f"acc={b['label_top1']:.3f} "
+                       f"reliable={b['reliable_frac']:.3f}")
+    hf = card.get("hint_flip")
+    if hf:
+        out.append(f"  hint-flip demo: {hf['before']} -> {hf['after']} "
+                   f"({hf['hint']}; flipped={hf['flipped']})")
+    return "\n".join(out)
+
+
+def _latest_scorecard(src: str | None):
+    """--eval source: an explicit ACC_r*.json path, or the
+    highest-numbered round in the repo root when given 'latest'."""
+    import json
+    from pathlib import Path
+    if src and src != "latest":
+        return json.loads(Path(src).read_text())
+    root = Path(__file__).resolve().parent.parent
+    cards = sorted(root.glob("ACC_r*.json"))
+    if not cards:
+        raise SystemExit("no ACC_r*.json found — run bench.py --eval")
+    return json.loads(cards[-1].read_text())
+
+
+def format_spans(text: str, spans: list, reg) -> str:
+    """Pretty-print per-span verdicts: one line per span with its byte
+    range, code, confidence, and the (escaped, truncated) text slice."""
+    out = []
+    data = text.encode("utf-8")
+    for off, ln, code, pct, rel in spans:
+        piece = data[off:off + ln].decode("utf-8", errors="replace")
+        piece = piece.replace("\n", " ")
+        if len(piece) > 48:
+            piece = piece[:45] + "..."
+        mark = " " if rel else "?"
+        out.append(f"  [{off:6d}..{off + ln:6d}) {code:4s} "
+                   f"{pct:3d}%{mark} {piece!r}")
+    return "\n".join(out)
+
+
 def _main(argv=None):
     """CLI harness (the reference's compact_lang_det_test.cc interactive
     tool): text from args/stdin -> summary + optional score trace and
@@ -456,6 +528,15 @@ def _main(argv=None):
                     help="summarize a traffic-capture directory tree "
                          "(LDT_CAPTURE_DIR): segment/record counts, "
                          "time span, tenant/lane/status mix")
+    ap.add_argument("--eval", metavar="SRC", nargs="?", const="latest",
+                    dest="eval_src",
+                    help="render an accuracy scorecard: SRC is an "
+                         "ACC_r*.json path, or omitted for the latest "
+                         "round in the repo root (bench.py --eval)")
+    ap.add_argument("--spans", action="store_true",
+                    help="pretty-print per-span language verdicts for "
+                         "the input text (the LDT_SPANS surface; "
+                         "scalar oracle, no accelerator needed)")
     ap.add_argument("--admission", metavar="SRC",
                     help="pretty-print admission-control state "
                          "(queue occupancy, brownout level, breaker, "
@@ -480,6 +561,20 @@ def _main(argv=None):
         return 0
     if args.admission:
         print(format_admission(_read_slow_source(args.admission)))
+        return 0
+    if args.eval_src:
+        print(format_scorecard(_latest_scorecard(args.eval_src)))
+        return 0
+    if args.spans:
+        from .engine_scalar import detect_scalar_spans
+        from .tables import load_tables
+        text = " ".join(args.text) if args.text else sys.stdin.read()
+        tables = load_tables()
+        r = detect_scalar_spans(text, tables, default_registry)
+        code = default_registry.code(r.summary_lang)
+        print(f"=> {code} reliable={r.is_reliable} "
+              f"spans={len(r.spans or [])}")
+        print(format_spans(text, r.spans or [], default_registry))
         return 0
     if args.engine_stats:
         docs = list(args.text) if args.text \

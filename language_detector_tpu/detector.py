@@ -32,6 +32,10 @@ class DetectionResult:
     # per-range results: [(offset, bytes, iso_code)] covering the original
     # input when requested (ResultChunkVector, compact_lang_det.h:147-154)
     chunks: list | None = None
+    # per-span verdicts: [(byte_offset, byte_len, iso_code, percent,
+    # reliable)] tiling the document (LDT_SPANS surfaces; span contract
+    # in docs/ACCURACY.md — engine_scalar.span_coverage_records)
+    spans: list | None = None
 
     @classmethod
     def from_scalar(cls, r: ScalarResult, reg: Registry) -> "DetectionResult":
@@ -44,6 +48,7 @@ class DetectionResult:
             text_bytes=r.text_bytes,
             chunks=None if r.chunks is None else
             [(c.offset, c.bytes, reg.code(c.lang1)) for c in r.chunks],
+            spans=getattr(r, "spans", None),
         )
 
 
@@ -140,6 +145,23 @@ class LanguageDetector:
         rs = eng.detect_batch(texts, hints=hints,
                               is_plain_text=is_plain_text,
                               return_chunks=return_chunks)
+        return [DetectionResult.from_scalar(r, self.registry) for r in rs]
+
+    def detect_spans(self, texts: list[str]) -> list[DetectionResult]:
+        """Per-span detection: every result carries `.spans` records
+        tiling the document bytes (byte_offset, byte_len, iso_code,
+        percent, reliable) alongside the usual top-3 summary. The
+        device lane (models/ngram.py detect_spans) and the scalar
+        oracle (engine_scalar.detect_scalar_spans) are bit-identical
+        (tests/test_spans.py); service fronts expose this behind
+        LDT_SPANS=1."""
+        from .engine_scalar import detect_scalar_spans
+        eng = self._get_batch_engine()
+        if eng is not None:
+            rs = eng.detect_spans(texts)
+        else:
+            rs = [detect_scalar_spans(t, self.tables, self.registry,
+                                      self.flags) for t in texts]
         return [DetectionResult.from_scalar(r, self.registry) for r in rs]
 
     def engine_stats(self) -> dict:

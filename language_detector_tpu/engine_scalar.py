@@ -826,6 +826,77 @@ class ScalarResult:
     text_bytes: int
     is_reliable: bool
     chunks: list | None = None  # ResultChunk vector when requested
+    # per-span verdicts [(byte_offset, byte_len, code, pct, reliable)]
+    # — filled only by the LDT_SPANS surfaces (engine detect_spans /
+    # detector span synthesis); None everywhere else
+    spans: list | None = None
+
+
+# -- per-span output (LDT_SPANS) --------------------------------------------
+#
+# The span contract (docs/ACCURACY.md): spans TILE the document's bytes.
+# Sub-document k's scored extent starts at its first letter char, so
+# span 0 pulls its start back to byte 0, span k ends where span k+1
+# starts, and the last span ends at the document's last byte —
+# non-letter gaps between scored extents attach to the preceding span.
+# The default split budget matches the pack ladder's mid tier (~4KB of
+# text per span group).
+
+SPAN_SPLIT_SLOTS = 1024
+
+
+def span_coverage_records(text: str, bounds: list,
+                          verdicts: list) -> list:
+    """(char extents, per-sub verdicts) -> covering span records
+    [(byte_offset, byte_len, code, pct, reliable)]. bounds[k] = (a, b)
+    char extent of sub-doc k (split_longdoc want_bounds); verdicts[k] =
+    (code, pct, reliable). Shared between the batched engine's span
+    lane and the scalar oracle so both emit byte-identical records."""
+    n = len(bounds)
+    starts = [0] + [bounds[k][0] for k in range(1, n)]
+    ends = starts[1:] + [len(text)]
+    spans = []
+    off = 0
+    for k in range(n):
+        seg = text[starts[k]:ends[k]]
+        blen = len(seg.encode("utf-8", "surrogatepass"))
+        code, pct, rel = verdicts[k]
+        spans.append((off, blen, code, pct, rel))
+        off += blen
+    return spans
+
+
+def split_for_spans(text: str, tables, split_slots: int):
+    """(subs, bounds) for the span surfaces: the long-doc lane's
+    span-aligned split (the only exact split points), or one whole-doc
+    span when the document is under budget / refuses to split."""
+    from .preprocess.pack import split_longdoc
+    got = split_longdoc(text, tables, max(split_slots, 1),
+                        want_bounds=True)
+    if not got:
+        return [text], [(0, len(text))]
+    return got
+
+
+def detect_scalar_spans(text: str, tables, reg, flags: int = 0,
+                        split_slots: int = SPAN_SPLIT_SLOTS
+                        ) -> "ScalarResult":
+    """Scalar oracle for the LDT_SPANS surface: the same span-aligned
+    split as the batched engine's span lane, each sub-document through
+    detect_scalar, records via the shared coverage builder. The batched
+    lane resolves every exception sub-doc through detect_scalar and
+    agrees with it everywhere else (the engine's core invariant), so
+    its spans are bit-identical to this function's by construction
+    (tests/test_spans.py pins it)."""
+    subs, bounds = split_for_spans(text, tables, split_slots)
+    res = detect_scalar(text, tables, reg, flags)
+    verdicts = []
+    for sub in subs:
+        r = detect_scalar(sub, tables, reg, flags)
+        verdicts.append((reg.code(r.summary_lang), int(r.percent3[0]),
+                         bool(r.is_reliable)))
+    res.spans = span_coverage_records(text, bounds, verdicts)
+    return res
 
 
 def _respan(text_bytes: bytes, ulscript: int,

@@ -1,0 +1,624 @@
+"""Vectorized on-device eval scorecard + the device reduction's oracle.
+
+Three pieces of the accuracy plane live here (docs/ACCURACY.md):
+
+  1. A bundled WiLI-style labeled corpus: a handcrafted multi-script
+     seed bank (SEED_BANK) expanded deterministically into ~120 labeled
+     documents by `generate_corpus` and checked in as
+     data/eval_corpus.tsv ("code<TAB>text" lines, the same shape
+     tools/eval_corpus.py streams). `corpus_pairs` loads the checked-in
+     TSV when present and regenerates it bit-identically when not, so
+     the corpus is reproducible from source alone.
+
+  2. `oracle_score_chunks`: a pure-numpy, op-for-op mirror of the
+     device chunk reduction (ops/score.py score_chunks_impl), INCLUDING
+     the LDT_HINTS per-doc prior term — the "scalar-oracle extension"
+     the hint-prior feature is pinned bit-exact against
+     (tests/test_hints_parity.py runs every LDT_KERNEL mode against
+     this function on the same wire).
+
+  3. `run_eval`: batch the corpus through the engine, then compute the
+     scorecard as vectorized array ops over int result planes — top-1 /
+     top-3 agreement against the scalar oracle (detect_scalar), label
+     accuracy, per-script confusion rows, and reliability calibration
+     buckets. bench.py --eval publishes the dict as ACC_rNN.json;
+     ci.sh's accuracy smoke fails the build when top-1 agreement drops
+     below the pinned floor.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from . import telemetry
+from .engine_scalar import detect_scalar
+from .registry import registry as default_registry
+from .tables import load_tables
+
+CORPUS_PATH = Path(__file__).resolve().parent / "data" / "eval_corpus.tsv"
+
+# device-vs-scalar-oracle agreement floor the published scorecard (and
+# the ci accuracy smoke) must clear — the engines are bit-exact by
+# construction, so anything below 1.0 means a real divergence; the
+# floor leaves headroom only for corpus edits landing mid-review
+AGREEMENT_FLOOR = 0.99
+
+# -- bundled corpus ---------------------------------------------------------
+#
+# code -> (ISO 15924 script of the language, seed sentences). The codes
+# are the registry's own ISO-639 codes (asserted at generation time);
+# sentences are handcrafted to be unambiguous for their language, and
+# the generator expands each language into 5 deterministic variants
+# (pairs, full joins, repeats, truncations) so length and structure
+# vary without any RNG.
+
+SEED_BANK: dict = {
+    "en": ("Latn", [
+        "The committee reviewed the proposal carefully and decided to "
+        "postpone the final vote until the next quarterly meeting.",
+        "She walked through the quiet village early in the morning "
+        "while the shops were still closed and the streets empty.",
+        "Scientists have discovered that the weather patterns over the "
+        "northern ocean are changing faster than anyone expected.",
+    ]),
+    "fr": ("Latn", [
+        "Le comité a examiné la proposition avec soin et a décidé de "
+        "reporter le vote final à la prochaine réunion trimestrielle.",
+        "Elle marchait dans le village tranquille tôt le matin alors "
+        "que les boutiques étaient encore fermées et les rues vides.",
+        "Les chercheurs ont découvert que les régimes climatiques au "
+        "dessus de l'océan changent plus vite que prévu.",
+    ]),
+    "de": ("Latn", [
+        "Der Ausschuss prüfte den Vorschlag sorgfältig und beschloss, "
+        "die endgültige Abstimmung auf die nächste Sitzung zu "
+        "verschieben.",
+        "Sie ging früh am Morgen durch das ruhige Dorf, während die "
+        "Geschäfte noch geschlossen und die Straßen leer waren.",
+        "Wissenschaftler haben entdeckt, dass sich die Wettermuster "
+        "über dem nördlichen Ozean schneller ändern als erwartet.",
+    ]),
+    "es": ("Latn", [
+        "El comité examinó la propuesta cuidadosamente y decidió "
+        "aplazar la votación final hasta la próxima reunión "
+        "trimestral.",
+        "Ella caminaba por el pueblo tranquilo temprano en la mañana "
+        "mientras las tiendas seguían cerradas y las calles vacías.",
+        "Los científicos han descubierto que los patrones del clima "
+        "sobre el océano del norte cambian más rápido de lo esperado.",
+    ]),
+    "it": ("Latn", [
+        "Il comitato ha esaminato attentamente la proposta e ha "
+        "deciso di rinviare la votazione finale alla prossima "
+        "riunione trimestrale.",
+        "Camminava per il paese tranquillo la mattina presto mentre i "
+        "negozi erano ancora chiusi e le strade vuote.",
+        "Gli scienziati hanno scoperto che i modelli del tempo "
+        "sull'oceano settentrionale cambiano più velocemente del "
+        "previsto.",
+    ]),
+    "pt": ("Latn", [
+        "O comitê examinou a proposta cuidadosamente e decidiu adiar "
+        "a votação final até a próxima reunião trimestral.",
+        "Ela caminhava pela aldeia tranquila de manhã cedo enquanto "
+        "as lojas ainda estavam fechadas e as ruas vazias.",
+        "Os cientistas descobriram que os padrões do clima sobre o "
+        "oceano do norte estão mudando mais rápido do que o esperado.",
+    ]),
+    "nl": ("Latn", [
+        "De commissie heeft het voorstel zorgvuldig bekeken en "
+        "besloten de eindstemming uit te stellen tot de volgende "
+        "vergadering.",
+        "Ze liep vroeg in de ochtend door het rustige dorp terwijl de "
+        "winkels nog gesloten waren en de straten leeg.",
+        "Wetenschappers hebben ontdekt dat de weerpatronen boven de "
+        "noordelijke oceaan sneller veranderen dan verwacht.",
+    ]),
+    "id": ("Latn", [
+        "Panitia memeriksa usulan itu dengan cermat dan memutuskan "
+        "untuk menunda pemungutan suara sampai rapat berikutnya.",
+        "Dia berjalan melewati desa yang tenang pagi-pagi sekali "
+        "ketika toko-toko masih tutup dan jalanan masih sepi.",
+        "Para ilmuwan menemukan bahwa pola cuaca di atas samudra "
+        "utara berubah lebih cepat daripada yang diperkirakan.",
+    ]),
+    "sv": ("Latn", [
+        "Kommittén granskade förslaget noggrant och beslutade att "
+        "skjuta upp den slutliga omröstningen till nästa möte.",
+        "Hon gick genom den tysta byn tidigt på morgonen medan "
+        "butikerna fortfarande var stängda och gatorna tomma.",
+        "Forskare har upptäckt att vädermönstren över norra havet "
+        "förändras snabbare än någon väntat sig.",
+    ]),
+    "tr": ("Latn", [
+        "Komite öneriyi dikkatle inceledi ve nihai oylamayı bir "
+        "sonraki üç aylık toplantıya ertelemeye karar verdi.",
+        "Sabahın erken saatlerinde dükkanlar hâlâ kapalıyken ve "
+        "sokaklar boşken sessiz köyün içinden yürüdü.",
+        "Bilim insanları kuzey okyanusu üzerindeki hava düzenlerinin "
+        "beklenenden daha hızlı değiştiğini keşfetti.",
+    ]),
+    "pl": ("Latn", [
+        "Komisja dokładnie przeanalizowała propozycję i postanowiła "
+        "odłożyć ostateczne głosowanie do następnego posiedzenia.",
+        "Szła przez spokojną wieś wcześnie rano, gdy sklepy były "
+        "jeszcze zamknięte, a ulice puste.",
+        "Naukowcy odkryli, że wzorce pogodowe nad północnym oceanem "
+        "zmieniają się szybciej, niż ktokolwiek się spodziewał.",
+    ]),
+    "vi": ("Latn", [
+        "Ủy ban đã xem xét đề xuất một cách cẩn thận và quyết định "
+        "hoãn cuộc bỏ phiếu cuối cùng đến cuộc họp quý sau.",
+        "Cô đi bộ qua ngôi làng yên tĩnh vào sáng sớm khi các cửa "
+        "hàng vẫn đóng cửa và đường phố vắng vẻ.",
+        "Các nhà khoa học phát hiện rằng các hình thái thời tiết trên "
+        "đại dương phía bắc đang thay đổi nhanh hơn dự kiến.",
+    ]),
+    "fi": ("Latn", [
+        "Valiokunta tarkasteli ehdotusta huolellisesti ja päätti "
+        "lykätä lopullista äänestystä seuraavaan kokoukseen.",
+        "Hän käveli hiljaisen kylän läpi varhain aamulla, kun kaupat "
+        "olivat vielä kiinni ja kadut tyhjiä.",
+        "Tutkijat ovat havainneet, että pohjoisen valtameren "
+        "sääilmiöt muuttuvat odotettua nopeammin.",
+    ]),
+    "da": ("Latn", [
+        "Udvalget gennemgik forslaget omhyggeligt og besluttede at "
+        "udskyde den endelige afstemning til det næste møde.",
+        "Hun gik gennem den stille landsby tidligt om morgenen, mens "
+        "butikkerne stadig var lukkede og gaderne tomme.",
+        "Forskere har opdaget, at vejrmønstrene over det nordlige "
+        "ocean ændrer sig hurtigere end nogen havde ventet.",
+    ]),
+    "ru": ("Cyrl", [
+        "Комитет внимательно рассмотрел предложение и решил отложить "
+        "окончательное голосование до следующего заседания.",
+        "Она шла через тихую деревню рано утром, когда магазины были "
+        "еще закрыты, а улицы пусты.",
+        "Ученые обнаружили, что погодные условия над северным "
+        "океаном меняются быстрее, чем кто-либо ожидал.",
+    ]),
+    "uk": ("Cyrl", [
+        "Комітет уважно розглянув пропозицію і вирішив відкласти "
+        "остаточне голосування до наступного засідання.",
+        "Вона йшла через тихе село рано вранці, коли крамниці були "
+        "ще зачинені, а вулиці порожні.",
+        "Вчені виявили, що погодні умови над північним океаном "
+        "змінюються швидше, ніж будь-хто очікував.",
+    ]),
+    "bg": ("Cyrl", [
+        "Комитетът разгледа внимателно предложението и реши да "
+        "отложи окончателното гласуване за следващото заседание.",
+        "Тя вървеше през тихото село рано сутринта, докато "
+        "магазините бяха още затворени, а улиците празни.",
+        "Учените откриха, че метеорологичните условия над северния "
+        "океан се променят по-бързо от очакваното.",
+    ]),
+    "el": ("Grek", [
+        "Η επιτροπή εξέτασε προσεκτικά την πρόταση και αποφάσισε να "
+        "αναβάλει την τελική ψηφοφορία για την επόμενη συνεδρίαση.",
+        "Περπατούσε μέσα στο ήσυχο χωριό νωρίς το πρωί, ενώ τα "
+        "μαγαζιά ήταν ακόμη κλειστά και οι δρόμοι άδειοι.",
+        "Οι επιστήμονες ανακάλυψαν ότι τα καιρικά μοτίβα πάνω από "
+        "τον βόρειο ωκεανό αλλάζουν ταχύτερα από το αναμενόμενο.",
+    ]),
+    "iw": ("Hebr", [
+        "הוועדה בחנה את ההצעה בקפידה והחליטה לדחות את ההצבעה "
+        "הסופית לישיבה הרבעונית הבאה.",
+        "היא הלכה בכפר השקט מוקדם בבוקר כשהחנויות היו עדיין "
+        "סגורות והרחובות ריקים.",
+        "מדענים גילו שדפוסי מזג האוויר מעל האוקיינוס הצפוני "
+        "משתנים מהר יותר מכפי שציפו.",
+    ]),
+    "ar": ("Arab", [
+        "راجعت اللجنة الاقتراح بعناية وقررت تأجيل التصويت النهائي "
+        "إلى الاجتماع الفصلي القادم.",
+        "مشت عبر القرية الهادئة في الصباح الباكر بينما كانت المتاجر "
+        "لا تزال مغلقة والشوارع فارغة.",
+        "اكتشف العلماء أن أنماط الطقس فوق المحيط الشمالي تتغير "
+        "أسرع مما توقعه أي شخص.",
+    ]),
+    "fa": ("Arab", [
+        "کمیته پیشنهاد را با دقت بررسی کرد و تصمیم گرفت رأی گیری "
+        "نهایی را به جلسه بعدی موکول کند.",
+        "او صبح زود از میان روستای آرام می گذشت در حالی که مغازه ها "
+        "هنوز بسته بودند و خیابان ها خالی.",
+        "دانشمندان دریافته اند که الگوهای آب و هوا بر فراز اقیانوس "
+        "شمالی سریعتر از انتظار تغییر می کنند.",
+    ]),
+    "ja": ("Jpan", [
+        "委員会は提案を慎重に検討し、最終投票を次回の四半期会議まで"
+        "延期することを決定しました。",
+        "彼女は朝早く静かな村を歩いていたが、店はまだ閉まっており、"
+        "通りには人がいなかった。",
+        "科学者たちは、北の海の上の気象パターンが予想よりも速く"
+        "変化していることを発見した。",
+    ]),
+    "zh": ("Hans", [
+        "委员会仔细审查了这项提案,并决定将最终表决推迟到下一次"
+        "季度会议。",
+        "清晨她走过安静的村庄,商店还没有开门,街道上空无一人。",
+        "科学家们发现,北方海洋上空的天气模式变化得比任何人预期的"
+        "都要快。",
+    ]),
+    "ko": ("Kore", [
+        "위원회는 제안을 신중하게 검토했으며 최종 투표를 다음 분기 "
+        "회의까지 연기하기로 결정했다.",
+        "그녀는 이른 아침 조용한 마을을 걸었고 가게들은 아직 닫혀 "
+        "있었으며 거리는 비어 있었다.",
+        "과학자들은 북쪽 바다 위의 날씨 패턴이 예상보다 빠르게 "
+        "변하고 있다는 것을 발견했다.",
+    ]),
+    "th": ("Thai", [
+        "คณะกรรมการพิจารณาข้อเสนออย่างรอบคอบและตัดสินใจเลื่อนการ"
+        "ลงคะแนนเสียงครั้งสุดท้ายไปยังการประชุมครั้งถัดไป",
+        "เธอเดินผ่านหมู่บ้านที่เงียบสงบในตอนเช้าตรู่ขณะที่ร้านค้ายังปิดอยู่"
+        "และถนนก็ว่างเปล่า",
+        "นักวิทยาศาสตร์ค้นพบว่ารูปแบบสภาพอากาศเหนือมหาสมุทรทางเหนือ"
+        "กำลังเปลี่ยนแปลงเร็วกว่าที่ใครคาดไว้",
+    ]),
+    "hi": ("Deva", [
+        "समिति ने प्रस्ताव की सावधानीपूर्वक समीक्षा की और अंतिम मतदान "
+        "को अगली तिमाही बैठक तक स्थगित करने का निर्णय लिया।",
+        "वह सुबह-सुबह शांत गांव से गुजर रही थी जबकि दुकानें अभी भी "
+        "बंद थीं और सड़कें खाली थीं।",
+        "वैज्ञानिकों ने पाया है कि उत्तरी महासागर के ऊपर मौसम के "
+        "पैटर्न अपेक्षा से अधिक तेजी से बदल रहे हैं।",
+    ]),
+}
+
+# the documented ambiguous-document hint demo (docs/ACCURACY.md): short
+# English-function-word text whose unhinted verdict is unreliable; a
+# content-language "id" prior flips it (run_eval records before/after,
+# tests/test_hints_parity.py pins that the flip happens and that
+# hint-off results stay byte-identical)
+HINT_DEMO_TEXT = ("the quick brown fox jumps over the lazy dog near "
+                  "the river bank")
+HINT_DEMO_HINT = "id"
+
+
+def generate_corpus(reg=None) -> list:
+    """Deterministic (code, text) expansion of SEED_BANK: 5 structural
+    variants per language — no RNG, so regenerating always reproduces
+    the checked-in TSV byte for byte."""
+    reg = reg or default_registry
+    pairs: list = []
+    for code, (_script, sents) in SEED_BANK.items():
+        if code not in reg.code_to_lang:
+            raise ValueError(f"eval corpus label {code!r} not in the "
+                             "registry; fix SEED_BANK")
+        s0, s1, s2 = sents[0], sents[1], sents[2]
+        variants = [
+            s0 + " " + s1,
+            s1 + " " + s2,
+            " ".join(sents),
+            (s2 + " ") * 3,
+            s0[:80] + " " + s2,
+        ]
+        for v in variants:
+            pairs.append((code, v.replace("\t", " ").replace("\n", " ")))
+    return pairs
+
+
+def write_corpus(path: Path | None = None) -> Path:
+    """Render the generated corpus as the checked-in TSV."""
+    path = Path(path) if path else CORPUS_PATH
+    lines = [f"{code}\t{text}" for code, text in generate_corpus()]
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    return path
+
+
+def corpus_pairs(path: Path | None = None) -> list:
+    """Load the bundled labeled corpus: the checked-in TSV when
+    present, else the bit-identical in-memory regeneration."""
+    path = Path(path) if path else CORPUS_PATH
+    if path.is_file():
+        pairs = []
+        for line in path.read_text(encoding="utf-8").splitlines():
+            if "\t" not in line:
+                continue
+            code, text = line.split("\t", 1)
+            pairs.append((code, text))
+        if pairs:
+            return pairs
+    return generate_corpus()
+
+
+# -- numpy oracle of the device chunk reduction -----------------------------
+
+
+def _oracle_reliability_expected(actual, expected):
+    """f32 ratio math, op-for-op with ops/score.py
+    _reliability_expected (float32 intermediates, so the int cast
+    truncates identically)."""
+    actual = np.asarray(actual, np.int64)
+    expected = np.asarray(expected, np.int64)
+    hi = np.maximum(actual, expected).astype(np.float32)
+    lo = np.minimum(actual, expected).astype(np.float32)
+    ratio = hi / np.maximum(lo, np.float32(1.0))
+    pct = (np.float32(100.0) * (np.float32(4.0) - ratio)
+           / np.float32(2.5)).astype(np.int32)
+    pct = np.where(ratio <= 1.5, 100, np.where(ratio > 4.0, 0, pct))
+    pct = np.where(expected == 0, 100, pct)
+    return np.where(actual == 0, np.where(expected == 0, 100, 0), pct)
+
+
+def oracle_score_chunks(tables, reg, wire: dict) -> np.ndarray:
+    """Pure-numpy mirror of ops/score.py score_chunks_impl: flat wire
+    dict (numpy arrays, exactly what pack_chunks_native built) ->
+    packed [G] u32 chunk words. Every stage — slot gather, langprob
+    decode, chunk totes, whacks, the LDT_HINTS prior term, group-in-use
+    top-2, reliability, word packing — follows the device program
+    op-for-op, so `oracle_score_chunks(t, r, cb.wire) ==
+    np.asarray(score_chunks(dt, cb.wire))` bit-for-bit under every
+    kernel mode (tests/test_hints_parity.py pins this, priors
+    included). This is the scalar-oracle extension the device prior
+    algebra is defined against."""
+    from .ops.device_tables import host_tables
+    from .ops.score import HINT_BASE
+
+    ht = host_tables(tables, reg)
+    cat_ind2 = ht.cat_ind2.astype(np.int64)
+    lg3 = np.asarray(tables.lg_prob[:, 5:8], np.uint8)
+    plang_to_lang = np.stack(
+        [reg.plang_to_lang_latn, reg.plang_to_lang_othr]).astype(np.int64)
+    expected = tables.avg_delta_octa_score.astype(np.int64)
+    close = np.array([reg.close_set(lang)
+                      for lang in range(reg.num_languages)], np.int64)
+
+    idxf = np.asarray(wire["idx"]).reshape(-1).astype(np.int64)
+    N = idxf.shape[0]
+    cnsl2 = np.asarray(wire["cnsl"]).astype(np.int64)
+    cstart = (np.cumsum(cnsl2, axis=-1) - cnsl2).reshape(-1)
+    cnsl = cnsl2.reshape(-1)
+    cmeta = np.asarray(wire["cmeta"]).reshape(-1).astype(np.uint32)
+    G = cstart.shape[0]
+    K = np.asarray(wire["k_iota"]).shape[0]
+
+    ki = np.arange(K, dtype=np.int64)
+    valid = ki[None, :] < cnsl[:, None]
+    gidx = np.clip(cstart[:, None] + ki[None, :], 0, N - 1)
+    raw = idxf[gidx]
+    hint_lp = np.asarray(wire["hint_lp"]).astype(np.int64)
+    H = hint_lp.shape[0]
+    lp_tbl = cat_ind2[np.clip(raw, 0, len(cat_ind2) - 1)]
+    lp_hint = hint_lp[np.clip(raw - HINT_BASE, 0, H - 1)]
+    lp = np.where(valid, np.where(raw >= HINT_BASE, lp_hint, lp_tbl), 0)
+
+    ps = np.stack([(lp >> 8) & 0xFF, (lp >> 16) & 0xFF,
+                   (lp >> 24) & 0xFF], axis=-1)        # [G, K, 3]
+    row = lp & 0xFF
+    # the device gather clamps out-of-range rows (XLA semantics);
+    # numpy fancy indexing must clamp explicitly to match
+    q = lg3[np.minimum(row, len(lg3) - 1)].astype(np.int64)
+    contrib = np.where(valid[..., None] & (ps > 0), q, 0)
+
+    scores = np.zeros((G, 256), np.int64)
+    gi = np.repeat(np.arange(G), K * 3)
+    np.add.at(scores, (gi, ps.reshape(-1)), contrib.reshape(-1))
+    # ps == 0 slots contributed 0 into plang 0 — identical to the
+    # device's (ps > 0) mask zeroing their contribution
+
+    cbytes = (cmeta & np.uint32(0xFFFF)).astype(np.int64)
+    grams = ((cmeta >> 16) & np.uint32(0xFFF)).astype(np.int64)
+    side = ((cmeta >> 28) & np.uint32(1)).astype(np.int64)
+    real = ((cmeta >> 29) & np.uint32(1)).astype(np.int64)
+    script = np.asarray(wire["cscript"]).reshape(-1).astype(np.int64)
+
+    group_scores = scores
+    if np.asarray(wire["cwhack"]).shape[-1] != 1:
+        cwhack = np.asarray(wire["cwhack"]).reshape(-1).astype(np.int64)
+        wtbl = np.asarray(wire["whack_tbl"])
+        wmask = wtbl[np.clip(cwhack, 0, wtbl.shape[0] - 1), side]
+        scores = np.where(wmask > 0, 0, scores)
+    if "cprior" in wire:
+        cprior = np.asarray(wire["cprior"]).reshape(-1).astype(np.int64)
+        ptbl = np.asarray(wire["prior_tbl"])
+        prior = ptbl[np.clip(cprior, 0, ptbl.shape[0] - 1),
+                     side].astype(np.int64)
+        scores = np.where(scores > 0, scores + prior, scores)
+
+    iota256 = np.arange(256, dtype=np.int64)
+    groups = (group_scores.reshape(G, 64, 4) > 0).any(axis=-1)
+    slot_in_use = np.repeat(groups, 4, axis=-1)
+    sortkey = np.where(slot_in_use, scores * 256 + (255 - iota256), -1)
+    k1 = np.argmax(sortkey, axis=-1)
+    top1 = np.take_along_axis(sortkey, k1[:, None], axis=-1)[:, 0]
+    sortkey2 = np.where(iota256 == k1[:, None], -1, sortkey)
+    k2 = np.argmax(sortkey2, axis=-1)
+    top2 = np.take_along_axis(sortkey2, k2[:, None], axis=-1)[:, 0]
+    s1 = np.where(top1 >= 0, top1 >> 8, 0)
+    s2 = np.where(top2 >= 0, top2 >> 8, 0)
+    k1 = np.where(top1 >= 0, k1, 0)
+    k2 = np.where(top2 >= 0, k2, 0)
+
+    lang1 = plang_to_lang[side, k1]
+    lang2 = plang_to_lang[side, k2]
+
+    actual_kb = np.where(cbytes > 0,
+                         (s1 << 10) // np.maximum(cbytes, 1), 0)
+    lscript4 = np.where(script == 1, 0,
+                        np.where(script == 3, 1,
+                                 np.where(script == 6, 2, 3)))
+    expected_kb = expected[lang1, lscript4]
+
+    maxp = np.where(grams < 8, 12 * grams, 100)
+    thresh = np.clip((grams * 5) >> 3, 3, 16)
+    delta = s1 - s2
+    rd = np.where(delta >= thresh, maxp,
+                  np.where(delta <= 0, 0,
+                           np.minimum(maxp, (100 * delta) // thresh)))
+    same_set = (close[lang1] != 0) & (close[lang1] == close[lang2])
+    rd = np.where(same_set, 100, rd)
+    rs = _oracle_reliability_expected(actual_kb, expected_kb)
+    crel = np.minimum(rd, rs)
+
+    word = (lang1.astype(np.uint32) |
+            (np.clip(s1, 0, 0x3FFF).astype(np.uint32) << 10) |
+            (np.clip(crel, 0, 127).astype(np.uint32) << 24) |
+            (real.astype(np.uint32) << 31))
+    return word
+
+
+# -- hint-flip demo ---------------------------------------------------------
+
+
+def hint_flip_demo(tables=None, reg=None) -> dict:
+    """Pack HINT_DEMO_TEXT with and without the LDT_HINTS prior and
+    report the before/after verdicts at the epilogue level — the
+    documented ambiguous-document flip the acceptance gate pins. Runs
+    entirely through the oracle (no jax needed)."""
+    from . import native
+    from .hints import CLDHints, apply_hints, prior_vector
+    from .ops.score import unpack_chunks_out
+
+    tables = tables or load_tables()
+    reg = reg or default_registry
+    hb = apply_hints(HINT_DEMO_TEXT, True,
+                     CLDHints(content_language_hint=HINT_DEMO_HINT),
+                     tables, reg)
+    pv = prior_vector(hb, tables)
+    cb0 = native.pack_chunks_native([HINT_DEMO_TEXT], tables, reg,
+                                    hint_boosts=[hb])
+    cb1 = native.pack_chunks_native([HINT_DEMO_TEXT], tables, reg,
+                                    hint_boosts=[hb], hint_priors=[pv])
+    out = {}
+    for name, cb in (("before", cb0), ("after", cb1)):
+        rows = unpack_chunks_out(oracle_score_chunks(tables, reg,
+                                                     cb.wire),
+                                 cb.wire["cmeta"])
+        ep = native.epilogue_flat_native(rows, cb, 0, reg)
+        out[name] = reg.code(int(ep[0][0]))
+    return {"text": HINT_DEMO_TEXT,
+            "hint": f"content-language: {HINT_DEMO_HINT}",
+            "before": out["before"], "after": out["after"],
+            "flipped": out["before"] != out["after"]}
+
+
+# -- scorecard --------------------------------------------------------------
+
+
+def _result_planes(results, reg) -> dict:
+    """Result objects -> int planes for the vectorized scorecard."""
+    n = len(results)
+    top3 = np.zeros((n, 3), np.int64)
+    pct1 = np.zeros(n, np.int64)
+    rel = np.zeros(n, bool)
+    lang1 = np.zeros(n, np.int64)
+    for i, r in enumerate(results):
+        top3[i] = list(r.language3)
+        pct1[i] = int(r.percent3[0])
+        rel[i] = bool(r.is_reliable)
+        lang1[i] = int(r.summary_lang)
+    return {"lang1": lang1, "top3": top3, "pct1": pct1, "rel": rel}
+
+
+def run_eval(engine=None, quick: bool = False, pairs=None,
+             tables=None, reg=None) -> dict:
+    """Batch the bundled corpus through the engine (or the scalar
+    engine when none is available) and compute the scorecard. The
+    agreement block compares the engine's verdicts against the scalar
+    oracle doc-for-doc; the accuracy/confusion/calibration blocks
+    compare against the corpus labels. All tallies are vectorized
+    numpy over the int result planes — no per-doc Python in the
+    scoring passes."""
+    reg = reg or (engine.reg if engine is not None else default_registry)
+    tables = tables or (engine.tables if engine is not None
+                        else load_tables())
+    pairs = list(pairs if pairs is not None else corpus_pairs())
+    if quick:
+        pairs = pairs[::3]
+    labels = [c for c, _ in pairs]
+    texts = [t for _, t in pairs]
+    telemetry.REGISTRY.counter_inc("ldt_eval_docs_total", len(texts))
+
+    oracle = [detect_scalar(t, tables, reg) for t in texts]
+    if engine is not None:
+        got = engine.detect_batch(texts)
+        engine_kind = "device"
+    else:
+        got = oracle
+        engine_kind = "scalar"
+
+    gp = _result_planes(got, reg)
+    op = _result_planes(oracle, reg)
+    label_ids = np.array([reg.code_to_lang.get(c, -1) for c in labels],
+                         np.int64)
+    scripts = np.array([SEED_BANK.get(c, ("??",))[0] if c in SEED_BANK
+                        else "??" for c in labels])
+
+    n = len(texts)
+    top1_agree = float((gp["lang1"] == op["lang1"]).mean())
+    top3_agree = float((op["lang1"][:, None]
+                        == gp["top3"]).any(axis=1).mean())
+    label_top1 = float((gp["lang1"] == label_ids).mean())
+    label_top3 = float((label_ids[:, None]
+                        == gp["top3"]).any(axis=1).mean())
+
+    # per-script rows: accuracy + confusion pairs, via np.unique over
+    # combined (label, got) keys — no per-doc python in the tally
+    per_script: dict = {}
+    for s in np.unique(scripts):
+        m = scripts == s
+        hits = gp["lang1"][m] == label_ids[m]
+        keys = label_ids[m] * 100000 + gp["lang1"][m]
+        uk, counts = np.unique(keys[~hits], return_counts=True)
+        confusions = [[reg.code(int(k // 100000)),
+                       reg.code(int(k % 100000)), int(c)]
+                      for k, c in zip(uk, counts)]
+        confusions.sort(key=lambda r: -r[2])
+        per_script[str(s)] = {
+            "docs": int(m.sum()),
+            "label_top1": float(hits.mean()),
+            "confusions": confusions[:8],
+        }
+
+    # reliability calibration: bucket the engine's top percent and
+    # compare claimed reliability against label accuracy per bucket
+    edges = np.array([0, 20, 40, 60, 80, 101])
+    bucket = np.digitize(gp["pct1"], edges) - 1
+    hits = (gp["lang1"] == label_ids).astype(np.int64)
+    calibration = []
+    nb = len(edges) - 1
+    docs_b = np.bincount(bucket, minlength=nb)[:nb]
+    hits_b = np.bincount(bucket, weights=hits, minlength=nb)[:nb]
+    rel_b = np.bincount(bucket, weights=gp["rel"].astype(np.int64),
+                        minlength=nb)[:nb]
+    for b in range(nb):
+        if docs_b[b] == 0:
+            continue
+        calibration.append({
+            "pct_lo": int(edges[b]), "pct_hi": int(edges[b + 1] - 1),
+            "docs": int(docs_b[b]),
+            "label_top1": float(hits_b[b] / docs_b[b]),
+            "reliable_frac": float(rel_b[b] / docs_b[b]),
+        })
+
+    return {
+        "corpus_docs": n,
+        "languages": len(set(labels)),
+        "quick": bool(quick),
+        "engine": engine_kind,
+        "agreement": {"top1": top1_agree, "top3": top3_agree,
+                      "floor": AGREEMENT_FLOOR},
+        "label_accuracy": {"top1": label_top1, "top3": label_top3},
+        "per_script": per_script,
+        "calibration": calibration,
+        "hint_flip": hint_flip_demo(tables, reg),
+    }
+
+
+def check_floor(card: dict) -> None:
+    """Raise when the published scorecard is below the agreement floor
+    (the ci.sh accuracy smoke's gate)."""
+    top1 = card["agreement"]["top1"]
+    if top1 < AGREEMENT_FLOOR:
+        raise AssertionError(
+            f"device-vs-scalar top-1 agreement {top1:.4f} below the "
+            f"{AGREEMENT_FLOOR} floor — engines diverged")
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_eval(quick=True), indent=2))

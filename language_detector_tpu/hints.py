@@ -392,6 +392,39 @@ def make_langprob(reg: Registry, lang: int, qprob: int) -> int:
     return (pslang << 8) | _BACKMAP[max(1, min(qprob, 12))]
 
 
+def prior_vector(hb: "HintBoosts | None",
+                 tables: ScoringTables) -> np.ndarray | None:
+    """One document's HintBoosts -> dense per-side prior vector
+    [2, 256] u8 for the device reduction (LDT_HINTS=1), or None when
+    the document carries no boosts.
+
+    Each boost langprob decodes exactly as the chunk tote would decode
+    a hint slot (plane 0 only — make_langprob fills one plane): pslang
+    from bits 8-15, qprob from lg_prob plane 0 of the row in bits 0-7.
+    The vector is the per-chunk score the reduction adds to every
+    POSITIVE post-whack tote entry before the top-2 select
+    (ops/score.py _chunk_out_word prior term); zero entries stay zero,
+    so a prior can never promote a language with no chunk evidence."""
+    if hb is None or hb.empty():
+        return None
+    lg3 = np.asarray(tables.lg_prob[:, 5:8], dtype=np.uint8)
+    pv = np.zeros((2, 256), np.int32)
+    any_set = False
+    for side, boosts in ((0, hb.boost_latn), (1, hb.boost_othr)):
+        for lp in list(boosts):
+            if lp <= 0:
+                continue
+            ps = (lp >> 8) & 0xFF
+            if ps == 0:
+                continue
+            row = min(lp & 0xFF, lg3.shape[0] - 1)
+            pv[side, ps] += int(lg3[row, 0])
+            any_set = True
+    if not any_set:
+        return None
+    return np.minimum(pv, 255).astype(np.uint8)
+
+
 def _is_latn_lang(reg: Registry, lang: int) -> bool:
     return int(reg.plang_to_lang_latn[reg.per_script_number(1, lang)]) \
         == lang

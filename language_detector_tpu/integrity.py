@@ -238,7 +238,21 @@ def build_from_env(engine) -> IntegrityMonitor | None:
 
     n_canary = knobs.get_int("LDT_CANARY_DOCS")
     n_canary = 8 if n_canary is None else n_canary
-    docs = list(CANARY_DOCS[:max(0, n_canary)])
+    # statistical canary gate: the first 8 docs are the pinned core
+    # set (exact-match, any miss fails — they are chosen to be
+    # unambiguous, so a single flip means real corruption); docs past
+    # 8 draw deterministically from the evalsuite corpus and pass on
+    # an agreement fraction >= LDT_CANARY_FLOOR, so a large canary set
+    # scales confidence without turning one borderline eval doc into
+    # a permanent false alarm
+    n_core = min(max(0, n_canary), len(CANARY_DOCS))
+    docs = list(CANARY_DOCS[:n_core])
+    if n_canary > len(CANARY_DOCS):
+        from .evalsuite import corpus_pairs
+        extra = [t for _, t in corpus_pairs()]
+        docs += extra[:n_canary - len(CANARY_DOCS)]
+    floor = knobs.get_float("LDT_CANARY_FLOOR")
+    floor = 0.95 if floor is None else floor
     canary_fn = None
     if docs:
         from . import native
@@ -278,7 +292,15 @@ def build_from_env(engine) -> IntegrityMonitor | None:
                                              engine.reg)
             got = [engine.reg.code(int(ep[b][0]))
                    for b in range(len(docs))]
-            return got == expected_codes()
+            want = expected_codes()
+            if got[:n_core] != want[:n_core]:
+                return False
+            ext_got, ext_want = got[n_core:], want[n_core:]
+            if not ext_got:
+                return True
+            agree = sum(g == w for g, w in zip(ext_got, ext_want)) \
+                / len(ext_got)
+            return agree >= floor
 
     expected = {ln.idx: fingerprint(ln.dt)
                 for ln in engine.pool.lanes if ln.dt is not None}

@@ -323,7 +323,18 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "pinned 8-doc pack, expected codes baked into model.ldta at "
        "pack time): each lane scores the pack and any code deviation "
        "quarantines the lane — catching compute faults a table digest "
-       "can't see. 0 disables the canary (digest scrub still runs)."),
+       "can't see. 0 disables the canary (digest scrub still runs). "
+       "Values past the pinned pack extend it with deterministic draws "
+       "from the bundled eval corpus (evalsuite.py) and the gate "
+       "becomes the LDT_CANARY_FLOOR agreement floor instead of "
+       "exact-8 equality."),
+    _k("LDT_CANARY_FLOOR", "float", 0.95,
+       "Agreement floor for the statistical canary gate: when "
+       "LDT_CANARY_DOCS extends past the pinned 8-doc pack, a scrub "
+       "pass quarantines the lane when the fraction of canary docs "
+       "matching their expected codes drops below this (the pinned "
+       "core 8 still require exact equality — any deviation there is "
+       "a quarantine regardless of the floor)."),
     _k("LDT_WIRE_CRC", "bool", False,
        "End-to-end frame payload CRC32 on the wire lanes: UDS v2 "
        "frames carry a CRC ext-flag + trailer word and shm slots "
@@ -370,6 +381,24 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "reliability gate re-scores whole regardless, so the lane takes "
        "only the fat tail where bucket-shape inflation actually "
        "bites."),
+    # -- accuracy plane (evalsuite.py, models/ngram.py, both fronts) --
+    _k("LDT_SPANS", "bool", False,
+       "Per-span language output: detector results carry a spans list "
+       "[(byte_offset, byte_len, code, pct, reliable)] tiling the "
+       "document (script-span-aligned, engine detect_spans), the HTTP "
+       "front adds a per-item \"spans\" JSON field, and UDS v2 frames "
+       "honor the FRAME_SPANS ext flag. Off (default): responses and "
+       "every device program are byte-identical to the pre-span "
+       "stack."),
+    _k("LDT_HINTS", "bool", False,
+       "Hint priors in the device reduction: hinted batches carry "
+       "per-doc dense prior vectors (hints.prior_vector — the boost "
+       "algebra's qprob deltas) that the scorer adds to languages a "
+       "chunk already observed, post-whack and before the top-2 "
+       "select, in every kernel mode. Bit-exact to the scalar-oracle "
+       "extension (tests/test_hints_parity.py); off (default) the "
+       "wire carries no prior keys and hint-off results stay "
+       "byte-identical."),
     # -- per-tenant isolation (service/admission.py) ------------------
     _k("LDT_TENANT_QUOTA_DOCS", "int", None,
        "Per-tenant cap on queued documents (X-LDT-Tenant header; "

@@ -231,6 +231,12 @@ class NgramBatchEngine:
             self.longdoc_chunk_slots,
             knobs.get_int("LDT_LONGDOC_SPLIT_SLOTS") or 0
             if longdoc_split_slots is None else longdoc_split_slots)
+        # LDT_HINTS=1: hinted batches additionally carry per-doc dense
+        # prior vectors (hints.prior_vector) that the device reduction
+        # adds to observed languages before the top-2 select. Off (the
+        # default) no wire key exists and every traced program is
+        # byte-identical to the pre-feature engine.
+        self.hint_priors_enabled = knobs.get_bool("LDT_HINTS")
         # host staging ring for the wire's bucketed arrays: capacity
         # covers the in-flight bound plus the batch being packed
         self._staging = native.StagingRing(
@@ -577,6 +583,94 @@ class NgramBatchEngine:
                 self.stats["scalar_recursion_docs"] += n_retry
         return out
 
+    def detect_spans(self, texts: list[str]) -> list:
+        """Per-span language verdicts (the LDT_SPANS surface): each text
+        splits on script-span boundaries exactly like the long-doc lane
+        (preprocess/pack.py split_longdoc — the only exact split
+        points), every sub-document scores as its own row range of one
+        flat pack, the MERGED epilogue yields the whole-document
+        summary (identical to the unsplit answer — the longdoc-lane
+        invariant) and the UNMERGED per-sub-doc epilogue yields the
+        span verdicts. Results are ScalarResult-compatible with .spans
+        = [(byte_offset, byte_len, code, pct, reliable)] tiling the
+        document's bytes (engine_scalar.span_coverage_records).
+        Exception docs keep exactness over speed: a sub-doc the packer
+        flagged or that failed the gate resolves its span via the
+        scalar engine, and a merged-doc exception resolves the whole
+        summary scalar — so the emitted records are bit-identical to
+        detect_scalar_spans on every document. Low-volume API path
+        (LDT_SPANS requests only): no pipelining, no retry lane."""
+        from ..engine_scalar import SPAN_SPLIT_SLOTS, detect_scalar_spans
+        if not texts:
+            return []
+        budget = self.longdoc_chunk_slots or SPAN_SPLIT_SLOTS
+        if self.flags & ~_DEVICE_OK_FLAGS:
+            return [detect_scalar_spans(t, self.tables, self.reg,
+                                        self.flags, budget)
+                    for t in texts]
+        out: list = []
+        for chunk in self._slices(texts, 16384):
+            out.extend(self._detect_spans_slice(chunk, budget))
+        return out
+
+    def _detect_spans_slice(self, texts: list[str],
+                            budget: int) -> list:
+        from .. import native
+        from ..engine_scalar import span_coverage_records, split_for_spans
+        from ..result_vector import merge_longdoc_chunks
+        subs_all: list = []
+        groups: list = []
+        bounds_all: list = []
+        for t in texts:
+            subs, bounds = split_for_spans(t, self.tables, budget)
+            groups.append((len(subs_all), len(subs)))
+            subs_all.extend(subs)
+            bounds_all.append(bounds)
+        cb = self._pack(subs_all)
+        rows = self._fetch_rows(cb, self._launch(cb, "spans"))
+        # per-sub-doc verdicts come from the UNMERGED epilogue (the rows
+        # the merge used to discard — satellite of the span work), the
+        # whole-doc summary from the merged one
+        sub_ep = native.epilogue_flat_native(rows, cb, self.flags,
+                                             self.reg)
+        mrows, mcb, _ = merge_longdoc_chunks(rows, cb, groups,
+                                             keep_spans=True)
+        ep = native.epilogue_flat_native(mrows, mcb, self.flags,
+                                         self.reg)
+        results: list = []
+        n_fb = 0
+        for j, text in enumerate(texts):
+            s, n = groups[j]
+            verdicts = []
+            for k in range(n):
+                i = s + k
+                row = sub_ep[i]
+                if cb.fallback[i] or cb.squeezed[i] or row[12]:
+                    r = detect_scalar(subs_all[i], self.tables,
+                                      self.reg, self.flags)
+                    verdicts.append((self.reg.code(r.summary_lang),
+                                     int(r.percent3[0]),
+                                     bool(r.is_reliable)))
+                else:
+                    verdicts.append((self.reg.code(int(row[0])),
+                                     int(row[4]), bool(row[11])))
+            if mcb.fallback[j] or mcb.squeezed[j] or ep[j, 12]:
+                n_fb += 1
+                res = detect_scalar(text, self.tables, self.reg,
+                                    self.flags)
+            else:
+                res = _result_from_row(ep[j])
+            res.spans = span_coverage_records(text, bounds_all[j],
+                                              verdicts)
+            results.append(res)
+        with self._stats_lock:
+            self.stats["batches"] += 1
+            self.stats["device_dispatches"] += 1
+            self.stats["fallback_docs"] += n_fb
+        telemetry.REGISTRY.counter_inc("ldt_span_docs_total",
+                                       len(texts))
+        return results
+
     def _detect_hinted(self, texts: list[str], hints,
                        is_plain_text: bool) -> list:
         """Hinted / HTML detection on the device path: hint priors ride
@@ -588,7 +682,7 @@ class NgramBatchEngine:
         engine with the ORIGINAL text + hints — exactness over speed on
         this low-volume path."""
         from .. import native
-        from ..hints import apply_hints
+        from ..hints import apply_hints, prior_vector
         from ..preprocess.html import clean_html
         if is_plain_text:
             # without HTML there is no per-document hint input (lang=
@@ -601,6 +695,11 @@ class NgramBatchEngine:
             hbs = [apply_hints(t, False, hints, self.tables, self.reg)
                    for t in texts]
             clean = [clean_html(t, self.tables)[0] for t in texts]
+        # LDT_HINTS=1: densify each doc's boosts into the prior plane
+        # the reduction adds pre-top-2; the plain-text batch shares one
+        # plane (same HintBoosts), deduped to one table row by the pack
+        prs = ([prior_vector(hb, self.tables) for hb in hbs]
+               if self.hint_priors_enabled else None)
 
         # budget-sliced jobs carrying (clean slice, original slice, hint
         # slice); the shared pipeline overlaps pack/score across slices
@@ -608,19 +707,21 @@ class NgramBatchEngine:
             pos = 0
             for chunk in self._slices(clean, 16384):
                 n = len(chunk)
-                yield (chunk, texts[pos:pos + n], hbs[pos:pos + n])
+                yield (chunk, texts[pos:pos + n], hbs[pos:pos + n],
+                       prs[pos:pos + n] if prs is not None else None)
                 pos += n
 
         def pack(job):
-            chunk, _, hb_slice = job
-            return self._pack(chunk, hint_boosts=hb_slice)
+            chunk, _, hb_slice, pr_slice = job
+            return self._pack(chunk, hint_boosts=hb_slice,
+                              hint_priors=pr_slice)
 
         def finish(job, cb, fut):
             # hinted twin of _epilogue/_finish: BOTH exception classes
             # (packer fallback, gate failure) resolve via the scalar
             # engine with the ORIGINAL text + hints — the batched retry
             # pass does not carry hint state
-            _, orig, _ = job
+            _, orig, _, _ = job
             rows = self._fetch_rows(cb, fut)
             ep = native.epilogue_flat_native(rows, cb, self.flags,
                                              self.reg)
@@ -1219,7 +1320,8 @@ class NgramBatchEngine:
             yield start, len(lengths)
 
     def _pack(self, texts: list[str], flags: int | None = None,
-              hint_boosts: list | None = None):
+              hint_boosts: list | None = None,
+              hint_priors: list | None = None):
         """Pack only (no device launch): the pipeline core launches on
         its worker pool so slice N's host->device transfer never blocks
         slice N+1's pack on the single-core host. Wire arrays come from
@@ -1233,6 +1335,8 @@ class NgramBatchEngine:
         padded = list(texts) + [""] * pad if pad else texts
         if pad and hint_boosts is not None:
             hint_boosts = list(hint_boosts) + [None] * pad
+        if pad and hint_priors is not None:
+            hint_priors = list(hint_priors) + [None] * pad
         t0 = _time.monotonic()
         with self._pipe_lock:
             overlapped = self._inflight > 0
@@ -1240,6 +1344,7 @@ class NgramBatchEngine:
             padded, self.tables, self.reg, flags=fl,
             n_shards=self._mesh_size, l_doc=self.max_slots,
             c_doc=self.max_chunks, hint_boosts=hint_boosts,
+            hint_priors=hint_priors,
             staging=self._staging)
         ms = (_time.monotonic() - t0) * 1e3
         with self._pipe_lock:
@@ -1471,11 +1576,15 @@ class EpilogueResult:
     building 16K eager dataclasses per batch costs ~70ms of single-core
     host time the common consumers (code-only service path, top-1 eval)
     never use."""
-    __slots__ = ("_r",)
+    __slots__ = ("_r", "spans")
     chunks = None  # ResultChunk vectors come from the scalar engine only
 
     def __init__(self, row: list):
         self._r = row
+        # per-span verdicts [(byte_offset, byte_len, code, pct,
+        # reliable)] — filled only by the LDT_SPANS surface
+        # (detect_spans); None everywhere else
+        self.spans = None
 
     @property
     def summary_lang(self) -> int:

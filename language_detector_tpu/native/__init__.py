@@ -625,13 +625,20 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
                        l_doc: int = 1 << 17, c_doc: int = 1 << 14,
                        max_direct: int = 64, n_threads: int = 0,
                        hint_boosts: list | None = None,
+                       hint_priors: list | None = None,
                        want_ranges: bool = False,
                        staging: "StagingRing | None" = None) -> ChunkBatch:
     """texts -> chunk-major flat wire (one dispatch regardless of the
     batch's document-length mix). len(texts) must divide n_shards.
     hint_boosts: optional per-doc hints.HintBoosts (None entries fine) —
     prior boosts ride the wire as extra chunk slots addressing the
-    hint_lp window; whacks become per-chunk mask rows."""
+    hint_lp window; whacks become per-chunk mask rows.
+    hint_priors: optional per-doc [2, 256] u8 prior vectors
+    (hints.prior_vector, None entries fine) for the LDT_HINTS=1
+    reduction term — deduped into a prior_tbl wire plane plus a
+    per-chunk cprior row index. The cprior/prior_tbl keys exist ONLY
+    when at least one document carries a prior, so prior-free batches
+    trace the identical device program they always did."""
     lib = _load()
     if not lib:
         raise RuntimeError("native packer unavailable")
@@ -762,6 +769,35 @@ def pack_chunks_native(texts: list[str], tables: ScoringTables,
     wire = dict(idx=idx, cnsl=cnsl, cmeta=cmeta,
                 cscript=cscript, cwhack=cwhack, hint_lp=hint_lp_w,
                 whack_tbl=whack_w, k_iota=np.zeros(K, np.uint8))
+    if hint_priors is not None and any(p is not None for p in hint_priors):
+        # LDT_HINTS=1 prior term: dedup the per-doc [2, 256] planes into
+        # a pow2-padded table (row 0 = the no-prior zero plane) and mark
+        # each document's chunks with its row via the flat contiguity
+        # invariant. Fresh allocations, not the staging ring — priors
+        # ride only the rare hinted lane, so pinning ring capacity for
+        # them would tax every plain batch.
+        planes: list[bytes] = [bytes(2 * 256)]
+        plane_row: dict[bytes, int] = {planes[0]: 0}
+        cprior = np.zeros((D, Gs), np.uint16)
+        cprior_flat = cprior.reshape(-1)
+        for b in range(min(B, len(hint_priors))):
+            pv = hint_priors[b]
+            if pv is None:
+                continue
+            key = np.ascontiguousarray(pv, dtype=np.uint8).tobytes()
+            row = plane_row.get(key)
+            if row is None:
+                row = len(planes)
+                planes.append(key)
+                plane_row[key] = row
+            s = int(doc_chunk_start[b])
+            cprior_flat[s:s + int(n_chunks[b])] = row
+        Pb = _next_pow2_min(len(planes), 1)
+        prior_tbl = np.zeros((Pb, 2, 256), np.uint8)
+        for row, key in enumerate(planes):
+            prior_tbl[row] = np.frombuffer(key, np.uint8).reshape(2, 256)
+        wire["cprior"] = cprior
+        wire["prior_tbl"] = prior_tbl
     return ChunkBatch(wire=wire, doc_chunk_start=doc_chunk_start,
                       direct_adds=direct_adds, text_bytes=text_bytes,
                       fallback=fallback, squeezed=squeezed,

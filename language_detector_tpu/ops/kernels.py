@@ -72,7 +72,8 @@ def _gather_wire(dt: DeviceTables, p: dict):
     decode, line-for-line the same math as score_chunks_impl
     (ops/score.py) so every kernel mode scores the identical [G, K]
     langprob grid. Returns (lp, cbytes, grams, side, real, script,
-    wmask-or-None); lp is zero outside each chunk's slot count."""
+    wmask-or-None, prior-or-None); lp is zero outside each chunk's
+    slot count."""
     idxf = p["idx"].reshape(-1)
     N = idxf.shape[0]
     cnsl2 = p["cnsl"].astype(jnp.int32)            # [D, Gs]
@@ -105,7 +106,15 @@ def _gather_wire(dt: DeviceTables, p: dict):
         wmask = p["whack_tbl"][jnp.clip(cwhack, 0,
                                         p["whack_tbl"].shape[0] - 1),
                                side]
-    return lp, cbytes, grams, side, real, script, wmask
+    if "cprior" in p:  # ldt-lint: disable=trace-python-branch -- dict-key membership on the wire dict is a trace-time structural test (like the cwhack shape test above), not a traced value
+        # LDT_HINTS=1 per-doc prior planes (see score_chunks_impl)
+        cprior = p["cprior"].reshape(-1).astype(jnp.int32)
+        prior = p["prior_tbl"][
+            jnp.clip(cprior, 0, p["prior_tbl"].shape[0] - 1),
+            side].astype(jnp.int32)
+    else:
+        prior = None
+    return lp, cbytes, grams, side, real, script, wmask, prior
 
 
 # ---------------------------------------------------------------------------
@@ -123,7 +132,8 @@ def _gather_wire(dt: DeviceTables, p: dict):
 
 def score_chunks_fused_impl(dt: DeviceTables, p: dict,
                             full_out: bool = False):
-    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    lp, cbytes, grams, side, real, script, wmask, prior = \
+        _gather_wire(dt, p)
     G = lp.shape[0]
     K = lp.shape[1]
 
@@ -147,7 +157,7 @@ def score_chunks_fused_impl(dt: DeviceTables, p: dict,
         whacked = jnp.where(wmask > 0, 0, scores)
     return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
                            script, group_scores=scores,
-                           full_out=full_out)
+                           full_out=full_out, prior=prior)
 
 
 score_chunks_fused = jax.jit(score_chunks_fused_impl)
@@ -169,7 +179,8 @@ score_chunks_fused_donated = jax.jit(score_chunks_fused_impl,
 
 def score_chunks_lax_impl(dt: DeviceTables, p: dict,
                           full_out: bool = False):
-    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    lp, cbytes, grams, side, real, script, wmask, prior = \
+        _gather_wire(dt, p)
     G = lp.shape[0]
     iota256 = jnp.arange(256, dtype=jnp.int32)
 
@@ -190,7 +201,7 @@ def score_chunks_lax_impl(dt: DeviceTables, p: dict,
         whacked = jnp.where(wmask > 0, 0, scores)
     return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
                            script, group_scores=scores,
-                           full_out=full_out)
+                           full_out=full_out, prior=prior)
 
 
 score_chunks_lax = jax.jit(score_chunks_lax_impl)
@@ -213,8 +224,10 @@ score_chunks_lax_donated = jax.jit(score_chunks_lax_impl,
 
 
 def _fused_tote_kernel(lp_ref, meta_ref, script_ref, wmask_ref,
-                       lg3_ref, exp_ref, p2l_ref, close_ref, out_ref):
-    """One [TILE_G, K] tile: tote + whack + top-2 + reliability."""
+                       prior_ref, lg3_ref, exp_ref, p2l_ref, close_ref,
+                       out_ref):
+    """One [TILE_G, K] tile: tote + whack + prior + top-2 +
+    reliability."""
     lp = lp_ref[...].astype(jnp.uint32)            # [TG, K]
     tg = lp.shape[0]
     ps = jnp.stack([(lp >> 8) & 0xFF, (lp >> 16) & 0xFF,
@@ -233,6 +246,10 @@ def _fused_tote_kernel(lp_ref, meta_ref, script_ref, wmask_ref,
                            dtype=jnp.int16).astype(jnp.int32)
     wmask = wmask_ref[...]
     scores = jnp.where(wmask > 0, 0, group_scores)
+    # hint prior (LDT_HINTS=1): all-zero plane when hints are off, so
+    # the add is the identity — matches the gated term bit-for-bit
+    prior = prior_ref[...].astype(jnp.int32)
+    scores = jnp.where(scores > 0, scores + prior, scores)
 
     # group-in-use top-2 (tote.cc semantics; see _chunk_out_word)
     groups = jnp.any((group_scores > 0).reshape(tg, 64, 4), axis=-1)
@@ -280,13 +297,17 @@ def _fused_tote_kernel(lp_ref, meta_ref, script_ref, wmask_ref,
 def _pallas_score_impl(dt: DeviceTables, p: dict, interpret: bool,
                        full_out: bool = False):
     """XLA prologue (gather) + the fused Pallas grid + output slice."""
-    lp, cbytes, grams, side, real, script, wmask = _gather_wire(dt, p)
+    lp, cbytes, grams, side, real, script, wmask, prior = \
+        _gather_wire(dt, p)
     G = lp.shape[0]
     K = lp.shape[1]
     if wmask is None:
         # the kernel body is branch-free: an all-zero mask whacks
         # nothing, matching the dropped gather exactly
         wmask = jnp.zeros((G, 256), jnp.uint8)
+    if prior is None:
+        # same trick for the hint-prior plane: zero add = identity
+        prior = jnp.zeros((G, 256), jnp.int32)
     meta = jnp.stack([cbytes, grams, side, real], axis=-1)  # [G, 4]
     gp = max(TILE_G, -(-G // TILE_G) * TILE_G)
     pad = gp - G
@@ -294,6 +315,7 @@ def _pallas_score_impl(dt: DeviceTables, p: dict, interpret: bool,
     meta = jnp.pad(meta, ((0, pad), (0, 0)))
     script2 = jnp.pad(script[:, None], ((0, pad), (0, 0)))
     wmask = jnp.pad(wmask, ((0, pad), (0, 0)))
+    prior = jnp.pad(prior, ((0, pad), (0, 0)))
 
     n_exp = dt.expected_score_pad.shape[0]
     out = pl.pallas_call(
@@ -304,6 +326,7 @@ def _pallas_score_impl(dt: DeviceTables, p: dict, interpret: bool,
             pl.BlockSpec((TILE_G, 4), lambda i: (i, 0)),
             pl.BlockSpec((TILE_G, 1), lambda i: (i, 0)),
             pl.BlockSpec((TILE_G, 256), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_G, 256), lambda i: (i, 0)),
             pl.BlockSpec((256, 3), lambda i: (0, 0)),
             pl.BlockSpec((n_exp, 4), lambda i: (0, 0)),
             pl.BlockSpec((2, 256), lambda i: (0, 0)),
@@ -312,7 +335,7 @@ def _pallas_score_impl(dt: DeviceTables, p: dict, interpret: bool,
         out_specs=pl.BlockSpec((TILE_G, 2), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((gp, 2), jnp.uint32),
         interpret=interpret,
-    )(lp, meta, script2, wmask, dt.lg_prob3_pad,
+    )(lp, meta, script2, wmask, prior, dt.lg_prob3_pad,
       dt.expected_score_pad, dt.plang_to_lang,
       dt.close_set_pad[:, None])
     word = out[:G]

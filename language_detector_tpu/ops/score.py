@@ -89,7 +89,7 @@ OUTW2_RS_SHIFT = 17
 
 
 def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
-                    group_scores=None, full_out=False):
+                    group_scores=None, full_out=False, prior=None):
     """[..., 256] chunk totes + chunk meta -> packed u32 chunk summary:
     group-in-use top-2 (tote.cc:30-100), reliability (cldutil.cc:553-605),
     output word OUTW_* layout. Leading dims are free.
@@ -97,11 +97,20 @@ def _chunk_out_word(dt, scores, cbytes, grams, side, real, script,
     group_scores: pre-whack scores for the group-in-use mask — the
     scalar tote marks groups in use at ADD time, and a hint whack zeroes
     the score without retiring the group (ZeroPSLang), so a fully
-    whacked chunk still reports its zeroed top language."""
+    whacked chunk still reports its zeroed top language.
+
+    prior: optional [..., 256] per-chunk hint-prior vector (LDT_HINTS=1,
+    hints.prior_vector) added to languages the chunk already scored,
+    post-whack and pre-top-2. Only observed languages move: a prior
+    never conjures a language with zero chunk evidence, and the
+    group-in-use mask stays on pre-whack/pre-prior scores, so a
+    prior-free document's word is bit-identical with hints on or off."""
     iota256 = jnp.arange(256, dtype=jnp.int32)
     lead = scores.shape[:-1]
     if group_scores is None:
         group_scores = scores
+    if prior is not None:
+        scores = jnp.where(scores > 0, scores + prior, scores)
     # group-in-use top-2 (qprob >= 1 invariant validated at
     # DeviceTables.from_host)
     groups = jnp.any((group_scores > 0).reshape(lead + (64, 4)), axis=-1)
@@ -185,6 +194,10 @@ def score_chunks_impl(dt: DeviceTables, p: dict, full_out: bool = False):
       hint_lp   [H]        u32  hint-prior langprob window (per batch)
       whack_tbl [W,2,256]  u8   close-set whack masks per side
       k_iota    [K]        u8   dense chunk-row length carrier
+      cprior    [G]        u16  OPTIONAL (LDT_HINTS=1): prior_tbl row
+                                per chunk (0 = no prior)
+      prior_tbl [P,2,256]  u8   OPTIONAL: per-doc hint-prior vectors
+                                per side (row 0 all-zero)
 
     Reductions are chunk-local: safe under jit and shard_map over the
     chunk axis with zero collectives (the cnsl cumsum is per shard
@@ -250,9 +263,20 @@ def score_chunks_impl(dt: DeviceTables, p: dict, full_out: bool = False):
                                         p["whack_tbl"].shape[0] - 1),
                                side]
         whacked = jnp.where(wmask > 0, 0, scores)
+    # hint priors (LDT_HINTS=1): per-doc [2, 256] planes, deduped into
+    # prior_tbl with each chunk carrying its doc's row. Keys exist only
+    # when some doc in the batch has priors — prior-free batches trace
+    # the identical program as before the feature existed.
+    if "cprior" in p:  # ldt-lint: disable=trace-python-branch -- dict-key membership on the wire dict is a trace-time structural test (like the cwhack shape test above), not a traced value
+        cprior = p["cprior"].reshape(-1).astype(jnp.int32)
+        prior = p["prior_tbl"][
+            jnp.clip(cprior, 0, p["prior_tbl"].shape[0] - 1),
+            side].astype(jnp.int32)
+    else:
+        prior = None
     return _chunk_out_word(dt, whacked, cbytes, grams, side, real,
                            script, group_scores=scores,
-                           full_out=full_out)
+                           full_out=full_out, prior=prior)
 
 
 score_chunks = jax.jit(score_chunks_impl)

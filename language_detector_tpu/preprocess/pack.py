@@ -112,12 +112,16 @@ def _maybe_multi_span(text: str, tables) -> bool:
 
 
 def split_longdoc(text: str, tables: ScoringTables,
-                  max_slots: int) -> list[str] | None:
+                  max_slots: int,
+                  want_bounds: bool = False) -> list[str] | None:
     """Split one oversized document into span-aligned sub-documents of
     about `max_slots` estimated slots each. Returns the sub-texts (>= 2,
     source-order slices of `text`), or None when the document cannot be
     split exactly (single span, or re-segmentation of a slice would not
-    reproduce the document's own spans).
+    reproduce the document's own spans). want_bounds=True returns
+    (subs, bounds) instead, bounds[i] = (a, b) char extent of subs[i]
+    in `text` (subs[i] == text[a:b]) — the LDT_SPANS surface derives
+    span byte offsets from these; None still means "cannot split".
 
     Exactness contract: each returned slice re-segments into exactly the
     spans the full document produced for that range, so packing the
@@ -160,6 +164,7 @@ def split_longdoc(text: str, tables: ScoringTables,
         return None
 
     subs = []
+    bounds = []
     for g in groups:
         a = extents[g[0]][0]
         b = extents[g[-1]][1]
@@ -187,7 +192,8 @@ def split_longdoc(text: str, tables: ScoringTables,
                                            os_.buf[:os_.text_bytes]):
                     return None
         subs.append(sub)
-    return subs
+        bounds.append((a, b))
+    return (subs, bounds) if want_bounds else subs
 
 
 @dataclasses.dataclass

@@ -181,7 +181,8 @@ def chunks_for_doc(text: str, records: list, reg: Registry):
 # -- long-doc chunk merge (the engine's longdoc lane) ------------------------
 
 
-def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
+def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list,
+                         keep_spans: bool = False):
     """Per-chunk score rows of span-aligned sub-documents -> one virtual
     document per group, ready for the flat epilogue.
 
@@ -197,7 +198,15 @@ def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
     fallback/squeeze on ANY sub-doc marks the whole document (those
     resolve via the scalar engine, same as an unsplit fallback). The
     DocTote is purely additive over chunks, so epilogue(merged) ==
-    epilogue(unsplit) whenever the split was span-exact."""
+    epilogue(unsplit) whenever the split was span-exact.
+
+    keep_spans=True returns (merged_rows, merged_cb, span_rows):
+    span_rows[j] lists one (row_start, n_chunks, text_bytes) record per
+    sub-document of group j, with row_start indexing into merged_rows —
+    the per-sub-doc verdict rows the merge used to discard (the
+    LDT_SPANS surface replays the epilogue over each slice for per-span
+    verdicts; tests/test_longdoc_span_merge.py pins that the retained
+    slices sum exactly to the merged totals)."""
     from .native import ChunkBatch
     rows = np.asarray(rows)
     n_out = len(groups)
@@ -217,6 +226,7 @@ def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
     n_slots = np.zeros(n_out, np.int32)
     n_chunks = np.zeros(n_out, np.int32)
 
+    span_rows: list = [[] for _ in range(n_out)] if keep_spans else []
     pos = 0  # write cursor in merged_rows
     for j, (s, n) in enumerate(groups):
         doc_chunk_start[j] = pos
@@ -226,6 +236,8 @@ def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
             nc = int(cb.n_chunks[i])
             g0 = int(cb.doc_chunk_start[i])
             merged_rows[pos:pos + nc] = rows[g0:g0 + nc]
+            if keep_spans:
+                span_rows[j].append((pos, nc, int(cb.text_bytes[i])))
             for pos_d in range(cb.direct_adds.shape[1]):
                 c, lang, nbytes = cb.direct_adds[i, pos_d]
                 if c < 0:
@@ -244,4 +256,6 @@ def merge_longdoc_chunks(rows: np.ndarray, cb, groups: list):
                         fallback=fallback, squeezed=squeezed,
                         n_slots=n_slots, n_chunks=n_chunks,
                         n_docs=n_out)
+    if keep_spans:
+        return merged_rows, merged, span_rows
     return merged_rows, merged

@@ -575,6 +575,26 @@ class DetectorService:
         return degraded_detect(texts, self.scalar_codes, cache=cache,
                                trace=trace)
 
+    def detect_spans_codes(self, texts: list, trace=None) -> list:
+        """Per-span serving (LDT_SPANS=1 requests): list of
+        (code, span_records) per doc, span_records =
+        [(byte_offset, byte_len, code, percent, reliable), ...] tiling
+        the document (docs/ACCURACY.md span contract). Bypasses the
+        codes batcher — the span lane is low-volume and its pack shape
+        (per-sub-doc split) doesn't share the codes path's dedup/cache
+        keys; device engine when available, scalar oracle otherwise
+        (bit-identical either way, tests/test_spans.py)."""
+        reg = self._registry
+        t0 = time.monotonic()
+        if self._engine is not None:
+            rs = self._engine.detect_spans(texts)
+        else:
+            from ..engine_scalar import detect_scalar_spans
+            tables = self._tables
+            rs = [detect_scalar_spans(t, tables, reg) for t in texts]
+        telemetry.observe_stage("spans_detect", t0, trace=trace)
+        return [(reg.code(r.summary_lang), r.spans or []) for r in rs]
+
     def log_processed(self, amount: int = 1):
         """Throughput log every OBJECTS_PER_LOG objects (main.go:209).
         Called from every handler thread, so the window counters live
@@ -806,9 +826,20 @@ class Handler(BaseHTTPRequestHandler):
                 # pool probe vehicles keep retry rights: a lost probe
                 # batch must fail over, not 500 (admission.Admit.probe)
                 trace.no_retry = True
+        # per-span verdicts (LDT_SPANS=1 server side + X-LDT-Spans on
+        # the request); degrade paths drop to plain codes, so brownout
+        # behavior is identical with spans on or off
+        want_spans = (self.headers.get("X-LDT-Spans") is not None
+                      and knobs.get_bool("LDT_SPANS"))
+        spans_list = None
         try:
             if admit is not None and admit.degrade:
                 codes = svc.detect_codes_degraded(texts, trace=trace)
+            elif want_spans:
+                pairs = svc.detect_spans_codes(texts, trace=trace) \
+                    if texts else []
+                codes = [c for c, _ in pairs]
+                spans_list = [s for _, s in pairs]
             else:
                 codes = svc.detect_codes(texts, trace=trace) \
                     if texts else []
@@ -849,7 +880,7 @@ class Handler(BaseHTTPRequestHandler):
                 adm.release(admit)
         t = telemetry.observe_stage("detect", t, trace=trace)
         status, buffers = wire.post_detect(
-            svc, codes, slots, responses, status)
+            svc, codes, slots, responses, status, spans=spans_list)
         telemetry.observe_stage("encode", t, trace=trace)
         self._send_buffers(status, buffers, headers=echo)
         telemetry.finish_request(

@@ -335,20 +335,28 @@ def assemble_response(fragments) -> list:
     return out
 
 
-def post_detect(svc, codes: list, slots, responses: list, status: int):
+def post_detect(svc, codes: list, slots, responses: list, status: int,
+                spans: list | None = None):
     """Detected codes -> (status, writev-style buffer list) + metrics.
     Unknown code answers name "Unknown" with HTTP 203
     (handlers.go:151-166). The buffers concatenate to bytes identical
     to the json.dumps they replace (fragments are built BY json.dumps,
-    once per distinct code)."""
+    once per distinct code). spans (LDT_SPANS requests only): per-item
+    span record lists, spliced into each cached fragment as a "spans"
+    key — the span lane is low-volume, so the per-item dumps is off
+    the main path and span-less responses stay byte-identical."""
     m = svc.metrics
     t0 = time.monotonic()
     lang_counts: dict = {}
     entry = svc._frag_cache.entry
-    for i, code in zip(slots, codes):
+    for pos, (i, code) in enumerate(zip(slots, codes)):
         frag, name, unknown = entry(code)
         if unknown and status == 200:
             status = 203
+        if spans is not None:
+            frag = (frag[:-1] + b', "spans": ' +
+                    json.dumps([list(s) for s in spans[pos] or []],
+                               separators=(",", ":")).encode() + b"}")
         responses[i] = frag
         lang_counts[name] = lang_counts.get(name, 0) + 1
     if codes:
@@ -387,6 +395,10 @@ FRAME_REQID = 0x02                         # flags bit1: 1-byte id length
 #                                            + id bytes follow the tenant
 FRAME_CRC = 0x04                           # flags bit2: u32 crc32(body)
 #                                            follows the reqid bytes
+FRAME_SPANS = 0x08                         # flags bit3: request per-span
+#                                            verdicts (LDT_SPANS=1 server
+#                                            side; ignored when off, so
+#                                            responses stay byte-identical)
 FRAME_CRC_WORD = struct.Struct("!I")
 
 REQUEST_ID_HEADER = "X-LDT-Request-Id"
@@ -420,7 +432,8 @@ def pack_frame(body: bytes, tenant: str | None = None,
                deadline_ms: int | None = None,
                priority: bool = False,
                request_id: str | None = None,
-               crc: bool | None = None) -> bytes:
+               crc: bool | None = None,
+               spans: bool = False) -> bytes:
     """Client-side frame builder. With no admission fields set this
     emits a plain v1 frame, so existing callers (and the parity tests'
     baseline) are untouched; any field promotes the frame to v2. A
@@ -432,10 +445,12 @@ def pack_frame(body: bytes, tenant: str | None = None,
     if crc is None:
         crc = bool(knobs.get_bool("LDT_WIRE_CRC"))
     if tenant is None and deadline_ms is None and not priority \
-            and request_id is None and not crc:
+            and request_id is None and not crc and not spans:
         return FRAME_HEADER.pack(len(body)) + body
     tb = (tenant or "").encode("latin-1")
     flags = FRAME_PRIORITY if priority else 0
+    if spans:
+        flags |= FRAME_SPANS
     rb = b""
     if request_id is not None:
         rb = request_id.encode("ascii")
@@ -528,7 +543,7 @@ def _recv_exact_into(sock, view, n: int) -> bool:
 
 def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
                  tenant=None, deadline_ms=None, priority=False,
-                 request_id=None):
+                 request_id=None, want_spans=False):
     """One UDS request body through the shared wire path ->
     (status, buffer list). Mirrors the HTTP fronts' POST flow
     (admission, degrade ladder, typed errors) minus header parsing;
@@ -536,7 +551,10 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
     header and feed the same per-tenant quota, deadline, brownout, and
     correlation decisions as the HTTP headers they mirror. The
     concatenated buffers are identical to the TCP payload for the same
-    batch."""
+    batch. want_spans (FRAME_SPANS ext flag) asks for per-span
+    verdicts; honored only when LDT_SPANS=1 AND the request is not on
+    a degrade path (spans drop to plain codes under brownout), so a
+    span-less server answers byte-identical v1/v2 responses."""
     from .. import flightrec
     m = svc.metrics
     m.inc("augmentation_requests_total")
@@ -580,9 +598,15 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
         trace.deadline = adm.deadline_from_header(deadline_ms)
         if admit.level >= 1 and not admit.probe:
             trace.no_retry = True
+    spans_list = None
     try:
         if admit is not None and admit.degrade:
             codes = svc.detect_codes_degraded(texts, trace=trace)
+        elif want_spans and knobs.get_bool("LDT_SPANS"):
+            pairs = svc.detect_spans_codes(texts, trace=trace) \
+                if texts else []
+            codes = [c for c, _ in pairs]
+            spans_list = [s for _, s in pairs]
         else:
             codes = detect(texts, trace=trace) if texts else []
     except DeadlineExceeded:
@@ -607,7 +631,8 @@ def handle_frame(svc, body, detect=None, nbytes=None, lane="uds",
         if admit is not None:
             adm.release(admit)
     t = telemetry.observe_stage("detect", t, trace=trace)
-    status, buffers = post_detect(svc, codes, slots, responses, status)
+    status, buffers = post_detect(svc, codes, slots, responses, status,
+                                  spans=spans_list)
     telemetry.observe_stage("encode", t, trace=trace)
     telemetry.finish_request(
         trace, meta=dict(base, docs=len(texts), status=status))
@@ -693,12 +718,14 @@ class UnixFrameServer:
                     priority = False
                     request_id = None
                     crc = None
+                    want_spans = False
                     if length & FRAME_V2_FLAG:
                         length &= ~FRAME_V2_FLAG
                         if not _recv_exact_into(conn, eview, len(ext)):
                             return  # truncated ext header
                         flags, tlen, dl = FRAME_EXT_HEADER.unpack(ext)
                         priority = bool(flags & FRAME_PRIORITY)
+                        want_spans = bool(flags & FRAME_SPANS)
                         if dl:
                             deadline_ms = dl
                         if tlen:
@@ -781,7 +808,8 @@ class UnixFrameServer:
                     status, buffers = handle_frame(
                         svc, buf, detect=self._detect, nbytes=length,
                         tenant=tenant, deadline_ms=deadline_ms,
-                        priority=priority, request_id=request_id)
+                        priority=priority, request_id=request_id,
+                        want_spans=want_spans)
                     send_frame(conn, status, buffers,
                                request_id=request_id)
                 finally:

@@ -384,6 +384,17 @@ METRICS: dict = {
         "gauge",
         "Fraction of the fleet-scope error budget left in the slow "
         "window (1.0 = untouched, 0 = fully burned)."),
+    # -- accuracy plane (evalsuite.py, detect_spans lane) -------------
+    "ldt_span_docs_total": (
+        "counter",
+        "Documents answered through the per-span lane (detect_spans; "
+        "LDT_SPANS=1 surfaces). Each doc also appears in the regular "
+        "dispatch counters — this series measures span-lane share."),
+    "ldt_eval_docs_total": (
+        "counter",
+        "Labeled corpus documents scored by the eval scorecard "
+        "(evalsuite.run_eval; bench.py --eval). Counts per run, so "
+        "rate() over it shows scorecard cadence, not serving load."),
 }
 
 

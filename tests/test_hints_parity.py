@@ -142,3 +142,129 @@ def test_apply_hints_whacks():
                       CLDHints(content_language_hint="id,ms"), tables,
                       registry)
     assert not hb3.whack_latn  # both of the set hinted: no whack
+
+
+# -- device prior term vs the numpy scalar-oracle extension ------------------
+#
+# The LDT_HINTS reduction term (ops/score.py _chunk_out_word prior add,
+# post-whack / pre-top-2) is defined against evalsuite.oracle_score_chunks
+# — a pure-numpy op-for-op mirror of the device program. The contract is
+# BIT-identity of the packed chunk words under EVERY kernel mode, with
+# and without priors on the wire, and byte-identity of prior-free wires
+# (hint-off batches must trace the identical program they always did).
+
+
+import numpy as np  # noqa: E402
+
+PRIOR_TEXTS = [
+    "the quick brown fox jumps over the lazy dog near the river bank",
+    TEXT_ID_MS,
+    TEXT_HR,
+    TEXT_EN,
+    "это русское предложение о языках и обнаружении текста",
+    "これは日本語の文章ですよろしくお願いします",
+    "dit is een nederlandse zin over taaldetectie en andere dingen",
+]
+
+
+@pytest.fixture(scope="module")
+def eng():
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine()
+
+
+def _hinted_pack(tables, with_priors):
+    """PRIOR_TEXTS packed with per-doc content-language boosts; when
+    with_priors, the same boosts also become cprior/prior_tbl wire
+    planes (the LDT_HINTS=1 reduction input)."""
+    from language_detector_tpu.hints import prior_vector
+    codes = ["id", "ms", "sr", "fr", "uk", "ja", "af"]
+    hbs = [apply_hints(t, True, CLDHints(content_language_hint=c),
+                       tables, registry)
+           for t, c in zip(PRIOR_TEXTS, codes)]
+    pvs = [prior_vector(hb, tables) for hb in hbs] \
+        if with_priors else None
+    from language_detector_tpu import native
+    return native.pack_chunks_native(PRIOR_TEXTS, tables, registry,
+                                     hint_boosts=hbs, hint_priors=pvs)
+
+
+def _device_modes(eng):
+    """(name, score_fn) for every LDT_KERNEL program, pallas via the
+    interpreter (the Mosaic lowering runs the identical kernel body)."""
+    from language_detector_tpu.ops import kernels
+    from language_detector_tpu.ops.score import score_chunks
+    modes = [("xla", score_chunks),
+             ("fused", kernels.score_chunks_fused),
+             ("lax", kernels.score_chunks_lax)]
+    ps, _, _ = kernels._pallas_score_fns(interpret=True)
+    modes.append(("pallas-interpret", ps))
+    return modes
+
+
+@pytest.mark.parametrize("with_priors", [False, True],
+                         ids=["no-prior", "prior"])
+def test_device_matches_numpy_oracle_all_modes(eng, with_priors):
+    """Every kernel mode emits the oracle's packed words bit-for-bit,
+    priors on the wire or not."""
+    from language_detector_tpu.evalsuite import oracle_score_chunks
+    cb = _hinted_pack(eng.tables, with_priors)
+    assert ("cprior" in cb.wire) == with_priors
+    want = oracle_score_chunks(eng.tables, registry, cb.wire)
+    for name, score in _device_modes(eng):
+        got = np.asarray(score(eng.dt, cb.wire))
+        assert np.array_equal(got, want), \
+            (name, np.flatnonzero(got != want)[:8])
+
+
+def test_prior_free_wire_identical():
+    """hint_priors=None and an all-None prior list build the same wire:
+    no cprior/prior_tbl keys, every shared plane byte-identical — the
+    hint-off acceptance gate at the wire level."""
+    from language_detector_tpu import native
+    tables = load_tables()
+    cb0 = native.pack_chunks_native(PRIOR_TEXTS, tables, registry)
+    cb1 = native.pack_chunks_native(PRIOR_TEXTS, tables, registry,
+                                    hint_priors=[None] *
+                                    len(PRIOR_TEXTS))
+    assert "cprior" not in cb0.wire and "cprior" not in cb1.wire
+    assert "prior_tbl" not in cb1.wire
+    assert set(cb0.wire) == set(cb1.wire)
+    for k in cb0.wire:
+        np.testing.assert_array_equal(np.asarray(cb0.wire[k]),
+                                      np.asarray(cb1.wire[k]),
+                                      err_msg=k)
+
+
+def test_hint_prior_flips_documented_demo():
+    """The documented ambiguous-document flip (docs/ACCURACY.md): the
+    content-language prior changes the verdict, and the prior-free pack
+    answers exactly as before."""
+    from language_detector_tpu.evalsuite import hint_flip_demo
+    demo = hint_flip_demo()
+    assert demo["flipped"], demo
+    assert demo["after"] == "id"
+    assert demo["before"] != "id"
+
+
+def test_prior_never_promotes_unscored_language(eng):
+    """A prior only amplifies positive chunk evidence: a document with
+    zero tote score for the hinted language answers identically with
+    and without the prior (the where(scores > 0) guard)."""
+    from language_detector_tpu import native
+    from language_detector_tpu.evalsuite import oracle_score_chunks
+    from language_detector_tpu.hints import prior_vector
+    tables = eng.tables
+    text = "これは日本語の文章ですよろしくお願いします"  # no Latin evidence
+    hb = apply_hints(text, True,
+                     CLDHints(content_language_hint="fr"), tables,
+                     registry)
+    pv = prior_vector(hb, tables)
+    assert pv is not None
+    cb0 = native.pack_chunks_native([text], tables, registry,
+                                    hint_boosts=[hb])
+    cb1 = native.pack_chunks_native([text], tables, registry,
+                                    hint_boosts=[hb], hint_priors=[pv])
+    w0 = oracle_score_chunks(tables, registry, cb0.wire)
+    w1 = oracle_score_chunks(tables, registry, cb1.wire)
+    np.testing.assert_array_equal(w0, w1)

@@ -1,0 +1,110 @@
+"""merge_longdoc_chunks keep_spans regression: nothing discarded.
+
+Before the span work, the long-doc merge threw away the per-sub-doc
+verdict rows after concatenating them into the virtual document. The
+LDT_SPANS lane needs those rows back (each sub-doc slice replays the
+epilogue for its span verdict), so keep_spans=True returns span_rows:
+one (row_start, n_chunks, text_bytes) record per sub-document, indexing
+into merged_rows. This file pins the no-waste invariant — the retained
+slices are exactly the source rows, and their counts and byte totals
+sum to the merged document's totals — and that keep_spans=False is
+byte-for-byte the merge it always was.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from language_detector_tpu import native
+from language_detector_tpu.engine_scalar import split_for_spans
+from language_detector_tpu.registry import registry
+from language_detector_tpu.result_vector import merge_longdoc_chunks
+from language_detector_tpu.tables import load_tables
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return load_tables()
+
+
+def _split_pack(texts, tables, budget=8):
+    """texts -> (sub-doc ChunkBatch, groups, synthetic rows). A tiny
+    budget forces real multi-sub-doc groups; rows are a distinct-value
+    ramp so slice placement is pinned exactly, not just by shape."""
+    subs_all, groups = [], []
+    for t in texts:
+        subs, _ = split_for_spans(t, tables, budget)
+        groups.append((len(subs_all), len(subs)))
+        subs_all.extend(subs)
+    cb = native.pack_chunks_native(subs_all, tables, registry)
+    G = int(cb.n_chunks.sum())
+    rows = np.arange(max(G, 1) * 5, dtype=np.int32).reshape(-1, 5)
+    return cb, groups, rows
+
+
+TEXTS = [
+    ("hello world this is a plainly english document " * 6 +
+     "это русское предложение о языках и текстах " * 6),
+    ("bonjour le monde ceci est une phrase en francais " * 5 +
+     "これは日本語の文章ですよろしくお願いします" * 5 +
+     "and back to english words for the tail of the document " * 4),
+    "short single-span doc",
+]
+
+
+def test_span_rows_sum_to_merged_totals(tables):
+    """The headline invariant: per-group span records partition the
+    merged document's chunk rows and byte total exactly."""
+    cb, groups, rows = _split_pack(TEXTS, tables)
+    assert any(n > 1 for _, n in groups)  # the budget actually split
+    mrows, mcb, span_rows = merge_longdoc_chunks(rows, cb, groups,
+                                                 keep_spans=True)
+    assert len(span_rows) == len(groups)
+    for j, (s, n) in enumerate(groups):
+        recs = span_rows[j]
+        assert len(recs) == n  # one record per sub-document
+        assert sum(nc for _, nc, _ in recs) == int(mcb.n_chunks[j])
+        assert sum(tb for _, _, tb in recs) == int(mcb.text_bytes[j])
+        assert int(mcb.text_bytes[j]) == \
+            int(cb.text_bytes[s:s + n].sum())
+        # records are contiguous from the document's first merged row
+        pos = int(mcb.doc_chunk_start[j])
+        for rs, nc, _ in recs:
+            assert rs == pos
+            pos += nc
+
+
+def test_retained_slices_equal_source_rows(tables):
+    """Each retained slice of merged_rows is bit-identical to the
+    sub-document's original row range — the rows the merge used to
+    discard."""
+    cb, groups, rows = _split_pack(TEXTS, tables)
+    mrows, mcb, span_rows = merge_longdoc_chunks(rows, cb, groups,
+                                                 keep_spans=True)
+    for j, (s, n) in enumerate(groups):
+        for k, (rs, nc, tb) in enumerate(span_rows[j]):
+            i = s + k
+            g0 = int(cb.doc_chunk_start[i])
+            assert nc == int(cb.n_chunks[i])
+            assert tb == int(cb.text_bytes[i])
+            np.testing.assert_array_equal(mrows[rs:rs + nc],
+                                          rows[g0:g0 + nc])
+
+
+def test_keep_spans_false_unchanged(tables):
+    """keep_spans=False returns the 2-tuple shape with the identical
+    merge — the flag may not perturb the long-doc lane."""
+    cb, groups, rows = _split_pack(TEXTS, tables)
+    out0 = merge_longdoc_chunks(rows, cb, groups)
+    assert len(out0) == 2
+    mrows0, mcb0 = out0
+    mrows1, mcb1, _ = merge_longdoc_chunks(rows, cb, groups,
+                                           keep_spans=True)
+    np.testing.assert_array_equal(mrows0, mrows1)
+    np.testing.assert_array_equal(mcb0.n_chunks, mcb1.n_chunks)
+    np.testing.assert_array_equal(mcb0.text_bytes, mcb1.text_bytes)
+    np.testing.assert_array_equal(mcb0.doc_chunk_start,
+                                  mcb1.doc_chunk_start)
+    np.testing.assert_array_equal(mcb0.direct_adds, mcb1.direct_adds)
+    np.testing.assert_array_equal(mcb0.fallback, mcb1.fallback)
+    np.testing.assert_array_equal(mcb0.squeezed, mcb1.squeezed)

@@ -1,0 +1,177 @@
+"""Per-span output bit-identity: device lane vs scalar oracle.
+
+The LDT_SPANS surface answers every document with `.spans` records
+(byte_offset, byte_len, iso_code, percent, reliable) tiling the
+document bytes. The contract (docs/ACCURACY.md) is BIT-identity, not
+approximate agreement: the device lane (models/ngram.py detect_spans —
+split, one flat pack, unmerged per-sub-doc epilogue) must emit exactly
+the records the scalar oracle (engine_scalar.detect_scalar_spans)
+emits, on every document of a multi-script corpus, including the docs
+whose sub-documents fall back or fail the gate. And when spans are NOT
+requested, nothing may change: span-less service responses stay
+byte-identical with the knob off.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from language_detector_tpu.engine_scalar import (SPAN_SPLIT_SLOTS,
+                                                 detect_scalar,
+                                                 detect_scalar_spans)
+from language_detector_tpu.evalsuite import corpus_pairs
+from language_detector_tpu.registry import registry
+
+
+@pytest.fixture(scope="module")
+def eng():
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    return NgramBatchEngine()
+
+
+def _span_corpus() -> list:
+    """>= 100 multi-script docs: the eval corpus plus cross-script
+    concatenations (the docs that actually produce multiple spans)."""
+    pairs = corpus_pairs()
+    texts = [t for _, t in pairs][:90]
+    by_code = dict(pairs)
+    mixes = [("en", "ru"), ("fr", "ja"), ("de", "ar"), ("es", "el"),
+             ("it", "zh"), ("pt", "iw"), ("nl", "th"), ("sv", "ko"),
+             ("pl", "hi"), ("tr", "uk")]
+    for a, b in mixes:
+        texts.append(by_code[a] + " " + by_code[b])
+        texts.append(by_code[b] + " " + by_code[a] + " " + by_code[a])
+    texts += ["", "a", "   ", "é"]
+    assert len(texts) >= 100
+    return texts
+
+
+def _records(r):
+    return (r.summary_lang, tuple(r.language3), tuple(r.percent3),
+            tuple(r.normalized_score3), r.is_reliable, r.text_bytes,
+            tuple(tuple(s) for s in (r.spans or [])))
+
+
+def test_device_spans_bit_identical_to_scalar(eng):
+    """The acceptance gate: >= 100-doc multi-script corpus, every span
+    record and every summary field identical between the device lane
+    and the scalar oracle."""
+    texts = _span_corpus()
+    got = eng.detect_spans(texts)
+    assert len(got) == len(texts)
+    for text, r in zip(texts, got):
+        want = detect_scalar_spans(text, eng.tables, eng.reg,
+                                   eng.flags)
+        assert _records(r) == _records(want), text[:60]
+
+
+def test_spans_tile_document_bytes(eng):
+    """Spans are a partition of the document's bytes: offsets start at
+    0, are contiguous, and sum to the UTF-8 length."""
+    texts = _span_corpus()
+    for text, r in zip(texts, eng.detect_spans(texts)):
+        spans = r.spans or []
+        nbytes = len(text.encode("utf-8"))
+        if nbytes == 0:
+            continue
+        assert spans, text[:60]
+        pos = 0
+        for off, ln, code, pct, rel in spans:
+            assert off == pos and ln > 0
+            assert isinstance(code, str) and 0 <= pct <= 100
+            assert isinstance(rel, bool)
+            pos += ln
+        assert pos == nbytes
+
+
+def test_small_budget_forces_splits_and_stays_identical(eng):
+    """A tiny per-sub-doc chunk budget forces every long doc through
+    the split path (multiple sub-docs -> multiple spans) without
+    perturbing the records: both engines split at the same exact span
+    boundaries, so identity must survive any budget."""
+    from language_detector_tpu.models.ngram import NgramBatchEngine
+    small = NgramBatchEngine(eng.tables, eng.reg,
+                             longdoc_chunk_slots=8)
+    pairs = corpus_pairs()
+    by_code = dict(pairs)
+    texts = [(by_code["en"] + " " + by_code["ru"]) * 2,
+             (by_code["ja"] + by_code["fr"]) * 3,
+             by_code["ar"] + " " + by_code["el"] + " " + by_code["de"]]
+    for text in texts:
+        r = small.detect_spans([text])[0]
+        want = detect_scalar_spans(text, eng.tables, eng.reg,
+                                   eng.flags, 8)
+        assert _records(r) == _records(want)
+        assert len(r.spans) > 1  # the budget actually split
+
+
+def test_span_summary_matches_unsplit_answer(eng):
+    """The whole-document summary riding a spans result is the same
+    verdict the plain (unsplit) path gives — the longdoc-lane merge
+    invariant surfaced through detect_spans."""
+    texts = [t for _, t in corpus_pairs()][:30]
+    got = eng.detect_spans(texts)
+    for text, r in zip(texts, got):
+        want = detect_scalar(text, eng.tables, eng.reg, eng.flags)
+        assert r.summary_lang == want.summary_lang
+        assert r.language3 == want.language3
+        assert r.percent3 == want.percent3
+
+
+def test_spans_off_responses_byte_identical(monkeypatch):
+    """LDT_SPANS=0 (or an un-flagged frame) answers with the exact
+    bytes the pre-span service produced: the span lane may not perturb
+    the default wire path."""
+    from language_detector_tpu.service import wire
+    from language_detector_tpu.service.server import DetectorService
+    monkeypatch.delenv("LDT_SPANS", raising=False)
+    svc = DetectorService(use_device=False)
+    body = json.dumps({"request": [
+        {"text": "hello world this is plainly english text"},
+        {"text": "bonjour le monde ceci est une phrase"},
+    ]}).encode()
+    s_plain, c_plain = wire.handle_frame(svc, body, want_spans=False)
+    # flag set but knob off: byte-identical
+    s_flag, c_flag = wire.handle_frame(svc, body, want_spans=True)
+    assert s_flag == s_plain
+    assert b"".join(c_flag) == b"".join(c_plain)
+    assert b"spans" not in b"".join(c_plain)
+    # knob on + flag: spans field appears, same verdict codes
+    monkeypatch.setenv("LDT_SPANS", "1")
+    s_on, c_on = wire.handle_frame(svc, body, want_spans=True)
+    assert s_on == s_plain
+    payload = json.loads(b"".join(c_on))
+    plain = json.loads(b"".join(c_plain))
+    for r_on, r_off in zip(payload["response"], plain["response"]):
+        spans = r_on.pop("spans")
+        assert r_on == r_off
+        assert spans and spans[0][0] == 0
+    # knob on but frame un-flagged: still byte-identical
+    s_noflag, c_noflag = wire.handle_frame(svc, body, want_spans=False)
+    assert b"".join(c_noflag) == b"".join(c_plain)
+
+
+def test_frame_spans_flag_roundtrip():
+    """FRAME_SPANS rides the v2 frame extension; span-less pack_frame
+    calls still emit the v1 short form."""
+    from language_detector_tpu.service import wire
+    v1 = wire.pack_frame(b"x")
+    v2 = wire.pack_frame(b"x", spans=True)
+    assert v1 != v2
+    assert len(v1) < len(v2)  # v1 short form kept when spans unset
+
+
+def test_detector_surface_spans(eng):
+    """LanguageDetector.detect_spans surfaces the records through the
+    public DetectionResult."""
+    from language_detector_tpu.detector import LanguageDetector
+    det = LanguageDetector(eng.tables, eng.reg)
+    det._batch_engine = eng
+    texts = ["hello world this is english text ok",
+             "это русское предложение о языках"]
+    rs = det.detect_spans(texts)
+    for text, r in zip(texts, rs):
+        assert r.spans and r.spans[0][0] == 0
+        assert sum(s[1] for s in r.spans) == len(text.encode("utf-8"))
