@@ -18,11 +18,12 @@ sys.path.insert(0, str(REPO))
 PER_CHIP_TARGET = 1_000_000 / 8  # docs/sec (BASELINE.json north star, v5e-8)
 
 # Budget for one full `python -m tools.lint` run (all analyzers, whole
-# tree, including the bounded model checker). ci.sh runs the suite on
-# every pass, so --smoke measures it and fails when it stops being
-# cheap; the live run is ~1.5s, so 30s absorbs a loaded CI host
-# without hiding a real regression (an accidental state-space blowup
-# in the model checker lands well past this).
+# tree, including the bounded model checker and the torn-write crash
+# schedules). ci.sh runs the suite on every pass, so --smoke measures
+# it and fails when it stops being cheap; the live run is ~4s, so 30s
+# absorbs a loaded CI host without hiding a real regression (an
+# accidental state-space or crash-schedule blowup lands well past
+# this).
 LINT_BUDGET_MS = 30_000
 
 # Per-record budgets for the always-on observability hot paths: one

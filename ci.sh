@@ -18,11 +18,12 @@ python3 tools/artifact_tool.py --verify
 echo "== static analysis =="
 # AST lint (docs/STATIC_ANALYSIS.md): trace safety, jit contracts,
 # lock discipline, knob/metric/fault registries, FSM conformance,
-# bounded model checking, future resolution. Non-zero on any
-# violation. CI always runs the FULL suite; `python3 -m tools.lint
-# --changed` is the git-diff-scoped variant for the local edit loop
-# (it can skip analyzers, never weaken them — registry or tools/lint
-# changes fall back to a full run).
+# bounded model checking, future resolution, and the binary-protocol
+# plane (layout registry, publish-order, torn-write crash schedules).
+# Non-zero on any violation. CI always runs the FULL suite;
+# `python3 -m tools.lint --changed` is the git-diff-scoped variant
+# for the local edit loop (it can skip analyzers, never weaken them —
+# registry or tools/lint changes fall back to a full run).
 python3 -m tools.lint
 
 if python3 -c "import mypy" 2>/dev/null; then
@@ -303,6 +304,116 @@ assert det["uds_docs_sec"] >= 0.3 * eng, \
 print(f"http front: {d['value']} docs/s ({ratio:.2f}x engine), "
       f"uds {det['uds_docs_sec']} docs/s, "
       f"fast-path hit rate {det['parse_fast_hit_rate']}")
+EOF
+
+echo "== torn-write smoke =="
+# a real crash, not just a model: SIGKILL a capture-ring writer and a
+# shm-ring client mid-record under the lock watchdog, then prove the
+# readers accept only whole committed records; finally re-prove the
+# crash-schedule product and its broken-protocol detector
+# (docs/STATIC_ANALYSIS.md, tools/lint/torn_write.py)
+LDT_LOCK_DEBUG=1 python3 - <<'EOF'
+import glob
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+from language_detector_tpu import capture as cap
+from language_detector_tpu.service import shmring as sm
+
+# -- capture ring: writer SIGKILLed mid-append ------------------------
+td = tempfile.mkdtemp(prefix="ldt-ci-torn-cap-")
+pid = os.fork()
+if pid == 0:
+    try:
+        w = cap.CaptureWriter(td, ring_records=64, sample=1.0,
+                              max_segments=4)
+        i = 0
+        while True:
+            w.append((i, i, 0, i % 64, 0.0, 1.0, 0.1, 0.2, 0.3,
+                      200, 1, 0, 0, 0))
+            i += 1
+    finally:
+        os._exit(1)
+deadline = time.time() + 10.0
+while time.time() < deadline and not cap.read_capture(td):
+    time.sleep(0.01)
+time.sleep(0.05)                      # let the writer get mid-record
+os.kill(pid, signal.SIGKILL)
+os.waitpid(pid, 0)
+recs = cap.read_capture(td)
+assert recs, "killed capture writer left no committed records"
+for r in recs:
+    # docs and arrival were written from the same counter: a torn
+    # half-record accepted by the reader cannot keep them consistent
+    assert r["docs"] == r["arrival_mono_ns"] % 64, r
+    assert r["status"] == 200 and r["total_ms"] == 1.0, r
+ring = glob.glob(os.path.join(td, "capture-*.ring"))[0]
+data = open(ring, "rb").read()
+committed = sum(
+    1 for i in range(64)
+    if cap.COMMIT.unpack_from(
+        data, cap.FILE_HDR.size + i * cap.SLOT_BYTES)[0] == i + 1)
+live = len(cap._read_file(ring))
+assert live == committed, (live, committed)
+
+# -- shm ring: client SIGKILLed mid-submit ----------------------------
+td2 = tempfile.mkdtemp(prefix="ldt-ci-torn-shm-")
+pid = os.fork()
+if pid == 0:
+    try:
+        c = sm.RingClient(td2, slots=4, slot_bytes=4096)
+        c.rf.set_generation(1, os.getpid())
+        i = 0
+        while True:
+            body = json.dumps({"k": i, "pad": "x" * (i % 7)}).encode()
+            s = c.submit(body)
+            if s is not None and s > 0:   # play the worker: free the
+                c.rf.write_slot(s, sm.SLOT_FREE, 0, 0, 0.0, 0, 0)
+                c.slots[s] = sm.RingSlot(s)   # slot (slot 0 is left
+                                              # READY for the parent)
+            i += 1
+    finally:
+        os._exit(1)
+deadline = time.time() + 10.0
+ring2 = None
+while time.time() < deadline and ring2 is None:
+    found = glob.glob(os.path.join(td2, "*.ring"))
+    ring2 = found[0] if found else None
+    time.sleep(0.01)
+assert ring2, "shm client never created its ring"
+time.sleep(0.2)                       # let submits spin mid-store
+os.kill(pid, signal.SIGKILL)
+os.waitpid(pid, 0)
+rf = sm.RingFile(ring2)
+ready = 0
+for i in range(rf.nslots):
+    st, gen, wpid, ts, ln, status = rf.read_slot(i)
+    assert st in (sm.SLOT_FREE, sm.SLOT_WRITING, sm.SLOT_READY,
+                  sm.SLOT_LEASED, sm.SLOT_DONE), st
+    if st == sm.SLOT_READY:
+        # READY is the commit word: the payload under it must be the
+        # whole frame the dead client stored, never a torn prefix
+        doc = json.loads(rf.read_payload(i, ln))
+        assert doc["k"] >= 0 and doc["pad"] == "x" * (doc["k"] % 7)
+        ready += 1
+assert ready >= 1, "slot 0 should have stayed READY"
+rf.close()
+
+# -- the exhaustive model over the same writers -----------------------
+from tools.lint import torn_write
+
+failures, n, exhausted = torn_write.run_product("torn-capture")
+assert failures == [] and exhausted and n > 10, (failures, n)
+bad, _n2, _e2 = torn_write.run_product(
+    "torn-capture", writer=torn_write.doctored_capture_commit_first)
+assert bad, "doctored commit-first writer must yield a counterexample"
+print(f"torn-write smoke: capture reader kept {len(recs)} whole "
+      f"records after SIGKILL, shm ring coherent ({ready} READY), "
+      f"product exhausted {n} schedules, doctored writer caught")
 EOF
 
 echo "== overload smoke =="

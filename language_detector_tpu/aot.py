@@ -59,6 +59,12 @@ MAGIC = b"LDTAOT1\n"
 _LEN = struct.Struct("<Q")
 _CRC = struct.Struct("<I")
 
+# pinned bundle geometry: a drive-by field edit must fail at import,
+# not strand every deployed AOT sidecar bundle
+# (tools/lint/layout_registry.py declares the same widths)
+assert _LEN.size == 8
+assert _CRC.size == 4
+
 # memo sentinel: the bundle has no (usable) entry for this shape — the
 # compile path owns it now and will write one back
 _ABSENT = object()

@@ -52,6 +52,13 @@ _HDR = struct.Struct("<IIII QQ")
 _DESC = struct.Struct("<48s8sI 4Q QQ")
 _FOOT = struct.Struct("<II")
 
+# pinned artifact geometry: a drive-by field edit must fail at import,
+# not invalidate every packed model.ldta in the field
+# (tools/lint/layout_registry.py declares the same widths)
+assert _HDR.size == 32
+assert _DESC.size == 108
+assert _FOOT.size == 8
+
 
 class ArtifactError(ValueError):
     """A corrupt, truncated, or wrong-version artifact file. Subclasses

@@ -75,6 +75,13 @@ COMMIT = struct.Struct("<I")         # per-slot commit word (index + 1)
 RECORD = struct.Struct("<QQQIfffffHBBBB")
 SLOT_BYTES = COMMIT.size + RECORD.size
 
+# pinned on-disk geometry: a drive-by field edit must fail at import,
+# not corrupt capture rings or strand sealed segments
+# (tools/lint/layout_registry.py declares the same widths)
+assert FILE_HDR.size == 36
+assert COMMIT.size == 4
+assert RECORD.size == 54
+
 LANES = {"tcp": 0, "uds": 1, "shm": 2}
 LANE_NAMES = {v: k for k, v in LANES.items()}
 # both HTTP fronts are the tcp lane; wire.handle_frame tags uds/shm

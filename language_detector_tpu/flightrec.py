@@ -55,6 +55,12 @@ FILE_HDR = struct.Struct("<4sIIIId")   # magic, version, slots,
 #                                        slot_bytes, pid, start_ts
 SLOT_HDR = struct.Struct("<IId")       # commit seq, payload len, ts
 
+# pinned on-disk geometry: a drive-by field edit must fail at import,
+# not corrupt rings at runtime (tools/lint/layout_registry.py declares
+# the same widths; layout-drift keeps module and registry in sync)
+assert FILE_HDR.size == 28
+assert SLOT_HDR.size == 16
+
 # Declared event types: name -> (category, operator-facing doc). The
 # event-registry analyzer (tools/lint/event_registry.py) keeps this
 # dict, the emit_event call sites, and the event table in
@@ -161,10 +167,12 @@ class FlightRecorder:
             time.time())
 
     def emit(self, name: str, fields: dict) -> bool:
-        """Write one event. Publish order: payload + header tail
-        first, the 4-byte commit/seq word LAST — its store is the
-        publication point, so a reader (even of a SIGKILLed writer's
-        file) never sees a committed-but-torn record."""
+        """Write one event. Publish order: zero the slot's commit
+        word (a wrapped slot holds the previous lap's committed
+        record), then payload + header tail, then the 4-byte
+        commit/seq word LAST — its store is the publication point, so
+        a reader (even of a SIGKILLed writer's file) never sees a
+        committed-but-torn record."""
         payload = json.dumps({"ev": name, **fields},
                              separators=(",", ":"),
                              default=str).encode("utf-8")
@@ -184,6 +192,12 @@ class FlightRecorder:
             rec = SLOT_HDR.pack(seq & 0xFFFFFFFF, len(payload),
                                 time.time())
             mm = self.mm
+            # after the first lap this slot still holds a COMMITTED
+            # record: zero its seq word before touching the tail or
+            # payload, or a crash mid-rewrite leaves the OLD seq
+            # presiding over NEW length/payload bytes — a torn record
+            # a reader would accept
+            mm[off:off + 4] = b"\0\0\0\0"
             mm[off + 4:off + SLOT_HDR.size] = rec[4:]
             mm[off + SLOT_HDR.size:off + SLOT_HDR.size + len(payload)] \
                 = payload

@@ -70,6 +70,13 @@ PROBE_WINDOW = 8
 
 _U32 = struct.Struct("<I")
 
+# pinned shm geometry: a drive-by field edit must fail at import, not
+# hand torn slots to every attached worker
+# (tools/lint/layout_registry.py declares the same widths)
+assert _HEADER.size == 20
+assert _SLOT_HDR.size == 40
+assert _U32.size == 4
+
 
 def _key_hash(key) -> bytes:
     """16-byte content hash of a (hints_key, text) cache key. repr of

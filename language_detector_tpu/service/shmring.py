@@ -86,6 +86,12 @@ SLOT_HDR = struct.Struct("<IIII d II")   # state, generation, owner_pid,
 #                                          reserved, lease_ts, length,
 #                                          status
 
+# pinned shm geometry: a drive-by field edit must fail at import, not
+# tear slots under every attached peer
+# (tools/lint/layout_registry.py declares the same widths)
+assert RING_HDR.size == 32
+assert SLOT_HDR.size == 32
+
 # Slot lifecycle states, declared in tools/lint/fsm_registry.py
 # (machine "shm-slot"): RingSlot.state only moves through the guarded
 # mark_* methods below, so the conformance pass proves every write

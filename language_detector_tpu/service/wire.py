@@ -401,6 +401,14 @@ FRAME_SPANS = 0x08                         # flags bit3: request per-span
 #                                            responses stay byte-identical)
 FRAME_CRC_WORD = struct.Struct("!I")
 
+# pinned v1/v2 wire widths: a drive-by field edit must fail at import,
+# not desync every deployed client mid-stream
+# (tools/lint/layout_registry.py declares the same widths)
+assert FRAME_HEADER.size == 4
+assert FRAME_RESP_HEADER.size == 6
+assert FRAME_EXT_HEADER.size == 7
+assert FRAME_CRC_WORD.size == 4
+
 REQUEST_ID_HEADER = "X-LDT-Request-Id"
 _REQID_RE = re.compile(r"[A-Za-z0-9._\-]{1,64}\Z")
 

@@ -157,3 +157,58 @@ def test_publish_order_commit_word_last(ring):
                             off + SLOT_HDR.size + 64])
     assert json.loads(payload[:payload.index(b"}") + 1])["ev"] \
         == "fault_fired"
+
+
+class _Journal:
+    """mm wrapper recording every (offset, bytes) store while applying
+    it — lets a test replay crash prefixes of a real emit()."""
+
+    def __init__(self, mm):
+        self.mm = mm
+        self.stores: list = []
+
+    def __setitem__(self, idx, val):
+        self.mm[idx] = val
+        self.stores.append((idx.start, bytes(val)))
+
+    def __getitem__(self, idx):
+        return self.mm[idx]
+
+
+def test_wrap_invalidates_commit_word_before_rewrite(ring, tmp_path):
+    """Regression: emit() on a wrapped slot must zero the previous
+    lap's commit word BEFORE storing the new tail/payload. The old
+    code's first store was the header tail, so a crash between the
+    payload and the final commit left the OLD seq word presiding over
+    NEW payload bytes — a torn record read_ring accepted."""
+    for i in range(8):
+        ring.emit("request_end", {"status": 200, "n": i})
+    base = bytes(ring.mm[:])
+    j = _Journal(ring.mm)
+    ring.mm = j
+    try:
+        ring.emit("request_end", {"status": 200, "n": 8})
+    finally:
+        ring.mm = j.mm
+    off = FILE_HDR.size            # seq 9 wraps onto slot 0
+    # store order is the contract: invalidate first, commit last
+    assert j.stores[0] == (off, b"\0\0\0\0")
+    last_off, last_data = j.stores[-1]
+    assert (last_off, len(last_data)) == (off, 4)
+    assert struct.unpack("<I", last_data)[0] == 9
+    # crash-replay every store prefix: the reader returns only whole
+    # committed records, never an old-seq/new-payload hybrid
+    allowed = {(i + 1, i) for i in range(9)}
+    probe = tmp_path / "crash.ring"
+    for k in range(len(j.stores) + 1):
+        state = bytearray(base)
+        for soff, data in j.stores[:k]:
+            state[soff:soff + len(data)] = data
+        probe.write_bytes(state)
+        events = flightrec.read_ring(str(probe))["events"]
+        seen = {(e["seq"], e["n"]) for e in events}
+        assert seen <= allowed, f"torn record after {k} stores: {seen}"
+        if k >= 1:                 # once invalidated, slot 0's old
+            assert all(s != 1 for s, _ in seen)   # record never
+                                                  # resurfaces torn
+    assert (9, 8) in seen                     # full replay publishes

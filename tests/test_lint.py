@@ -406,6 +406,10 @@ def test_changed_scoping_runs_only_touched_scopes():
     # run whole-tree (they are only sound that way) — still clean
     assert run(root=REPO,
                changed={"language_detector_tpu/ops/score.py"}) == 0
+    # a protocol file: layout/publish-order/torn-write scope to it
+    # (one torn product runs, not all four) — still clean
+    assert run(root=REPO,
+               changed={"language_detector_tpu/capture.py"}) == 0
     # docs-only change: nothing to analyze, vacuously clean
     assert run(root=REPO, changed={"README.md"}) == 0
     assert run(root=REPO, changed=set()) == 0
@@ -420,3 +424,138 @@ def test_changed_cli_falls_back_to_full_on_lint_changes(tmp_path):
     # tree the CLI must announce the full-run fallback
     if "registry/analyzer files changed" in r.stderr:
         assert "clean" in r.stdout
+
+
+# -- layout registry ---------------------------------------------------------
+
+from tools.lint import layout_registry, publish_order  # noqa: E402
+
+_LG = f"{FIX}/layout_good.py"
+_LB = f"{FIX}/layout_bad.py"
+
+
+def _layout(name, file, var, fmt, size, **kw):
+    return layout_registry.Layout(
+        name, file, var, fmt, size, ("a", "b", "c"), "fixture", **kw)
+
+
+_LAYOUT_GOOD = (
+    _layout("fix-rec", _LG, "REC", "<IHH", 8,
+            writers=(f"{_LG}::write_rec",),
+            readers=(f"{_LG}::read_rec",)),
+)
+
+_LAYOUT_BAD = (
+    _layout("fix-rec", _LB, "REC", "<IHH", 8,
+            writers=(f"{_LB}::write_rec",),
+            readers=(f"{_LB}::read_rec",)),
+    _layout("fix-gone", _LB, "GONE", "<I", 4),
+    _layout("fix-word", _LB, "WORD", "<I", 4),
+)
+
+
+def test_layout_bad_fixture_trips_every_rule():
+    v, _ = layout_registry.check(root=REPO, files=[_LB],
+                                 layouts=_LAYOUT_BAD)
+    rules = _rules(v)
+    # REC format drift + REC missing width assert + GONE missing from
+    # the module + WORD assert pinning the wrong width
+    assert rules["layout-drift"] == 4
+    # EXTRA module Struct, the inline "<ff" pack, the ad-hoc Struct
+    assert rules["layout-undeclared"] == 3
+    # declared writer/reader gone both ways + the undeclared stray
+    assert rules["layout-reader-writer-mismatch"] == 3
+    assert sum(rules.values()) == 10
+    texts = "\n".join(x.message for x in v)
+    assert "write_rec no longer packs" in texts
+    assert "read_rec no longer unpacks" in texts
+    assert "stray_writer packs layout 'fix-word'" in texts
+
+
+def test_layout_good_fixture_clean_with_suppression():
+    v, ns = layout_registry.check(root=REPO, files=[_LG],
+                                  layouts=_LAYOUT_GOOD)
+    assert v == []
+    assert ns == 1                   # the reasoned SCRATCH suppression
+
+
+def test_layout_live_docs_table_current():
+    # the generated table matches docs/OBSERVABILITY.md verbatim (drift
+    # either direction is a layout-drift violation on the live tree)
+    table = layout_registry.generated_table()
+    text = (REPO / layout_registry.DOCS_REL).read_text()
+    between = text.split(layout_registry.MARK_BEGIN, 1)[1] \
+        .split(layout_registry.MARK_END, 1)[0].strip()
+    assert between == table.strip()
+
+
+def test_layout_live_tree_is_clean():
+    v, _ = layout_registry.check(root=REPO)
+    assert v == []
+
+
+# -- publish order -----------------------------------------------------------
+
+_PG = f"{FIX}/publish_good.py"
+_PB = f"{FIX}/publish_bad.py"
+
+
+def _pub_layouts(rel, writers, readers, seq_writer=None):
+    out = [_layout("fix-slot", rel, "HDR", "<IId", 16,
+                   commit="seq", commit_slice=True,
+                   pub_writers=writers, guard_readers=readers)]
+    if seq_writer:
+        out.append(_layout(
+            "fix-seqslot", rel, "HDR", "<IId", 16, commit="seq",
+            seqlock=True, commit_struct="SEQ",
+            pub_writers=(seq_writer,),
+            guard_readers=(f"{rel}::SeqSlot.get",) if "good" in rel
+            else (), read_helpers=("_seq",)))
+    return tuple(out)
+
+
+def test_publish_bad_fixture_trips_every_failure_mode():
+    layouts = _pub_layouts(
+        _PB,
+        writers=(f"{_PB}::bad_write_after_commit",
+                 f"{_PB}::bad_commit_first",
+                 f"{_PB}::bad_never_commit"),
+        readers=(f"{_PB}::bad_reader_no_commit",
+                 f"{_PB}::bad_reader_unguarded"),
+        seq_writer=f"{_PB}::SeqBad.put")
+    v, _ = publish_order.check(root=REPO, files=[_PB], layouts=layouts)
+    assert all(x.rule == "publish-order" for x in v)
+    assert len(v) == 6
+    texts = "\n".join(x.message for x in v)
+    assert "write-after-commit" in texts
+    assert "commit-before-payload" in texts
+    assert "never stores the commit word" in texts
+    assert "breaks the seqlock sequence" in texts
+    assert "never reads the commit word" in texts
+    assert "does not re-validate" in texts
+
+
+def test_publish_good_fixture_is_clean():
+    layouts = _pub_layouts(
+        _PG,
+        writers=(f"{_PG}::write_rec",),
+        readers=(f"{_PG}::read_rec",),
+        seq_writer=f"{_PG}::SeqSlot.put")
+    v, ns = publish_order.check(root=REPO, files=[_PG],
+                                layouts=layouts)
+    assert v == []
+    assert ns == 0
+
+
+def test_publish_stale_registry_entry_fails():
+    layouts = _pub_layouts(
+        _PG, writers=(f"{_PG}::renamed_away",), readers=())
+    v, _ = publish_order.check(root=REPO, files=[_PG],
+                               layouts=layouts)
+    assert len(v) == 1
+    assert "does not exist" in v[0].message
+
+
+def test_publish_live_tree_is_clean():
+    v, _ = publish_order.check(root=REPO)
+    assert v == []

@@ -138,3 +138,68 @@ def test_check_files_filter_scopes_products():
     v, _ = model_check.check(
         root=REPO, files=["language_detector_tpu/parallel/pool.py"])
     assert v == []
+
+
+# -- torn-write products (tools/lint/torn_write.py) ---------------------------
+
+from tools.lint import torn_write  # noqa: E402
+
+TORN_NAMES = [p[0] for p in torn_write.TORN_PRODUCTS]
+
+
+@pytest.mark.parametrize("name", TORN_NAMES)
+def test_torn_product_exhausts_with_no_failures(name):
+    failures, n_schedules, exhausted = torn_write.run_product(name)
+    assert failures == [], failures
+    assert exhausted, (f"{name}: crash-schedule exploration hit the "
+                       f"cap after {n_schedules} schedules")
+    # the journal actually tore something: more schedules than stores
+    assert n_schedules > 10
+
+
+@pytest.mark.parametrize("name", TORN_NAMES)
+def test_torn_product_is_deterministic(name):
+    a = torn_write.run_product(name)
+    b = torn_write.run_product(name)
+    assert a == b
+
+
+@pytest.mark.parametrize("name,doctored", [
+    ("torn-flightrec", torn_write.doctored_flightrec_commit_first),
+    ("torn-capture", torn_write.doctored_capture_commit_first),
+])
+def test_torn_doctored_writer_produces_counterexample(name, doctored):
+    """The harness detects broken protocols, it does not just bless
+    working ones: the classic commit-word-first memcpy must yield a
+    minimal counterexample trace."""
+    failures, _n, exhausted = torn_write.run_product(
+        name, writer=doctored)
+    assert exhausted
+    assert failures, f"{name}: doctored writer survived every schedule"
+    inv, trace, detail = failures[0]
+    assert inv == "old-value-or-refusal"
+    assert "store#" in trace        # the minimal crash-point schedule
+    assert "torn" in trace or "->" in trace
+
+
+def test_torn_check_clean_and_restores_fault_config():
+    from language_detector_tpu import faults
+
+    faults.configure("queue_put:error:p=0.0")
+    try:
+        before = faults.ACTIVE
+        violations, n_sup = torn_write.check(root=REPO)
+        assert violations == []
+        assert n_sup == 0
+        assert faults.ACTIVE is before
+    finally:
+        faults.configure(None)
+
+
+def test_torn_check_files_filter_scopes_products():
+    v, _ = torn_write.check(
+        root=REPO, files=["language_detector_tpu/capture.py"])
+    assert v == []
+    # a non-subject file scopes to zero products, vacuously clean
+    v, _ = torn_write.check(root=REPO, files=["README.md"])
+    assert v == []

@@ -1,11 +1,12 @@
 """CLI: python -m tools.lint [--rule r1,r2] [--changed]
-[--knob-table] [--write-knob-docs]
+[--knob-table] [--write-knob-docs] [--layout-table]
+[--write-layout-docs]
 
-Default run executes all ten analyzers over the live tree and exits
-non-zero on any violation — ci.sh runs exactly this before the test
-suite. ``--changed`` is the editor-loop mode: analyzers scope to the
-files git reports as modified (unstaged + staged + untracked), and the
-run silently widens back to a full sweep whenever a registry or
+Default run executes all thirteen analyzers over the live tree and
+exits non-zero on any violation — ci.sh runs exactly this before the
+test suite. ``--changed`` is the editor-loop mode: analyzers scope to
+the files git reports as modified (unstaged + staged + untracked), and
+the run silently widens back to a full sweep whenever a registry or
 analyzer file itself changed — an edited transition table must re-judge
 every conforming file, not just the table.
 """
@@ -16,8 +17,9 @@ import subprocess
 import sys
 
 from . import event_registry, faults_registry, fsm_registry, \
-    future_resolution, jit_contract, knob_registry, lock_discipline, \
-    metric_registry, model_check, trace_safety
+    future_resolution, jit_contract, knob_registry, layout_registry, \
+    lock_discipline, metric_registry, model_check, publish_order, \
+    torn_write, trace_safety
 from .base import RULE_IDS, repo_root
 
 # analyzer -> the rule ids it can emit (every analyzer can additionally
@@ -41,6 +43,11 @@ ANALYZERS = (
     ("model-check", model_check.check, {"model-check-invariant"}),
     ("future-resolution", future_resolution.check,
      {"future-unresolved", "future-consumer-guard"}),
+    ("layout-registry", layout_registry.check,
+     {"layout-undeclared", "layout-drift",
+      "layout-reader-writer-mismatch"}),
+    ("publish-order", publish_order.check, {"publish-order"}),
+    ("torn-write", torn_write.check, {"torn-write-invariant"}),
 )
 
 # analyzers whose scan set is a fixed file list: in --changed mode they
@@ -51,6 +58,9 @@ _SCOPED = {
     "future-resolution": lambda: set(future_resolution.SCAN_FILES),
     "fsm-conformance": lambda: {m.file for m in fsm_registry.MACHINES},
     "model-check": lambda: {p[1] for p in model_check.PRODUCTS},
+    "layout-registry": lambda: set(layout_registry.SCAN_FILES),
+    "publish-order": lambda: set(layout_registry.SCAN_FILES),
+    "torn-write": lambda: {p[1] for p in torn_write.TORN_PRODUCTS},
 }
 
 # any change here invalidates incremental scoping wholesale: the
@@ -164,6 +174,12 @@ def main(argv=None) -> int:
     ap.add_argument("--write-knob-docs", action="store_true",
                     help="regenerate the knob table in "
                          "docs/OBSERVABILITY.md and exit")
+    ap.add_argument("--layout-table", action="store_true",
+                    help="print the generated binary-layout markdown "
+                         "table and exit")
+    ap.add_argument("--write-layout-docs", action="store_true",
+                    help="regenerate the binary-layout table in "
+                         "docs/OBSERVABILITY.md and exit")
     args = ap.parse_args(argv)
     root = repo_root()
     if args.knob_table:
@@ -171,6 +187,14 @@ def main(argv=None) -> int:
         return 0
     if args.write_knob_docs:
         changed = knob_registry.write_knob_docs(root)
+        print("docs/OBSERVABILITY.md "
+              + ("updated" if changed else "already current"))
+        return 0
+    if args.layout_table:
+        print(layout_registry.generated_table())
+        return 0
+    if args.write_layout_docs:
+        changed = layout_registry.write_layout_docs(root)
         print("docs/OBSERVABILITY.md "
               + ("updated" if changed else "already current"))
         return 0

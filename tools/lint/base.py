@@ -41,6 +41,11 @@ RULE_IDS = frozenset({
     "fsm-undeclared-transition",
     "fsm-dead-transition",
     "model-check-invariant",
+    "layout-undeclared",
+    "layout-drift",
+    "layout-reader-writer-mismatch",
+    "publish-order",
+    "torn-write-invariant",
     "future-unresolved",
     "future-consumer-guard",
     "jit-donated-read",

@@ -1,0 +1,27 @@
+"""Bad fixture: every layout-registry failure mode, seeded.
+
+The test registry declares REC as "<IHH"/8 with writer write_rec and
+reader read_rec, GONE as a registered Struct this module should carry,
+and WORD as "<I"/4.
+"""
+import struct
+
+REC = struct.Struct("<IHB")       # drift: registry pins "<IHH" (and
+                                  # the import-time assert is missing)
+WORD = struct.Struct("<I")
+EXTRA = struct.Struct("<QQ")      # undeclared module-level Struct
+assert WORD.size == 8             # drift: pins the wrong width
+
+
+def write_rec(buf):
+    # mismatch: the declared writer no longer packs REC — and the
+    # inline format it packs instead is not a declared layout
+    struct.pack_into("<ff", buf, 0, 1.0, 2.0)
+
+
+def stray_writer(buf):
+    WORD.pack_into(buf, 0, 1)     # mismatch: not a declared writer
+
+
+def ad_hoc(n):
+    return struct.Struct("<B")    # undeclared ad-hoc format

@@ -1,0 +1,17 @@
+"""Good fixture for the layout-registry analyzer: one declared record,
+width-asserted at import, with matched declared writer/reader, plus a
+reasoned suppression for a scratch format."""
+import struct
+
+REC = struct.Struct("<IHH")
+assert REC.size == 8
+
+SCRATCH = struct.Struct("<B")  # ldt-lint: disable=layout-undeclared -- fixture: scratch format, never ships bytes
+
+
+def write_rec(buf, a, b, c):
+    REC.pack_into(buf, 0, a, b, c)
+
+
+def read_rec(buf):
+    return REC.unpack_from(buf, 0)

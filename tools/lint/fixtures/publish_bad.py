@@ -1,0 +1,40 @@
+"""Bad fixture: every publish-order failure mode, seeded — one
+function per message family."""
+import struct
+
+HDR = struct.Struct("<IId")
+SEQ = struct.Struct("<I")
+
+
+def bad_write_after_commit(mm, off, rec, payload):
+    mm[off + 4:off + HDR.size] = rec[4:]
+    mm[off:off + 4] = rec[:4]
+    mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+
+
+def bad_commit_first(mm, off, rec, payload):
+    mm[off:off + 4] = rec[:4]
+    mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+
+
+def bad_never_commit(mm, off, rec, payload):
+    mm[off + 4:off + HDR.size] = rec[4:]
+    mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+
+
+class SeqBad:
+    def put(self, mm, off, payload, s):
+        # fields land before any claim: readers can observe a torn
+        # record under an even (valid-looking) seq
+        HDR.pack_into(mm, off, s + 1, len(payload), 0.0)
+        mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+        SEQ.pack_into(mm, off, s + 2)
+
+
+def bad_reader_no_commit(mm, off):
+    return mm[off + HDR.size:off + HDR.size + 8]
+
+
+def bad_reader_unguarded(mm, off):
+    seq, length, _ts = HDR.unpack_from(mm, off)
+    return mm[off + HDR.size:off + HDR.size + length]

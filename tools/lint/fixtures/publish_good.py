@@ -1,0 +1,41 @@
+"""Good fixture for the publish-order analyzer: the two legal writer
+shapes (invalidate -> tail -> payload -> commit, and the seqlock
+odd -> fields -> even bracket) and readers that re-validate."""
+import struct
+
+HDR = struct.Struct("<IId")
+SEQ = struct.Struct("<I")
+
+
+def write_rec(mm, off, rec, payload):
+    mm[off:off + 4] = b"\0\0\0\0"
+    mm[off + 4:off + HDR.size] = rec[4:]
+    mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+    mm[off:off + 4] = rec[:4]
+
+
+def read_rec(mm, off):
+    seq, length, _ts = HDR.unpack_from(mm, off)
+    if seq == 0:
+        return None
+    return mm[off + HDR.size:off + HDR.size + length]
+
+
+class SeqSlot:
+    def put(self, mm, off, payload, s):
+        SEQ.pack_into(mm, off, s + 1)
+        HDR.pack_into(mm, off, s + 1, len(payload), 0.0)
+        mm[off + HDR.size:off + HDR.size + len(payload)] = payload
+        SEQ.pack_into(mm, off, s + 2)
+
+    def _seq(self, mm, off):
+        return SEQ.unpack_from(mm, off)[0]
+
+    def get(self, mm, off):
+        s1 = self._seq(mm, off)
+        if s1 & 1:
+            return None
+        body = mm[off + HDR.size:off + HDR.size + 8]
+        if self._seq(mm, off) != s1:
+            return None
+        return body

@@ -1,0 +1,663 @@
+"""Layout-registry analyzer: every on-wire/on-disk record, declared once.
+
+PRs 10-17 grew five hand-rolled binary protocols (UDS frames, shm ring
+slots, flightrec/capture rings, seqlock shared-cache slots) plus the
+AOT bundle and the artifact footer. Each format lives as a bare
+``struct.Struct`` in its module, and the byte-compat / crash-safety
+claims in docs rest on nothing but convention. LAYOUTS below is the
+single source of truth: name, declaring module, struct format, pinned
+byte width, field names, magic/version, the commit/seq word (if any),
+and the declared writer/reader functions. Three rules keep the code
+and the registry from drifting — both ways:
+
+  layout-undeclared   a struct.Struct / struct.pack* / struct.unpack*
+                      call site in a protocol file whose format string
+                      is not a declared layout (new records must be
+                      registered before they ship bytes)
+  layout-drift        the declared Struct no longer matches the
+                      registry format, the format no longer calcsizes
+                      to the pinned v1/v2 byte width, the module's
+                      import-time width assert is missing or wrong, or
+                      the generated layout table in
+                      docs/OBSERVABILITY.md is stale
+                      (``--write-layout-docs`` regenerates it)
+  layout-reader-writer-mismatch
+                      a declared writer/reader no longer packs/unpacks
+                      its layout, or a function packs/unpacks a layout
+                      without being declared — a reader whose format
+                      disagrees with its paired writer shows up here
+                      or as layout-undeclared before it ships
+
+The commit-word fields (``commit``/``seqlock``/``pub_writers``/
+``guard_readers``) additionally drive tools/lint/publish_order.py and
+the torn-write model-check products (tools/lint/torn_write.py).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import struct
+from pathlib import Path
+
+from .base import (Violation, apply_suppressions, load_source,
+                   repo_root)
+
+DOCS_REL = "docs/OBSERVABILITY.md"
+MARK_BEGIN = "<!-- ldt-layout-table:begin -->"
+MARK_END = "<!-- ldt-layout-table:end -->"
+
+_PACK_METHODS = frozenset({"pack", "pack_into"})
+_UNPACK_METHODS = frozenset({"unpack", "unpack_from", "iter_unpack"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """One binary record format. ``writers``/``readers`` entries are
+    ``"<repo-relative file>::<qualname>"`` where qualname collapses to
+    the topmost enclosing def (``Class.method``, ``function``, or
+    ``<module>``)."""
+    name: str
+    file: str            # declaring module (repo-relative)
+    var: str | None      # module-level Struct name; None = inline fmt
+    fmt: str             # struct format; "{}" marks a dynamic count
+    size: int | None     # pinned byte width (None only when dynamic)
+    fields: tuple
+    doc: str
+    magic: str = ""
+    version: str = ""
+    commit: str = ""     # commit/seq/state field name ("" = none)
+    seqlock: bool = False
+    # how the commit word is stored: a 4-byte slice at the record base
+    # (mm[base:base+4] = ...) and/or a dedicated Struct packed at base
+    commit_slice: bool = False
+    commit_struct: str = ""
+    crc_span: str = ""
+    writers: tuple = ()
+    readers: tuple = ()
+    # publish-order analyzer inputs (commit layouts only): the writer
+    # functions whose store order is proven, the reader functions that
+    # must re-validate the commit word, and helper callables whose
+    # return value IS the commit word (e.g. sharedcache._seq)
+    pub_writers: tuple = ()
+    guard_readers: tuple = ()
+    read_helpers: tuple = ()
+
+
+_W = "language_detector_tpu/service/wire.py"
+_AIO = "language_detector_tpu/service/aioserver.py"
+_S = "language_detector_tpu/service/shmring.py"
+_H = "language_detector_tpu/service/sharedcache.py"
+_F = "language_detector_tpu/flightrec.py"
+_C = "language_detector_tpu/capture.py"
+_A = "language_detector_tpu/aot.py"
+_R = "language_detector_tpu/artifact.py"
+
+# the protocol files this analyzer scans (aioserver reaches wire's
+# frame structs by attribute, so it is part of the conformance plane)
+SCAN_FILES = (_W, _AIO, _S, _H, _F, _C, _A, _R)
+
+# module-name -> declaring file, for cross-module uses like
+# ``wire.FRAME_HEADER.unpack`` in aioserver.py
+MODULE_FILES = {"wire": _W}
+
+LAYOUTS: tuple = (
+    # -- UDS frame lane (service/wire.py; network byte order) --------
+    Layout(
+        "uds-frame-len", _W, "FRAME_HEADER", "!I", 4, ("length",),
+        "v1 request frame length prefix; v2 sets FRAME_V2_FLAG in the "
+        "same word and appends the ext header",
+        version="v1/v2",
+        writers=(f"{_W}::pack_frame",),
+        readers=(f"{_W}::UnixFrameServer._serve_conn",
+                 f"{_AIO}::AioService.handle_uds")),
+    Layout(
+        "uds-resp-header", _W, "FRAME_RESP_HEADER", "!IH", 6,
+        ("length", "status"),
+        "response frame header: body length (v2 sets FRAME_V2_FLAG) "
+        "and HTTP-equivalent status",
+        version="v1/v2",
+        writers=(f"{_W}::send_frame",
+                 f"{_AIO}::AioService.handle_uds"),
+        readers=(f"{_W}::recv_response_frame",)),
+    Layout(
+        "uds-ext-header", _W, "FRAME_EXT_HEADER", "!BHI", 7,
+        ("flags", "tenant_len", "deadline_ms"),
+        "v2 extension header: flag bits (priority/reqid/crc/spans), "
+        "tenant byte length, deadline budget ms",
+        version="v2",
+        writers=(f"{_W}::pack_frame",),
+        readers=(f"{_W}::UnixFrameServer._serve_conn",
+                 f"{_AIO}::AioService.handle_uds")),
+    Layout(
+        "uds-crc-word", _W, "FRAME_CRC_WORD", "!I", 4, ("crc32",),
+        "optional v2 body CRC (FRAME_CRC flag, LDT_WIRE_CRC)",
+        version="v2", crc_span="frame body",
+        writers=(f"{_W}::pack_frame",),
+        readers=(f"{_W}::UnixFrameServer._serve_conn",)),
+    # -- shm ingest ring (service/shmring.py) ------------------------
+    Layout(
+        "shm-ring-header", _S, "RING_HDR", "<IIIIII Q", 32,
+        ("magic", "version", "generation", "slots", "client_pid",
+         "worker_pid", "slot_bytes"),
+        "ring file header; generation fences stale attachments",
+        magic="0x5253444C", version="1",
+        writers=(f"{_S}::RingFile.__init__",
+                 f"{_S}::RingFile.set_generation"),
+        readers=(f"{_S}::RingFile.__init__",
+                 f"{_S}::RingFile.generation",
+                 f"{_S}::RingFile.client_pid",
+                 f"{_S}::RingFile.worker_pid",
+                 f"{_S}::RingFile.set_generation")),
+    Layout(
+        "shm-slot-header", _S, "SLOT_HDR", "<IIII d II", 32,
+        ("state", "generation", "owner_pid", "request_id", "ts",
+         "length", "status"),
+        "per-slot header; the state word is the publication point "
+        "(tail stored first, state word last)",
+        commit="state", commit_slice=True,
+        writers=(f"{_S}::RingFile.write_slot",),
+        readers=(f"{_S}::RingFile.read_slot",
+                 f"{_S}::RingFile.slot_request_id"),
+        pub_writers=(f"{_S}::RingFile.write_slot",),
+        guard_readers=(f"{_S}::RingClient._refresh",
+                       f"{_S}::ShmRingServer._sweep_ring"),
+        read_helpers=("read_slot",)),
+    Layout(
+        "shm-slot-crc-word", _S, None, "<I", 4, ("crc32",),
+        "optional per-slot payload CRC right after the slot header "
+        "(LDT_WIRE_CRC)",
+        crc_span="slot payload",
+        writers=(f"{_S}::RingFile.write_crc",),
+        readers=(f"{_S}::RingFile.read_crc",)),
+    # -- seqlock shared result cache (service/sharedcache.py) --------
+    Layout(
+        "sharedcache-file-header", _H, "_HEADER", "<8sIII", 20,
+        ("magic", "version", "slot_count", "slot_bytes"),
+        "cache file header, written once under flock at creation",
+        magic='b"LDTSHC1\\n"', version="1",
+        writers=(f"{_H}::SharedResultCache._attach",),
+        readers=(f"{_H}::SharedResultCache._attach",)),
+    Layout(
+        "sharedcache-slot-header", _H, "_SLOT_HDR", "<IIQ16sII", 40,
+        ("seq", "crc", "epoch", "key", "vlen", "pad"),
+        "seqlock slot header: odd seq claims, even seq publishes; "
+        "readers re-check seq + epoch + CRC before trusting payload",
+        commit="seq", seqlock=True, commit_struct="_U32",
+        crc_span="epoch+key+vlen+payload",
+        writers=(f"{_H}::SharedResultCache.put",
+                 f"{_H}::SharedResultCache.set_epoch"),
+        readers=(f"{_H}::SharedResultCache.get",
+                 f"{_H}::SharedResultCache.put",
+                 f"{_H}::SharedResultCache.set_epoch"),
+        pub_writers=(f"{_H}::SharedResultCache.put",
+                     f"{_H}::SharedResultCache.set_epoch"),
+        guard_readers=(f"{_H}::SharedResultCache.get",
+                       f"{_H}::SharedResultCache.put",
+                       f"{_H}::SharedResultCache.set_epoch"),
+        read_helpers=("_seq",)),
+    Layout(
+        "sharedcache-seq-word", _H, "_U32", "<I", 4, ("seq",),
+        "bare seq-word view of the slot header, for the claim/publish "
+        "stores and the reader's revalidation reads",
+        writers=(f"{_H}::SharedResultCache.put",
+                 f"{_H}::SharedResultCache.set_epoch"),
+        readers=(f"{_H}::SharedResultCache._seq",)),
+    Layout(
+        "sharedcache-crc-span", _H, None, "<Q16sI", 28,
+        ("epoch", "key", "vlen"),
+        "CRC input material (never lands on disk as-is): the crc field "
+        "covers epoch+key+vlen prefix plus the payload bytes",
+        crc_span="epoch+key+vlen+payload",
+        writers=(f"{_H}::SharedResultCache._crc",),
+        readers=()),
+    # -- flight recorder ring (flightrec.py) -------------------------
+    Layout(
+        "flightrec-file-header", _F, "FILE_HDR", "<4sIIIId", 28,
+        ("magic", "version", "slots", "slot_bytes", "pid", "start_ts"),
+        "recorder file header, written once at ring creation",
+        magic='b"LDFR"', version="1",
+        writers=(f"{_F}::FlightRecorder.__init__",),
+        readers=(f"{_F}::read_ring",)),
+    Layout(
+        "flightrec-slot-header", _F, "SLOT_HDR", "<IId", 16,
+        ("seq", "length", "ts"),
+        "per-event slot header; the seq word is the publication point "
+        "and is zeroed before a wrapped slot is rewritten",
+        commit="seq", commit_slice=True,
+        writers=(f"{_F}::FlightRecorder.emit",),
+        readers=(f"{_F}::read_ring",),
+        pub_writers=(f"{_F}::FlightRecorder.emit",),
+        guard_readers=(f"{_F}::read_ring",)),
+    # -- traffic capture ring (capture.py) ---------------------------
+    Layout(
+        "capture-file-header", _C, "FILE_HDR", "<4sIIIIdQ", 36,
+        ("magic", "version", "slots", "record_size", "pid",
+         "wall_anchor", "mono_anchor_ns"),
+        "ring/segment file header; for sealed segments the slots "
+        "field is the committed record count",
+        magic='b"LDCR" / b"LDCS"', version="1",
+        writers=(f"{_C}::CaptureWriter.__init__",
+                 f"{_C}::CaptureWriter._seal_locked"),
+        readers=(f"{_C}::_read_file",)),
+    Layout(
+        "capture-commit-word", _C, "COMMIT", "<I", 4, ("commit",),
+        "per-slot commit word (slot index + 1), stored after the "
+        "record payload",
+        commit="commit", commit_slice=True,
+        writers=(f"{_C}::CaptureWriter.append",),
+        readers=(f"{_C}::CaptureWriter._seal_locked",
+                 f"{_C}::_read_file"),
+        pub_writers=(f"{_C}::CaptureWriter.append",),
+        guard_readers=(f"{_C}::CaptureWriter._seal_locked",
+                       f"{_C}::_read_file")),
+    Layout(
+        "capture-record", _C, "RECORD", "<QQQIfffffHBBBB", 54,
+        ("arrival_mono_ns", "tenant_hash", "cache_bits", "docs",
+         "deadline_ms", "total_ms", "parse_ms", "detect_ms",
+         "encode_ms", "status", "size_bucket", "lane", "verdict",
+         "flags"),
+        "one anonymized request shape (docs/OBSERVABILITY.md)",
+        writers=(f"{_C}::CaptureWriter.append",),
+        readers=(f"{_C}::_decode",)),
+    # -- AOT executable bundle (aot.py) ------------------------------
+    Layout(
+        "aot-section-len", _A, "_LEN", "<Q", 8, ("length",),
+        "length prefix for each bundle section (meta/HLO/executable)",
+        magic='b"LDTAOT1\\n"', version="1",
+        writers=(f"{_A}::_pack_entry",),
+        readers=(f"{_A}::_unpack_entry",)),
+    Layout(
+        "aot-entry-crc", _A, "_CRC", "<I", 4, ("crc32",),
+        "entry trailer CRC over every section after the magic",
+        crc_span="all sections after magic",
+        writers=(f"{_A}::_pack_entry",),
+        readers=(f"{_A}::_unpack_entry",)),
+    # -- packed model artifact (artifact.py) -------------------------
+    Layout(
+        "artifact-header", _R, "_HDR", "<IIII QQ", 32,
+        ("magic", "version", "n_arrays", "flags", "header_bytes",
+         "total_bytes"),
+        "artifact file header; total_bytes pins the exact file size",
+        magic="0x4154444C", version="1",
+        writers=(f"{_R}::write_artifact",),
+        readers=(f"{_R}::load_artifact", f"{_R}::artifact_digest")),
+    Layout(
+        "artifact-descriptor", _R, "_DESC", "<48s8sI 4Q QQ", 108,
+        ("name", "dtype", "ndim", "shape0", "shape1", "shape2",
+         "shape3", "offset", "nbytes"),
+        "per-array descriptor (name, dtype, shape, data extent)",
+        writers=(f"{_R}::write_artifact",),
+        readers=(f"{_R}::load_artifact",)),
+    Layout(
+        "artifact-footer", _R, "_FOOT", "<II", 8,
+        ("magic", "n_digests"),
+        "digest footer marker before the per-array CRC words",
+        magic="0x4454444C",
+        writers=(f"{_R}::write_artifact",),
+        readers=(f"{_R}::load_artifact",)),
+    Layout(
+        "artifact-crc-words", _R, None, "<{}I", None, ("crc32[n]",),
+        "per-array CRC32 words after the footer (FLAG_DIGESTS)",
+        crc_span="per-array payload",
+        writers=(f"{_R}::write_artifact",),
+        readers=(f"{_R}::load_artifact",)),
+)
+
+
+def registry_sizes(rel: str, layouts=LAYOUTS) -> dict:
+    """var -> pinned width for one module's static layouts — protocol
+    modules assert against this at import time (via a literal the
+    analyzer cross-checks, so the module never imports tools.lint)."""
+    return {lay.var: lay.size for lay in layouts
+            if lay.file == rel and lay.var and lay.size is not None}
+
+
+def _fmt_key(fmt: str) -> str:
+    """Normalize a format for matching: spaces are struct no-ops, and
+    dynamic repeat counts collapse to the {} skeleton."""
+    return fmt.replace(" ", "")
+
+
+def _joined_skeleton(node: ast.JoinedStr) -> str | None:
+    """f"<{n}I" -> "<{}I"; None when any literal part is non-str."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            if not isinstance(v.value, str):
+                return None
+            parts.append(v.value)
+        elif isinstance(v, ast.FormattedValue):
+            parts.append("{}")
+    return "".join(parts)
+
+
+def _fmt_of(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return _joined_skeleton(node)
+    return None
+
+
+@dataclasses.dataclass
+class _Use:
+    layout: "Layout | None"
+    kind: str        # "pack" | "unpack"
+    qual: str
+    line: int
+
+
+class _FileScan(ast.NodeVisitor):
+    """One protocol file's declarations, struct call sites, and
+    import-time width asserts, with topmost-def qualnames."""
+
+    def __init__(self, sf, layouts):
+        self.sf = sf
+        self.layouts = layouts
+        self.by_var = {lay.var: lay for lay in layouts
+                       if lay.file == sf.rel and lay.var}
+        self.by_fmt = {_fmt_key(lay.fmt): lay for lay in layouts
+                       if lay.file == sf.rel and lay.var is None}
+        self.decls: dict = {}     # var -> (fmt, line)
+        self.asserts: dict = {}   # var -> (value, line)
+        self.uses: list = []      # resolved _Use entries
+        self.out: list = []       # violations
+        self.fn_lines: dict = {}  # qualname -> def line
+        self._stack: list = []    # enclosing (kind, name)
+
+    # -- scope tracking ----------------------------------------------
+    def _qual(self) -> str:
+        names = [n for k, n in self._stack if k == "f"][:1]
+        cls = [n for k, n in self._stack if k == "c"][:1]
+        if not names:
+            return "<module>"
+        return ".".join(cls + names)
+
+    def visit_ClassDef(self, node):
+        self._stack.append(("c", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_def(self, node):
+        self._stack.append(("f", node.name))
+        self.fn_lines.setdefault(self._qual(), node.lineno)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    # -- declarations and asserts ------------------------------------
+    def visit_Assign(self, node):
+        call = node.value
+        if not self._stack and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(call, ast.Call) \
+                and isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "Struct" \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "struct":
+            fmt = _fmt_of(call.args[0]) if call.args else None
+            if fmt is not None:
+                self.decls[node.targets[0].id] = (fmt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        t = node.test
+        if not self._stack and isinstance(t, ast.Compare) \
+                and len(t.ops) == 1 and isinstance(t.ops[0], ast.Eq) \
+                and isinstance(t.left, ast.Attribute) \
+                and t.left.attr == "size" \
+                and isinstance(t.left.value, ast.Name) \
+                and isinstance(t.comparators[0], ast.Constant) \
+                and isinstance(t.comparators[0].value, int):
+            self.asserts[t.left.value.id] = \
+                (t.comparators[0].value, node.lineno)
+        self.generic_visit(node)
+
+    # -- call sites --------------------------------------------------
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "Struct" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "struct" and self._stack:
+                # module-level Struct assigns are handled in
+                # visit_Assign; any other Struct() is an ad-hoc format
+                self.out.append(Violation(
+                    "layout-undeclared", self.sf.rel, node.lineno,
+                    "ad-hoc struct.Struct: binary formats must be a "
+                    "module-level Struct declared in "
+                    "tools/lint/layout_registry.py"))
+            elif f.attr in _PACK_METHODS or f.attr in _UNPACK_METHODS \
+                    or f.attr == "calcsize":
+                self._classify(node, f)
+        self.generic_visit(node)
+
+    def _classify(self, node, f):
+        kind = "pack" if f.attr in _PACK_METHODS else "unpack"
+        base = f.value
+        if isinstance(base, ast.Name) and base.id == "struct":
+            # bare struct.pack_into("fmt", ...) etc: inline format
+            fmt = _fmt_of(node.args[0]) if node.args else None
+            if fmt is None:
+                self.out.append(Violation(
+                    "layout-undeclared", self.sf.rel, node.lineno,
+                    f"struct.{f.attr} with a non-literal format: "
+                    f"formats must be registry-declared literals"))
+                return
+            lay = self.by_fmt.get(_fmt_key(fmt))
+            if lay is None:
+                self.out.append(Violation(
+                    "layout-undeclared", self.sf.rel, node.lineno,
+                    f"struct format {fmt!r} is not a declared layout "
+                    f"of {self.sf.rel} "
+                    f"(tools/lint/layout_registry.py)"))
+                return
+            if f.attr != "calcsize":
+                self.uses.append(
+                    _Use(lay, kind, self._qual(), node.lineno))
+            return
+        var = mod = None
+        if isinstance(base, ast.Name):
+            var = base.id
+        elif isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name):
+            var, mod = base.attr, base.value.id
+        if var is None or not var[:1].isupper() and var[:1] != "_":
+            return  # method named pack/unpack on a non-struct object
+        if mod is not None:
+            src = MODULE_FILES.get(mod)
+            if src is None:
+                return  # not a protocol module attribute
+            lay = next((x for x in self.layouts
+                        if x.file == src and x.var == var), None)
+        else:
+            lay = self.by_var.get(var)
+            if lay is None and var not in self.decls:
+                return  # local name, not a module-level Struct
+        if lay is None:
+            self.out.append(Violation(
+                "layout-undeclared", self.sf.rel, node.lineno,
+                f"{var}.{f.attr}: {var} is not a declared layout "
+                f"(tools/lint/layout_registry.py)"))
+            return
+        if f.attr != "calcsize":
+            self.uses.append(_Use(lay, kind, self._qual(), node.lineno))
+
+
+def _check_file(sf, layouts, out: list, uses: dict, fn_lines: dict):
+    scan = _FileScan(sf, layouts)
+    scan.visit(sf.tree)
+    out.extend(scan.out)
+    fn_lines[sf.rel] = scan.fn_lines
+    for u in scan.uses:
+        uses.setdefault((u.layout.name, u.kind), {}).setdefault(
+            f"{sf.rel}::{u.qual}", u.line)
+    # declaration drift: the module Struct vs the registry, both ways
+    mine = [lay for lay in layouts if lay.file == sf.rel and lay.var]
+    for lay in mine:
+        decl = scan.decls.get(lay.var)
+        if decl is None:
+            out.append(Violation(
+                "layout-drift", sf.rel, 1,
+                f"layout {lay.name!r}: module-level Struct "
+                f"{lay.var} is declared in the registry but missing "
+                f"from the module"))
+            continue
+        fmt, line = decl
+        if _fmt_key(fmt) != _fmt_key(lay.fmt):
+            out.append(Violation(
+                "layout-drift", sf.rel, line,
+                f"layout {lay.name!r}: module format {fmt!r} != "
+                f"registry format {lay.fmt!r} — update "
+                f"tools/lint/layout_registry.py (and bump the layout "
+                f"version) or revert the field edit"))
+        elif lay.size is not None \
+                and struct.calcsize(fmt) != lay.size:
+            out.append(Violation(
+                "layout-drift", sf.rel, line,
+                f"layout {lay.name!r}: format {fmt!r} is "
+                f"{struct.calcsize(fmt)} bytes but the registry pins "
+                f"{lay.size} — byte compatibility is versioned, not "
+                f"incidental"))
+        if lay.size is not None:
+            a = scan.asserts.get(lay.var)
+            if a is None:
+                out.append(Violation(
+                    "layout-drift", sf.rel, line,
+                    f"layout {lay.name!r}: missing import-time width "
+                    f"assert — add `assert {lay.var}.size == "
+                    f"{lay.size}` so a drive-by field edit fails at "
+                    f"import, not by corrupting rings"))
+            elif a[0] != lay.size:
+                out.append(Violation(
+                    "layout-drift", sf.rel, a[1],
+                    f"layout {lay.name!r}: import-time assert pins "
+                    f"{a[0]} bytes but the registry declares "
+                    f"{lay.size}"))
+    # module-level Structs the registry does not know about
+    for var, (fmt, line) in scan.decls.items():
+        if not any(lay.var == var for lay in mine):
+            out.append(Violation(
+                "layout-undeclared", sf.rel, line,
+                f"module-level Struct {var} ({fmt!r}) is not declared "
+                f"in tools/lint/layout_registry.py"))
+
+
+def _check_conformance(layouts, scope: set, out: list, uses: dict,
+                       fn_lines: dict):
+    """Both-ways writer/reader conformance over the scanned scope."""
+    for lay in layouts:
+        for kind, declared in (("pack", lay.writers),
+                               ("unpack", lay.readers)):
+            seen = uses.get((lay.name, kind), {})
+            word = "writer" if kind == "pack" else "reader"
+            verb = "packs" if kind == "pack" else "unpacks"
+            for entry in declared:
+                rel, _, qual = entry.partition("::")
+                if rel not in scope:
+                    continue
+                if entry in seen:
+                    continue
+                line = fn_lines.get(rel, {}).get(qual, 1)
+                out.append(Violation(
+                    "layout-reader-writer-mismatch", rel, line,
+                    f"declared {word} {qual} no longer {verb} layout "
+                    f"{lay.name!r} — update the registry or restore "
+                    f"the call"))
+            for entry, line in sorted(seen.items()):
+                if entry in declared:
+                    continue
+                rel, _, qual = entry.partition("::")
+                out.append(Violation(
+                    "layout-reader-writer-mismatch", rel, line,
+                    f"{qual} {verb} layout {lay.name!r} but is not a "
+                    f"declared {word} — declare it in "
+                    f"tools/lint/layout_registry.py so the "
+                    f"publish-order/torn-write contracts cover it"))
+
+
+# -- generated docs table --------------------------------------------
+
+
+def generated_table(root: Path | None = None, layouts=LAYOUTS) -> str:
+    rows = ["| layout | module | format | bytes | magic | ver | "
+            "commit word | CRC span |",
+            "|---|---|---|---|---|---|---|---|"]
+    for lay in sorted(layouts, key=lambda x: (x.file, x.name)):
+        size = str(lay.size) if lay.size is not None else "dyn"
+        commit = lay.commit or "—"
+        if lay.seqlock:
+            commit += " (seqlock)"
+        rows.append(
+            f"| `{lay.name}` | `{lay.file.rsplit('/', 1)[-1]}` "
+            f"| `{lay.fmt}` | {size} | {lay.magic or '—'} "
+            f"| {lay.version or '—'} | {commit} "
+            f"| {lay.crc_span or '—'} |")
+    return "\n".join(rows)
+
+
+def _check_docs(root: Path, out: list):
+    docs = root / DOCS_REL
+    if not docs.exists():
+        out.append(Violation("layout-drift", DOCS_REL, 1,
+                             "docs/OBSERVABILITY.md is missing"))
+        return
+    text = docs.read_text()
+    if MARK_BEGIN not in text or MARK_END not in text:
+        out.append(Violation(
+            "layout-drift", DOCS_REL, 1,
+            f"layout-table markers ({MARK_BEGIN} / {MARK_END}) are "
+            f"missing; the binary-layout table must be generated, "
+            f"not hand-maintained"))
+        return
+    current = text.split(MARK_BEGIN, 1)[1].split(MARK_END, 1)[0].strip()
+    if current != generated_table(root).strip():
+        line = text[:text.index(MARK_BEGIN)].count("\n") + 1
+        out.append(Violation(
+            "layout-drift", DOCS_REL, line,
+            "binary-layout table is stale; run "
+            "`python -m tools.lint --write-layout-docs`"))
+
+
+def write_layout_docs(root: Path | None = None) -> bool:
+    """Regenerate the docs table in place. Returns True when the file
+    changed."""
+    root = root or repo_root()
+    docs = root / DOCS_REL
+    text = docs.read_text()
+    head, _, rest = text.partition(MARK_BEGIN)
+    _, _, tail = rest.partition(MARK_END)
+    new = (head + MARK_BEGIN + "\n" + generated_table(root).strip()
+           + "\n" + MARK_END + tail)
+    if new != text:
+        docs.write_text(new)
+        return True
+    return False
+
+
+def check(root: Path | None = None, files=None, check_docs=True,
+          layouts=LAYOUTS):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    rels = list(SCAN_FILES) if files is None else list(files)
+    violations: list = []
+    n_suppressed = 0
+    uses: dict = {}
+    fn_lines: dict = {}
+    scope: set = set()
+    for rel in rels:
+        path = root / rel
+        if not path.exists():
+            continue
+        sf = load_source(path, root)
+        scope.add(sf.rel)
+        file_violations: list = []
+        _check_file(sf, layouts, file_violations, uses, fn_lines)
+        kept, ns = apply_suppressions(sf, file_violations)
+        violations.extend(kept)
+        n_suppressed += ns
+    _check_conformance(layouts, scope, violations, uses, fn_lines)
+    if check_docs and files is None:
+        _check_docs(root, violations)
+    return violations, n_suppressed

@@ -1,0 +1,300 @@
+"""Publish-order analyzer: the commit word is the LAST store.
+
+Every crash-safe record in this repo publishes by store order: payload
+and header tail land in the shared buffer first, then one aligned
+4-byte commit/seq/state word makes the record visible. The seqlock
+variants (sharedcache) bracket the field stores with an odd claim and
+an even publish. Readers must re-validate that word before trusting
+payload bytes. docs/ROBUSTNESS.md states this; nothing enforced it.
+
+For each registry layout that declares a commit word
+(tools/lint/layout_registry.py), this analyzer runs a flow-sensitive
+pass over the declared ``pub_writers``: it linearizes every store into
+the mmap buffer (slice/index assignment on an ``mm``-named target, or
+``X.pack_into(mm, ...)``) in source order and proves
+
+  * the final buffer store is the commit-word store (flagging
+    write-after-commit and commit-before-payload), and
+  * seqlock layouts store the commit word at least twice, first
+    (the odd claim) and last (the even publish), with every field
+    store in between.
+
+and over the declared ``guard_readers`` that they bind a value from
+the commit word (a layout/commit-struct unpack, or a declared
+``read_helpers`` call like ``sharedcache._seq``) and branch on it
+before using payload bytes. All findings share one rule id:
+
+  publish-order    messages distinguish write-after-commit,
+                   commit-before-payload, the missing odd->fields->even
+                   seqlock sequence, and readers that skip revalidation
+
+A commit-word store is recognized as a 4-byte slice at the record base
+(``mm[off:off + 4]`` / ``mm[off:off + COMMIT.size]``) or a
+``pack_into`` through the layout's declared ``commit_struct``.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .base import Violation, apply_suppressions, load_source, repo_root
+from .layout_registry import LAYOUTS, SCAN_FILES
+
+_UNPACK_METHODS = frozenset({"unpack", "unpack_from", "iter_unpack"})
+
+
+def _is_mm_name(node: ast.expr) -> bool:
+    """The buffer expression every protocol module stores through:
+    a bare ``mm`` local or a ``*.mm`` / ``*._mm`` attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id in ("mm", "_mm")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("mm", "_mm")
+    return False
+
+
+def _commit_width_ok(node: ast.expr, lay) -> bool:
+    """Is this slice-width expression exactly the 4-byte commit word?
+    Literal 4, or ``X.size`` where X is the layout's own var and that
+    layout is 4 bytes wide (capture's COMMIT)."""
+    if isinstance(node, ast.Constant) and node.value == 4:
+        return True
+    return (isinstance(node, ast.Attribute) and node.attr == "size"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == (lay.var or "")
+            and lay.size == 4)
+
+
+def _is_commit_slice(sub: ast.Subscript, lay) -> bool:
+    """mm[L : L + 4] — a 4-byte store at the record base."""
+    sl = sub.slice
+    if not isinstance(sl, ast.Slice) or sl.lower is None \
+            or sl.upper is None or sl.step is not None:
+        return False
+    up = sl.upper
+    return (isinstance(up, ast.BinOp) and isinstance(up.op, ast.Add)
+            and ast.dump(up.left) == ast.dump(sl.lower)
+            and _commit_width_ok(up.right, lay))
+
+
+class _StoreScan(ast.NodeVisitor):
+    """Ordered buffer-store events of one writer function. AST child
+    order is source order, so a depth-first walk linearizes the stores
+    exactly as the CPU issues them on the straight-line publish path."""
+
+    def __init__(self, lay):
+        self.lay = lay
+        self.events: list = []   # ("commit" | "field", lineno)
+
+    def _record_target(self, tgt, lineno):
+        if isinstance(tgt, ast.Subscript) and _is_mm_name(tgt.value):
+            kind = "commit" if self.lay.commit_slice and \
+                _is_commit_slice(tgt, self.lay) else "field"
+            self.events.append((kind, lineno))
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._record_target(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "pack_into":
+            # VAR.pack_into(mm, off, ...) or struct.pack_into(fmt, mm,.)
+            buf_idx = 1 if isinstance(f.value, ast.Name) \
+                and f.value.id == "struct" else 0
+            if len(node.args) > buf_idx \
+                    and _is_mm_name(node.args[buf_idx]):
+                kind = "field"
+                if self.lay.commit_struct \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == self.lay.commit_struct:
+                    kind = "commit"
+                self.events.append((kind, node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are not part of the straight-line store path
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _find_fn(tree: ast.Module, qual: str):
+    """Resolve 'Class.method' / 'function' to its def node."""
+    parts = qual.split(".")
+    scope: list = tree.body
+    node = None
+    for i, name in enumerate(parts):
+        node = next(
+            (n for n in scope if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                    ast.ClassDef)) and n.name == name), None)
+        if node is None:
+            return None
+        scope = node.body
+    return node if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+
+
+def _check_writer(sf, lay, qual: str, out: list):
+    fn = _find_fn(sf.tree, qual)
+    if fn is None:
+        out.append(Violation(
+            "publish-order", sf.rel, 1,
+            f"layout {lay.name!r}: declared pub_writer {qual} does "
+            f"not exist — update tools/lint/layout_registry.py"))
+        return
+    scan = _StoreScan(lay)
+    for stmt in fn.body:
+        scan.visit(stmt)
+    events = scan.events
+    commits = [i for i, (k, _) in enumerate(events) if k == "commit"]
+    if not commits:
+        out.append(Violation(
+            "publish-order", sf.rel, fn.lineno,
+            f"layout {lay.name!r}: writer {qual} never stores the "
+            f"commit word — records it writes are unpublishable or "
+            f"unconditionally trusted"))
+        return
+    if lay.seqlock:
+        bad = len(commits) < 2 or commits[0] != 0 \
+            or commits[-1] != len(events) - 1
+        if bad:
+            out.append(Violation(
+                "publish-order", sf.rel, events[commits[-1]][1],
+                f"layout {lay.name!r}: writer {qual} breaks the "
+                f"seqlock sequence — stores must go odd claim -> "
+                f"fields/payload -> even publish, with the seq word "
+                f"first and last"))
+        return
+    if commits[-1] != len(events) - 1:
+        line = events[commits[-1] + 1][1]
+        if commits[-1] < min(i for i, (k, _) in enumerate(events)
+                             if k == "field"):
+            out.append(Violation(
+                "publish-order", sf.rel, line,
+                f"layout {lay.name!r}: writer {qual} publishes the "
+                f"commit word BEFORE the payload/header stores "
+                f"(commit-before-payload) — a reader of a crashed "
+                f"writer would trust a torn record"))
+        else:
+            out.append(Violation(
+                "publish-order", sf.rel, line,
+                f"layout {lay.name!r}: writer {qual} stores into the "
+                f"record AFTER the commit-word publication "
+                f"(write-after-commit) — the store order is the only "
+                f"thing standing between a SIGKILL and a torn record"))
+
+
+class _GuardScan(ast.NodeVisitor):
+    """Commit-word bindings and condition references in one reader."""
+
+    def __init__(self, lay):
+        self.lay = lay
+        self.commit_names: set = set()
+        self.guarded = False
+
+    def _bind(self, target):
+        if isinstance(target, ast.Name):
+            self.commit_names.add(target.id)
+        elif isinstance(target, ast.Tuple) and target.elts \
+                and isinstance(target.elts[0], ast.Name):
+            # the commit/seq/state word is field 0 of every commit
+            # layout, so the first unpacked name is the guard value
+            self.commit_names.add(target.elts[0].id)
+
+    def visit_Assign(self, node):
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute):
+            attr = v.func.attr
+            base = v.func.value
+            is_unpack = attr in _UNPACK_METHODS and (
+                isinstance(base, ast.Name)
+                and base.id in (self.lay.var, self.lay.commit_struct))
+            is_helper = attr in self.lay.read_helpers
+            if is_unpack or is_helper:
+                for tgt in node.targets:
+                    self._bind(tgt)
+        self.generic_visit(node)
+
+    def _check_test(self, test):
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and n.id in self.commit_names:
+                self.guarded = True
+
+    def visit_If(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+
+def _check_reader(sf, lay, qual: str, out: list):
+    fn = _find_fn(sf.tree, qual)
+    if fn is None:
+        out.append(Violation(
+            "publish-order", sf.rel, 1,
+            f"layout {lay.name!r}: declared guard_reader {qual} does "
+            f"not exist — update tools/lint/layout_registry.py"))
+        return
+    scan = _GuardScan(lay)
+    scan.visit(fn)
+    if not scan.commit_names:
+        out.append(Violation(
+            "publish-order", sf.rel, fn.lineno,
+            f"layout {lay.name!r}: reader {qual} never reads the "
+            f"commit word (no {lay.var or lay.commit_struct} unpack "
+            f"or {'/'.join(lay.read_helpers) or 'helper'} call)"))
+        return
+    if not scan.guarded:
+        out.append(Violation(
+            "publish-order", sf.rel, fn.lineno,
+            f"layout {lay.name!r}: reader {qual} does not re-validate "
+            f"the commit/seq word before trusting payload bytes — "
+            f"torn records of a crashed writer would be accepted"))
+
+
+def check(root: Path | None = None, files=None, layouts=LAYOUTS):
+    """Run the analyzer. Returns (violations, n_suppressed)."""
+    root = root or repo_root()
+    scope = set(SCAN_FILES) if files is None else set(files)
+    violations: list = []
+    n_suppressed = 0
+    by_file: dict = {}
+    for lay in layouts:
+        if not lay.commit:
+            continue
+        for qual_list, checker in ((lay.pub_writers, _check_writer),
+                                   (lay.guard_readers, _check_reader)):
+            for entry in qual_list:
+                rel, _, qual = entry.partition("::")
+                if rel not in scope:
+                    continue
+                by_file.setdefault(rel, []).append(
+                    (lay, qual, checker))
+    for rel in sorted(by_file):
+        path = root / rel
+        if not path.exists():
+            continue
+        sf = load_source(path, root)
+        file_violations: list = []
+        for lay, qual, checker in by_file[rel]:
+            checker(sf, lay, qual, file_violations)
+        kept, ns = apply_suppressions(sf, file_violations)
+        violations.extend(kept)
+        n_suppressed += ns
+    return violations, n_suppressed

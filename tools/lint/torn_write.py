@@ -1,0 +1,490 @@
+"""Torn-write model checking: crash the REAL writers after every store.
+
+The publish-order analyzer (tools/lint/publish_order.py) proves the
+store ORDER syntactically; this module proves the store order is
+SUFFICIENT. Each product drives the real writer class (flightrec ring,
+capture ring, sharedcache seqlock slot, shmring slot) against a
+journaling buffer that records every individual store into the mmap
+region, then replays byte-prefix crash schedules: the writer is
+"SIGKILLed" after every completed store and, for every multi-byte
+store, after every byte of a partially applied store (only an aligned
+4-byte store — the commit word — is atomic). Each crash state is
+handed to the REAL reader, and the invariant is exhaustively checked:
+
+  old-value-or-refusal   a reader of a crashed writer's buffer returns
+                         previously committed records (or a miss) —
+                         never a torn/mixed record
+  commit-liveness        with every store applied, the reader returns
+                         the NEW record (the protocol publishes, it
+                         does not just refuse forever)
+
+Failures carry the minimal store-schedule trace that produced the bad
+state. ``run_product(name, writer=...)`` accepts a replacement writer
+so tests and ci.sh can prove the harness detects broken protocols:
+``doctored_flightrec_commit_first`` / ``doctored_capture_commit_first``
+reintroduce the classic single-forward-memcpy bug (commit word first)
+and MUST produce a counterexample.
+
+Deliberately a separate module from model_check.py: that file is
+pinned clock-free/random-free by tests, while these products patch the
+subject modules' ``time`` binding with a fake so journals are
+byte-deterministic.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import struct as _struct
+import sys
+import tempfile
+from pathlib import Path
+
+from .base import Violation, repo_root
+
+_REPO = repo_root()
+if str(_REPO) not in sys.path:  # `python -m tools.lint` has it; direct
+    sys.path.insert(0, str(_REPO))  # imports of this module may not
+
+MAX_SCHEDULES = 20000
+
+
+class TornBuffer:
+    """mmap stand-in that journals every store (offset, bytes)."""
+
+    def __init__(self, initial: bytes):
+        self.data = bytearray(initial)
+        self.journal: list = []   # [(offset, bytes), ...]
+
+    def _store(self, off: int, data: bytes) -> None:
+        self.data[off:off + len(data)] = data
+        self.journal.append((off, bytes(data)))
+
+    def __setitem__(self, idx, value) -> None:
+        if isinstance(idx, slice):
+            start = idx.start or 0
+            self._store(start, bytes(value))
+        else:
+            self._store(idx, bytes([value]))
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return bytes(self.data[idx])
+        return self.data[idx]
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+class _StructProxy:
+    """Struct wrapper that routes pack_into/unpack_from through a
+    TornBuffer (journaling stores) and passes real buffers through."""
+
+    def __init__(self, st):
+        self._st = st
+        self.size = st.size
+        self.format = st.format
+
+    def pack(self, *a):
+        return self._st.pack(*a)
+
+    def unpack(self, buf):
+        return self._st.unpack(buf)
+
+    def pack_into(self, buf, off, *vals):
+        if isinstance(buf, TornBuffer):
+            buf._store(off, self._st.pack(*vals))
+        else:
+            self._st.pack_into(buf, off, *vals)
+
+    def unpack_from(self, buf, off=0):
+        if isinstance(buf, TornBuffer):
+            return self._st.unpack_from(buf.data, off)
+        return self._st.unpack_from(buf, off)
+
+
+class _ModStructProxy:
+    """``struct`` module stand-in for bare struct.pack_into /
+    struct.unpack_from call sites (shmring's crc word)."""
+
+    Struct = _struct.Struct
+    pack = staticmethod(_struct.pack)
+    unpack = staticmethod(_struct.unpack)
+    calcsize = staticmethod(_struct.calcsize)
+
+    @staticmethod
+    def pack_into(fmt, buf, off, *vals):
+        if isinstance(buf, TornBuffer):
+            buf._store(off, _struct.pack(fmt, *vals))
+        else:
+            _struct.pack_into(fmt, buf, off, *vals)
+
+    @staticmethod
+    def unpack_from(fmt, buf, off=0):
+        if isinstance(buf, TornBuffer):
+            return _struct.unpack_from(fmt, buf.data, off)
+        return _struct.unpack_from(fmt, buf, off)
+
+
+class _FakeTime:
+    """Deterministic ``time`` module stand-in for the subject module:
+    journals must be byte-identical across runs."""
+
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def time(self) -> float:
+        self.t += 0.001
+        return self.t
+
+    def monotonic(self) -> float:
+        self.t += 0.001
+        return self.t
+
+    def monotonic_ns(self) -> int:
+        self.t += 0.001
+        return int(self.t * 1e9)
+
+    def sleep(self, _dt) -> None:
+        pass
+
+
+@contextlib.contextmanager
+def _patched(mod, **attrs):
+    prev = {k: getattr(mod, k) for k in attrs}
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            setattr(mod, k, v)
+
+
+def _proxied_structs(mod, names):
+    return _patched(mod, **{n: _StructProxy(getattr(mod, n))
+                            for n in names})
+
+
+def crash_states(initial: bytes, journal):
+    """Yield (trace, state_bytes, complete) for every crash point:
+    after each completed store and after every byte prefix of each
+    multi-byte store. An aligned 4-byte store (the commit word) is
+    atomic — all-or-nothing; everything longer tears bytewise. The
+    final yielded state has every store applied (complete=True)."""
+    buf = bytearray(initial)
+    applied: list = []
+    yield "(no stores applied)", bytes(buf), False
+    for k, (off, data) in enumerate(journal):
+        label = f"store#{k}@+{off}x{len(data)}"
+        atomic = len(data) == 4 and off % 4 == 0
+        if not atomic:
+            for j in range(1, len(data)):
+                torn = bytearray(buf)
+                torn[off:off + j] = data[:j]
+                yield (" -> ".join(
+                    applied + [f"{label} torn at {j}/{len(data)}B"]),
+                    bytes(torn), False)
+        buf[off:off + len(data)] = data
+        applied.append(label)
+        yield (" -> ".join(applied), bytes(buf),
+               k == len(journal) - 1)
+
+
+def _verify_states(base, journal, verify, max_schedules):
+    """Run verify(state, complete) -> detail|None over every crash
+    state. Returns (failures, n_schedules, exhausted)."""
+    failures: list = []
+    n = 0
+    exhausted = True
+    for trace, state, complete in crash_states(base, journal):
+        if n >= max_schedules:
+            exhausted = False
+            break
+        n += 1
+        detail = verify(state, complete)
+        if detail is not None:
+            failures.append((
+                "commit-liveness" if complete
+                else "old-value-or-refusal", trace, detail))
+    if not journal:
+        failures.append((
+            "commit-liveness", "(no stores applied)",
+            "the writer stored nothing — nothing was published"))
+    return failures, n, exhausted
+
+
+# -- doctored writers (broken-protocol detection hooks) ---------------
+
+
+def doctored_flightrec_commit_first(rec) -> None:
+    """The classic bug: one forward memcpy, commit/seq word FIRST.
+    run_product('torn-flightrec', writer=...) with this writer must
+    produce a counterexample — tests/ci pin that the harness detects
+    broken protocols, not just blesses working ones."""
+    from language_detector_tpu import flightrec as fr
+    payload = json.dumps({"ev": "ev", "k": 9},
+                         separators=(",", ":")).encode()
+    rec._seq += 1
+    seq = rec._seq
+    off = fr.FILE_HDR.size + ((seq - 1) % rec.slots) * rec.slot_bytes
+    rec.mm[off:off + fr.SLOT_HDR.size] = fr.SLOT_HDR.pack(
+        seq & 0xFFFFFFFF, len(payload), 0.0)
+    rec.mm[off + fr.SLOT_HDR.size:
+           off + fr.SLOT_HDR.size + len(payload)] = payload
+
+
+def doctored_capture_commit_first(writer, rec) -> None:
+    """Capture-ring variant of the same bug: commit word before the
+    record body."""
+    from language_detector_tpu import capture as cap
+    payload = cap.RECORD.pack(*rec)
+    i = writer._seq
+    off = cap.FILE_HDR.size + i * cap.SLOT_BYTES
+    writer.mm[off:off + cap.COMMIT.size] = cap.COMMIT.pack(i + 1)
+    writer.mm[off + cap.COMMIT.size:off + cap.SLOT_BYTES] = payload
+    writer._seq = i + 1
+
+
+# -- products ---------------------------------------------------------
+
+
+def _run_flightrec(writer=None, max_schedules=MAX_SCHEDULES):
+    """Wrap the 8-slot ring, then crash-journal the 9th emit — the
+    wrap is the hard case: the overwritten slot already holds a
+    COMMITTED record from the previous lap."""
+    from language_detector_tpu import flightrec as fr
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "fr.ring")
+        with _patched(fr, time=_FakeTime()):
+            rec = fr.FlightRecorder(path, slots=8, slot_bytes=96)
+            real_mm = rec.mm
+            try:
+                for i in range(1, 9):
+                    rec.emit("ev", {"k": i})
+                base = bytes(real_mm[:])
+                buf = TornBuffer(base)
+                rec.mm = buf
+                if writer is None:
+                    rec.emit("ev", {"k": 9})
+                else:
+                    writer(rec)
+            finally:
+                rec.mm = real_mm
+                real_mm.close()
+        allowed = {(i, i) for i in range(1, 10)}
+        probe = os.path.join(td, "probe.ring")
+
+        def verify(state, complete):
+            Path(probe).write_bytes(state)
+            events = fr.read_ring(probe)["events"]
+            seen = {(e["seq"], e.get("k")) for e in events}
+            torn = sorted(seen - allowed)
+            if torn:
+                return (f"reader accepted torn record(s) "
+                        f"{torn} (seq/payload mixed across laps)")
+            if complete and (9, 9) not in seen:
+                return "fully applied write never became readable"
+            return None
+
+        return _verify_states(base, buf.journal, verify, max_schedules)
+
+
+def _mk_capture_record(i: int) -> tuple:
+    """A RECORD tuple whose docs field identifies the record."""
+    return (i, i, 0, i, 0.0, 1.0, 0.1, 0.2, 0.3, 200, 1, 0, 0, 0)
+
+
+def _run_capture(writer=None, max_schedules=MAX_SCHEDULES):
+    """Commit one record, then crash-journal the append of a second."""
+    from language_detector_tpu import capture as cap
+    with tempfile.TemporaryDirectory() as td:
+        w = cap.CaptureWriter(td, ring_records=16, sample=1.0,
+                              max_segments=2, seed=0)
+        real_mm = w.mm
+        try:
+            w.append(_mk_capture_record(1))
+            base = bytes(real_mm[:])
+            buf = TornBuffer(base)
+            w.mm = buf
+            if writer is None:
+                w.append(_mk_capture_record(2))
+            else:
+                writer(w, _mk_capture_record(2))
+        finally:
+            w.mm = real_mm
+            real_mm.close()
+        probe = os.path.join(td, "probe.ring")
+
+        def verify(state, complete):
+            Path(probe).write_bytes(state)
+            docs = [r["docs"] for r in cap._read_file(probe)]
+            if complete:
+                if docs != [1, 2]:
+                    return (f"fully applied append reads back as "
+                            f"{docs}, want [1, 2]")
+                return None
+            if any(d not in (1, 2) for d in docs) or docs[:1] != [1]:
+                return (f"reader accepted a torn record: docs={docs} "
+                        f"(committed prefix is [1])")
+            return None
+
+        return _verify_states(base, buf.journal, verify, max_schedules)
+
+
+def _run_sharedcache(writer=None, max_schedules=MAX_SCHEDULES):
+    """Crash-journal a seqlock put into a cache that already holds an
+    unrelated committed key; the reader must keep returning the old
+    key's value and never a torn view of the new one."""
+    from language_detector_tpu.service import sharedcache as sc
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "cache.shm")
+        cache = sc.SharedResultCache(
+            path, sc.HEADER_BYTES + 8 * sc.SLOT_BYTES)
+        real_mm = cache._mm
+        try:
+            cache.put("k0", "old")
+            base = bytes(real_mm[:])
+            with _proxied_structs(sc, ("_U32", "_SLOT_HDR")):
+                buf = TornBuffer(base)
+                cache._mm = buf
+                if writer is None:
+                    cache.put("k1", "v1")
+                else:
+                    writer(cache)
+
+                def verify(state, complete):
+                    cache._mm = TornBuffer(state)
+                    v0 = cache.get("k0")
+                    v1 = cache.get("k1")
+                    if v0 != "old":
+                        return (f"committed neighbour key was "
+                                f"disturbed: get('k0') -> {v0!r}")
+                    if complete:
+                        if v1 != "v1":
+                            return (f"fully applied put never became "
+                                    f"readable: get('k1') -> {v1!r}")
+                    elif v1 not in (None, "v1"):
+                        return (f"reader accepted a torn value: "
+                                f"get('k1') -> {v1!r}")
+                    return None
+
+                out = _verify_states(base, buf.journal, verify,
+                                     max_schedules)
+        finally:
+            cache._mm = real_mm
+            cache.close()
+        return out
+
+
+def _run_shmring(writer=None, max_schedules=MAX_SCHEDULES):
+    """Crash-journal a client submit; a sweep-side reader must never
+    observe READY with an unsettled header or payload."""
+    from language_detector_tpu.service import shmring as sm
+    body = b'{"texts":["torn-write probe"]}'
+    with tempfile.TemporaryDirectory() as td:
+        with _patched(sm, time=_FakeTime(), struct=_ModStructProxy()), \
+                _proxied_structs(sm, ("RING_HDR", "SLOT_HDR")):
+            client = sm.RingClient(td, slots=2, slot_bytes=4096)
+            rf = client.rf
+            real_mm = rf.mm
+            try:
+                rf.set_generation(1, os.getpid())
+                base = bytes(real_mm[:])
+                buf = TornBuffer(base)
+                rf.mm = buf
+                if writer is None:
+                    assert client.submit(body) == 0
+                else:
+                    writer(client, body)
+            finally:
+                rf.mm = real_mm
+                rf.close()
+        probe = os.path.join(td, "probe.ring")
+        final = None
+
+        def read_state(state):
+            Path(probe).write_bytes(state)
+            prf = sm.RingFile(probe)
+            try:
+                hdr = prf.read_slot(0)
+                payload = bytes(prf.mm[prf.payload_off(0):
+                                       prf.payload_off(0) + len(body)])
+            finally:
+                prf.close()
+            return hdr, payload
+
+        # the fully-applied state defines the one legal READY header
+        full = bytearray(base)
+        for off, data in buf.journal:
+            full[off:off + len(data)] = data
+        final = read_state(bytes(full))
+
+        def verify(state, complete):
+            hdr, payload = read_state(state)
+            st = hdr[0]
+            if complete:
+                if st != sm.SLOT_READY or payload != body:
+                    return (f"fully applied submit not readable: "
+                            f"state={st} payload={payload!r}")
+                return None
+            if st == sm.SLOT_READY and (hdr, payload) != final:
+                return (f"reader observed READY over an unsettled "
+                        f"slot: header={hdr} payload={payload!r}")
+            return None
+
+        return _verify_states(base, buf.journal, verify, max_schedules)
+
+
+# (name, subject file, runner) — mirrors model_check.PRODUCTS shape
+TORN_PRODUCTS = (
+    ("torn-flightrec", "language_detector_tpu/flightrec.py",
+     _run_flightrec),
+    ("torn-capture", "language_detector_tpu/capture.py",
+     _run_capture),
+    ("torn-sharedcache", "language_detector_tpu/service/sharedcache.py",
+     _run_sharedcache),
+    ("torn-shmring", "language_detector_tpu/service/shmring.py",
+     _run_shmring),
+)
+
+
+def run_product(name, writer=None, max_schedules=MAX_SCHEDULES):
+    """Explore one named product; returns (failures, n_schedules,
+    exhausted). `writer` replaces the real writer — the broken-protocol
+    detection hook for tests and the ci torn-write smoke."""
+    for pname, _path, runner in TORN_PRODUCTS:
+        if pname == name:
+            return runner(writer=writer, max_schedules=max_schedules)
+    raise KeyError(name)
+
+
+def check(root=None, files=None, products=TORN_PRODUCTS):
+    """Run every torn-write product. `files` (repo-relative paths)
+    restricts to products whose subject module is listed. Violations
+    carry the minimal store-schedule trace of the failing crash
+    state."""
+    from language_detector_tpu import faults
+    _ = Path(root) if root else _REPO
+    if files is not None:
+        keep = {str(f) for f in files}
+        products = [p for p in products if p[1] in keep]
+    violations: list = []
+    prev = faults.ACTIVE
+    try:
+        faults.configure(None)
+        for name, path, runner in products:
+            failures, n, exhausted = runner()
+            if not exhausted:
+                violations.append(Violation(
+                    "torn-write-invariant", path, 1,
+                    f"[{name}] crash-schedule exploration hit the "
+                    f"safety cap after {n} schedules without closing"))
+            for inv, trace, detail in failures:
+                violations.append(Violation(
+                    "torn-write-invariant", path, 1,
+                    f"[{name}] invariant {inv} violated at crash "
+                    f"point {trace}: {detail}"))
+    finally:
+        faults.ACTIVE = prev
+    return violations, 0
