@@ -1986,6 +1986,205 @@ print("capture/replay + SLO:", f"{len(records)} records captured",
       f"with 0 drops (p95 skew {d['schedule']['p95_skew_ms']}ms)")
 EOF
 
+echo "== flash-crowd config-plane smoke =="
+# the runtime config plane + synthetic load model (PR 20,
+# docs/ROBUSTNESS.md): a 2-member fleet under the lock-order watchdog
+# and a declared SLO rides out a seeded loadgen flash crowd; a
+# doctored-bad fleet config push (1 ms default deadline: every request
+# 504s, deterministically — no fault timing to race) burns the SLO
+# fast window on the canary and AUTO-ROLLS-BACK within probation while
+# the rest of the fleet never sees the bad generation; the SLO alert
+# fires during the burn and recovers after; a good push then commits
+# canary-then-fan-out and every member converges on the new
+# generation; a second flash crowd serves clean under it. Zero worker
+# deaths throughout, SIGTERM drains to exit 0, and the config_* event
+# journal lands in the flight recorder.
+python3 - <<'EOF'
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+PORT, MBASE, SPORT = 3193, 31930, 31939
+TMP = tempfile.mkdtemp(prefix="ldt_cfg_")
+FREC = os.path.join(TMP, "flightrec")
+env = dict(os.environ)
+env.update({
+    "LISTEN_PORT": str(PORT), "PROMETHEUS_PORT": str(MBASE),
+    "LDT_FLEET_WORKERS": "2",
+    "LDT_FLEET_STATUS_PORT": str(SPORT),
+    "LDT_FLIGHTREC_DIR": FREC,
+    # ~1.6k requests emit start+end pairs; the default 256-slot ring
+    # would wrap and evict the drill-phase slo_breach/config_* journal
+    # this smoke asserts on
+    "LDT_FLIGHTREC_SLOTS": "8192",
+    # generous latency target: the flash crowd itself holds the SLO;
+    # only the doctored deadline's 504s burn budget. The 2% error
+    # budget makes the slow (96 s) window cross burn 1.0 on the first
+    # few 504s — before rollback restores the canary — so the
+    # multiwindow alert provably fires during the drill
+    "LDT_SLO": "p99_ms=30000,err_pct=2,window_sec=8",
+    # the crowd must stress the 2 members we assert on, not autoscale
+    "LDT_FLEET_SCALE_UP_DEPTH": "100000",
+    "LDT_CRASH_BACKOFF_BASE_SEC": "0.2",
+    "LDT_LOCK_DEBUG": "1",
+})
+log = open("/tmp/ldt_cfg_smoke.log", "w")
+sup = subprocess.Popen(
+    [sys.executable, "-m", "language_detector_tpu.service.supervisor",
+     "language_detector_tpu.service.aioserver"],
+    env=env, stdout=log, stderr=subprocess.STDOUT,
+    start_new_session=True)
+
+sys.path.insert(0, os.getcwd())
+import bench  # noqa: E402
+from language_detector_tpu import flightrec, loadgen  # noqa: E402
+
+
+def get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def wait_for(pred, what, deadline_sec, path="/fleetz"):
+    deadline = time.time() + deadline_sec
+    while True:
+        doc = get(f"http://127.0.0.1:{SPORT}{path}")
+        if doc is not None and pred(doc):
+            return doc
+        assert time.time() < deadline, \
+            f"never reached: {what} — last: {json.dumps(doc)[:4000]}"
+        assert sup.poll() is None, f"supervisor died rc={sup.poll()}"
+        time.sleep(0.25)
+
+
+def push_config(batch, probation_sec, timeout=90):
+    body = json.dumps({"set": batch,
+                       "probation_sec": probation_sec}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{SPORT}/configz", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+try:
+    fz = wait_for(lambda s: s["ready"] == 2, "2 READY members", 300)
+    pids0 = sorted(m["pid"] for m in fz["members"])
+
+    # -- lap 1: seeded flash crowd under env defaults holds the SLO --
+    crowd = loadgen.generate("flash_crowd", n=160, tenants=8,
+                             base_rps=40, seed=7)
+    r1 = bench.replay_records(crowd, PORT, speedup=1.0, clients=8)
+    assert r1["counts"]["drop"] == 0, f"lap1 drops: {r1['counts']}"
+    assert r1["counts"]["error"] == 0, f"lap1 errors: {r1['counts']}"
+
+    # -- doctored-bad push: canary burns, rolls back, fleet is held --
+    # the 1 ms deadline only bites under concurrency (queue wait must
+    # exceed it), so the flash crowd keeps replaying while the push is
+    # in flight: the canary's 504s burn its SLO fast window
+    push_out = {}
+
+    def bad_push():
+        push_out["st"], push_out["doc"] = push_config(
+            {"LDT_DEFAULT_DEADLINE_MS": "1"}, probation_sec=30,
+            timeout=120)
+
+    t = threading.Thread(target=bad_push)
+    t.start()
+    burned = 0
+    while t.is_alive():
+        # 32 concurrent clients: the doctored deadline fails a whole
+        # swept batch at once, so several 504s land inside one
+        # probation tick — enough to cross the slow window's burn
+        # (firing the multiwindow alert), not just the fast one
+        lap = bench.replay_records(crowd, PORT, speedup=2.0,
+                                   clients=32)
+        burned += lap["counts"]["error"]
+    t.join()
+    assert push_out["st"] == 409, push_out
+    assert "rolled" in push_out["doc"]["error"], push_out
+    assert push_out["doc"]["canary"]["state"] == "rolled_back", push_out
+    assert burned > 0, "doctored deadline never bit (no 504s)"
+    # the bad generation never reached the fleet-committed config
+    fz = get(f"http://127.0.0.1:{SPORT}/fleetz")
+    assert fz["config"]["generation"] == 0, fz["config"]
+    assert fz["config"]["values"] == {}, fz["config"]
+
+    # -- rollback restored the prior config: a clean lap serves ------
+    r_back = bench.replay_records(crowd, PORT, speedup=1.0, clients=8)
+    assert r_back["counts"]["error"] == 0, \
+        f"canary still doctored after rollback: {r_back['counts']}"
+
+    # -- the burn fired the alert; rollback lets it recover ----------
+    wait_for(lambda s: s.get("alert") == "ok", "slo alert recovered",
+             120, path="/sloz")
+
+    # -- good push: canary probation, commit, fan-out, convergence ---
+    st, doc = push_config({"LDT_MAX_QUEUE_DOCS": "4000"},
+                          probation_sec=3)
+    assert st == 200, (st, doc)
+    gen = doc["generation"]
+    assert doc["values"] == {"LDT_MAX_QUEUE_DOCS": "4000"}, doc
+    wait_for(
+        lambda s: s["config"]["generation"] == gen
+        and all(m["config_generation"] == gen for m in s["members"]),
+        "every member on the committed generation", 60)
+
+    # -- lap 2: flash crowd again, on the committed config -----------
+    r2 = bench.replay_records(crowd, PORT, speedup=1.0, clients=8)
+    assert r2["counts"]["drop"] == 0, f"lap2 drops: {r2['counts']}"
+    assert r2["counts"]["error"] == 0, f"lap2 errors: {r2['counts']}"
+    slo = get(f"http://127.0.0.1:{SPORT}/sloz")
+    assert slo.get("alert") == "ok", slo
+
+    # -- zero worker deaths, clean SIGTERM drain ---------------------
+    fz = get(f"http://127.0.0.1:{SPORT}/fleetz")
+    assert sorted(m["pid"] for m in fz["members"]) == pids0, \
+        f"a member was respawned: {fz['members']}"
+    assert not fz.get("postmortems"), fz["postmortems"]
+    sup.send_signal(signal.SIGTERM)
+    rc = sup.wait(timeout=120)
+    assert rc == 0, f"fleet exit {rc}"
+finally:
+    try:
+        os.killpg(sup.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    sup.wait(timeout=30)
+    log.close()
+
+evs = []
+for ring in glob.glob(os.path.join(FREC, "**", "flightrec-*.ring"),
+                      recursive=True):
+    evs += [e["ev"] for e in flightrec.read_ring(ring)["events"]]
+for want in ("config_staged", "config_applied", "config_rolled_back",
+             "config_committed", "slo_breach", "slo_recovered"):
+    assert want in evs, f"no {want} event recorded"
+
+shutil.rmtree(TMP, ignore_errors=True)
+print("flash-crowd config plane:",
+      f"{len(crowd)} crowd requests per lap with 0 drops,",
+      f"doctored push rolled back on the canary ({burned} burned"
+      " 504s, fleet held at gen 0),",
+      f"good push committed at gen {gen} and converged,",
+      "alert fired+recovered, 0 worker deaths, SIGTERM exit 0")
+EOF
+
 echo "== accuracy smoke =="
 # the evalsuite scorecard (docs/ACCURACY.md): score the bundled corpus
 # through the device engine, pin device-vs-scalar-oracle agreement at

@@ -44,6 +44,7 @@ can only ever leave a torn tmp file, which readers never open.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import json
 import os
@@ -225,6 +226,9 @@ class AotStore:
         self.loads = 0
         self.exports = 0
         self.refusals = 0
+        # flipped (sticky) by offer() on a disk-full export: loads
+        # keep working, export compiles stop
+        self.export_disabled = False
 
     # -- load path ----------------------------------------------------
 
@@ -349,6 +353,8 @@ class AotStore:
         that triggered it."""
         sig = shape_signature(wire)
         with self._lock:
+            if self.export_disabled:
+                return False  # disk full: stop paying export compiles
             known = self._entries.get(sig)
             if sig in self._exported:
                 return False  # this store already wrote the entry
@@ -411,7 +417,18 @@ class AotStore:
                 f.write(blob)
             os.replace(tmp, path)
         except Exception as e:  # noqa: BLE001 - write-back is best-effort
-            _log("aot export failed", path=path, error=repr(e))
+            if isinstance(e, OSError) and e.errno == errno.ENOSPC:
+                # sticky: every later offer would recompile just to
+                # fail the same write — loads still work, the service
+                # keeps serving, the disable is counted and logged
+                with self._lock:
+                    self.export_disabled = True
+                telemetry.REGISTRY.counter_inc(
+                    "ldt_aot_disabled_total", reason="enospc")
+                _log("aot exports disabled", reason="enospc",
+                     path=path, error=repr(e))
+            else:
+                _log("aot export failed", path=path, error=repr(e))
             return False
         with self._lock:
             self.exports += 1
@@ -464,6 +481,7 @@ class AotStore:
                     "digest": self.digest, "loads": self.loads,
                     "exports": self.exports,
                     "refusals": self.refusals,
+                    "export_disabled": self.export_disabled,
                     "entries": sum(1 for v in self._entries.values()
                                    if v is not _ABSENT)}
 
@@ -499,6 +517,10 @@ def build_from_env(kernel_mode: str, dt) -> AotStore | None:
             os.makedirs(directory, exist_ok=True)
             _log("aot bundle dir created", dir=directory)
         except OSError as e:
+            telemetry.REGISTRY.counter_inc(
+                "ldt_aot_disabled_total",
+                reason="enospc" if e.errno == errno.ENOSPC
+                else "oserror")
             _log("aot bundle dir unusable — AOT disabled",
                  dir=directory, error=repr(e))
             return None

@@ -51,8 +51,10 @@ input `bench.py --replay` re-drives against a live fleet.
 """
 from __future__ import annotations
 
+import errno
 import glob
 import hashlib
+import json
 import mmap
 import os
 import random
@@ -61,6 +63,10 @@ import time
 
 from . import knobs
 from .locks import make_lock
+
+
+def _log(msg: str, **fields) -> None:
+    print(json.dumps({"msg": msg, **fields}), flush=True)
 
 RING_MAGIC = b"LDCR"
 SEG_MAGIC = b"LDCS"
@@ -175,6 +181,9 @@ class CaptureWriter:
         self.max_segments = max(int(max_segments), 1)
         self._rng = random.Random(seed)
         self._lock = make_lock("capture.ring")
+        # set by _seal_locked on a disk-full seal; observe() reads it
+        # outside the ring lock and retires the plane for good
+        self.disabled_reason: str | None = None
         self._seq = 0            # committed records in the active ring
         self._segments = 0       # segments sealed over the lifetime
         self._records_total = 0
@@ -249,7 +258,12 @@ class CaptureWriter:
                                       self._mono_anchor))
                 f.write(bytes(records))
             os.replace(tmp, seg)
-        except OSError:
+        except OSError as e:
+            # a full disk is terminal for the plane, not the service:
+            # flag it here (observe() retires the writer outside this
+            # lock) instead of burning a failed seal every ring fill
+            if e.errno == errno.ENOSPC:
+                self.disabled_reason = "enospc"
             try:
                 os.remove(tmp)
             except OSError:
@@ -306,9 +320,22 @@ def init_from_env() -> CaptureWriter | None:
         return None
     try:
         WRITER = CaptureWriter(directory)
-    except OSError:
+    except OSError as e:
+        _disable("enospc" if e.errno == errno.ENOSPC else "oserror",
+                 directory, repr(e))
         return None
     return WRITER
+
+
+def _disable(reason: str, directory: str, detail: str) -> None:
+    """Retire the capture plane: structured log + counted disable. The
+    service keeps serving — capture is observability, never load-
+    bearing."""
+    from . import telemetry
+    telemetry.REGISTRY.counter_inc("ldt_capture_disabled_total",
+                                   reason=reason)
+    _log("capture disabled", reason=reason, dir=directory,
+         detail=detail)
 
 
 def observe(trace, meta: dict | None, total_ms: float) -> None:
@@ -316,8 +343,16 @@ def observe(trace, meta: dict | None, total_ms: float) -> None:
     request. No-op (one attribute check) when capture is off. Counter
     increments happen HERE, outside the ring lock — the telemetry
     registry lock must never nest inside capture.ring."""
+    global WRITER
     w = WRITER
     if w is None:
+        return
+    if w.disabled_reason:
+        # a seal hit disk-full: unbind the writer so the fast path
+        # returns to one attribute check, and keep serving
+        WRITER = None
+        _disable(w.disabled_reason, w.dir, "seal failed")
+        w.close()
         return
     segments_before = w._segments
     kept = w.append(record_from(trace, meta, total_ms))

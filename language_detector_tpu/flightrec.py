@@ -127,6 +127,26 @@ EVENTS: dict = {
         "transition",
         "A firing SLO burn-rate alert cleared: the fast window's burn "
         "rate dropped back under 1.0 (slo.py)."),
+    "config_staged": (
+        "transition",
+        "A runtime config batch passed registry validation and was "
+        "staged for apply (configplane.py; generation + knob names "
+        "attached)."),
+    "config_applied": (
+        "transition",
+        "A staged config batch went live under SLO probation "
+        "(configplane.py; the generation serves but is not yet "
+        "committed)."),
+    "config_committed": (
+        "transition",
+        "A config generation survived its probation window and "
+        "committed (configplane.py)."),
+    "config_rolled_back": (
+        "transition",
+        "A config generation was auto-rolled-back: the SLO fast-window "
+        "burn rate crossed 1.0 during probation, or an operator "
+        "reverted it — the prior overrides are restored "
+        "(configplane.py)."),
     "postmortem": (
         "lifecycle",
         "A dead member's recorder was harvested into postmortem JSON "
@@ -244,8 +264,20 @@ def init_from_env(role: str = "worker") -> FlightRecorder | None:
     try:
         os.makedirs(directory, exist_ok=True)
         rec = FlightRecorder(ring_path(directory))
-    except OSError:
-        return None  # best-effort observability, never a startup fail
+    except OSError as e:
+        # best-effort observability, never a startup fail — but a
+        # counted, logged disable (a full disk silently eating the
+        # postmortem recorder is how outages lose their evidence)
+        import errno
+
+        from . import telemetry
+        reason = "enospc" if e.errno == errno.ENOSPC else "oserror"
+        telemetry.REGISTRY.counter_inc("ldt_flightrec_disabled_total",
+                                       reason=reason)
+        print(json.dumps({"msg": "flightrec disabled",
+                          "reason": reason, "dir": directory,
+                          "detail": repr(e)}), flush=True)
+        return None
     RECORDER = rec
     emit_event("proc_start", role=role,
                generation=knobs.get_int("LDT_WORKER_GENERATION") or 0)

@@ -23,6 +23,13 @@ Semantics (shared by every knob, formerly re-implemented per file):
 Values are read from the environment at every call (no import-time
 caching) so tests that monkeypatch a knob and re-init a component see
 the change.
+
+Knobs declared `mutable=True` form the runtime config plane's settable
+surface: POST /configz stages a validated override batch
+(apply_overrides) that the accessors consult before the environment,
+and `current()` returns a versioned snapshot of the whole mutable
+surface. Mutable knobs must therefore be read at use time — the lint
+analyzer flags any import-time-cached read of one.
 """
 from __future__ import annotations
 
@@ -47,11 +54,19 @@ class Knob:
     external: bool = field(default=False)  # contract var owned by the
     # platform (JAX/TPU launchers); declared for the docs table and the
     # lint registry, defaults are never exported back
+    mutable: bool = field(default=False)  # runtime-settable through the
+    # config plane (POST /configz -> apply_overrides); mutable knobs
+    # must be read at use time, never cached at import (lint-enforced)
+    mrange: tuple | None = field(default=None)  # (lo, hi) inclusive
+    # bounds a runtime override must satisfy; also the autotuner's
+    # declared search interval for this knob
 
 
 def _k(name: str, ktype: str, default: object, doc: str,
-       bound: bool = False, external: bool = False) -> Knob:
-    return Knob(name, ktype, default, doc, bound, external)
+       bound: bool = False, external: bool = False,
+       mutable: bool = False, mrange: tuple | None = None) -> Knob:
+    return Knob(name, ktype, default, doc, bound, external,
+                mutable, mrange)
 
 
 _DECLARATIONS: tuple[Knob, ...] = (
@@ -80,17 +95,22 @@ _DECLARATIONS: tuple[Knob, ...] = (
     # -- admission control (service/admission.py) ---------------------
     _k("LDT_MAX_QUEUE_DOCS", "int", None,
        "Admission bound: max documents admitted and not yet completed; "
-       "past it requests shed with 429.", bound=True),
+       "past it requests shed with 429.", bound=True,
+       mutable=True, mrange=(1, 1_000_000)),
     _k("LDT_MAX_QUEUE_BYTES", "int", None,
        "Admission bound: max byte-weighted cost (4 bytes per estimated "
-       "packer slot) held at once.", bound=True),
+       "packer slot) held at once.", bound=True,
+       mutable=True, mrange=(1, 1 << 31)),
     _k("LDT_MAX_INFLIGHT", "int", None,
-       "Admission bound: max HTTP requests in flight.", bound=True),
+       "Admission bound: max HTTP requests in flight.", bound=True,
+       mutable=True, mrange=(1, 65536)),
     _k("LDT_DEFAULT_DEADLINE_MS", "float", None,
        "Default request deadline when X-LDT-Deadline-Ms is absent; "
-       "expired work is dropped at dequeue (504).", bound=True),
+       "expired work is dropped at dequeue (504).", bound=True,
+       mutable=True, mrange=(1.0, 600_000.0)),
     _k("LDT_BROWNOUT_ALPHA", "float", 0.3,
-       "EWMA smoothing factor for the brownout ladder's load signal."),
+       "EWMA smoothing factor for the brownout ladder's load signal.",
+       mutable=True, mrange=(0.01, 1.0)),
     _k("LDT_BROWNOUT_ENTER", "levels", (0.60, 0.80, 0.95),
        "Comma-separated occupancy thresholds to ENTER brownout levels "
        "1..3."),
@@ -160,13 +180,16 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "(at 3x this the member is killed and respawned)."),
     _k("LDT_FLEET_SCALE_UP_DEPTH", "int", 64,
        "Sustained per-member admission queue depth (or brownout level "
-       ">= 2) that scales the fleet up one member."),
+       ">= 2) that scales the fleet up one member.",
+       mutable=True, mrange=(1, 100_000)),
     _k("LDT_FLEET_SCALE_DOWN_DEPTH", "int", 0,
        "Queue depth at or below which (with no brownout) the fleet "
-       "scales down one member via a zero-drop drain."),
+       "scales down one member via a zero-drop drain.",
+       mutable=True, mrange=(0, 100_000)),
     _k("LDT_FLEET_SCALE_HOLD_SEC", "float", 10.0,
        "Hysteresis hold: the overload/idle condition must persist this "
-       "long before one scale step fires (and the timer re-arms)."),
+       "long before one scale step fires (and the timer re-arms).",
+       mutable=True, mrange=(0.1, 3600.0)),
     _k("LDT_FLEET_CIRCUIT_COOLDOWN_SEC", "float", 5.0,
        "Open fleet-circuit cooldown before one half-open probe member "
        "is spawned; its readiness closes the circuit."),
@@ -404,11 +427,11 @@ _DECLARATIONS: tuple[Knob, ...] = (
        "Per-tenant cap on queued documents (X-LDT-Tenant header; "
        "absent header = tenant \"default\"); over it the tenant sheds "
        "429 tenant_docs while other tenants keep admitting.",
-       bound=True),
+       bound=True, mutable=True, mrange=(1, 1_000_000)),
     _k("LDT_TENANT_QUOTA_BYTES", "int", None,
        "Per-tenant cap on queued byte-weighted cost (same accounting "
        "as LDT_MAX_QUEUE_BYTES); over it the tenant sheds 429 "
-       "tenant_bytes.", bound=True),
+       "tenant_bytes.", bound=True, mutable=True, mrange=(1, 1 << 31)),
     _k("LDT_TENANT_WEIGHTS", "str", None,
        "Deficit-weighted fair queueing weights as "
        "\"tenantA=4,tenantB=1\" (unlisted tenants weigh 1). Setting it "
@@ -467,6 +490,14 @@ _DECLARATIONS: tuple[Knob, ...] = (
     _k("LDT_SLO_MIN_EVENTS", "int", 4,
        "Minimum fast-window events before a burn-rate breach may "
        "fire; suppresses alerts on near-idle traffic."),
+    # -- runtime config plane (configplane.py) ------------------------
+    _k("LDT_CONFIG_PROBATION_SEC", "float", 10.0,
+       "Default probation window for a POST /configz apply: the new "
+       "config serves under SLO watch this long; a fast-window burn "
+       "rate >= 1.0 inside the window auto-rolls the apply back "
+       "(configplane.py). A per-request probation_sec overrides it; "
+       "0 commits immediately (the fleet's fan-out of an already-"
+       "proven config)."),
     # -- debug / CI ---------------------------------------------------
     _k("LDT_LOCK_DEBUG", "bool", False,
        "Build order-checking debug locks (language_detector_tpu/locks)"
@@ -492,15 +523,28 @@ _DECLARATIONS: tuple[Knob, ...] = (
 
 KNOBS: dict[str, Knob] = {k.name: k for k in _DECLARATIONS}
 
+# Runtime overrides for MUTABLE knobs, applied by the config plane
+# (configplane.apply -> apply_overrides). Stored as raw env-style
+# strings so every override rides the exact same parse / bound
+# semantics as an environment value. _VERSION bumps on every change so
+# components that cache derived state (AdmissionController) can detect
+# staleness with one int compare per call.
+_OVERRIDES: dict[str, str] = {}
+_VERSION: int = 0
+
 
 def raw(name: str) -> str | None:
     """The registry's single environment touch: the raw string value of
     a DECLARED knob, or None when unset. Reading an undeclared name is
-    a programming error (declare it above)."""
+    a programming error (declare it above). Mutable knobs consult the
+    runtime override map first, so an applied /configz change is live
+    for every accessor without re-exec."""
     knob = KNOBS.get(name)
     if knob is None:
         raise KeyError(f"undeclared env knob {name!r}; declare it in "
                        "language_detector_tpu/knobs.py")
+    if knob.mutable and name in _OVERRIDES:
+        return _OVERRIDES[name]
     return os.environ.get(name)
 
 
@@ -592,13 +636,109 @@ def get_levels(name: str) -> tuple[float, ...]:
     return v
 
 
+def mutable_knobs() -> tuple[Knob, ...]:
+    """Every knob declared runtime-settable, in declaration order —
+    the config plane's settable surface and the autotuner's search
+    space."""
+    return tuple(k for k in _DECLARATIONS if k.mutable)
+
+
+def overrides_version() -> int:
+    """Monotonic version of the runtime-override state; bumps on every
+    apply_overrides / clear_overrides so callers can cache derived
+    config behind one int compare."""
+    return _VERSION
+
+
+def current() -> dict:
+    """Versioned snapshot of the mutable-knob surface: the effective
+    (env + overrides, fully parsed) value of every mutable knob, the
+    raw override map, and the override version. Components that must
+    see /configz changes read through this (or the typed accessors,
+    which consult the same override map) — never an import-time
+    cache."""
+    return {
+        "version": _VERSION,
+        "values": {k.name: value(k.name) for k in mutable_knobs()},
+        "overrides": dict(_OVERRIDES),
+    }
+
+
+def _validate_override(name: str, rawv: str) -> str | None:
+    """Error string when `rawv` is not a legal runtime value for the
+    mutable knob `name`, else None. Validation is the same parse the
+    environment gets, plus the declared mrange — an apply must refuse
+    loudly where an env mistype merely warns-and-defaults."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        return f"undeclared knob {name!r}"
+    if not knob.mutable:
+        return f"{name} is not mutable"
+    if knob.ktype not in ("int", "float"):
+        return f"{name}: mutable {knob.ktype} knobs are unsupported"
+    try:
+        n = _parse_scalar(knob, rawv)
+    except ValueError:
+        return f"{name}={rawv!r} is not a valid {knob.ktype}"
+    if knob.bound and n <= 0:  # type: ignore[operator]
+        return None  # non-positive bound = "feature off": always legal
+    if knob.mrange is not None:
+        lo, hi = knob.mrange
+        if not (lo <= n <= hi):  # type: ignore[operator]
+            return (f"{name}={rawv} outside declared range "
+                    f"[{lo}, {hi}]")
+    return None
+
+
+def apply_overrides(updates: dict) -> dict:
+    """Validate and apply a runtime override batch atomically: every
+    entry must pass the type/bound/mrange contract or the whole batch
+    is refused with ValueError (no partial applies). A None value
+    removes that knob's override (reverts to the environment). Returns
+    the post-apply current() snapshot."""
+    global _VERSION
+    staged: dict[str, str | None] = {}
+    errors: list[str] = []
+    for name, v in updates.items():
+        if v is None:
+            knob = KNOBS.get(name)
+            if knob is None or not knob.mutable:
+                errors.append(f"{name} is not a mutable knob")
+            else:
+                staged[name] = None
+            continue
+        rawv = str(v)
+        err = _validate_override(name, rawv)
+        if err is not None:
+            errors.append(err)
+        else:
+            staged[name] = rawv
+    if errors:
+        raise ValueError("; ".join(errors))
+    for name, rawv in staged.items():
+        if rawv is None:
+            _OVERRIDES.pop(name, None)
+        else:
+            _OVERRIDES[name] = rawv
+    _VERSION += 1
+    return current()
+
+
+def clear_overrides() -> None:
+    """Drop every runtime override (rollback to pure-environment
+    config). Bumps the version so cached derived state refreshes."""
+    global _VERSION
+    _OVERRIDES.clear()
+    _VERSION += 1
+
+
 def doc_table() -> str:
     """Markdown table of every declared knob, written into
     docs/OBSERVABILITY.md between the ldt-knob-table markers by
     `python -m tools.lint --write-knob-docs` and drift-checked by the
     knob-registry analyzer."""
-    rows = ["| Knob | Type | Default | Meaning |",
-            "| --- | --- | --- | --- |"]
+    rows = ["| Knob | Type | Default | Mutable | Meaning |",
+            "| --- | --- | --- | --- | --- |"]
     for knob in _DECLARATIONS:
         if knob.default is None:
             dflt = "off" if knob.bound else "unset"
@@ -608,9 +748,16 @@ def doc_table() -> str:
             dflt = "(empty)"
         else:
             dflt = f"{knob.default}"
+        if knob.mutable and knob.mrange is not None:
+            lo, hi = knob.mrange
+            mut = f"yes [{lo:g}, {hi:g}]"
+        elif knob.mutable:
+            mut = "yes"
+        else:
+            mut = ""
         doc = knob.doc
         if knob.external:
             doc += " (platform contract variable)"
-        rows.append(f"| `{knob.name}` | {knob.ktype} | {dflt} | "
-                    f"{doc} |")
+        rows.append(f"| `{knob.name}` | {knob.ktype} | {dflt} | {mut} "
+                    f"| {doc} |")
     return "\n".join(rows)

@@ -251,6 +251,14 @@ class BrownoutLadder:
                                  prev=prev)
         return level
 
+    def retune(self, alpha: float | None = None) -> None:
+        """Runtime retune from the config plane (LDT_BROWNOUT_ALPHA is
+        a mutable knob); the level and EMA carry over so a retune never
+        resets an in-progress brownout."""
+        with self._lock:
+            if alpha is not None and 0.0 < alpha <= 1.0:
+                self.alpha = alpha
+
     def snapshot(self) -> tuple:
         """(level, ema) read under the ladder's own lock — stats
         reporters must not read the raw attributes (lock-discipline
@@ -422,6 +430,12 @@ class AdmissionController:
 
     def __init__(self, config: AdmissionConfig | None = None):
         self.config = config or AdmissionConfig.from_env()
+        # runtime-config staleness marker: the admission bounds are
+        # mutable knobs (POST /configz), so try_admit re-derives the
+        # config whenever the override version moved — one int compare
+        # per admit while nothing changes
+        self._config_version = knobs.overrides_version() \
+            if config is None else None
         c = self.config
         self.ladder = BrownoutLadder(enter=c.brownout_enter,
                                      exit=c.brownout_exit,
@@ -454,7 +468,11 @@ class AdmissionController:
 
     @classmethod
     def from_env(cls) -> "AdmissionController":
-        return cls(AdmissionConfig.from_env())
+        # config=None, NOT cls(AdmissionConfig.from_env()): passing the
+        # config explicitly marks it injected (tests), which pins
+        # _config_version to None and detaches the controller from
+        # runtime /configz overrides
+        return cls()
 
     def attach_pool(self, provider) -> None:
         """Wire the device pool's capacity into the brownout ladder.
@@ -508,6 +526,24 @@ class AdmissionController:
         return Admit(True, status, reason, _SHED_MESSAGES[reason], ra,
                      level, False, docs, cost, tenant)
 
+    def _refresh_config(self) -> None:
+        """Pick up runtime overrides of the mutable admission knobs
+        (POST /configz): when the knobs override version moved, the
+        config re-derives from the registry and the ladder retunes its
+        alpha. Controllers built from an explicitly injected config
+        (tests) never refresh."""
+        v = self._config_version
+        if v is None:
+            return
+        nv = knobs.overrides_version()
+        if nv == v:
+            return
+        c = AdmissionConfig.from_env()
+        with self._lock:
+            self.config = c
+            self._config_version = nv
+        self.ladder.retune(alpha=c.brownout_alpha)
+
     def try_admit(self, texts: list, priority: bool = False,
                   tenant: str | None = None) -> Admit:
         """Admit or shed one request. Order: the brownout ladder sheds
@@ -516,6 +552,7 @@ class AdmissionController:
         sheds on its own budget before it can fill the global queue),
         then the hard bounds shed anything over capacity (429 —
         priority included; a bound is a bound)."""
+        self._refresh_config()
         docs = len(texts)
         cost = request_cost(texts)
         tenant = tenant or DEFAULT_TENANT

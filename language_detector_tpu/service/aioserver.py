@@ -871,6 +871,17 @@ class AioService:
                         pstatus, payload = profiling.arm()
                         writer.write(_http_response(
                             pstatus, json.dumps(payload).encode()))
+                    elif method == b"POST" and path == "/configz":
+                        from .. import configplane
+                        cstatus, payload = configplane.handle_post(
+                            body)
+                        writer.write(_http_response(
+                            cstatus, json.dumps(payload).encode()))
+                    elif path == "/configz":
+                        from .. import configplane
+                        body = json.dumps(configplane.handle_get(),
+                                          indent=2).encode()
+                        writer.write(_http_response(200, body))
                     elif path in ("/healthz", "/readyz"):
                         hstatus, hbody = health_response(self.svc, path)
                         writer.write(_http_response(hstatus, hbody))

@@ -63,6 +63,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from .. import faults, flightrec, knobs, telemetry
@@ -117,6 +118,7 @@ class FleetMember:
         self.fail_streak = 0
         self.queue_docs = 0
         self.brownout = 0
+        self.config_generation = 0  # from the member's /debug/vars
         self.crash_times: list = []
         self.consec_crashes = 0
         self.next_spawn_at = 0.0
@@ -269,6 +271,158 @@ class FleetStatus:
             return self._snap
 
 
+class FleetConfig:
+    """The fleet-committed runtime-config batch: the result of the
+    last canary-proven POST /configz push. Written by the status-server
+    thread, read by the main loop's heal pass (which re-pushes it onto
+    respawned or fan-out-missed members), so a SIGKILLed member cannot
+    leave the fleet split-brained on config generation."""
+
+    def __init__(self):
+        self._lock = make_lock("fleet.config")
+        # serializes whole canary-push campaigns (non-blocking acquire:
+        # a second concurrent POST /configz answers 409, mirroring the
+        # per-member probation-in-flight refusal)
+        self.push_lock = make_lock("fleet.config.push")
+        self.generation = 0
+        self.values: dict = {}
+
+    def next_generation(self) -> int:
+        with self._lock:
+            return self.generation + 1
+
+    def commit(self, generation: int, values: dict) -> None:
+        with self._lock:
+            if generation > self.generation:
+                self.generation = generation
+                self.values = dict(values)
+
+    def read(self) -> tuple:
+        with self._lock:
+            return self.generation, dict(self.values)
+
+
+def _member_configz(port: int, payload: dict | None = None,
+                    timeout: float = 2.0) -> tuple:
+    """POST (payload given) or GET one member's /configz. Returns
+    (status, body dict); a 4xx refusal still carries the member's JSON
+    body, so callers can surface the member's own error."""
+    url = f"http://127.0.0.1:{port}/configz"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode() or "{}")
+        except ValueError:
+            return e.code, {}
+
+
+def _fleet_config_push(snap: dict, fleet_config: FleetConfig,
+                       body: bytes) -> tuple:
+    """The supervisor's guarded fleet-wide config push: canary first,
+    fan out only after the canary survives probation.
+
+    1. Stage the batch on ONE ready member (the canary) with the
+       requested probation window, so every other member — at least
+       N-1 of the fleet — keeps serving on the old config.
+    2. Poll the canary's GET /configz (each poll drives its probation
+       tick) until it reports committed or rolled_back.
+    3. Only on commit: fan the batch out to the rest of the fleet with
+       probation 0 and the SAME generation stamp, record it as the
+       fleet-committed config (the main loop heals any member the
+       fan-out missed), and apply it to the supervisor's own process so
+       fleet-scoped knobs (autoscale thresholds) go live too.
+
+    Returns (http status, response dict) for the status server."""
+    try:
+        req = json.loads(body or b"{}")
+        if not isinstance(req, dict):
+            raise ValueError("body must be a JSON object")
+        updates = req.get("set")
+        if not isinstance(updates, dict) or not updates:
+            raise ValueError('body must carry a non-empty "set" object')
+        probation = req.get("probation_sec")
+        probation = float(probation) if probation is not None else (
+            knobs.get_float("LDT_CONFIG_PROBATION_SEC") or 0.0)
+    except (ValueError, json.JSONDecodeError) as e:
+        return 400, {"error": f"bad /configz request: {e}"}
+    ports = [int(m.get("metrics_port") or 0)
+             for m in snap.get("members", ())
+             if m.get("state") in ("ready", "degraded")
+             and int(m.get("metrics_port") or 0) > 0]
+    if not ports:
+        return 503, {"error": "no ready member to canary the config on"}
+    if not fleet_config.push_lock.acquire(blocking=False):
+        return 409, {"error": "a fleet config push is already in flight"}
+    try:
+        generation = fleet_config.next_generation()
+        canary, rest = ports[0], ports[1:]
+        try:
+            st, result = _member_configz(
+                canary, {"set": updates, "probation_sec": probation,
+                         "generation": generation})
+        except Exception as e:  # noqa: BLE001 - surface, don't crash
+            return 503, {"error": f"canary push failed: {e!r}"}
+        if st != 200:
+            return st, {"error": "canary refused the config",
+                        "canary": result}
+        deadline = time.time() + probation + 10.0
+        while True:
+            state = (result or {}).get("state")
+            if state == "committed" \
+                    and result.get("generation") == generation:
+                break
+            if state == "rolled_back" \
+                    and result.get("staged_generation") == generation:
+                flightrec.emit_event("config_rolled_back",
+                                     generation=generation,
+                                     reason="canary rolled back")
+                return 409, {"error": "canary rolled the config back",
+                             "generation": generation,
+                             "canary": result}
+            if time.time() >= deadline:
+                return 504, {"error": "canary probation did not "
+                                      "resolve in time",
+                             "generation": generation,
+                             "canary": result}
+            time.sleep(0.2)
+            try:
+                _, result = _member_configz(canary)
+            except Exception:  # canary mid-restart: keep polling
+                pass
+        fanout = {"set": updates, "probation_sec": 0,
+                  "generation": generation}
+        pushed, heal_pending = [canary], []
+        for port in rest:
+            try:
+                st, _r = _member_configz(port, fanout)
+            except Exception:  # noqa: BLE001 - heal pass converges it
+                st = 0
+            (pushed if st == 200 else heal_pending).append(port)
+        fleet_config.commit(generation, updates)
+        try:
+            # the supervisor's own process: autoscale knobs go live
+            knobs.apply_overrides(updates)
+        except ValueError as e:
+            _log("fleet: committed batch refused by supervisor's own "
+                 "registry", reason="config-push", error=repr(e))
+        telemetry.REGISTRY.counter_inc("ldt_config_applies_total",
+                                       result="committed")
+        flightrec.emit_event("config_committed", generation=generation)
+        _log("fleet: config push committed", reason="config-push",
+             generation=generation, canary_port=canary,
+             pushed=len(pushed), heal_pending=len(heal_pending))
+        return 200, {"generation": generation, "values": updates,
+                     "probation_sec": probation, "canary_port": canary,
+                     "pushed": pushed, "heal_pending": heal_pending,
+                     "canary": result}
+    finally:
+        fleet_config.push_lock.release()
+
+
 def _fleet_families(snap: dict) -> list:
     """Gauge families for the fleet control plane, rendered from the
     latest snapshot (counters come from the process registry)."""
@@ -404,14 +558,34 @@ def _fleet_traces(snap: dict, flightrec_base: str | None) -> dict:
 
 
 def _start_status_server(port: int, status: FleetStatus,
-                         flightrec_base: str | None = None):
+                         flightrec_base: str | None = None,
+                         fleet_config: FleetConfig | None = None):
     """GET /fleetz (JSON control-plane view: per-member slot, pid,
     generation, state — the chaos smoke picks its SIGKILL victim here),
     GET /tracez (fleet-scoped request-id merge across member slow rings
-    and recorder files) and GET /metrics (ldt_fleet_* exposition) on a
-    daemon thread."""
+    and recorder files), GET /metrics (ldt_fleet_* exposition) and
+    POST /configz (canary-then-fan-out fleet config push,
+    _fleet_config_push) on a daemon thread."""
 
     class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if not self.path.startswith("/configz") \
+                    or fleet_config is None:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(min(length, 65536)) if length else b""
+            code, payload = _fleet_config_push(status.read(),
+                                               fleet_config, raw)
+            body = json.dumps(payload, indent=2).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             snap = status.read()
             if self.path.startswith("/fleetz"):
@@ -613,6 +787,7 @@ def fleet_main(module: str) -> int:
         m.fail_streak = 0
         m.queue_docs = 0
         m.brownout = 0
+        m.config_generation = 0  # fresh process: heal re-pushes
         m.last_scrape = 0.0
         m.ready_deadline = time.time() + 2 * swap_timeout
         telemetry.REGISTRY.counter_inc("ldt_fleet_spawn_total", 1,
@@ -648,8 +823,9 @@ def fleet_main(module: str) -> int:
         signal.signal(signal.SIGHUP, _request_swap)
 
     status = FleetStatus()
+    fleet_config = FleetConfig()
     status_srv = _start_status_server(status_port, status,
-                                      flightrec_base) \
+                                      flightrec_base, fleet_config) \
         if status_port > 0 else None
     postmortems: list = []  # newest-last, bounded below
 
@@ -834,6 +1010,56 @@ def fleet_main(module: str) -> int:
             if _spawn(m, reason):
                 m.mark_spawning()
 
+    def _config_heal(m: FleetMember) -> None:
+        """Converge a drifted member (respawned after a crash, or one
+        the fan-out missed) onto the fleet-committed config: re-push
+        the committed batch with no probation and the committed
+        generation stamp. The fleet's view wins — a member whose local
+        generation ran ahead through direct pushes is pulled back."""
+        fgen, fvalues = fleet_config.read()
+        if fgen <= 0 or m.config_generation == fgen or not fvalues:
+            return
+        try:
+            st, _resp = _member_configz(
+                m.metrics_port,
+                {"set": fvalues, "probation_sec": 0, "generation": fgen})
+        except Exception as e:  # noqa: BLE001 - retried next scrape
+            _log("fleet: config heal push failed",
+                 reason="config-heal", slot=m.slot, error=repr(e))
+            return
+        if st == 200:
+            m.config_generation = fgen
+            telemetry.REGISTRY.counter_inc(
+                "ldt_fleet_config_heal_total", 1)
+            _log("fleet: member healed onto committed config",
+                 reason="config-heal", slot=m.slot, generation=fgen)
+        else:
+            # e.g. 409: the member has its own probation in flight —
+            # the next health scrape retries
+            _log("fleet: config heal refused", reason="config-heal",
+                 slot=m.slot, status=st)
+
+    knob_version = knobs.overrides_version()
+
+    def _refresh_control_knobs() -> None:
+        """The autoscale thresholds are mutable knobs: re-derive the
+        FleetControl fields when a committed push bumped the override
+        version (one int compare per loop otherwise)."""
+        nonlocal knob_version
+        v = knobs.overrides_version()
+        if v == knob_version:
+            return
+        knob_version = v
+        control.scale_hold_sec = (
+            knobs.get_float("LDT_FLEET_SCALE_HOLD_SEC") or 10.0)
+        control.up_depth = knobs.get_int("LDT_FLEET_SCALE_UP_DEPTH") or 64
+        control.down_depth = (
+            knobs.get_int("LDT_FLEET_SCALE_DOWN_DEPTH") or 0)
+        _log("fleet: autoscale knobs refreshed from committed config",
+             reason="config-push", up_depth=control.up_depth,
+             down_depth=control.down_depth,
+             scale_hold_sec=control.scale_hold_sec)
+
     def _health_step(now: float) -> None:
         nonlocal probe_slot
         for m in members:
@@ -879,6 +1105,8 @@ def fleet_main(module: str) -> int:
                 adm = d.get("admission") or {}
                 m.queue_docs = int(adm.get("queue_docs") or 0)
                 m.brownout = int(adm.get("brownout_level") or 0)
+                cfg = d.get("config") or {}
+                m.config_generation = int(cfg.get("generation") or 0)
                 rd = d.get("ready")
                 if isinstance(rd, dict) and rd.get("ready") is False:
                     ok = False
@@ -890,6 +1118,7 @@ def fleet_main(module: str) -> int:
                          slot=m.slot, fails=m.fail_streak)
                 m.fail_streak = 0
                 m.mark_ready()
+                _config_heal(m)
             else:
                 m.fail_streak += 1
                 if m.fail_streak == degraded_fails:
@@ -970,6 +1199,7 @@ def fleet_main(module: str) -> int:
                              .get("metrics_port") or 0)
         m.last_scrape = 0.0
         m.fail_streak = 0
+        m.config_generation = 0  # promoted process: heal re-pushes
         _log("fleet: roll complete", reason="swap", slot=m.slot,
              generation=gen)
         return True
@@ -1047,6 +1277,7 @@ def fleet_main(module: str) -> int:
                      desired=desired, queue_docs=depth)
 
     def _snapshot() -> dict:
+        fgen, fvalues = fleet_config.read()
         return {
             "members": [
                 {"slot": m.slot,
@@ -1056,9 +1287,11 @@ def fleet_main(module: str) -> int:
                  "metrics_port": m.metrics_port,
                  "queue_docs": m.queue_docs,
                  "brownout": m.brownout,
+                 "config_generation": m.config_generation,
                  "parked": m.parked,
                  "retiring": m.retiring}
                 for m in sorted(members, key=lambda x: x.slot)],
+            "config": {"generation": fgen, "values": fvalues},
             "desired": desired,
             "ready": sum(1 for m in members
                          if m.state == FLEET_READY),
@@ -1103,6 +1336,7 @@ def fleet_main(module: str) -> int:
             if swap_requested:
                 swap_requested = False
                 _rolling_swap()
+            _refresh_control_knobs()
             _autoscale_step(now)
             status.update(_snapshot())
             try:

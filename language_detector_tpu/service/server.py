@@ -297,6 +297,17 @@ class Metrics:
         cp = self.capture_stats() or {}
         fams.append(one("ldt_capture_ring_occupancy",
                         cp.get("ring_occupancy", 0)))
+        # runtime config plane (configplane.py;
+        # ldt_config_applies_total is a registry counter and renders
+        # with the families below)
+        from .. import configplane
+        cfg = configplane.stats() or {}
+        fams.append(one("ldt_config_generation",
+                        cfg.get("generation", 0)))
+        fams.append(one("ldt_config_state",
+                        {"idle": 0, "staged": 1, "probation": 2,
+                         "committed": 3, "rolled_back": 4}.get(
+                             cfg.get("state", "idle"), 0)))
         # shared telemetry registry: stage/request histograms + compile
         # counters (both fronts render the same registry)
         fams.extend(telemetry.REGISTRY.families())
@@ -913,6 +924,11 @@ class MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/sloz":
             body = json.dumps(slo.sloz(), indent=2).encode()
             ctype = "application/json; charset=utf-8"
+        elif path == "/configz":
+            from .. import configplane
+            body = json.dumps(configplane.handle_get(),
+                              indent=2).encode()
+            ctype = "application/json; charset=utf-8"
         elif path == "/debug/slow":
             ring = telemetry.REGISTRY.slow
             body = json.dumps(
@@ -933,12 +949,24 @@ class MetricsHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         """POST /swap: in-process artifact hot swap (service/swap.py).
         POST /profilez: arm one bounded jax.profiler window
-        (profiling.py). Both live on the metrics port — operator
-        actions, not client traffic."""
+        (profiling.py). POST /configz: runtime mutable-knob apply with
+        SLO-watched probation (configplane.py). All live on the
+        metrics port — operator actions, not client traffic."""
         path = self.path.split("?", 1)[0]
         if path == "/profilez":
             from .. import profiling
             status, payload = profiling.arm()
+            self._answer(status, json.dumps(payload).encode())
+            return
+        if path == "/configz":
+            from .. import configplane
+            try:
+                length = int(self.headers.get("Content-Length", 0)
+                             or 0)
+            except ValueError:
+                length = 0
+            body = self.rfile.read(max(min(length, 65536), 0))
+            status, payload = configplane.handle_post(body)
             self._answer(status, json.dumps(payload).encode())
             return
         if path != "/swap":

@@ -252,6 +252,11 @@ METRICS: dict = {
         "gauge",
         "Fleet crash circuit: 0 closed, 1 open (correlated crash — "
         "restarts parked), 2 half-open probe in flight."),
+    "ldt_fleet_config_heal_total": (
+        "counter",
+        "Members re-pushed onto the fleet-committed config by the "
+        "supervisor's heal pass (a respawn or missed fan-out left "
+        "them on an older config generation)."),
     "ldt_shm_rings": (
         "gauge",
         "Shared-memory ring files currently attached by the scan "
@@ -384,6 +389,49 @@ METRICS: dict = {
         "gauge",
         "Fraction of the fleet-scope error budget left in the slow "
         "window (1.0 = untouched, 0 = fully burned)."),
+    # -- runtime config plane (configplane.py) ------------------------
+    "ldt_config_generation": (
+        "gauge",
+        "Last COMMITTED runtime-config generation in this process "
+        "(0 = no POST /configz apply ever committed)."),
+    "ldt_config_state": (
+        "gauge",
+        "Config-plane FSM state (0=idle 1=staged 2=probation "
+        "3=committed 4=rolled_back)."),
+    "ldt_config_applies_total": (
+        "counter",
+        "POST /configz apply outcomes by result: applied (live under "
+        "SLO probation), committed (survived the window), rolled_back "
+        "(fast-window burn crossed 1.0 — the prior overrides were "
+        "restored), or refused (registry type/bound/range validation "
+        "failed; nothing applied)."),
+    # -- SLO autotuner (autotune.py, bench.py --autotune) -------------
+    "ldt_autotune_evals_total": (
+        "counter",
+        "Autotuner candidate-config evaluations: one scored probe "
+        "(replayed traffic slice) per candidate in the coordinate-"
+        "descent search over the mutable-knob space."),
+    "ldt_autotune_rounds_total": (
+        "counter",
+        "Autotuner coordinate-descent passes over the declared "
+        "mutable-knob space (a pass with no improvement ends the "
+        "search)."),
+    # -- disk-full hardening (capture.py, flightrec.py, aot.py) -------
+    "ldt_capture_disabled_total": (
+        "counter",
+        "Traffic-capture plane disabled at runtime by reason=enospc "
+        "(ring create or segment seal hit a disk-full/unwritable "
+        "OSError); serving continues, capture becomes a no-op."),
+    "ldt_flightrec_disabled_total": (
+        "counter",
+        "Flight recorder disabled at runtime by reason=enospc (ring "
+        "create/mmap hit a disk-full OSError); serving continues, "
+        "every emit is one None check."),
+    "ldt_aot_disabled_total": (
+        "counter",
+        "AOT export write-back disabled for the process by "
+        "reason=enospc (a bundle write hit a disk-full OSError); "
+        "loads keep working and serving is untouched."),
     # -- accuracy plane (evalsuite.py, detect_spans lane) -------------
     "ldt_span_docs_total": (
         "counter",
@@ -887,6 +935,10 @@ def finish_request(trace: Trace, meta: dict | None = None) -> float:
     from . import slo as _slo
     _capture.observe(trace, meta, total)
     _slo.observe(trace, meta, total)
+    # a config probation, if one is in flight, advances on the same
+    # edge (one module-attribute check when no plane exists)
+    from . import configplane as _configplane
+    _configplane.maybe_tick()
     return total
 
 
@@ -985,4 +1037,15 @@ def debug_vars(metrics=None) -> dict:
     fr = flightrec.stats()
     if fr is not None:
         d["flightrec"] = fr
+    # effective runtime config: generation + per-process mutable-knob
+    # values, so a /configz rollback is observable after the fact
+    # (rendered even before any apply — the fleet's health scrape
+    # reads the generation off every member unconditionally)
+    from . import configplane
+    cfg = configplane.stats()
+    if cfg is None:
+        cfg = {"state": "idle", "generation": 0,
+               "values": {k.name: knobs.value(k.name)
+                          for k in knobs.mutable_knobs()}}
+    d["config"] = cfg
     return d

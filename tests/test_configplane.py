@@ -1,0 +1,287 @@
+"""Tests for the runtime config plane (configplane.py) and the knobs
+registry's mutable-override machinery it sits on.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from language_detector_tpu import configplane, knobs
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    configplane.reset_for_tests()
+    yield
+    configplane.reset_for_tests()
+
+
+def _plane(burn=None):
+    clock = _FakeClock()
+    p = configplane.ConfigPlane(
+        clock=clock, burn_source=(lambda: burn[0]) if burn is not None
+        else (lambda: None))
+    return p, clock
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- knobs override machinery -------------------------------------------------
+
+
+def test_mutable_knobs_are_declared():
+    names = [k.name for k in knobs.mutable_knobs()]
+    assert "LDT_MAX_INFLIGHT" in names
+    assert "LDT_BROWNOUT_ALPHA" in names
+    assert "LDT_CAPTURE_DIR" not in names  # paths stay immutable
+
+
+def test_apply_overrides_is_atomic():
+    v0 = knobs.overrides_version()
+    with pytest.raises(ValueError):
+        knobs.apply_overrides({"LDT_MAX_INFLIGHT": "64",
+                               "LDT_BROWNOUT_ALPHA": "99"})  # out of range
+    # nothing from the refused batch landed
+    assert knobs.current()["overrides"] == {}
+    assert knobs.overrides_version() == v0
+    knobs.apply_overrides({"LDT_MAX_INFLIGHT": "64"})
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 64
+    assert knobs.overrides_version() == v0 + 1
+
+
+def test_override_rejects_immutable_and_undeclared():
+    with pytest.raises(ValueError, match="not a mutable"):
+        knobs.apply_overrides({"LDT_CAPTURE_DIR": None})
+    with pytest.raises(ValueError, match="undeclared"):
+        knobs.apply_overrides({"LDT_NO_SUCH_KNOB": "1"})
+    with pytest.raises(ValueError, match="not mutable"):
+        knobs.apply_overrides({"LDT_SLO": "p99_ms=1"})
+
+
+def test_none_removes_override():
+    knobs.apply_overrides({"LDT_MAX_INFLIGHT": "64"})
+    knobs.apply_overrides({"LDT_MAX_INFLIGHT": None})
+    assert knobs.current()["overrides"] == {}
+
+
+def test_bound_knob_accepts_nonpositive_as_off():
+    knobs.apply_overrides({"LDT_MAX_INFLIGHT": "0"})
+    assert knobs.get_int("LDT_MAX_INFLIGHT") is None  # bound: off
+
+
+def test_doc_table_has_mutable_column():
+    table = knobs.doc_table()
+    assert "| Mutable |" in table.splitlines()[0]
+    row = next(line for line in table.splitlines()
+               if line.startswith("| `LDT_MAX_INFLIGHT` "))
+    assert "yes [1, 65536]" in row
+
+
+# -- plane FSM ----------------------------------------------------------------
+
+
+def test_push_commits_after_probation_window():
+    p, clock = _plane()
+    snap = p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=5.0)
+    assert snap["state"] == "probation"
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 64  # live immediately
+    p.tick()
+    assert p.state == configplane.CONFIG_PROBATION  # window not over
+    clock.advance(5.1)
+    p.tick()
+    assert p.state == configplane.CONFIG_COMMITTED
+    assert p.generation == 1
+
+
+def test_zero_probation_commits_immediately():
+    p, _clock = _plane()
+    snap = p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=0)
+    assert snap["state"] == "committed"
+    assert snap["generation"] == 1
+
+
+def test_burn_during_probation_rolls_back_and_restores_prior():
+    burn = [0.0]
+    p, clock = _plane(burn)
+    p.push({"LDT_MAX_INFLIGHT": "32"}, probation_sec=0)   # gen 1
+    p.push({"LDT_MAX_INFLIGHT": "9999"}, probation_sec=5.0)
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 9999
+    burn[0] = 2.0
+    p.tick()
+    assert p.state == configplane.CONFIG_ROLLED_BACK
+    # the prior committed override came back
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 32
+    assert p.generation == 1          # committed generation unchanged
+    assert p.last_rollback["generation"] == 2
+    assert "burn" in p.last_rollback["reason"]
+    assert p.last_rollback["peak_burn"] == 2.0
+
+
+def test_refused_batch_returns_to_idle_and_applies_nothing():
+    p, _clock = _plane()
+    snap = p.push({"LDT_MAX_INFLIGHT": "zebra"}, probation_sec=5.0)
+    assert "error" in snap
+    assert p.state == configplane.CONFIG_IDLE
+    assert knobs.current()["overrides"] == {}
+
+
+def test_push_refused_while_probation_in_flight():
+    p, _clock = _plane()
+    p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=5.0)
+    snap = p.push({"LDT_MAX_INFLIGHT": "32"}, probation_sec=5.0)
+    assert "in flight" in snap["error"]
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 64  # first batch holds
+
+
+def test_rollback_then_next_push_restages():
+    burn = [2.0]
+    p, _clock = _plane(burn)
+    p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=5.0)
+    p.tick()
+    assert p.state == configplane.CONFIG_ROLLED_BACK
+    burn[0] = 0.0
+    snap = p.push({"LDT_MAX_INFLIGHT": "48"}, probation_sec=0)
+    assert snap["state"] == "committed"
+    assert knobs.get_int("LDT_MAX_INFLIGHT") == 48
+
+
+def test_generation_stamp_is_honored():
+    p, _clock = _plane()
+    snap = p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=0,
+                  generation=41)
+    assert snap["generation"] == 41
+
+
+def test_sick_burn_source_does_not_wedge_probation():
+    def explode():
+        raise RuntimeError("scrape failed")
+
+    clock = _FakeClock()
+    p = configplane.ConfigPlane(clock=clock, burn_source=explode)
+    p.push({"LDT_MAX_INFLIGHT": "64"}, probation_sec=5.0)
+    clock.advance(5.1)
+    p.tick()
+    assert p.state == configplane.CONFIG_COMMITTED
+
+
+# -- http-facing helpers ------------------------------------------------------
+
+
+def test_handle_post_applies_and_reports():
+    status, resp = configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": 64}, "probation_sec": 0}
+    ).encode())
+    assert status == 200
+    assert resp["state"] == "committed"
+    assert resp["values"]["LDT_MAX_INFLIGHT"] == 64
+
+
+def test_handle_post_bad_shape_is_400():
+    for body in (b"[]", b"{}", b'{"set": {}}', b"not json"):
+        status, resp = configplane.handle_post(body)
+        assert status == 400, body
+        assert "error" in resp
+
+
+def test_handle_post_conflict_is_409():
+    configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": 64},
+         "probation_sec": 60}).encode())
+    status, resp = configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": 32}}).encode())
+    assert status == 409
+    assert "in flight" in resp["error"]
+
+
+def test_handle_post_invalid_value_is_400():
+    status, resp = configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": "zebra"}}).encode())
+    assert status == 400
+    assert "error" in resp
+
+
+def test_handle_get_drives_probation():
+    configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": 64},
+         "probation_sec": 0.0}).encode())
+    doc = configplane.handle_get()
+    assert doc["state"] == "committed"
+    assert doc["generation"] == 1
+    assert doc["override_version"] == knobs.overrides_version()
+
+
+def test_stats_none_until_plane_exists():
+    assert configplane.stats() is None
+    configplane.get_plane()
+    assert configplane.stats() is not None
+
+
+def test_maybe_tick_cheap_noop_without_plane():
+    configplane.maybe_tick()  # must not create the plane
+    assert configplane.PLANE is None
+
+
+# -- debug_vars / metrics wiring ---------------------------------------------
+
+
+def test_debug_vars_carries_config_section():
+    from language_detector_tpu import telemetry
+
+    d = telemetry.debug_vars()
+    assert "config" in d
+    assert d["config"]["generation"] == 0
+    assert "LDT_MAX_INFLIGHT" in d["config"]["values"]
+    configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_INFLIGHT": 64},
+         "probation_sec": 0}).encode())
+    d = telemetry.debug_vars()
+    assert d["config"]["generation"] == 1
+    assert d["config"]["values"]["LDT_MAX_INFLIGHT"] == 64
+
+
+# -- admission controller pickup ---------------------------------------------
+# regression: AdmissionController.from_env() used to pass the config
+# positionally, which marked it injected and pinned _config_version to
+# None — production fronts silently never saw a /configz override
+
+
+def test_from_env_controller_picks_up_overrides():
+    from language_detector_tpu.service.admission import (
+        AdmissionController)
+
+    ctl = AdmissionController.from_env()
+    assert ctl._config_version is not None
+    assert ctl.config.default_deadline_ms is None
+    assert ctl.config.max_queue_docs is None
+    configplane.handle_post(json.dumps(
+        {"set": {"LDT_DEFAULT_DEADLINE_MS": "1",
+                 "LDT_MAX_QUEUE_DOCS": "7"},
+         "probation_sec": 0}).encode())
+    ctl.try_admit(["hello world"])
+    assert ctl.config.default_deadline_ms == 1.0
+    assert ctl.config.max_queue_docs == 7
+    dl = ctl.deadline_from_header(None)
+    assert dl is not None and dl.remaining_ms() <= 1.0
+
+
+def test_injected_config_controller_never_refreshes():
+    from language_detector_tpu.service.admission import (
+        AdmissionConfig, AdmissionController)
+
+    ctl = AdmissionController(AdmissionConfig.from_env())
+    assert ctl._config_version is None
+    configplane.handle_post(json.dumps(
+        {"set": {"LDT_MAX_QUEUE_DOCS": "7"},
+         "probation_sec": 0}).encode())
+    ctl.try_admit(["hello world"])
+    assert ctl.config.max_queue_docs is None  # pinned, by contract

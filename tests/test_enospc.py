@@ -1,0 +1,192 @@
+"""Disk-full (ENOSPC) hardening for the write-to-disk planes: the
+capture ring seal, the flight-recorder ring create, and AOT bundle
+export. Contract: the plane disables itself (sticky), emits a
+structured log and an ldt_*_disabled_total{reason="enospc"} counter,
+and the service keeps serving.
+"""
+from __future__ import annotations
+
+import errno
+
+import pytest
+
+from language_detector_tpu import aot, capture, flightrec, telemetry
+
+ENOSPC = OSError(errno.ENOSPC, "No space left on device")
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    capture.reset_for_tests()
+    saved = flightrec.RECORDER
+    flightrec.RECORDER = None
+    yield
+    capture.reset_for_tests()
+    if flightrec.RECORDER is not None:
+        flightrec.RECORDER.close()
+    flightrec.RECORDER = saved
+
+
+def _fill_ring(w):
+    rec = (0, 0, 0, 1, 0.0, 1.0, 0.1, 0.2, 0.3, 200, 8, 0, 0, 0)
+    for _ in range(w.ring_records + 1):  # +1 forces the seal
+        w.append(rec)
+    return rec
+
+
+# -- capture ring seal --------------------------------------------------------
+
+
+def test_capture_seal_enospc_flags_writer(tmp_path, monkeypatch):
+    w = capture.CaptureWriter(str(tmp_path), ring_records=16)
+    monkeypatch.setattr(capture.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(ENOSPC))
+    _fill_ring(w)
+    assert w.disabled_reason == "enospc"
+    w.close()
+
+
+def test_capture_seal_other_oserror_keeps_plane(tmp_path, monkeypatch):
+    w = capture.CaptureWriter(str(tmp_path), ring_records=16)
+    monkeypatch.setattr(
+        capture.os, "replace",
+        lambda *a: (_ for _ in ()).throw(OSError(errno.EACCES, "no")))
+    _fill_ring(w)
+    # transient failure: segment dropped, plane stays armed
+    assert w.disabled_reason is None
+    w.close()
+
+
+def test_capture_observe_retires_flagged_writer(tmp_path, monkeypatch):
+    """The sticky disable: observe() unbinds the module writer, counts
+    the disable once, and later observes are one-attribute-check
+    no-ops — serving continues."""
+    monkeypatch.setenv("LDT_CAPTURE_DIR", str(tmp_path))
+    w = capture.init_from_env()
+    assert w is not None
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_capture_disabled_total", reason="enospc")
+    monkeypatch.setattr(capture.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(ENOSPC))
+
+    class _Trace:
+        t0 = 0.0
+        tenant = "t"
+        deadline = None
+
+        def span_ms(self, _name):
+            return 0.0
+
+    tr = _Trace()
+    for _ in range(w.ring_records + 2):
+        capture.observe(tr, {"status": 200, "docs": 1}, 1.0)
+    assert capture.WRITER is None
+    after = telemetry.REGISTRY.counter_value(
+        "ldt_capture_disabled_total", reason="enospc")
+    assert after == before + 1
+    capture.observe(tr, {"status": 200, "docs": 1}, 1.0)  # no-op, no raise
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_capture_disabled_total", reason="enospc") == after
+
+
+def test_capture_init_enospc_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_CAPTURE_DIR", str(tmp_path / "sub"))
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_capture_disabled_total", reason="enospc")
+    monkeypatch.setattr(
+        capture, "CaptureWriter",
+        lambda *a, **k: (_ for _ in ()).throw(ENOSPC))
+    assert capture.init_from_env() is None
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_capture_disabled_total", reason="enospc") == before + 1
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flightrec_init_enospc_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_FLIGHTREC_DIR", str(tmp_path))
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_flightrec_disabled_total", reason="enospc")
+    monkeypatch.setattr(
+        flightrec, "FlightRecorder",
+        lambda *a, **k: (_ for _ in ()).throw(ENOSPC))
+    assert flightrec.init_from_env(role="test") is None
+    assert flightrec.RECORDER is None
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_flightrec_disabled_total", reason="enospc") == before + 1
+    # the event path stays a safe no-op
+    assert flightrec.emit_event("proc_start", role="test",
+                                generation=0) is False
+
+
+def test_flightrec_init_other_oserror_reason(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_FLIGHTREC_DIR", str(tmp_path))
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_flightrec_disabled_total", reason="oserror")
+    monkeypatch.setattr(
+        flightrec, "FlightRecorder",
+        lambda *a, **k: (_ for _ in ()).throw(
+            OSError(errno.EACCES, "no")))
+    assert flightrec.init_from_env(role="test") is None
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_flightrec_disabled_total", reason="oserror") == before + 1
+
+
+# -- aot export ---------------------------------------------------------------
+
+
+def _store(tmp_path):
+    return aot.AotStore(str(tmp_path), digest="d" * 16,
+                        backend="cpu", kernel_mode="vector",
+                        require=False)
+
+
+class _RaisingJit:
+    """Stand-in jit_fn whose lowering fails the way a full disk fails
+    an export (the compile-cache write is the first thing to touch the
+    filesystem on this path)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+    def lower(self, *a, **k):
+        raise self.exc
+
+
+_WIRE = {"x": aot._SpecView((4,), "float32")}
+
+
+def test_aot_export_enospc_sticky_disable(tmp_path):
+    store = _store(tmp_path)
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_aot_disabled_total", reason="enospc")
+    assert store.offer(_WIRE, jit_fn=_RaisingJit(ENOSPC),
+                       dt=None) is False
+    assert store.export_disabled is True
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_aot_disabled_total", reason="enospc") == before + 1
+    assert store.stats()["export_disabled"] is True
+    # sticky: the next offer is refused before any compile work
+    assert store.offer(_WIRE, jit_fn=_RaisingJit(ENOSPC),
+                       dt=None) is False
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_aot_disabled_total", reason="enospc") == before + 1
+
+
+def test_aot_export_other_failure_not_sticky(tmp_path):
+    store = _store(tmp_path)
+    assert store.offer(_WIRE, jit_fn=_RaisingJit(RuntimeError("boom")),
+                       dt=None) is False
+    assert store.export_disabled is False
+
+
+def test_aot_build_from_env_enospc_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("LDT_AOT_DIR", str(tmp_path / "missing"))
+    before = telemetry.REGISTRY.counter_value(
+        "ldt_aot_disabled_total", reason="enospc")
+    monkeypatch.setattr(aot.os, "makedirs",
+                        lambda *a, **k: (_ for _ in ()).throw(ENOSPC))
+    assert aot.build_from_env("vector", dt=None) is None
+    assert telemetry.REGISTRY.counter_value(
+        "ldt_aot_disabled_total", reason="enospc") == before + 1

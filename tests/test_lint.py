@@ -111,7 +111,9 @@ def test_knob_bad_fixture_trips():
     rules = _rules(v)
     assert rules["knob-direct-env"] == 3   # from-import, environ, getenv
     assert rules["knob-undeclared"] == 1   # LDT_NOT_DECLARED
-    assert sum(rules.values()) == 4
+    # module-level _CACHED_INFLIGHT + def-time default in g()
+    assert rules["knob-mutable-cached"] == 2
+    assert sum(rules.values()) == 6
 
 
 def test_knob_good_fixture_clean_with_suppression():

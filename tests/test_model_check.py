@@ -51,6 +51,32 @@ def test_products_cover_all_four_invariants():
     assert "probe-admitted-through-shed" in invs        # (d)
 
 
+def test_config_doctored_no_rollback_produces_counterexample():
+    """The config-plane harness detects a broken apply path, it does
+    not just bless the working one: a plane whose probation ignores
+    the burn signal must yield a minimal counterexample trace."""
+    failures, _n, exhausted = run_product(
+        "config-apply", build=model_check.doctored_config_build)
+    assert exhausted
+    assert failures, "doctored no-rollback plane survived exploration"
+    by_inv = {}
+    for inv, trace, detail in failures:
+        by_inv.setdefault(inv, []).append(trace)
+    assert "cfg-bad-config-rolls-back" in by_inv
+    shortest = min(by_inv["cfg-bad-config-rolls-back"], key=len)
+    assert len(shortest) <= 3  # minimal: burn spikes, bad batch lands
+    assert "push" in shortest
+
+
+def test_config_product_leaves_no_override_residue():
+    """run_product drives the real runtime-override map; it must hand
+    the process back with no overrides applied."""
+    from language_detector_tpu import knobs
+
+    run_product("config-apply")
+    assert knobs.current()["overrides"] == {}
+
+
 # -- the explorer detects broken systems --------------------------------------
 
 

@@ -31,7 +31,8 @@ ANALYZERS = (
      {"jit-donated-read", "jit-recompile-capture"}),
     ("lock-discipline", lock_discipline.check, {"lock-discipline"}),
     ("knob-registry", knob_registry.check,
-     {"knob-direct-env", "knob-undeclared", "knob-docs-drift"}),
+     {"knob-direct-env", "knob-undeclared", "knob-mutable-cached",
+      "knob-docs-drift"}),
     ("metric-registry", metric_registry.check,
      {"metric-undeclared", "metric-undocumented", "metric-unused"}),
     ("event-registry", event_registry.check,

@@ -28,6 +28,7 @@ RULE_IDS = frozenset({
     "lock-discipline",
     "knob-direct-env",
     "knob-undeclared",
+    "knob-mutable-cached",
     "knob-docs-drift",
     "metric-undeclared",
     "metric-undocumented",

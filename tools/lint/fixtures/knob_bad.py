@@ -1,7 +1,10 @@
-"""Fixture: knob-registry violations — direct env reads plus an
-accessor naming an undeclared knob."""
+"""Fixture: knob-registry violations — direct env reads, an accessor
+naming an undeclared knob, and import-time caching of mutable knobs."""
 import os
 from os import environ
+
+# mutable knob read at import: frozen before any /configz push lands
+_CACHED_INFLIGHT = knobs.get_int("LDT_MAX_INFLIGHT")
 
 
 def f():
@@ -9,3 +12,7 @@ def f():
     b = os.getenv("LDT_Y")                  # direct env access
     c = knobs.get_int("LDT_NOT_DECLARED")   # undeclared knob
     return a, b, c, environ
+
+
+def g(limit=knobs.get_int("LDT_MAX_QUEUE_DOCS")):  # default = def time
+    return limit

@@ -7,5 +7,11 @@ def f():
     return knobs.get_int("LDT_SLOW_TRACE_RING")
 
 
+def use_time_mutable_read():
+    # mutable knobs are fine when read inside a function body: every
+    # call observes the current override generation
+    return knobs.get_int("LDT_MAX_INFLIGHT")
+
+
 def passthrough():
     return {**os.environ}  # ldt-lint: disable=knob-direct-env -- fixture: whole-environment passthrough, not a config read

@@ -215,6 +215,31 @@ MACHINES = (
         }),
     ),
     Machine(
+        name="config-plane",
+        file="language_detector_tpu/configplane.py",
+        scope=("class", "ConfigPlane"),
+        kind="attr",
+        var="state",
+        states={"CONFIG_IDLE": 0, "CONFIG_STAGED": 1,
+                "CONFIG_PROBATION": 2, "CONFIG_COMMITTED": 3,
+                "CONFIG_ROLLED_BACK": 4},
+        initial="CONFIG_IDLE",
+        transitions=frozenset({
+            # a push stages from any settled state
+            ("CONFIG_IDLE", "CONFIG_STAGED"),
+            ("CONFIG_COMMITTED", "CONFIG_STAGED"),
+            ("CONFIG_ROLLED_BACK", "CONFIG_STAGED"),
+            # registry validation refused the batch: nothing applied
+            ("CONFIG_STAGED", "CONFIG_IDLE"),
+            # the batch went live under SLO probation
+            ("CONFIG_STAGED", "CONFIG_PROBATION"),
+            # probation window elapsed without a burn breach
+            ("CONFIG_PROBATION", "CONFIG_COMMITTED"),
+            # fast-window burn crossed 1.0: prior overrides restored
+            ("CONFIG_PROBATION", "CONFIG_ROLLED_BACK"),
+        }),
+    ),
+    Machine(
         name="shm-slot",
         file="language_detector_tpu/service/shmring.py",
         scope=("class", "RingSlot"),

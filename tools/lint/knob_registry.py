@@ -1,6 +1,6 @@
 """Knob-registry analyzer: knobs.py is the only legal env-config read.
 
-Three checks over language_detector_tpu/:
+Four checks over language_detector_tpu/:
 
   knob-direct-env   any os.environ / os.getenv / os.environb touch (or
                     `from os import environ/getenv`) outside knobs.py.
@@ -12,6 +12,15 @@ Three checks over language_detector_tpu/:
                     that language_detector_tpu/knobs.py does not
                     declare (would raise KeyError at runtime — caught
                     at lint time instead)
+  knob-mutable-cached
+                    an accessor read of a knob declared mutable=True
+                    that executes at module import time (module top
+                    level, a class body, or a function default) — the
+                    value freezes into a module/class attribute before
+                    any POST /configz override can land, silently
+                    detaching that code from the runtime config plane.
+                    Mutable knobs must be read at use time (or behind
+                    an overrides_version() staleness check)
   knob-docs-drift   the generated table in docs/OBSERVABILITY.md
                     (between the ldt-knob-table markers) no longer
                     matches knobs.doc_table(); regenerate with
@@ -38,16 +47,67 @@ ACCESSORS = frozenset({"raw", "is_set", "value", "get_int", "get_float",
 
 def declared_knobs(root: Path) -> set:
     """Knob names declared in knobs.py, by AST (no import needed)."""
+    return {name for name, _mutable in _declarations(root)}
+
+
+def mutable_knob_names(root: Path) -> set:
+    """Knob names declared with mutable=True — the runtime-config
+    subset the knob-mutable-cached rule polices."""
+    return {name for name, mutable in _declarations(root) if mutable}
+
+
+def _declarations(root: Path) -> list:
     sf = load_source(root / KNOBS_REL, root)
-    names: set = set()
+    out: list = []
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Name) and \
                 node.func.id in ("Knob", "_k"):
             name = first_str_arg(node)
-            if name:
-                names.add(name)
-    return names
+            if not name:
+                continue
+            mutable = any(
+                kw.arg == "mutable" and
+                isinstance(kw.value, ast.Constant) and
+                kw.value.value is True
+                for kw in node.keywords)
+            out.append((name, mutable))
+    return out
+
+
+def _import_time_calls(tree) -> set:
+    """ids of Call nodes that execute while the module is being
+    imported: module top level, class bodies, decorator lists, and
+    function default-argument expressions. Function *bodies* run at
+    call time and are excluded (nested defs included — their defaults
+    evaluate when the enclosing body runs, not at import)."""
+    calls: set = set()
+
+    def walk(node, at_import):
+        if isinstance(node, ast.Call) and at_import:
+            calls.add(id(node))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                walk(dec, at_import)
+            for default in (list(node.args.defaults)
+                            + [d for d in node.args.kw_defaults
+                               if d is not None]):
+                walk(default, at_import)
+            for stmt in node.body:
+                walk(stmt, False)
+            return
+        if isinstance(node, ast.Lambda):
+            for default in (list(node.args.defaults)
+                            + [d for d in node.args.kw_defaults
+                               if d is not None]):
+                walk(default, at_import)
+            walk(node.body, False)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, at_import)
+
+    walk(tree, True)
+    return calls
 
 
 def load_knobs_module(root: Path):
@@ -70,7 +130,8 @@ def generated_table(root: Path) -> str:
     return load_knobs_module(root).doc_table()
 
 
-def _check_file(sf, declared: set, out: list):
+def _check_file(sf, declared: set, mutable: set, out: list):
+    import_calls = _import_time_calls(sf.tree)
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.ImportFrom) and node.module == "os":
             bad = [a.name for a in node.names
@@ -99,6 +160,12 @@ def _check_file(sf, declared: set, out: list):
                     "knob-undeclared", sf.rel, node.lineno,
                     f"knob {name!r} is not declared in "
                     f"language_detector_tpu/knobs.py"))
+            elif name in mutable and id(node) in import_calls:
+                out.append(Violation(
+                    "knob-mutable-cached", sf.rel, node.lineno,
+                    f"import-time read of mutable knob {name!r}: the "
+                    f"cached value can never see a POST /configz "
+                    f"override; read it at use time"))
 
 
 def _check_docs(root: Path, out: list):
@@ -145,6 +212,7 @@ def check(root: Path | None = None, files=None, check_docs=True):
     """Run the analyzer. Returns (violations, n_suppressed)."""
     root = root or repo_root()
     declared = declared_knobs(root)
+    mutable = mutable_knob_names(root)
     violations: list = []
     n_suppressed = 0
     paths = list(iter_package_files(root)) if files is None else \
@@ -155,7 +223,7 @@ def check(root: Path | None = None, files=None, check_docs=True):
         if sf.rel == KNOBS_REL:
             continue
         file_violations: list = []
-        _check_file(sf, declared, file_violations)
+        _check_file(sf, declared, mutable, file_violations)
         kept, ns = apply_suppressions(sf, file_violations)
         violations.extend(kept)
         n_suppressed += ns

@@ -1071,6 +1071,301 @@ def _p6_inv_corrupt_converges_active(clock, pool, mon, st):
 
 
 # ---------------------------------------------------------------------
+# product 7: runtime config plane (configplane.py) — canary push x
+# SLO burn x probation x member crash
+#
+# Drives the REAL ConfigPlane (real knobs.apply_overrides validation,
+# injectable clock + burn source) as the canary of a two-member fleet,
+# composed with an abstract coordinator (fleet._fleet_config_push's
+# canary-then-fan-out protocol) and an abstract follower member. The
+# obligations from the ISSUE: a burn >= 1.0 during probation always
+# rolls the batch back to the pre-push overrides; the follower never
+# holds a generation the coordinator has not committed (the >= N-1
+# hold); and from ANY reachable state — including a canary SIGKILL
+# mid-probation — the bounded heal procedure reconverges every member
+# onto the committed generation, so a stable split brain is
+# unreachable.
+
+_CFG_PROBATION = 5.0
+_CFG_VALUES = ({"LDT_MAX_INFLIGHT": "64"}, {"LDT_MAX_INFLIGHT": "96"})
+
+
+class _CfgModel:
+    """Coordinator + real-ConfigPlane canary + abstract follower.
+
+    The canary's knob overrides are the real process-global ones
+    (knobs._OVERRIDES) — build() resets them, so every replay is
+    deterministic; run_product/check clear them afterwards."""
+
+    def __init__(self, no_rollback: bool = False):
+        import logging
+
+        from language_detector_tpu import knobs
+        from language_detector_tpu.configplane import ConfigPlane
+
+        # thousands of replayed rollbacks would each warn otherwise
+        logging.getLogger(
+            "language_detector_tpu.configplane").setLevel(logging.ERROR)
+        knobs.clear_overrides()
+        self.knobs = knobs
+        self.clock = FakeClock()
+        self.burn = 0.0
+        if no_rollback:
+            # the doctored apply path: probation ignores the burn
+            # signal and commits on time alone — the
+            # cfg-bad-config-rolls-back invariant must catch it
+            class _NoRollbackPlane(ConfigPlane):
+                def _rollback_locked(self, reason):
+                    self._commit_locked()
+            self._plane_cls = _NoRollbackPlane
+        else:
+            self._plane_cls = ConfigPlane
+        self.canary = self._plane_cls(
+            clock=self.clock, burn_source=lambda: self.burn)
+        # coordinator (fleet supervisor) state
+        self.pending_gen = None       # push in flight, not yet decided
+        self.pending_values: dict = {}
+        self.pre_push: dict = {}      # overrides before the apply
+        self.fleet_gen = 0            # last coordinator-committed
+        self.fleet_values: dict = {}
+        # abstract follower member (its own process in reality)
+        self.follower_gen = 0
+        self.follower_values: dict = {}
+        self.pushes = 0
+        self.canary_crashes = 0
+        self.follower_crashes = 0
+
+    # -- coordinator --------------------------------------------------
+
+    def push(self):
+        """Coordinator stages the next batch on the canary with a
+        probation window (fleet._fleet_config_push step 1)."""
+        if self.pending_gen is not None or self.pushes >= 2:
+            return False
+        values = _CFG_VALUES[self.pushes]
+        self.pushes += 1
+        self.pre_push = self.knobs.current()["overrides"]
+        gen = self.fleet_gen + 1
+        snap = self.canary.push(values, probation_sec=_CFG_PROBATION,
+                                generation=gen)
+        if "error" in snap:
+            return True  # refused: coordinator reports and gives up
+        self.pending_gen = gen
+        self.pending_values = dict(values)
+        return True
+
+    def poll(self):
+        """Coordinator observes the canary's GET /configz outcome
+        (step 2): commit-and-record, or abort on rollback."""
+        if self.pending_gen is None:
+            return False
+        from language_detector_tpu.configplane import (
+            CONFIG_COMMITTED, CONFIG_ROLLED_BACK)
+        if self.canary.state == CONFIG_COMMITTED \
+                and self.canary.generation == self.pending_gen:
+            self.fleet_gen = self.pending_gen
+            self.fleet_values = dict(self.pending_values)
+            self.pending_gen = None
+            return True
+        if self.canary.state == CONFIG_ROLLED_BACK \
+                and self.canary.staged_generation == self.pending_gen:
+            self.pending_gen = None
+            return True
+        return False
+
+    def push_timeout(self):
+        """Coordinator's poll deadline fires: the canary crashed
+        mid-probation and its replacement knows nothing of the staged
+        generation — the push is abandoned uncommitted."""
+        from language_detector_tpu.configplane import CONFIG_IDLE
+        if self.pending_gen is None \
+                or self.canary.state != CONFIG_IDLE:
+            return False
+        self.pending_gen = None
+        return True
+
+    def fanout(self):
+        """Step 3 / the heal pass: push the COMMITTED batch (and only
+        that) onto a drifted follower with no probation."""
+        if self.fleet_gen <= 0 \
+                or self.follower_gen == self.fleet_gen:
+            return False
+        self.follower_gen = self.fleet_gen
+        self.follower_values = dict(self.fleet_values)
+        return True
+
+    def heal_canary(self):
+        """The supervisor's _config_heal aimed at a respawned canary:
+        re-push the committed batch with generation stamp, probation
+        0."""
+        if self.fleet_gen <= 0 \
+                or self.canary.generation == self.fleet_gen \
+                or self.pending_gen is not None:
+            return False
+        snap = self.canary.push(self.fleet_values, probation_sec=0,
+                                generation=self.fleet_gen)
+        return "error" not in snap or True
+
+    # -- canary-side dynamics -----------------------------------------
+
+    def burn_high(self):
+        if self.burn >= 1.0:
+            return False
+        self.burn = 2.0
+        return True
+
+    def burn_ok(self):
+        if self.burn < 1.0:
+            return False
+        self.burn = 0.0
+        return True
+
+    def elapse(self):
+        from language_detector_tpu.configplane import CONFIG_PROBATION
+        if self.canary.state != CONFIG_PROBATION \
+                or self.clock() >= self.canary.probation_deadline:
+            return False
+        self.clock.advance(_CFG_PROBATION + 0.1)
+        return True
+
+    def tick(self):
+        from language_detector_tpu.configplane import CONFIG_PROBATION
+        if self.canary.state != CONFIG_PROBATION:
+            return False
+        self.canary.tick()
+        return True
+
+    def canary_crash(self):
+        """SIGKILL mid-anything: the replacement process has a fresh
+        plane and NO overrides (they lived in the dead process)."""
+        if self.canary_crashes >= 1:
+            return False
+        self.canary_crashes += 1
+        self.knobs.clear_overrides()
+        self.canary = self._plane_cls(
+            clock=self.clock, burn_source=lambda: self.burn)
+        return True
+
+    def follower_crash(self):
+        if self.follower_crashes >= 1 or self.follower_gen == 0:
+            return False
+        self.follower_crashes += 1
+        self.follower_gen = 0
+        self.follower_values = {}
+        return True
+
+
+def _cfg_build():
+    return (_CfgModel(),)
+
+
+def doctored_config_build():
+    """Negative-test build: the no-rollback apply path. Exploring the
+    same events must now produce a minimal counterexample trace for
+    cfg-bad-config-rolls-back."""
+    return (_CfgModel(no_rollback=True),)
+
+
+_CFG_EVENTS = {
+    "push": lambda m: m.push(),
+    "poll": lambda m: m.poll(),
+    "push_timeout": lambda m: m.push_timeout(),
+    "fanout": lambda m: m.fanout(),
+    "heal_canary": lambda m: m.heal_canary(),
+    "burn_high": lambda m: m.burn_high(),
+    "burn_ok": lambda m: m.burn_ok(),
+    "elapse": lambda m: m.elapse(),
+    "tick": lambda m: m.tick(),
+    "canary_crash": lambda m: m.canary_crash(),
+    "follower_crash": lambda m: m.follower_crash(),
+}
+
+
+def _cfg_key(m):
+    from language_detector_tpu.configplane import CONFIG_PROBATION
+    deadline_passed = (m.canary.state == CONFIG_PROBATION
+                       and m.clock() >= m.canary.probation_deadline)
+    return (m.canary.state, m.canary.generation,
+            m.canary.staged_generation,
+            tuple(sorted(m.knobs.current()["overrides"].items())),
+            m.burn >= 1.0, deadline_passed,
+            m.pending_gen, m.fleet_gen,
+            tuple(sorted(m.fleet_values.items())),
+            m.follower_gen,
+            tuple(sorted(m.follower_values.items())),
+            m.pushes, m.canary_crashes, m.follower_crashes)
+
+
+def _cfg_inv_bad_config_rolls_back(m):
+    """THE rollback property: a probation observing burn >= 1.0 always
+    rolls back, restoring the exact pre-push override map — the bad
+    batch never commits."""
+    from language_detector_tpu.configplane import (
+        CONFIG_PROBATION, CONFIG_ROLLED_BACK)
+    if m.canary.state != CONFIG_PROBATION or m.burn < 1.0:
+        return None
+    m.canary.tick()
+    if m.canary.state != CONFIG_ROLLED_BACK:
+        return ("probation ticked with fast burn >= 1.0 but the plane "
+                "did not roll back (state "
+                f"{m.canary.state})")
+    if m.knobs.current()["overrides"] != m.pre_push:
+        return ("rollback did not restore the pre-push overrides: "
+                f"{m.knobs.current()['overrides']} != {m.pre_push}")
+    return None
+
+
+def _cfg_inv_follower_holds_old(m):
+    """The >= N-1 hold: while a push is in flight (staged on the
+    canary, not yet coordinator-committed) the follower still serves
+    the OLD generation — it never sees an uncommitted batch."""
+    if m.pending_gen is None:
+        return None
+    if m.follower_gen >= m.pending_gen:
+        return (f"follower holds generation {m.follower_gen} while "
+                f"generation {m.pending_gen} is still on canary "
+                f"probation — the fleet lost its N-1 hold")
+    return None
+
+
+def _cfg_inv_no_stable_split_brain(m):
+    """From ANY reachable state — canary SIGKILLed mid-probation
+    included — the coordinator's resolve + heal procedure reconverges
+    every member onto the committed generation and values. A crashed
+    member can delay convergence, never prevent it."""
+    from language_detector_tpu.configplane import CONFIG_PROBATION
+    for _ in range(3):
+        if m.pending_gen is None:
+            break
+        if m.canary.state == CONFIG_PROBATION:
+            m.burn = 0.0
+            m.elapse()
+            m.tick()
+        if not m.poll():
+            m.push_timeout()
+    if m.pending_gen is not None:
+        return ("the coordinator could not resolve an in-flight push "
+                "(neither commit, rollback, nor timeout applied)")
+    m.fanout()
+    m.heal_canary()
+    if m.follower_gen != m.fleet_gen \
+            or m.follower_values != m.fleet_values:
+        return (f"follower stuck on generation {m.follower_gen} "
+                f"(fleet committed {m.fleet_gen}) after the heal pass")
+    if m.fleet_gen > 0:
+        if m.canary.generation != m.fleet_gen:
+            return (f"canary stuck on generation "
+                    f"{m.canary.generation} (fleet committed "
+                    f"{m.fleet_gen}) after the heal pass")
+        if m.knobs.current()["overrides"] != m.fleet_values:
+            return ("canary's live overrides diverge from the "
+                    "committed batch after the heal pass: "
+                    f"{m.knobs.current()['overrides']} != "
+                    f"{m.fleet_values}")
+    return None
+
+
+# ---------------------------------------------------------------------
 # analyzer entry point
 
 PRODUCTS = (
@@ -1108,18 +1403,32 @@ PRODUCTS = (
          "never-serve-while-corrupt": _p6_inv_never_serve_corrupt,
          "corrupt-converges-active": _p6_inv_corrupt_converges_active,
      }),
+    ("config-apply", "language_detector_tpu/configplane.py",
+     _cfg_build, _CFG_EVENTS, _cfg_key, {
+         "cfg-bad-config-rolls-back": _cfg_inv_bad_config_rolls_back,
+         "cfg-follower-holds-old": _cfg_inv_follower_holds_old,
+         "cfg-no-stable-split-brain": _cfg_inv_no_stable_split_brain,
+     }),
 )
 
 
-def run_product(name, max_depth=24, max_states=5000):
+def run_product(name, max_depth=24, max_states=5000, build=None):
     """Explore one named product; returns (failures, n_states,
-    exhausted). Test hook — check() wraps this for the CLI."""
-    for pname, _path, build, events, key_fn, invs in PRODUCTS:
-        if pname == name:
-            return _explore(build, events, key_fn, invs,
-                            max_depth=max_depth,
-                            max_states=max_states)
-    raise KeyError(name)
+    exhausted). Test hook — check() wraps this for the CLI. `build`
+    substitutes a doctored system factory (the negative tests prove
+    the invariants actually bite)."""
+    from language_detector_tpu import knobs
+    try:
+        for pname, _path, bld, events, key_fn, invs in PRODUCTS:
+            if pname == name:
+                return _explore(build or bld, events, key_fn, invs,
+                                max_depth=max_depth,
+                                max_states=max_states)
+        raise KeyError(name)
+    finally:
+        # the config-apply product drives the real runtime-override
+        # map; never leak its final replay state to the caller
+        knobs.clear_overrides()
 
 
 def check(root=None, files=None, products=PRODUCTS):
@@ -1138,6 +1447,8 @@ def check(root=None, files=None, products=PRODUCTS):
         for name, path, build, events, key_fn, invs in products:
             failures, n_states, exhausted = _explore(
                 build, events, key_fn, invs)
+            from language_detector_tpu import knobs
+            knobs.clear_overrides()
             if not exhausted:
                 violations.append(Violation(
                     "model-check-invariant", path, 1,
